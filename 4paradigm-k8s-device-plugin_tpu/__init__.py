@@ -1,0 +1,30 @@
+"""vtpu-device-plugin: a TPU-native Kubernetes device-plugin framework.
+
+Partitions each Cloud TPU chip into multiple ``4paradigm.com/vtpu`` Kubernetes
+resources with hard HBM quotas and compute (device-time) quotas, enforced
+transparently inside unmodified user containers.
+
+Two cooperating halves (mirroring the capability set of the 4paradigm vGPU
+device plugin, re-designed TPU-first — see SURVEY.md):
+
+1. ``vtpu.plugin`` — the device-plugin daemon: enumerates TPU chips
+   (``vtpu.discovery``), splits each into N virtual devices
+   (``vtpu.plugin.vdevice``), registers with the kubelet over the
+   device-plugin v1beta1 gRPC API (``vtpu.plugin.server``) and injects the
+   quota env contract + the native shim at Allocate() time.
+
+2. ``vtpu.runtime`` + ``native/`` — in-container / on-node enforcement:
+   a C++ shared-region HBM accountant and device-time token bucket
+   (``native/vtpucore``), a PJRT wrapper plugin (``native/libvtpu``), and a
+   node-level vTPU multiplexer that time-shares one physical chip between
+   tenant processes (the TPU-native replacement for CUDA-level
+   LD_PRELOAD interception: libtpu holds a per-process chip lock, so
+   single-chip sharing is done by a runtime that owns the chip and
+   schedules tenants, Pathways-style).
+
+Workload model zoo (``vtpu.models``), TPU parallelism layer
+(``vtpu.parallel``) and Pallas kernels (``vtpu.ops``) provide the JAX
+benchmark clients (ai-benchmark cases, BERT, Llama) used by ``bench.py``.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""TPU chip discovery & health.
+
+Replaces the reference's NVML enumeration layer (reference nvidia.go:43-46
+``ResourceManager`` interface, nvidia.go:81-101 enumeration,
+nvidia.go:166-237 health loop) with TPU-native backends:
+
+- ``fake``   — deterministic fake chips, first-class for tests (the seam the
+               reference lacked; see SURVEY.md §4).
+- ``sysfs``  — /dev/accel* + /sys/class/accel + PCI scan on real TPU VMs.
+- ``pjrt``   — enumeration through a live PJRT/JAX client (authoritative
+               HBM sizes + core counts, used when the daemon may touch the
+               chip).
+"""
+
+from .types import TpuChip, TpuTopology, Health  # noqa: F401
+from .base import ChipBackend  # noqa: F401
+from .factory import make_backend  # noqa: F401

@@ -1,0 +1,45 @@
+"""The ChipBackend seam — the reference's ``ResourceManager`` interface
+(reference nvidia.go:43-46: ``Devices()`` + ``CheckHealth(stop, devices,
+unhealthy)``), kept deliberately narrow so a fake backend is first-class
+for tests (SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Callable, List, Optional
+
+from .types import TpuChip, TpuTopology
+
+
+class ChipBackend(abc.ABC):
+    """Enumerates physical chips and watches their health."""
+
+    @abc.abstractmethod
+    def chips(self) -> List[TpuChip]:
+        """Enumerate physical TPU chips on this node."""
+
+    @abc.abstractmethod
+    def topology(self) -> TpuTopology:
+        """The ICI topology the chips form."""
+
+    def check_health(
+        self,
+        stop: threading.Event,
+        chips: List[TpuChip],
+        on_unhealthy: Callable[[TpuChip, str], None],
+    ) -> None:
+        """Blocking health loop; invokes ``on_unhealthy(chip, reason)`` and
+        returns when ``stop`` is set.  Mirrors the reference's XID event
+        loop (reference nvidia.go:166-237).  Default: poll ``probe()``
+        every 5 seconds (the reference's event-wait timeout).
+        """
+        while not stop.wait(5.0):
+            for chip in chips:
+                reason = self.probe(chip)
+                if reason is not None:
+                    on_unhealthy(chip, reason)
+
+    def probe(self, chip: TpuChip) -> Optional[str]:
+        """Return an unhealth reason for ``chip``, or None if healthy."""
+        return None

@@ -1,0 +1,32 @@
+"""Backend selection: fake (tests/CI) → sysfs (real nodes) → pjrt."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .base import ChipBackend
+from .fake import FakeChipBackend
+from .pjrt import PjrtChipBackend
+from .sysfs import SysfsChipBackend
+
+
+def make_backend(kind: Optional[str] = None) -> ChipBackend:
+    """``kind`` ∈ {fake, sysfs, pjrt, auto}; default from VTPU_DISCOVERY."""
+    kind = (kind or os.environ.get("VTPU_DISCOVERY", "auto")).lower()
+    if kind == "fake":
+        return FakeChipBackend.from_env()
+    if kind == "sysfs":
+        return SysfsChipBackend()
+    if kind == "pjrt":
+        return PjrtChipBackend()
+    # auto: sysfs if it finds chips, else pjrt, else fake when allowed.
+    sysfs = SysfsChipBackend()
+    if sysfs.chips():
+        return sysfs
+    pjrt = PjrtChipBackend()
+    if pjrt.chips():
+        return pjrt
+    if os.environ.get("VTPU_ALLOW_FAKE", "").lower() in ("1", "true"):
+        return FakeChipBackend.from_env()
+    return sysfs  # empty — caller applies fail-on-init-error semantics

@@ -1,0 +1,75 @@
+"""Deterministic fake chip backend — the test seam the reference never had.
+
+Configure programmatically or via ``VTPU_FAKE_CHIPS`` (int) and
+``VTPU_FAKE_GENERATION``; health faults are injected by touching
+``<fault_dir>/<chip-uuid>`` (contents = reason), which the health loop
+picks up on its next poll — a stand-in for TPU driver error interrupts
+(the XID-event analogue, reference nvidia.go:166-237).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .base import ChipBackend
+from .types import (CORES_PER_CHIP, HBM_BYTES, TpuChip, TpuCore, TpuTopology,
+                    default_topology)
+
+
+class FakeChipBackend(ChipBackend):
+    def __init__(
+        self,
+        num_chips: int = 4,
+        generation: str = "v5e",
+        hbm_bytes: Optional[int] = None,
+        cores_per_chip: Optional[int] = None,
+        fault_dir: Optional[str] = None,
+    ):
+        self.num_chips = num_chips
+        self.generation = generation
+        self.hbm_bytes = hbm_bytes or HBM_BYTES.get(generation, 16 * 2**30)
+        self.cores_per_chip = cores_per_chip or CORES_PER_CHIP.get(
+            generation, 1)
+        self.fault_dir = fault_dir
+        self._topology = default_topology(generation, num_chips)
+
+    @classmethod
+    def from_env(cls) -> "FakeChipBackend":
+        return cls(
+            num_chips=int(os.environ.get("VTPU_FAKE_CHIPS", "4")),
+            generation=os.environ.get("VTPU_FAKE_GENERATION", "v5e"),
+            fault_dir=os.environ.get("VTPU_FAKE_FAULT_DIR"),
+        )
+
+    def chips(self) -> List[TpuChip]:
+        coords = self._topology.coords()
+        out = []
+        for i in range(self.num_chips):
+            cores = [TpuCore(index=c, global_index=i * self.cores_per_chip + c)
+                     for c in range(self.cores_per_chip)]
+            out.append(TpuChip(
+                uuid=f"TPU-fake-{self.generation}-{i:02d}",
+                index=i,
+                generation=self.generation,
+                hbm_bytes=self.hbm_bytes,
+                cores=cores,
+                coord=coords[i] if i < len(coords) else (i,),
+                pci_bus_id=f"0000:{i:02x}:00.0",
+                device_paths=[f"/dev/accel{i}"],
+                numa_node=0 if i < self.num_chips // 2 or self.num_chips < 2
+                else 1,
+            ))
+        return out
+
+    def topology(self) -> TpuTopology:
+        return self._topology
+
+    def probe(self, chip: TpuChip) -> Optional[str]:
+        if not self.fault_dir:
+            return None
+        path = os.path.join(self.fault_dir, chip.uuid)
+        if os.path.exists(path):
+            with open(path) as f:
+                return f.read().strip() or "injected fault"
+        return None

@@ -1,0 +1,128 @@
+"""Chip discovery through a live PJRT client (via JAX).
+
+Authoritative where sysfs is not: HBM byte counts (``memory_stats``), core
+counts, and ICI coordinates come straight from the runtime.  The daemon
+uses this backend only when it is allowed to open the chip (libtpu holds a
+per-process lock; a daemon that holds it would starve tenants), so the
+factory prefers sysfs and falls back here — or combines: enumerate once at
+startup, then release.
+
+Runs the enumeration in a *subprocess* so the parent daemon never holds
+the libtpu chip lock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+from .base import ChipBackend
+from .types import (CORES_PER_CHIP, HBM_BYTES, TpuChip, TpuCore, TpuTopology,
+                    default_topology)
+
+_ENUM_SNIPPET = r"""
+import json
+import jax
+
+devs = jax.devices()
+out = []
+for d in devs:
+    stats = {}
+    try:
+        stats = d.memory_stats() or {}
+    except Exception:
+        pass
+    out.append({
+        "id": d.id,
+        "kind": getattr(d, "device_kind", "tpu"),
+        "coords": list(getattr(d, "coords", []) or []),
+        "core_on_chip": getattr(d, "core_on_chip", 0),
+        "hbm_bytes": stats.get("bytes_limit", 0),
+        "process_index": getattr(d, "process_index", 0),
+    })
+print(json.dumps(out))
+"""
+
+
+def _kind_to_generation(kind: str) -> str:
+    kind = kind.lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5litepod" in kind:
+        return "v5e"
+    if "v5p" in kind or "v5" in kind:
+        return "v5p"
+    if "v6" in kind:
+        return "v6e"
+    if "v4" in kind:
+        return "v4"
+    return "v5e"
+
+
+def enumerate_via_pjrt(timeout: float = 120.0) -> Optional[List[dict]]:
+    """Enumerate devices in a throwaway subprocess; None on failure."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _ENUM_SNIPPET],
+            capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": os.environ.get(
+                "JAX_PLATFORMS", "")},
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if proc.returncode != 0:
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return None
+
+
+class PjrtChipBackend(ChipBackend):
+    def __init__(self, raw: Optional[List[dict]] = None):
+        self._raw = raw
+        self._chips: Optional[List[TpuChip]] = None
+
+    def chips(self) -> List[TpuChip]:
+        if self._chips is not None:
+            return self._chips
+        raw = self._raw if self._raw is not None else enumerate_via_pjrt()
+        if not raw:
+            self._chips = []
+            return self._chips
+        generation = _kind_to_generation(raw[0].get("kind", ""))
+        ncores = CORES_PER_CHIP.get(generation, 1)
+        # PJRT devices are TensorCores; group into chips by coords (or by
+        # id//ncores when coords are absent).
+        by_chip: dict = {}
+        for d in raw:
+            key = tuple(d["coords"]) if d.get("coords") else d["id"] // ncores
+            by_chip.setdefault(key, []).append(d)
+        chips: List[TpuChip] = []
+        for index, (key, devs) in enumerate(sorted(by_chip.items(),
+                                                   key=lambda kv: str(kv[0]))):
+            hbm = sum(d.get("hbm_bytes", 0) for d in devs) or \
+                HBM_BYTES.get(generation, 16 * 2**30)
+            coord = key if isinstance(key, tuple) else (index,)
+            chips.append(TpuChip(
+                uuid=f"TPU-{generation}-" + "-".join(str(c) for c in coord),
+                index=index,
+                generation=generation,
+                hbm_bytes=hbm,
+                cores=[TpuCore(index=i, global_index=index * len(devs) + i)
+                       for i in range(len(devs))],
+                coord=tuple(coord),
+            ))
+        self._chips = chips
+        return chips
+
+    def topology(self) -> TpuTopology:
+        chips = self.chips()
+        if chips and len(chips[0].coord) > 1:
+            shape = tuple(max(c.coord[a] for c in chips) + 1
+                          for a in range(len(chips[0].coord)))
+            return TpuTopology(generation=chips[0].generation,
+                               mesh_shape=shape)
+        return default_topology(chips[0].generation if chips else "v5e",
+                                len(chips))

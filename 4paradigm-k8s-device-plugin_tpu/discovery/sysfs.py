@@ -1,0 +1,153 @@
+"""Real-node chip discovery from sysfs/devfs, without touching the chip.
+
+The reference daemon shells out to ``lspci`` for the PCI inventory
+(reference main.go:164-185) and uses NVML for enumeration.  On a Cloud TPU
+VM the equivalents are:
+
+- ``/dev/accel<N>`` (or ``/dev/vfio/<N>``) — one node per chip; these are
+  also the device nodes injected into containers when ``pass_device_specs``
+  is on (reference server.go:618-655 analogue).
+- ``/sys/class/accel/accel<N>/device`` → PCI address, vendor 0x1ae0
+  (Google), numa_node.
+- ``/sys/bus/pci/devices/*`` fallback scan for vendor 0x1ae0.
+
+HBM size / core count are not exposed by sysfs, so they come from the
+generation table (types.HBM_BYTES) or are refined by the pjrt backend.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import List, Optional
+
+from .base import ChipBackend
+from .types import (CORES_PER_CHIP, HBM_BYTES, TpuChip, TpuCore, TpuTopology,
+                    default_topology)
+
+GOOGLE_PCI_VENDOR = "0x1ae0"
+
+# PCI device IDs → TPU generation (public Cloud TPU VM values).
+_PCI_DEVICE_GENERATION = {
+    "0x005e": "v4",
+    "0x0062": "v5e",
+    "0x0063": "v5p",
+    "0x006f": "v6e",
+}
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+class SysfsChipBackend(ChipBackend):
+    def __init__(self, root: str = "/", generation: Optional[str] = None):
+        self.root = root
+        self._generation_override = generation
+        self._chips: Optional[List[TpuChip]] = None
+
+    def _accel_nodes(self) -> List[str]:
+        return sorted(
+            glob.glob(os.path.join(self.root, "dev", "accel[0-9]*")),
+            key=lambda p: int(re.search(r"(\d+)$", p).group(1)))
+
+    def _pci_for_accel(self, accel: str) -> Optional[str]:
+        n = re.search(r"(\d+)$", accel).group(1)
+        link = os.path.join(self.root, "sys", "class", "accel",
+                            f"accel{n}", "device")
+        try:
+            return os.path.basename(os.path.realpath(link))
+        except OSError:
+            return None
+
+    def _scan_pci(self) -> List[str]:
+        """PCI addresses of Google accelerators, for nodes where /dev/accel
+        is absent (e.g. vfio-based runtimes)."""
+        out = []
+        for dev in sorted(glob.glob(
+                os.path.join(self.root, "sys", "bus", "pci", "devices", "*"))):
+            if _read(os.path.join(dev, "vendor")) == GOOGLE_PCI_VENDOR:
+                cls = _read(os.path.join(dev, "class")) or ""
+                if cls.startswith("0x1200") or cls.startswith("0x0b40"):
+                    out.append(os.path.basename(dev))
+        return out
+
+    def chips(self) -> List[TpuChip]:
+        if self._chips is not None:
+            return self._chips
+        chips: List[TpuChip] = []
+        accels = self._accel_nodes()
+        if accels:
+            for i, node in enumerate(accels):
+                pci = self._pci_for_accel(node)
+                chips.append(self._build(i, pci, [node.replace(self.root, "/", 1)
+                                                  if self.root != "/" else node]))
+        else:
+            for i, pci in enumerate(self._scan_pci()):
+                chips.append(self._build(i, pci, []))
+        topo = default_topology(self._generation(chips), len(chips))
+        coords = topo.coords()
+        for i, chip in enumerate(chips):
+            chip.coord = coords[i] if i < len(coords) else (i,)
+        self._chips = chips
+        return chips
+
+    def _generation(self, chips: List[TpuChip]) -> str:
+        if self._generation_override:
+            return self._generation_override
+        return chips[0].generation if chips else "v5e"
+
+    def _build(self, index: int, pci: Optional[str],
+               device_paths: List[str]) -> TpuChip:
+        generation = self._generation_override
+        numa = None
+        if pci:
+            dev_dir = os.path.join(self.root, "sys", "bus", "pci",
+                                   "devices", pci)
+            if generation is None:
+                did = _read(os.path.join(dev_dir, "device")) or ""
+                generation = _PCI_DEVICE_GENERATION.get(did, "v5e")
+            numa_s = _read(os.path.join(dev_dir, "numa_node"))
+            if numa_s is not None and int(numa_s) >= 0:
+                numa = int(numa_s)
+        generation = generation or "v5e"
+        ncores = CORES_PER_CHIP.get(generation, 1)
+        return TpuChip(
+            uuid=f"TPU-{pci or index}",
+            index=index,
+            generation=generation,
+            hbm_bytes=HBM_BYTES.get(generation, 16 * 2**30),
+            cores=[TpuCore(index=c, global_index=index * ncores + c)
+                   for c in range(ncores)],
+            pci_bus_id=pci,
+            device_paths=device_paths,
+            numa_node=numa,
+        )
+
+    def topology(self) -> TpuTopology:
+        chips = self.chips()
+        return default_topology(self._generation(chips), len(chips))
+
+    def probe(self, chip: TpuChip) -> Optional[str]:
+        """A chip whose device node vanished is unhealthy (driver unbind /
+        PCI surprise-removal — the hard-fault analogue of a critical XID)."""
+        for path in chip.device_paths:
+            if not os.path.exists(path):
+                return f"device node {path} disappeared"
+        return None
+
+
+def write_pci_inventory(path: str, chips: List[TpuChip]) -> None:
+    """Persist the PCI inventory for the in-container shim (the reference
+    writes $PCIBUSFILE at startup, main.go:164-185, and mounts it as
+    pciinfo.vgpu, server.go:516-517)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for chip in chips:
+            f.write(f"{chip.index} {chip.uuid} {chip.pci_bus_id or '-'}\n")
+    os.replace(tmp, path)

@@ -1,0 +1,146 @@
+"""Core discovery datatypes: chips, ICI topology, health.
+
+The reference models a GPU as ``pluginapi.Device + Paths + Index``
+(reference nvidia.go:36-40) and leaves topology to the vendored
+``gpuallocator`` NVLink scorer.  TPUs have a *regular* interconnect — a 2D
+(v5e/v5p partial) or 3D (v4/v5p) torus of chips — so we model coordinates
+explicitly and derive ICI adjacency from them; the preferred allocator
+(vtpu.plugin.allocator) scores candidate chip sets by torus compactness
+instead of consulting a link database.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class Health(str, Enum):
+    HEALTHY = "Healthy"
+    UNHEALTHY = "Unhealthy"
+
+
+@dataclass(frozen=True)
+class TpuTopology:
+    """An ICI torus of chips, e.g. v5e-8 = (2, 4) mesh (no wrap at that size).
+
+    ``mesh_shape`` is chips per axis; ``wrap`` marks axes with wraparound
+    links (full pods are tori; small slices are meshes).
+    """
+
+    generation: str                 # "v4" | "v5e" | "v5p" | "v6e" | "fake"
+    mesh_shape: Tuple[int, ...]
+    wrap: Tuple[bool, ...] = ()
+
+    @property
+    def num_chips(self) -> int:
+        n = 1
+        for d in self.mesh_shape:
+            n *= d
+        return n
+
+    def coords(self) -> List[Tuple[int, ...]]:
+        return list(itertools.product(*[range(d) for d in self.mesh_shape]))
+
+    def neighbors(self, coord: Tuple[int, ...]) -> List[Tuple[int, ...]]:
+        """ICI-adjacent coordinates (±1 per axis, honoring wraparound)."""
+        out = []
+        wrap = self.wrap or tuple(False for _ in self.mesh_shape)
+        for axis, size in enumerate(self.mesh_shape):
+            if size <= 1:
+                continue
+            for delta in (-1, 1):
+                c = list(coord)
+                c[axis] += delta
+                if 0 <= c[axis] < size:
+                    out.append(tuple(c))
+                elif wrap[axis] and size > 2:
+                    c[axis] %= size
+                    out.append(tuple(c))
+        return out
+
+
+# Default HBM per chip by generation (bytes); authoritative values come from
+# the pjrt backend when available.
+HBM_BYTES = {
+    "v4": 32 * 2**30,
+    "v5e": 16 * 2**30,
+    "v5p": 95 * 2**30,
+    "v6e": 32 * 2**30,
+}
+
+# TensorCores per chip by generation (v4/v5p are dual-core "megacore" chips —
+# the TPU analogue of a 2-slice MIG partition; v5e/v6e are single-core).
+CORES_PER_CHIP = {"v4": 2, "v5e": 1, "v5p": 2, "v6e": 1}
+
+
+@dataclass
+class TpuCore:
+    """One TensorCore of a chip — the finest hard-partition granule
+    (the MIG-slice analogue; see vtpu.plugin.split)."""
+
+    index: int            # core index within the chip
+    global_index: int     # core index on the node
+
+
+@dataclass
+class TpuChip:
+    """One physical TPU chip on this node."""
+
+    uuid: str                       # stable node-unique ID (like GPU-UUID)
+    index: int                      # node-local chip ordinal
+    generation: str
+    hbm_bytes: int
+    cores: List[TpuCore] = field(default_factory=list)
+    coord: Tuple[int, ...] = ()     # position in the ICI torus
+    pci_bus_id: Optional[str] = None
+    device_paths: List[str] = field(default_factory=list)  # /dev/accel*, vfio
+    numa_node: Optional[int] = None
+    health: Health = Health.HEALTHY
+
+    def ici_distance(self, other: "TpuChip",
+                     topology: Optional[TpuTopology] = None) -> int:
+        """Hop count between two chips over the torus (L1 with wraparound)."""
+        if not self.coord or not other.coord:
+            return abs(self.index - other.index)
+        dist = 0
+        shape = topology.mesh_shape if topology else None
+        wrap = (topology.wrap if topology and topology.wrap
+                else tuple(False for _ in self.coord))
+        for axis, (a, b) in enumerate(zip(self.coord, other.coord)):
+            d = abs(a - b)
+            if shape and axis < len(wrap) and wrap[axis]:
+                d = min(d, shape[axis] - d)
+            dist += d
+        return dist
+
+
+def chips_connected(chips: Sequence[TpuChip], topology: TpuTopology) -> bool:
+    """True iff the chip set forms a connected subgraph of the ICI torus —
+    the admission criterion for multi-vTPU pods that need collectives over
+    ICI rather than DCN/PCIe."""
+    if len(chips) <= 1:
+        return True
+    coords = {c.coord for c in chips}
+    if len(coords) != len(chips):
+        return False
+    seen = {chips[0].coord}
+    frontier = [chips[0].coord]
+    while frontier:
+        cur = frontier.pop()
+        for n in topology.neighbors(cur):
+            if n in coords and n not in seen:
+                seen.add(n)
+                frontier.append(n)
+    return len(seen) == len(coords)
+
+
+def default_topology(generation: str, num_chips: int) -> TpuTopology:
+    """Best-guess torus shape for a node with ``num_chips`` chips."""
+    shapes: Dict[int, Tuple[int, ...]] = {
+        1: (1,), 2: (2,), 4: (2, 2), 8: (2, 4), 16: (4, 4), 32: (4, 8),
+    }
+    shape = shapes.get(num_chips, (num_chips,))
+    return TpuTopology(generation=generation, mesh_shape=shape)

@@ -1,0 +1,2 @@
+"""Device-plugin daemon: vdevice model, split strategies, gRPC server,
+preferred allocation, vdevice controller, CLI."""

@@ -1,0 +1,161 @@
+"""Daemon configuration: CLI flags, each mirrored to an env var, validated
+up front — the reference's urfave/cli surface (reference main.go:55-161)
+with TPU naming.  Flag-for-flag parity table in docs/FLAGS.md."""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
+KUBELET_SOCKET = DEVICE_PLUGIN_PATH + "kubelet.sock"
+RESOURCE_NAME = "4paradigm.com/vtpu"
+VTPU_SOCKET_NAME = "4paradigm.com-vtpu.sock"
+
+# Host staging dir for the shim artifacts (the reference's /usr/local/vgpu,
+# populated by entrypoint.sh, consumed by Allocate mounts server.go:511-522).
+HOST_LIB_DIR = "/usr/local/vtpu"
+
+SPLIT_STRATEGIES = ("none", "core", "mixed")
+DEVICE_LIST_STRATEGIES = ("envvar", "device-specs")
+DEVICE_ID_STRATEGIES = ("uuid", "index")
+
+
+@dataclass
+class Config:
+    # reference --mig-strategy analogue: how chips are partitioned.
+    #   none  = time-share split via --device-split-count (vGPU mode)
+    #   core  = one vdevice per TensorCore (hard partition; MIG 'single')
+    #   mixed = core-split on dual-core chips + time-share on the rest
+    split_strategy: str = "none"
+    fail_on_init_error: bool = True
+    pass_device_specs: bool = False
+    device_list_strategy: str = "envvar"
+    device_id_strategy: str = "uuid"
+    device_split_count: int = 2
+    device_memory_scaling: float = 1.0
+    device_cores_scaling: float = 1.0
+    enable_legacy_preferred: bool = False
+    verbose: int = 0
+    # discovery backend: auto|fake|sysfs|pjrt
+    discovery: str = "auto"
+    # node dirs / files
+    host_lib_dir: str = HOST_LIB_DIR
+    pcibus_file: Optional[str] = None
+    device_plugin_path: str = DEVICE_PLUGIN_PATH
+    resource_name: str = RESOURCE_NAME
+    # enable the node-level runtime multiplexer (single-chip sharing)
+    enable_runtime: bool = True
+    runtime_socket: str = "/usr/local/vtpu/vtpu-runtime.sock"
+    # monitor mode: per-pod shared cache dirs under host_lib_dir/shared
+    monitor_mode: bool = False
+    node_name: Optional[str] = None
+
+    def validate(self) -> List[str]:
+        """Up-front validation (reference main.go:143-161)."""
+        errors = []
+        if self.split_strategy not in SPLIT_STRATEGIES:
+            errors.append(f"invalid --split-strategy {self.split_strategy!r}")
+        if self.device_list_strategy not in DEVICE_LIST_STRATEGIES:
+            errors.append(
+                f"invalid --device-list-strategy {self.device_list_strategy!r}")
+        if self.device_id_strategy not in DEVICE_ID_STRATEGIES:
+            errors.append(
+                f"invalid --device-id-strategy {self.device_id_strategy!r}")
+        if self.device_split_count < 1:
+            errors.append("--device-split-count must be >= 1")
+        if self.device_memory_scaling <= 0:
+            errors.append("--device-memory-scaling must be > 0")
+        if self.device_cores_scaling <= 0:
+            errors.append("--device-cores-scaling must be > 0")
+        if self.enable_legacy_preferred and not (
+                self.node_name or os.environ.get("NODE_NAME")):
+            errors.append("--enable-legacy-preferred requires NODE_NAME")
+        return errors
+
+    @property
+    def oversubscribe(self) -> bool:
+        return self.device_memory_scaling > 1.0
+
+
+def _env(name: str, default):
+    return os.environ.get(name, default)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="vtpu-device-plugin",
+        description="TPU-sharing Kubernetes device plugin")
+    p.add_argument("--split-strategy", default=_env("SPLIT_STRATEGY", "none"),
+                   help="none|core|mixed (chip partitioning strategy)")
+    p.add_argument("--fail-on-init-error", type=_bool,
+                   default=_bool(_env("FAIL_ON_INIT_ERROR", "true")))
+    p.add_argument("--pass-device-specs", type=_bool,
+                   default=_bool(_env("PASS_DEVICE_SPECS", "false")))
+    p.add_argument("--device-list-strategy",
+                   default=_env("DEVICE_LIST_STRATEGY", "envvar"))
+    p.add_argument("--device-id-strategy",
+                   default=_env("DEVICE_ID_STRATEGY", "uuid"))
+    p.add_argument("--device-split-count", type=int,
+                   default=int(_env("DEVICE_SPLIT_COUNT", "2")))
+    p.add_argument("--device-memory-scaling", type=float,
+                   default=float(_env("DEVICE_MEMORY_SCALING", "1.0")))
+    p.add_argument("--device-cores-scaling", type=float,
+                   default=float(_env("DEVICE_CORES_SCALING", "1.0")))
+    p.add_argument("--enable-legacy-preferred", type=_bool,
+                   default=_bool(_env("ENABLE_LEGACY_PREFERRED", "false")))
+    p.add_argument("--verbose", type=int, default=int(_env("VERBOSE", "0")))
+    p.add_argument("--discovery", default=_env("VTPU_DISCOVERY", "auto"))
+    p.add_argument("--host-lib-dir", default=_env("VTPU_HOST_LIB_DIR",
+                                                  HOST_LIB_DIR))
+    p.add_argument("--pcibus-file", default=_env("PCIBUSFILE", None))
+    p.add_argument("--device-plugin-path",
+                   default=_env("DEVICE_PLUGIN_PATH", DEVICE_PLUGIN_PATH))
+    p.add_argument("--resource-name", default=_env("RESOURCE_NAME",
+                                                   RESOURCE_NAME))
+    p.add_argument("--enable-runtime", type=_bool,
+                   default=_bool(_env("VTPU_ENABLE_RUNTIME", "true")))
+    p.add_argument("--runtime-socket",
+                   default=_env("VTPU_RUNTIME_SOCKET",
+                                HOST_LIB_DIR + "/vtpu-runtime.sock"))
+    p.add_argument("--monitor-mode", type=_bool,
+                   default=_bool(_env("VTPU_MONITOR_MODE", "false")))
+    p.add_argument("--node-name", default=_env("NODE_NAME", None))
+    return p
+
+
+def _bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes", "on")
+
+
+def parse_args(argv: Optional[List[str]] = None) -> Config:
+    ns = build_parser().parse_args(argv)
+    cfg = Config(
+        split_strategy=ns.split_strategy,
+        fail_on_init_error=ns.fail_on_init_error,
+        pass_device_specs=ns.pass_device_specs,
+        device_list_strategy=ns.device_list_strategy,
+        device_id_strategy=ns.device_id_strategy,
+        device_split_count=ns.device_split_count,
+        device_memory_scaling=ns.device_memory_scaling,
+        device_cores_scaling=ns.device_cores_scaling,
+        enable_legacy_preferred=ns.enable_legacy_preferred,
+        verbose=ns.verbose,
+        discovery=ns.discovery,
+        host_lib_dir=ns.host_lib_dir,
+        pcibus_file=ns.pcibus_file,
+        device_plugin_path=ns.device_plugin_path,
+        resource_name=ns.resource_name,
+        enable_runtime=ns.enable_runtime,
+        runtime_socket=ns.runtime_socket,
+        monitor_mode=ns.monitor_mode,
+        node_name=ns.node_name,
+    )
+    errors = cfg.validate()
+    if errors:
+        raise SystemExit("invalid flags:\n  " + "\n  ".join(errors))
+    return cfg

@@ -1,0 +1,103 @@
+"""Split strategies — the reference's mig-strategy.go re-mapped onto TPU
+multi-core topology (SURVEY.md §7.1).
+
+The reference's three MIG strategies become three chip-partitioning
+strategies:
+
+- ``none``   → time-share: every chip split into ``--device-split-count``
+               vdevices under one ``4paradigm.com/vtpu`` resource
+               (reference mig-strategy.go:62-71).
+- ``core``   → hard partition: one vdevice per TensorCore; validates the
+               node is core-partitionable (homogeneous, multi-core chips)
+               like MIG 'single' validates homogeneous MIG config
+               (reference mig-strategy.go:78-135).  Resource name
+               ``4paradigm.com/vtpu-core``.
+- ``mixed``  → per-generation resources: dual-core chips are advertised as
+               ``…/vtpu-core`` slices AND single-core chips as time-share
+               vtpus, each set under its own plugin+socket (reference
+               mig-strategy.go:167-210).
+
+Each returned ``PluginSpec`` is materialised as one gRPC server on its own
+unix socket by vtpu.plugin.server.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from ..discovery.base import ChipBackend
+from ..utils import logging as log
+from .config import Config
+from .vdevice import VDevice, split_chip, split_chip_by_core
+
+
+@dataclass
+class PluginSpec:
+    resource_name: str
+    socket_name: str
+    vdevices: List[VDevice]
+    time_shared: bool           # False → whole cores/chips, no rate limiting
+
+
+def _socket_for(resource_name: str) -> str:
+    return resource_name.replace("/", ".") + ".sock"
+
+
+def build_plugin_specs(cfg: Config, backend: ChipBackend) -> List[PluginSpec]:
+    chips = backend.chips()
+    if not chips:
+        return []
+    strategy = cfg.split_strategy
+    if strategy == "none":
+        vdevs: List[VDevice] = []
+        for chip in chips:
+            vdevs.extend(split_chip(chip, cfg.device_split_count,
+                                    cfg.device_memory_scaling,
+                                    cfg.device_cores_scaling))
+        return [PluginSpec(cfg.resource_name, _socket_for(cfg.resource_name),
+                           vdevs, time_shared=cfg.device_split_count > 1)]
+
+    if strategy == "core":
+        multi = [c for c in chips if len(c.cores) > 1]
+        if not multi:
+            raise RuntimeError(
+                "split-strategy=core requires multi-TensorCore chips "
+                f"(found {chips[0].generation}); use 'none' on "
+                "single-core generations")
+        if len({c.generation for c in multi}) != 1:
+            raise RuntimeError(
+                "split-strategy=core requires a homogeneous node")
+        vdevs = []
+        for chip in multi:
+            vdevs.extend(split_chip_by_core(chip, cfg.device_memory_scaling))
+        name = cfg.resource_name + "-core"
+        return [PluginSpec(name, _socket_for(name), vdevs, time_shared=False)]
+
+    if strategy == "mixed":
+        specs: List[PluginSpec] = []
+        whole = [c for c in chips if len(c.cores) <= 1]
+        multi = [c for c in chips if len(c.cores) > 1]
+        if whole:
+            vdevs = []
+            for chip in whole:
+                vdevs.extend(split_chip(chip, cfg.device_split_count,
+                                        cfg.device_memory_scaling,
+                                        cfg.device_cores_scaling))
+            specs.append(PluginSpec(cfg.resource_name,
+                                    _socket_for(cfg.resource_name), vdevs,
+                                    time_shared=cfg.device_split_count > 1))
+        if multi:
+            vdevs = []
+            for chip in multi:
+                vdevs.extend(split_chip_by_core(chip,
+                                                cfg.device_memory_scaling))
+            name = cfg.resource_name + "-core"
+            specs.append(PluginSpec(name, _socket_for(name), vdevs,
+                                    time_shared=False))
+        log.info("mixed split: %d time-share vdevices, %d core vdevices",
+                 sum(len(s.vdevices) for s in specs if s.time_shared),
+                 sum(len(s.vdevices) for s in specs if not s.time_shared))
+        return specs
+
+    raise ValueError(f"unknown split strategy {strategy!r}")

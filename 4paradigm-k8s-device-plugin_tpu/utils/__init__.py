@@ -1,0 +1,1 @@
+"""Shared utilities: env-var quota contract, logging, unit parsing."""

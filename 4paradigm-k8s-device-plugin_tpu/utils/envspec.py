@@ -1,0 +1,199 @@
+"""The env-var contract between the device-plugin daemon and the in-container
+enforcement layer.
+
+This is the single channel through which the Go-less daemon configures the
+native shim / runtime client inside an unmodified user container: the
+``ContainerAllocateResponse`` carries only env vars + mounts.  The reference
+uses ``CUDA_DEVICE_MEMORY_LIMIT_<i>`` / ``CUDA_DEVICE_SM_LIMIT`` /
+``NVIDIA_DEVICE_MAP`` etc. (reference server.go:486-507 produces them;
+libvgpu.so consumes them).  Our TPU contract is the same shape with TPU
+naming; both producer (vtpu.plugin.server) and consumers (vtpu.runtime,
+native/libvtpu) import the names from here so they cannot drift.
+
+Memory limit values accept Kubernetes-style quantities: a bare integer is
+bytes; suffixes ``k/m/g/t`` (decimal, case-insensitive, the reference's
+"3000m" MB convention maps to ``m``) and ``Ki/Mi/Gi/Ti`` (binary).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Env var names (producer: plugin/server.py Allocate(); consumer: runtime/shim)
+# ---------------------------------------------------------------------------
+
+# Per-virtual-device HBM cap, in K8s quantity syntax; ``_<i>`` is the
+# container-visible device ordinal.  Unsuffixed form applies to all devices.
+ENV_HBM_LIMIT = "VTPU_DEVICE_HBM_LIMIT"
+# Compute quota as a percentage of one chip's device time (0-100, 0 = no cap).
+ENV_CORE_LIMIT = "VTPU_DEVICE_CORE_LIMIT"
+# Ordinal→physical mapping: "<i>:<chip-uuid> <j>:<chip-uuid> ...".
+ENV_DEVICE_MAP = "VTPU_DEVICE_MAP"
+# Path of the cross-process shared accounting region (mmap'd file).
+ENV_SHARED_CACHE = "VTPU_DEVICE_MEMORY_SHARED_CACHE"
+# "true" → allocations past the HBM cap spill to host RAM instead of OOM.
+ENV_OVERSUBSCRIBE = "VTPU_OVERSUBSCRIBE"
+# Task priority for the compute scheduler (0 = highest; reference
+# CUDA_TASK_PRIORITY semantics).
+ENV_TASK_PRIORITY = "VTPU_TASK_PRIORITY"
+# Compute-limit policy: DEFAULT (limit iff shared), FORCE, DISABLE.
+ENV_UTILIZATION_POLICY = "VTPU_CORE_UTILIZATION_POLICY"
+# "true" → kill the offending process on quota violation instead of failing
+# the allocation (reference ACTIVE_OOM_KILLER).
+ENV_ACTIVE_OOM_KILLER = "VTPU_ACTIVE_OOM_KILLER"
+# Which physical chips the container may see (comma-separated uuids/indices) —
+# the TPU analogue of NVIDIA_VISIBLE_DEVICES; also understood by libtpu as
+# TPU_VISIBLE_CHIPS when chip-granular.
+ENV_VISIBLE_DEVICES = "VTPU_VISIBLE_DEVICES"
+# Unix socket of the node-level vTPU runtime multiplexer (single-chip
+# time-sharing path).
+ENV_RUNTIME_SOCKET = "VTPU_RUNTIME_SOCKET"
+# Interceptor log level: 0=errors .. 4=debug (reference LIBCUDA_LOG_LEVEL).
+ENV_LOG_LEVEL = "VTPU_LOG_LEVEL"
+# PCI/platform inventory file mounted by the daemon so the shim can present
+# stable virtual device identities (reference pciinfo.vgpu).
+ENV_PCIBUS_FILE = "VTPU_PCIINFO_FILE"
+
+ALL_ENV_VARS = [
+    ENV_HBM_LIMIT,
+    ENV_CORE_LIMIT,
+    ENV_DEVICE_MAP,
+    ENV_SHARED_CACHE,
+    ENV_OVERSUBSCRIBE,
+    ENV_TASK_PRIORITY,
+    ENV_UTILIZATION_POLICY,
+    ENV_ACTIVE_OOM_KILLER,
+    ENV_VISIBLE_DEVICES,
+    ENV_RUNTIME_SOCKET,
+    ENV_LOG_LEVEL,
+    ENV_PCIBUS_FILE,
+]
+
+# Hard cap mirrored in native/vtpucore/shrreg.h (reference: "Max Gpus Per
+# Node can't excced 16").
+MAX_DEVICES_PER_NODE = 16
+
+_QUANTITY_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kmgtKMGT]i?|)\s*[bB]?\s*$")
+
+_MULTIPLIERS = {
+    "": 1,
+    "k": 10**3, "m": 10**6, "g": 10**9, "t": 10**12,
+    "ki": 2**10, "mi": 2**20, "gi": 2**30, "ti": 2**40,
+}
+
+
+def parse_quantity(value: str) -> int:
+    """Parse a K8s-style quantity into bytes. Raises ValueError on junk."""
+    m = _QUANTITY_RE.match(value)
+    if not m:
+        raise ValueError(f"invalid device memory limit {value!r}")
+    number, suffix = m.group(1), m.group(2).lower()
+    return int(float(number) * _MULTIPLIERS[suffix])
+
+
+def format_quantity_mb(nbytes: int) -> str:
+    """Render bytes as the reference's `<N>m` megabyte convention."""
+    return f"{nbytes // 10**6}m"
+
+
+def _parse_bool(value: Optional[str]) -> bool:
+    return (value or "").strip().lower() in ("true", "1", "yes", "on")
+
+
+@dataclass
+class DeviceMapEntry:
+    ordinal: int
+    chip_uuid: str
+
+
+@dataclass
+class QuotaSpec:
+    """Parsed view of the contract as seen inside one container."""
+
+    # ordinal -> HBM cap in bytes (0 = unlimited)
+    hbm_limit_bytes: Dict[int, int] = field(default_factory=dict)
+    # percentage of one chip's device time, 0-100; 0 = no cap
+    core_limit_pct: int = 0
+    device_map: List[DeviceMapEntry] = field(default_factory=list)
+    shared_cache: Optional[str] = None
+    oversubscribe: bool = False
+    task_priority: int = 1
+    utilization_policy: str = "DEFAULT"  # DEFAULT | FORCE | DISABLE
+    active_oom_killer: bool = False
+    visible_devices: List[str] = field(default_factory=list)
+    runtime_socket: Optional[str] = None
+    log_level: int = 1
+
+    def limit_for(self, ordinal: int) -> int:
+        """HBM cap for a container-visible ordinal (0 = unlimited)."""
+        if ordinal in self.hbm_limit_bytes:
+            return self.hbm_limit_bytes[ordinal]
+        return self.hbm_limit_bytes.get(-1, 0)
+
+    def compute_capped(self, n_tenants_sharing: int = 2) -> bool:
+        """Whether execute gating applies, honoring the policy switch.
+
+        DEFAULT caps only when the device is actually shared (the reference
+        applies the SM limit whenever configured but documents DEFAULT as
+        "limit iff utilization-bound"); FORCE always caps; DISABLE never.
+        """
+        if self.utilization_policy == "DISABLE" or self.core_limit_pct <= 0:
+            return False
+        if self.utilization_policy == "FORCE":
+            return True
+        return n_tenants_sharing > 1
+
+
+def parse_device_map(raw: str) -> List[DeviceMapEntry]:
+    entries: List[DeviceMapEntry] = []
+    for token in raw.split():
+        if ":" not in token:
+            raise ValueError(f"invalid {ENV_DEVICE_MAP} entry {token!r}")
+        ordinal_s, uuid = token.split(":", 1)
+        entries.append(DeviceMapEntry(ordinal=int(ordinal_s), chip_uuid=uuid))
+    return entries
+
+
+def quota_from_env(env: Optional[Dict[str, str]] = None) -> QuotaSpec:
+    """Parse the contract from an environment mapping (defaults to os.environ)."""
+    if env is None:
+        env = dict(os.environ)
+    spec = QuotaSpec()
+
+    if ENV_HBM_LIMIT in env:
+        spec.hbm_limit_bytes[-1] = parse_quantity(env[ENV_HBM_LIMIT])
+    for key, val in env.items():
+        if key.startswith(ENV_HBM_LIMIT + "_"):
+            ordinal = int(key[len(ENV_HBM_LIMIT) + 1:])
+            if ordinal >= MAX_DEVICES_PER_NODE:
+                raise ValueError(
+                    f"device ordinal {ordinal} exceeds node cap "
+                    f"{MAX_DEVICES_PER_NODE}")
+            spec.hbm_limit_bytes[ordinal] = parse_quantity(val)
+
+    if ENV_CORE_LIMIT in env:
+        pct = int(env[ENV_CORE_LIMIT])
+        spec.core_limit_pct = max(0, min(100, pct))
+    if ENV_DEVICE_MAP in env:
+        spec.device_map = parse_device_map(env[ENV_DEVICE_MAP])
+    spec.shared_cache = env.get(ENV_SHARED_CACHE)
+    spec.oversubscribe = _parse_bool(env.get(ENV_OVERSUBSCRIBE))
+    if ENV_TASK_PRIORITY in env:
+        spec.task_priority = int(env[ENV_TASK_PRIORITY])
+    policy = env.get(ENV_UTILIZATION_POLICY, "DEFAULT").strip().upper()
+    if policy not in ("DEFAULT", "FORCE", "DISABLE"):
+        policy = "DEFAULT"
+    spec.utilization_policy = policy
+    spec.active_oom_killer = _parse_bool(env.get(ENV_ACTIVE_OOM_KILLER))
+    if env.get(ENV_VISIBLE_DEVICES):
+        spec.visible_devices = [
+            t for t in env[ENV_VISIBLE_DEVICES].replace(",", " ").split() if t
+        ]
+    spec.runtime_socket = env.get(ENV_RUNTIME_SOCKET)
+    if ENV_LOG_LEVEL in env:
+        spec.log_level = int(env[ENV_LOG_LEVEL])
+    return spec

@@ -1,0 +1,76 @@
+"""Discovery backends: fake chips, topology/ICI modeling, fault injection."""
+
+import threading
+
+from vtpu.discovery.fake import FakeChipBackend
+from vtpu.discovery.types import (TpuTopology, chips_connected,
+                                  default_topology)
+
+
+def test_fake_backend_enumeration():
+    b = FakeChipBackend(num_chips=4, generation="v5e")
+    chips = b.chips()
+    assert len(chips) == 4
+    assert len({c.uuid for c in chips}) == 4
+    assert all(c.hbm_bytes == 16 * 2**30 for c in chips)
+    assert all(len(c.cores) == 1 for c in chips)
+    assert b.topology().mesh_shape == (2, 2)
+
+
+def test_fake_v4_dual_core():
+    chips = FakeChipBackend(num_chips=2, generation="v4").chips()
+    assert all(len(c.cores) == 2 for c in chips)
+    assert chips[1].cores[1].global_index == 3
+
+
+def test_topology_neighbors_mesh_and_torus():
+    mesh = TpuTopology("v5e", (2, 4))
+    assert set(mesh.neighbors((0, 0))) == {(1, 0), (0, 1)}
+    torus = TpuTopology("v5e", (4, 4), wrap=(True, True))
+    assert (3, 0) in torus.neighbors((0, 0))
+    assert len(torus.neighbors((1, 1))) == 4
+
+
+def test_ici_distance_with_wrap():
+    topo = TpuTopology("v4", (4, 4), wrap=(True, True))
+    chips = FakeChipBackend(num_chips=16, generation="v4").chips()
+    a = next(c for c in chips if c.coord == (0, 0))
+    b = next(c for c in chips if c.coord == (3, 0))
+    assert a.ici_distance(b, topo) == 1      # wraparound link
+    assert a.ici_distance(b) == 3            # without topology info
+
+
+def test_chips_connected():
+    topo = default_topology("v5e", 8)        # (2,4) mesh
+    chips = FakeChipBackend(num_chips=8).chips()
+    by_coord = {c.coord: c for c in chips}
+    line = [by_coord[(0, 0)], by_coord[(0, 1)], by_coord[(0, 2)]]
+    assert chips_connected(line, topo)
+    gap = [by_coord[(0, 0)], by_coord[(0, 2)]]
+    assert not chips_connected(gap, topo)
+    assert chips_connected([by_coord[(1, 3)]], topo)
+
+
+def test_fault_injection_health(tmp_path):
+    b = FakeChipBackend(num_chips=2, fault_dir=str(tmp_path))
+    chips = b.chips()
+    assert b.probe(chips[0]) is None
+    (tmp_path / chips[0].uuid).write_text("ICI link down")
+    assert b.probe(chips[0]) == "ICI link down"
+    assert b.probe(chips[1]) is None
+
+    # the generic health loop delivers the event and honors stop
+    stop = threading.Event()
+    events = []
+
+    def on_unhealthy(chip, reason):
+        events.append((chip.uuid, reason))
+        stop.set()
+
+    t = threading.Thread(
+        target=lambda: b.check_health(stop, chips, on_unhealthy))
+    # shrink poll interval by monkeypatching wait via a pre-set event race:
+    t.start()
+    stop.wait(7)
+    t.join(timeout=8)
+    assert events and events[0][0] == chips[0].uuid

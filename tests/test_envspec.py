@@ -1,0 +1,81 @@
+"""Env-var quota contract: parsing, limits, policy switches."""
+
+import pytest
+
+from vtpu.utils import envspec as E
+
+
+def test_parse_quantity_units():
+    assert E.parse_quantity("123") == 123
+    assert E.parse_quantity("3000m") == 3000 * 10**6
+    assert E.parse_quantity("2g") == 2 * 10**9
+    assert E.parse_quantity("2Gi") == 2 * 2**30
+    assert E.parse_quantity("1.5Gi") == int(1.5 * 2**30)
+    assert E.parse_quantity(" 16 GiB ".replace("B", "")) == 16 * 2**30
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "12x", "-5m", "m"])
+def test_parse_quantity_rejects_junk(bad):
+    with pytest.raises(ValueError):
+        E.parse_quantity(bad)
+
+
+def test_quota_from_env_full_contract():
+    env = {
+        E.ENV_HBM_LIMIT + "_0": "4000m",
+        E.ENV_HBM_LIMIT + "_1": "2Gi",
+        E.ENV_CORE_LIMIT: "25",
+        E.ENV_DEVICE_MAP: "0:TPU-aaa 1:TPU-bbb",
+        E.ENV_SHARED_CACHE: "/tmp/x.cache",
+        E.ENV_OVERSUBSCRIBE: "true",
+        E.ENV_TASK_PRIORITY: "0",
+        E.ENV_UTILIZATION_POLICY: "force",
+        E.ENV_ACTIVE_OOM_KILLER: "1",
+        E.ENV_VISIBLE_DEVICES: "TPU-aaa,TPU-bbb",
+        E.ENV_RUNTIME_SOCKET: "/run/vtpu.sock",
+        E.ENV_LOG_LEVEL: "4",
+    }
+    q = E.quota_from_env(env)
+    assert q.limit_for(0) == 4000 * 10**6
+    assert q.limit_for(1) == 2 * 2**30
+    assert q.limit_for(7) == 0          # unknown ordinal, no default → uncapped
+    assert q.core_limit_pct == 25
+    assert [e.chip_uuid for e in q.device_map] == ["TPU-aaa", "TPU-bbb"]
+    assert q.oversubscribe and q.active_oom_killer
+    assert q.task_priority == 0
+    assert q.utilization_policy == "FORCE"
+    assert q.visible_devices == ["TPU-aaa", "TPU-bbb"]
+    assert q.runtime_socket == "/run/vtpu.sock"
+    assert q.log_level == 4
+
+
+def test_quota_default_limit_applies_to_all_ordinals():
+    q = E.quota_from_env({E.ENV_HBM_LIMIT: "1g"})
+    assert q.limit_for(0) == q.limit_for(5) == 10**9
+
+
+def test_core_limit_clamped():
+    assert E.quota_from_env({E.ENV_CORE_LIMIT: "150"}).core_limit_pct == 100
+    assert E.quota_from_env({E.ENV_CORE_LIMIT: "-5"}).core_limit_pct == 0
+
+
+def test_device_ordinal_cap_enforced():
+    with pytest.raises(ValueError):
+        E.quota_from_env({E.ENV_HBM_LIMIT + "_16": "1g"})
+
+
+def test_compute_capped_policy_matrix():
+    q = E.quota_from_env({E.ENV_CORE_LIMIT: "50"})
+    assert q.compute_capped(n_tenants_sharing=2)
+    assert not q.compute_capped(n_tenants_sharing=1)      # DEFAULT
+    q = E.quota_from_env({E.ENV_CORE_LIMIT: "50",
+                          E.ENV_UTILIZATION_POLICY: "FORCE"})
+    assert q.compute_capped(n_tenants_sharing=1)
+    q = E.quota_from_env({E.ENV_CORE_LIMIT: "50",
+                          E.ENV_UTILIZATION_POLICY: "DISABLE"})
+    assert not q.compute_capped(n_tenants_sharing=4)
+
+
+def test_roundtrip_format():
+    assert E.parse_quantity(E.format_quantity_mb(8 * 2**30)) \
+        == (8 * 2**30 // 10**6) * 10**6

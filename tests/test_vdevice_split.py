@@ -1,0 +1,110 @@
+"""vDevice split model + split strategies (MIG analogue)."""
+
+import pytest
+
+from vtpu.discovery.fake import FakeChipBackend
+from vtpu.plugin import vdevice as V
+from vtpu.plugin.config import Config
+from vtpu.plugin.split import build_plugin_specs
+
+
+def test_split_chip_counts_and_quota():
+    chip = FakeChipBackend(num_chips=1, generation="v5e",
+                           hbm_bytes=16 * 2**30).chips()[0]
+    vdevs = V.split_chip(chip, split_count=4, memory_scaling=1.0,
+                         cores_scaling=1.0)
+    assert len(vdevs) == 4
+    assert all(v.hbm_bytes == 4 * 2**30 for v in vdevs)
+    assert all(v.core_pct == 25 for v in vdevs)
+    assert [v.id for v in vdevs] == [f"{chip.uuid}-vtpu-{i}" for i in range(4)]
+
+
+def test_split_memory_scaling_overcommit():
+    chip = FakeChipBackend(num_chips=1, hbm_bytes=10 * 2**30).chips()[0]
+    vdevs = V.split_chip(chip, split_count=2, memory_scaling=1.8)
+    # 10G * 1.8 / 2 = 9G per vdevice: 2 tenants can jointly exceed physical.
+    assert vdevs[0].hbm_bytes == int(10 * 2**30 * 1.8 / 2)
+
+
+def test_core_pct_capped_at_100():
+    chip = FakeChipBackend(num_chips=1).chips()[0]
+    vdevs = V.split_chip(chip, split_count=1, cores_scaling=3.0)
+    assert vdevs[0].core_pct == 100
+
+
+def test_split_by_core_hard_partition():
+    chip = FakeChipBackend(num_chips=1, generation="v4",
+                           hbm_bytes=32 * 2**30).chips()[0]
+    vdevs = V.split_chip_by_core(chip)
+    assert len(vdevs) == 2
+    assert vdevs[0].core_index == 0 and vdevs[1].core_index == 1
+    assert all(v.hbm_bytes == 16 * 2**30 for v in vdevs)
+    assert all(v.core_pct == 0 for v in vdevs)   # whole core: no rate limit
+
+
+def test_vdevices_by_ids_order_preserving():
+    chip = FakeChipBackend(num_chips=1).chips()[0]
+    vdevs = V.split_chip(chip, 3)
+    picked = V.vdevices_by_ids(vdevs, [vdevs[2].id, vdevs[0].id])
+    assert [p.id for p in picked] == [vdevs[2].id, vdevs[0].id]
+    with pytest.raises(KeyError):
+        V.vdevices_by_ids(vdevs, ["nope"])
+
+
+def test_unique_chip_uuids_dedupes():
+    backend = FakeChipBackend(num_chips=2)
+    vdevs = []
+    for chip in backend.chips():
+        vdevs.extend(V.split_chip(chip, 2))
+    assert len(V.unique_chip_uuids(vdevs)) == 2
+
+
+def test_strategy_none_single_resource():
+    cfg = Config(split_strategy="none", device_split_count=3)
+    specs = build_plugin_specs(cfg, FakeChipBackend(num_chips=4))
+    assert len(specs) == 1
+    assert specs[0].resource_name == "4paradigm.com/vtpu"
+    assert len(specs[0].vdevices) == 12
+    assert specs[0].time_shared
+
+
+def test_strategy_core_on_v4():
+    cfg = Config(split_strategy="core")
+    specs = build_plugin_specs(cfg, FakeChipBackend(num_chips=2,
+                                                    generation="v4"))
+    assert len(specs) == 1
+    assert specs[0].resource_name.endswith("-core")
+    assert len(specs[0].vdevices) == 4
+    assert not specs[0].time_shared
+
+
+def test_strategy_core_rejects_single_core_node():
+    cfg = Config(split_strategy="core")
+    with pytest.raises(RuntimeError):
+        build_plugin_specs(cfg, FakeChipBackend(num_chips=2,
+                                                generation="v5e"))
+
+
+def test_strategy_mixed_v4_node_gets_core_resource_only():
+    cfg = Config(split_strategy="mixed", device_split_count=2)
+    specs = build_plugin_specs(cfg, FakeChipBackend(num_chips=2,
+                                                    generation="v4"))
+    assert len(specs) == 1 and specs[0].resource_name.endswith("-core")
+
+
+def test_strategy_mixed_v5e_node_gets_timeshare_only():
+    cfg = Config(split_strategy="mixed", device_split_count=2)
+    specs = build_plugin_specs(cfg, FakeChipBackend(num_chips=2,
+                                                    generation="v5e"))
+    assert len(specs) == 1 and specs[0].resource_name == "4paradigm.com/vtpu"
+    assert len(specs[0].vdevices) == 4
+
+
+def test_config_validation():
+    assert Config().validate() == []
+    assert Config(device_split_count=0).validate()
+    assert Config(split_strategy="bogus").validate()
+    assert Config(device_memory_scaling=-1).validate()
+    assert Config(enable_legacy_preferred=True).validate()  # needs NODE_NAME
+    assert Config(enable_legacy_preferred=True,
+                  node_name="n1").validate() == []
