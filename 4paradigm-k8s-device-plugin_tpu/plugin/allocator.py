@@ -1,0 +1,130 @@
+"""ICI-topology-aware preferred allocation.
+
+The reference delegates GetPreferredAllocation scoring to the vendored
+``gpuallocator`` NVLink-affinity policies (reference server.go:271-326,
+mig-strategy.go:62-71: best-effort policy).  TPUs have a *regular* ICI
+torus instead of an irregular NVLink graph, so the policy here is
+first-principles:
+
+1. Project the available vdevice IDs onto their distinct physical chips
+   (one vdevice per chip per request — the reference has the same
+   "vGPUs per task <= physical GPUs per node" shape, README.md:96-98).
+2. Choose a chip set of the requested size that (a) forms a *connected*
+   subgraph of the ICI torus when possible — multi-chip JAX pods need
+   their collectives to ride ICI, not host DCN — and (b) minimises total
+   pairwise torus distance (compactness → ring/line subsets on the torus).
+3. Tie-break toward chips that are already fragmented (fewest free
+   vdevices), keeping whole chips free for future multi-chip pods
+   (bin-packing pressure, which gpuallocator gets implicitly from its
+   "prefer busy boards" heuristic).
+4. Map the chosen chips back to one available vdevice each.
+
+Falls back to first-N available when no connected set exists (reference
+server.go:298-300 falls back the same way).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+from ..discovery.types import TpuChip, TpuTopology, chips_connected
+from .vdevice import VDevice
+
+# Enumerating subsets is exponential; nodes cap at 16 chips (envspec
+# MAX_DEVICES_PER_NODE) so C(16, k) stays small, but guard anyway.
+_MAX_ENUMERATION = 20000
+
+
+def _pairwise_cost(chips: Sequence[TpuChip], topo: Optional[TpuTopology]) -> int:
+    cost = 0
+    for a, b in itertools.combinations(chips, 2):
+        cost += a.ici_distance(b, topo)
+    return cost
+
+
+def preferred_allocation(
+    available: Sequence[VDevice],
+    must_include: Sequence[VDevice],
+    size: int,
+    topology: Optional[TpuTopology] = None,
+) -> List[VDevice]:
+    """Pick ``size`` vdevices from ``available`` (superset of
+    ``must_include``), at most one per physical chip, ICI-compact."""
+    if size <= 0:
+        return []
+    if size > len(available):
+        # Kubelet should never ask for more than it advertised available;
+        # degrade to everything we have.
+        return list(available)
+
+    # Group available vdevices per chip, order-preserving.
+    by_chip: Dict[str, List[VDevice]] = {}
+    chip_of: Dict[str, TpuChip] = {}
+    for v in available:
+        by_chip.setdefault(v.chip_uuid, []).append(v)
+        chip_of[v.chip_uuid] = v.chip
+
+    # Every must-include vdevice must appear in the response verbatim (the
+    # kubelet contract) — even when several share one chip; the
+    # one-vdevice-per-chip preference applies only to the free slots.
+    forced_chips = []
+    seen = set()
+    for v in must_include:
+        if v.chip_uuid not in seen:
+            seen.add(v.chip_uuid)
+            forced_chips.append(v.chip_uuid)
+
+    candidate_uuids = [u for u in by_chip if u not in seen]
+    n_free_slots = size - len(must_include)
+
+    if n_free_slots < 0 or len(must_include) + len(candidate_uuids) < size:
+        # Cannot satisfy one-vdevice-per-chip (e.g. split-count vdevices of
+        # the same chip requested together) — fall back to first-N
+        # (reference server.go:298-300).
+        return _first_n(available, must_include, size)
+
+    best: Optional[List[str]] = None
+    best_key = None
+    n_combos = 0
+    for combo in itertools.combinations(candidate_uuids, n_free_slots):
+        n_combos += 1
+        if n_combos > _MAX_ENUMERATION:
+            break
+        uuids = forced_chips + list(combo)
+        chips = [chip_of[u] for u in uuids]
+        connected = (topology is None
+                     or len(chips) <= 1
+                     or chips_connected(chips, topology))
+        cost = _pairwise_cost(chips, topology)
+        # Fragmentation pressure: prefer chips with fewer free vdevices.
+        frag = sum(len(by_chip[u]) for u in uuids)
+        key = (not connected, cost, frag)
+        if best_key is None or key < best_key:
+            best_key = key
+            best = uuids
+
+    if best is None:
+        return _first_n(available, must_include, size)
+
+    # All must-include vdevices verbatim, then one fresh vdevice per chosen
+    # free chip.
+    out: List[VDevice] = list(must_include)
+    forced_set = set(seen)
+    for uuid in best:
+        if uuid not in forced_set:
+            out.append(by_chip[uuid][0])
+    return out
+
+
+def _first_n(available: Sequence[VDevice], must_include: Sequence[VDevice],
+             size: int) -> List[VDevice]:
+    out = list(must_include[:size])
+    have = {v.id for v in out}
+    for v in available:
+        if len(out) >= size:
+            break
+        if v.id not in have:
+            out.append(v)
+            have.add(v.id)
+    return out

@@ -1,0 +1,387 @@
+"""The device-plugin gRPC server: one ``VtpuDevicePlugin`` per resource name,
+serving the kubelet v1beta1 API on its own unix socket.
+
+Mirrors the reference's ``NvidiaDevicePlugin`` (reference server.go:62-655):
+``Serve()`` with a crash-budgeted restart loop and a blocking self-dial
+liveness probe, ``Register()`` against kubelet.sock, ``ListAndWatch``
+streaming vdevice health, topology-scored ``GetPreferredAllocation``, and
+``Allocate``-time injection of the quota env contract + shim mounts — the
+only channel between the daemon and the in-container enforcement layer
+(reference server.go:486-522).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid as uuidlib
+from concurrent import futures
+from typing import Dict, List, Optional, Sequence
+
+import grpc
+
+from ..discovery.types import Health, TpuTopology
+from ..proto import DEVICE_PLUGIN_VERSION, pb, rpc
+from ..utils import envspec
+from ..utils import logging as log
+from .allocator import preferred_allocation
+from .config import Config
+from .split import PluginSpec
+from .vdevice import VDevice, unique_chip_uuids, vdevices_by_ids
+
+# Container-side install prefix of the shim artifacts (the reference mounts
+# into /usr/local/vgpu, server.go:511-522).
+CONTAINER_LIB_DIR = "/usr/local/vtpu"
+
+# Annotations used by the legacy-preferred controller to persist the
+# vdevice<->request mapping across kubelet restarts (reference
+# vdevice-controller.go:25-29).
+ANNOTATION_REQUEST = "4paradigm.com/vtpu-request"
+ANNOTATION_USING = "4paradigm.com/vtpu-using"
+
+# Serve-loop crash budget: give up after this many crashes within the window
+# (reference server.go:180-208: 5 restarts/hour).
+_CRASH_BUDGET = 5
+_CRASH_WINDOW_S = 3600.0
+
+
+class VtpuDevicePlugin(rpc.DevicePluginServicer):
+    """One device-plugin service instance (resource name + unix socket)."""
+
+    def __init__(
+        self,
+        spec: PluginSpec,
+        cfg: Config,
+        topology: Optional[TpuTopology] = None,
+        controller=None,          # vtpu.plugin.controller.VDeviceController
+        pod_lister=None,          # callable(node) -> [pod dict] (monitor mode)
+    ):
+        self.spec = spec
+        self.cfg = cfg
+        self.topology = topology
+        self.controller = controller
+        self.pod_lister = pod_lister
+        self.vdevices: List[VDevice] = list(spec.vdevices)
+        self.socket_path = os.path.join(cfg.device_plugin_path,
+                                        spec.socket_name)
+        self._server: Optional[grpc.Server] = None
+        self._stop = threading.Event()
+        self._health_version = 0
+        self._health_cond = threading.Condition()
+        self._crash_times: List[float] = []
+
+    # ------------------------------------------------------------------
+    # Lifecycle (reference server.go:132-243)
+    # ------------------------------------------------------------------
+
+    def start(self, register: bool = True) -> None:
+        """Serve + optional Register; raises on failure so the daemon's
+        restart loop can decide (reference Start, server.go:132-154)."""
+        self._stop.clear()
+        self.serve()
+        if register:
+            self.register()
+        log.info("plugin %s serving on %s with %d vdevices",
+                 self.spec.resource_name, self.socket_path,
+                 len(self.vdevices))
+
+    def serve(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8),
+            options=[("grpc.max_receive_message_length", 16 << 20)])
+        rpc.add_DevicePluginServicer_to_server(self, server)
+        server.add_insecure_port(f"unix://{self.socket_path}")
+        server.start()
+        self._server = server
+        # Blocking self-dial to confirm the socket answers before we
+        # register (reference server.go:210-215).
+        ch = grpc.insecure_channel(f"unix://{self.socket_path}")
+        try:
+            grpc.channel_ready_future(ch).result(timeout=5)
+        finally:
+            ch.close()
+
+    def register(self) -> None:
+        """Register with the kubelet over its own socket (reference
+        server.go:221-243)."""
+        kubelet_sock = os.path.join(self.cfg.device_plugin_path,
+                                    "kubelet.sock")
+        ch = grpc.insecure_channel(f"unix://{kubelet_sock}")
+        try:
+            grpc.channel_ready_future(ch).result(timeout=5)
+            stub = rpc.RegistrationStub(ch)
+            stub.Register(pb.RegisterRequest(
+                version=DEVICE_PLUGIN_VERSION,
+                endpoint=self.spec.socket_name,
+                resource_name=self.spec.resource_name,
+                options=pb.DevicePluginOptions(
+                    # Advertise preferred allocation only when we score it
+                    # ourselves and the legacy controller is off (reference
+                    # server.go:233-235).
+                    get_preferred_allocation_available=(
+                        self.controller is None),
+                ),
+            ))
+        finally:
+            ch.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._health_cond:
+            self._health_cond.notify_all()
+        if self._server is not None:
+            self._server.stop(grace=1).wait()
+            self._server = None
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+    def record_crash(self) -> bool:
+        """Crash-budget accounting for the daemon's serve retry loop;
+        returns False when the budget is exhausted (reference
+        server.go:180-208)."""
+        now = time.monotonic()
+        self._crash_times = [t for t in self._crash_times
+                             if now - t < _CRASH_WINDOW_S]
+        self._crash_times.append(now)
+        return len(self._crash_times) <= _CRASH_BUDGET
+
+    # ------------------------------------------------------------------
+    # Health (reference nvidia.go:166-237 -> server.go:254-268)
+    # ------------------------------------------------------------------
+
+    def set_chip_health(self, chip_uuid: str, health: Health,
+                        reason: str = "") -> None:
+        changed = False
+        for v in self.vdevices:
+            if v.chip_uuid == chip_uuid and v.health != health:
+                v.health = health
+                changed = True
+        if changed:
+            if health is Health.UNHEALTHY:
+                log.warn("chip %s unhealthy: %s", chip_uuid, reason)
+            with self._health_cond:
+                self._health_version += 1
+                self._health_cond.notify_all()
+
+    def set_all_unhealthy(self, reason: str = "") -> None:
+        for v in self.vdevices:
+            v.health = Health.UNHEALTHY
+        log.warn("all vdevices unhealthy: %s", reason)
+        with self._health_cond:
+            self._health_version += 1
+            self._health_cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # gRPC surface
+    # ------------------------------------------------------------------
+
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions(
+            get_preferred_allocation_available=(self.controller is None))
+
+    def _api_devices(self) -> List[pb.Device]:
+        """vdevices as kubelet Devices, with NUMA topology hints
+        (reference apiDevices/buildDevice, server.go:583-596 +
+        nvidia.go:148-164)."""
+        out = []
+        for v in self.vdevices:
+            d = pb.Device(ID=v.id, health=v.health.value)
+            if v.chip.numa_node is not None:
+                d.topology.nodes.add(ID=v.chip.numa_node)
+            out.append(d)
+        return out
+
+    def ListAndWatch(self, request, context):
+        """Initial device list, then a refresh per health change
+        (reference server.go:254-268)."""
+        last_sent = -1
+        while not self._stop.is_set() and context.is_active():
+            with self._health_cond:
+                version = self._health_version
+                if version == last_sent:
+                    self._health_cond.wait(timeout=5.0)
+                    version = self._health_version
+            if version != last_sent:
+                last_sent = version
+                yield pb.ListAndWatchResponse(devices=self._api_devices())
+
+    def GetPreferredAllocation(self, request, context):
+        resp = pb.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            available = vdevices_by_ids(self.vdevices,
+                                        creq.available_deviceIDs)
+            must = vdevices_by_ids(self.vdevices,
+                                   creq.must_include_deviceIDs)
+            chosen = preferred_allocation(available, must,
+                                          creq.allocation_size,
+                                          self.topology)
+            resp.container_responses.add(deviceIDs=[v.id for v in chosen])
+        return resp
+
+    def PreStartContainer(self, request, context):
+        return pb.PreStartContainerResponse()
+
+    # ------------------------------------------------------------------
+    # Allocate (reference server.go:361-533)
+    # ------------------------------------------------------------------
+
+    def Allocate(self, request, context):
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            ids = list(creq.devicesIDs)
+            if self.controller is not None:
+                # Legacy-preferred path: kubelet's IDs may be stale —
+                # reconcile from its checkpoint and re-pick (reference
+                # server.go:408-457).
+                ids = self.controller.reallocate(self, ids)
+            vdevs = vdevices_by_ids(self.vdevices, ids)
+            car = resp.container_responses.add()
+            self._fill_allocate_response(car, vdevs, ids)
+        return resp
+
+    def _shared_cache_path(self, n_vdevices: int) -> str:
+        """Per-allocation shared-region path; in monitor mode a per-pod dir
+        under the host lib dir so the node monitor can read it (reference
+        server.go:494-504)."""
+        if self.cfg.monitor_mode and self.pod_lister is not None:
+            match = self._match_pending_pod(n_vdevices)
+            if match is not None:
+                ns, pod, container, uid = match
+                # Namespace + UID keep distinct same-named pods from
+                # colliding on one accounting region.
+                d = os.path.join(CONTAINER_LIB_DIR, "shared",
+                                 f"{ns}_{pod}_{container}_{uid[:8]}")
+                return os.path.join(d, "vtpushr.cache")
+        return f"/tmp/vtpu_{uuidlib.uuid4().hex[:12]}.cache"
+
+    def _match_pending_pod(self, n_vdevices: int):
+        """Identify the pod this Allocate serves by matching a pending
+        pod's vtpu limit against the request size — crude, but Allocate
+        carries no pod identity (reference server.go:365-406)."""
+        try:
+            pods = self.pod_lister(self.cfg.node_name)
+        except Exception as e:  # noqa: BLE001 - monitor mode is best-effort
+            log.warn("monitor mode pod list failed: %s", e)
+            return None
+        for pod in pods:
+            if pod.get("status", {}).get("phase") != "Pending":
+                continue
+            meta = pod.get("metadata", {})
+            for ctr in pod.get("spec", {}).get("containers", []):
+                limits = ctr.get("resources", {}).get("limits", {})
+                want = limits.get(self.spec.resource_name)
+                if want is not None and int(want) == n_vdevices:
+                    return (meta.get("namespace", "default"),
+                            meta.get("name", "pod"),
+                            ctr.get("name", "ctr"),
+                            meta.get("uid", "nouid"))
+        return None
+
+    def _fill_allocate_response(self, car, vdevs: Sequence[VDevice],
+                                ids: Sequence[str]) -> None:
+        envs: Dict[str, str] = {}
+        chip_uuids = unique_chip_uuids(vdevs)
+
+        # Visibility: physical chips backing the grant (reference
+        # NVIDIA_VISIBLE_DEVICES, server.go:469-471, 565-581).
+        if self.cfg.device_id_strategy == "index":
+            by_uuid = {v.chip_uuid: v.chip.index for v in vdevs}
+            envs[envspec.ENV_VISIBLE_DEVICES] = ",".join(
+                str(by_uuid[u]) for u in chip_uuids)
+        else:
+            envs[envspec.ENV_VISIBLE_DEVICES] = ",".join(chip_uuids)
+
+        # Ordinal -> physical map + per-ordinal HBM caps (reference
+        # server.go:486-493).
+        map_entries = []
+        for i, v in enumerate(vdevs):
+            map_entries.append(f"{i}:{v.chip_uuid}")
+            if v.hbm_bytes > 0:
+                envs[f"{envspec.ENV_HBM_LIMIT}_{i}"] = (
+                    envspec.format_quantity_mb(v.hbm_bytes))
+        envs[envspec.ENV_DEVICE_MAP] = " ".join(map_entries)
+
+        # Compute quota: only meaningful for time-shared splits (reference
+        # CUDA_DEVICE_SM_LIMIT, server.go:492).
+        if self.spec.time_shared and vdevs and vdevs[0].core_pct > 0:
+            envs[envspec.ENV_CORE_LIMIT] = str(vdevs[0].core_pct)
+
+        # Core pinning for hard-partition (core-split) grants: the shim
+        # translates to libtpu core selection.
+        core_ids = [str(v.core_index) for v in vdevs
+                    if v.core_index is not None]
+        if core_ids:
+            envs["VTPU_CORE_INDICES"] = ",".join(core_ids)
+
+        envs[envspec.ENV_SHARED_CACHE] = self._shared_cache_path(len(vdevs))
+        if self.cfg.oversubscribe:
+            envs[envspec.ENV_OVERSUBSCRIBE] = "true"
+        if self.cfg.enable_runtime and self.spec.time_shared:
+            envs[envspec.ENV_RUNTIME_SOCKET] = os.path.join(
+                CONTAINER_LIB_DIR, os.path.basename(self.cfg.runtime_socket))
+        if self.cfg.pcibus_file:
+            envs[envspec.ENV_PCIBUS_FILE] = os.path.join(
+                CONTAINER_LIB_DIR, "tpuinfo.vtpu")
+
+        # Native injection: make any libtpu loader (JAX, PyTorch/XLA, TF)
+        # load the interposer instead of the raw driver — the TPU-native
+        # ld.so.preload (reference server.go:511-515 mounts
+        # /etc/ld.so.preload).
+        envs["TPU_LIBRARY_PATH"] = os.path.join(CONTAINER_LIB_DIR,
+                                                "libvtpu_pjrt.so")
+        # Python-level preload for CPU-backend fallback + runtime client
+        # bootstrap.  Allocate cannot see the image's own PYTHONPATH, so
+        # this overrides it; sitecustomize re-appends the original value
+        # from /proc/1/environ when present.
+        envs["PYTHONPATH"] = os.path.join(CONTAINER_LIB_DIR, "shim")
+
+        for k, v in envs.items():
+            car.envs[k] = v
+
+        # Shim artifact mounts from the hostPath staged by entrypoint.sh
+        # (reference server.go:511-522).
+        host = self.cfg.host_lib_dir
+        mounts = [
+            (os.path.join(CONTAINER_LIB_DIR, "libvtpu_pjrt.so"),
+             os.path.join(host, "libvtpu_pjrt.so"), True),
+            (os.path.join(CONTAINER_LIB_DIR, "libvtpucore.so"),
+             os.path.join(host, "libvtpucore.so"), True),
+            (os.path.join(CONTAINER_LIB_DIR, "shim"),
+             os.path.join(host, "shim"), True),
+        ]
+        if self.cfg.pcibus_file:
+            mounts.append((os.path.join(CONTAINER_LIB_DIR, "tpuinfo.vtpu"),
+                           self.cfg.pcibus_file, True))
+        if self.cfg.enable_runtime and self.spec.time_shared:
+            mounts.append(
+                (os.path.join(CONTAINER_LIB_DIR,
+                              os.path.basename(self.cfg.runtime_socket)),
+                 self.cfg.runtime_socket, False))
+        if self.cfg.monitor_mode:
+            mounts.append((os.path.join(CONTAINER_LIB_DIR, "shared"),
+                           os.path.join(host, "shared"), False))
+        for cpath, hpath, ro in mounts:
+            car.mounts.add(container_path=cpath, host_path=hpath,
+                           read_only=ro)
+
+        # Device nodes for CPUManager compatibility (reference
+        # --pass-device-specs, server.go:618-655).
+        if self.cfg.pass_device_specs:
+            seen_paths = set()
+            for v in vdevs:
+                for p in v.chip.device_paths:
+                    if p not in seen_paths:
+                        seen_paths.add(p)
+                        car.devices.add(container_path=p, host_path=p,
+                                        permissions="rw")
+
+        # Legacy-mode ownership annotations (reference server.go:480-485).
+        if self.controller is not None:
+            car.annotations[ANNOTATION_REQUEST] = ",".join(ids)
+            car.annotations[ANNOTATION_USING] = ",".join(v.id for v in vdevs)

@@ -1,0 +1,9 @@
+"""Generated + hand-written gRPC bindings for the kubelet device-plugin
+v1beta1 API.  ``deviceplugin_pb2.py`` is produced by ``make proto`` (protoc
+--python_out) from ``deviceplugin.proto``; ``deviceplugin_grpc.py`` is the
+hand-written service glue (the image lacks grpcio-tools)."""
+
+from . import deviceplugin_pb2 as pb  # noqa: F401
+from . import deviceplugin_grpc as rpc  # noqa: F401
+
+DEVICE_PLUGIN_VERSION = "v1beta1"

@@ -1,0 +1,76 @@
+"""A minimal kubelet simulator for integration tests: serves the
+Registration service on `<dir>/kubelet.sock` and drives the plugin's
+DevicePlugin service like the real kubelet would (Register →
+GetDevicePluginOptions → ListAndWatch → GetPreferredAllocation →
+Allocate).  This is the test seam the reference never built (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent import futures
+from typing import List, Optional
+
+import grpc
+
+from vtpu.proto import pb, rpc
+
+
+class KubeletSim(rpc.RegistrationServicer):
+    def __init__(self, plugin_dir: str):
+        self.plugin_dir = plugin_dir
+        self.socket_path = os.path.join(plugin_dir, "kubelet.sock")
+        self.registrations: "queue.Queue[pb.RegisterRequest]" = queue.Queue()
+        self._server: Optional[grpc.Server] = None
+
+    # Registration service ------------------------------------------------
+    def Register(self, request, context):
+        self.registrations.put(request)
+        return pb.Empty()
+
+    def start(self):
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        rpc.add_RegistrationServicer_to_server(self, server)
+        server.add_insecure_port(f"unix://{self.socket_path}")
+        server.start()
+        self._server = server
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop(grace=0.5).wait()
+            self._server = None
+
+    def wait_registration(self, timeout=5.0) -> pb.RegisterRequest:
+        return self.registrations.get(timeout=timeout)
+
+    # Kubelet-side client over a plugin's socket --------------------------
+    def plugin_stub(self, endpoint: str):
+        path = os.path.join(self.plugin_dir, endpoint)
+        ch = grpc.insecure_channel(f"unix://{path}")
+        grpc.channel_ready_future(ch).result(timeout=5)
+        return rpc.DevicePluginStub(ch), ch
+
+
+def collect_stream(stream, n: int, timeout: float = 5.0) -> List:
+    """Collect n responses from a ListAndWatch stream in a side thread."""
+    out: List = []
+    done = threading.Event()
+
+    def run():
+        try:
+            for resp in stream:
+                out.append(resp)
+                if len(out) >= n:
+                    break
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    done.wait(timeout)
+    return out

@@ -1,0 +1,77 @@
+"""ICI-topology-aware preferred allocation: compactness, connectivity,
+must-include, fragmentation tie-breaks, fallback."""
+
+from vtpu.discovery.fake import FakeChipBackend
+from vtpu.plugin.allocator import preferred_allocation
+from vtpu.plugin.vdevice import split_chip
+
+
+def make(num_chips=8, split=2, generation="v5e"):
+    backend = FakeChipBackend(num_chips=num_chips, generation=generation)
+    topo = backend.topology()
+    vdevs = []
+    for chip in backend.chips():
+        vdevs.extend(split_chip(chip, split))
+    return vdevs, topo
+
+
+def chip_coords(chosen):
+    return [v.chip.coord for v in chosen]
+
+
+def test_compact_pair_is_adjacent():
+    vdevs, topo = make(8)
+    chosen = preferred_allocation(vdevs, [], 2, topo)
+    assert len(chosen) == 2
+    (a, b) = [v.chip for v in chosen]
+    assert a.ici_distance(b, topo) == 1
+
+
+def test_four_chips_form_connected_square():
+    vdevs, topo = make(8)  # 2x4 mesh
+    chosen = preferred_allocation(vdevs, [], 4, topo)
+    assert len(chosen) == 4
+    coords = set(chip_coords(chosen))
+    assert len(coords) == 4
+    # Optimal compact 4-set on a 2x4 mesh is a 2x2 square: pairwise cost 8.
+    chips = [v.chip for v in chosen]
+    total = sum(chips[i].ici_distance(chips[j], topo)
+                for i in range(4) for j in range(i + 1, 4))
+    assert total == 8
+
+
+def test_one_vdevice_per_chip():
+    vdevs, topo = make(4, split=4)
+    chosen = preferred_allocation(vdevs, [], 3, topo)
+    assert len({v.chip_uuid for v in chosen}) == 3
+
+
+def test_must_include_respected():
+    vdevs, topo = make(8)
+    forced = vdevs[10]  # some middle chip
+    chosen = preferred_allocation(vdevs, [forced], 2, topo)
+    assert forced.id in [v.id for v in chosen]
+    others = [v for v in chosen if v.id != forced.id]
+    assert others[0].chip.ici_distance(forced.chip, topo) == 1
+
+
+def test_fragmentation_tiebreak_prefers_busy_chips():
+    vdevs, topo = make(4, split=2)
+    # Remove one vdevice of chip 0 -> chip 0 is fragmented; a single-vdevice
+    # request should land there, keeping whole chips free.
+    available = [v for v in vdevs if v.id != vdevs[0].id]
+    chosen = preferred_allocation(available, [], 1, topo)
+    assert chosen[0].chip.index == 0
+
+
+def test_fallback_when_fewer_chips_than_size():
+    vdevs, topo = make(2, split=4)
+    # 8 vdevices on 2 chips; asking for 4 cannot give distinct chips.
+    chosen = preferred_allocation(vdevs, [], 4, topo)
+    assert len(chosen) == 4  # first-N fallback
+
+
+def test_size_larger_than_available():
+    vdevs, topo = make(2, split=1)
+    chosen = preferred_allocation(vdevs, [], 5, topo)
+    assert len(chosen) == 2

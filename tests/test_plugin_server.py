@@ -1,0 +1,169 @@
+"""Integration tests: kubelet simulator <-> VtpuDevicePlugin over real gRPC
+unix sockets — Register, ListAndWatch + health flip, preferred allocation,
+Allocate env/mount contract."""
+
+import os
+
+import pytest
+
+from kubelet_sim import KubeletSim, collect_stream
+from vtpu.discovery.fake import FakeChipBackend
+from vtpu.discovery.types import Health
+from vtpu.plugin.config import Config
+from vtpu.plugin.server import VtpuDevicePlugin
+from vtpu.plugin.split import build_plugin_specs
+from vtpu.proto import pb
+from vtpu.utils import envspec
+
+
+@pytest.fixture()
+def env(tmp_path):
+    cfg = Config(
+        device_plugin_path=str(tmp_path) + "/",
+        device_split_count=2,
+        device_memory_scaling=1.0,
+        host_lib_dir=str(tmp_path / "vtpu"),
+        runtime_socket=str(tmp_path / "vtpu" / "rt.sock"),
+    )
+    backend = FakeChipBackend(num_chips=4, generation="v5e")
+    specs = build_plugin_specs(cfg, backend)
+    assert len(specs) == 1
+    plugin = VtpuDevicePlugin(specs[0], cfg, topology=backend.topology())
+    sim = KubeletSim(str(tmp_path)).start()
+    plugin.start(register=True)
+    yield sim, plugin, cfg
+    plugin.stop()
+    sim.stop()
+
+
+def test_register_and_options(env):
+    sim, plugin, cfg = env
+    reg = sim.wait_registration()
+    assert reg.version == "v1beta1"
+    assert reg.resource_name == "4paradigm.com/vtpu"
+    assert reg.options.get_preferred_allocation_available
+
+    stub, ch = sim.plugin_stub(reg.endpoint)
+    opts = stub.GetDevicePluginOptions(pb.Empty())
+    assert opts.get_preferred_allocation_available
+    ch.close()
+
+
+def test_list_and_watch_health_flip(env):
+    sim, plugin, cfg = env
+    reg = sim.wait_registration()
+    stub, ch = sim.plugin_stub(reg.endpoint)
+    stream = stub.ListAndWatch(pb.Empty())
+
+    first = collect_stream(stream, 1)
+    assert len(first) == 1
+    devs = first[0].devices
+    assert len(devs) == 8  # 4 chips x split 2
+    assert all(d.health == "Healthy" for d in devs)
+
+    # Flip one chip unhealthy -> new list pushed with its 2 vdevices bad.
+    sick = plugin.vdevices[0].chip_uuid
+    stream2 = stub.ListAndWatch(pb.Empty())
+    collect_stream(stream2, 1)
+    plugin.set_chip_health(sick, Health.UNHEALTHY, "injected")
+    more = collect_stream(stream2, 1)
+    assert more, "expected a health refresh"
+    bad = [d for d in more[-1].devices if d.health == "Unhealthy"]
+    assert len(bad) == 2
+    ch.close()
+
+
+def test_preferred_allocation_distinct_chips(env):
+    sim, plugin, cfg = env
+    reg = sim.wait_registration()
+    stub, ch = sim.plugin_stub(reg.endpoint)
+
+    req = pb.PreferredAllocationRequest()
+    creq = req.container_requests.add(
+        available_deviceIDs=[v.id for v in plugin.vdevices],
+        allocation_size=2,
+    )
+    resp = stub.GetPreferredAllocation(req)
+    ids = list(resp.container_responses[0].deviceIDs)
+    assert len(ids) == 2
+    chips = {i.rsplit("-vtpu-", 1)[0] for i in ids}
+    assert len(chips) == 2, "one vdevice per physical chip"
+    ch.close()
+
+
+def test_allocate_env_contract(env):
+    sim, plugin, cfg = env
+    reg = sim.wait_registration()
+    stub, ch = sim.plugin_stub(reg.endpoint)
+
+    want = [plugin.vdevices[0].id, plugin.vdevices[2].id]
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=want)
+    resp = stub.Allocate(req)
+    car = resp.container_responses[0]
+    envs = dict(car.envs)
+
+    # HBM quota: 16 GiB / 2 per vdevice, in the <N>m convention.
+    per_vdev = int(16 * 2**30 / 2)
+    assert envs[f"{envspec.ENV_HBM_LIMIT}_0"] == f"{per_vdev // 10**6}m"
+    assert envs[f"{envspec.ENV_HBM_LIMIT}_1"] == f"{per_vdev // 10**6}m"
+    assert envs[envspec.ENV_CORE_LIMIT] == "50"
+
+    # Device map covers both ordinals and real chip uuids.
+    entries = envs[envspec.ENV_DEVICE_MAP].split()
+    assert len(entries) == 2
+    assert entries[0].startswith("0:TPU-fake-")
+
+    # Parse back through the consumer-side parser: round-trip must agree.
+    spec = envspec.quota_from_env(envs)
+    assert spec.limit_for(0) == (per_vdev // 10**6) * 10**6
+    assert spec.core_limit_pct == 50
+    assert len(spec.device_map) == 2
+    assert spec.shared_cache
+
+    # Native injection channel.
+    assert envs["TPU_LIBRARY_PATH"].endswith("libvtpu_pjrt.so")
+    assert envs["PYTHONPATH"].endswith("/shim")
+
+    mounts = {m.container_path: m.host_path for m in car.mounts}
+    assert "/usr/local/vtpu/libvtpu_pjrt.so" in mounts
+    assert "/usr/local/vtpu/shim" in mounts
+    ch.close()
+
+
+def test_allocate_unknown_id_errors(env):
+    sim, plugin, cfg = env
+    reg = sim.wait_registration()
+    stub, ch = sim.plugin_stub(reg.endpoint)
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=["nope-vtpu-0"])
+    import grpc as grpcmod
+    with pytest.raises(grpcmod.RpcError):
+        stub.Allocate(req)
+    ch.close()
+
+
+def test_pass_device_specs(tmp_path):
+    cfg = Config(
+        device_plugin_path=str(tmp_path) + "/",
+        pass_device_specs=True,
+        host_lib_dir=str(tmp_path / "vtpu"),
+        runtime_socket=str(tmp_path / "vtpu" / "rt.sock"),
+    )
+    backend = FakeChipBackend(num_chips=2)
+    specs = build_plugin_specs(cfg, backend)
+    plugin = VtpuDevicePlugin(specs[0], cfg, topology=backend.topology())
+    sim = KubeletSim(str(tmp_path)).start()
+    plugin.start()
+    try:
+        reg = sim.wait_registration()
+        stub, ch = sim.plugin_stub(reg.endpoint)
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=[plugin.vdevices[0].id])
+        resp = stub.Allocate(req)
+        devs = resp.container_responses[0].devices
+        assert [d.host_path for d in devs] == ["/dev/accel0"]
+        ch.close()
+    finally:
+        plugin.stop()
+        sim.stop()
