@@ -1,0 +1,204 @@
+"""Daemon entrypoint: flag parsing, chip inventory, plugin restart loop.
+
+The reference's main.go: validate flags, write the PCI inventory file for
+the in-container shim, init the driver library with fail-or-block
+semantics, then a ``goto restart`` loop that rebuilds every plugin when the
+kubelet socket is recreated or on SIGHUP, and exits on other signals
+(reference main.go:48-293).
+
+Run: ``python -m vtpu.plugin.main --discovery fake --device-split-count 4``
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import signal
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from ..discovery.base import ChipBackend
+from ..discovery.factory import make_backend
+from ..discovery.types import Health, TpuChip
+from ..utils import logging as log
+from .config import Config, parse_args
+from .server import VtpuDevicePlugin
+from .split import build_plugin_specs
+from .watchers import FsWatcher, SignalWatcher
+
+
+def write_chip_inventory(cfg: Config, chips: List[TpuChip]) -> None:
+    """Write the platform inventory the shim uses to present stable virtual
+    device identities — the reference's lspci → $PCIBUSFILE scan
+    (reference main.go:164-185, consumed as pciinfo.vgpu)."""
+    if not cfg.pcibus_file:
+        return
+    os.makedirs(os.path.dirname(cfg.pcibus_file), exist_ok=True)
+    with open(cfg.pcibus_file, "w") as f:
+        for c in chips:
+            coord = ",".join(str(x) for x in c.coord)
+            f.write(f"{c.index} {c.uuid} {c.pci_bus_id or '-'} "
+                    f"{c.hbm_bytes} {c.generation} {coord or '-'}\n")
+    log.info("wrote chip inventory (%d chips) to %s", len(chips),
+             cfg.pcibus_file)
+
+
+class Daemon:
+    """Owns the plugin set + health loop across restarts."""
+
+    def __init__(self, cfg: Config, backend: Optional[ChipBackend] = None):
+        self.cfg = cfg
+        self.backend = backend
+        self.plugins: List[VtpuDevicePlugin] = []
+        # Fresh per generation: a slow probe can outlive stop_plugins()'s
+        # bounded join, and reusing one Event would un-stop that stale
+        # loop on the next start.
+        self._health_stop: Optional[threading.Event] = None
+        self._health_thread: Optional[threading.Thread] = None
+
+    # -- plugin set lifecycle ------------------------------------------------
+
+    def start_plugins(self) -> bool:
+        """Discover, split, serve, register.  Returns False on an init
+        error the caller should handle per --fail-on-init-error
+        (reference main.go:186-199, 225-252)."""
+        if self.backend is None:
+            self.backend = make_backend(self.cfg.discovery)
+        chips = self.backend.chips()
+        if not chips:
+            log.error("no TPU chips discovered (discovery=%s)",
+                      self.cfg.discovery)
+            return False
+        write_chip_inventory(self.cfg, chips)
+
+        controller = None
+        if self.cfg.enable_legacy_preferred:
+            from .controller import VDeviceController
+            controller = VDeviceController(self.cfg)
+
+        specs = build_plugin_specs(self.cfg, self.backend)
+        topo = self.backend.topology()
+        plugins = [VtpuDevicePlugin(s, self.cfg, topology=topo,
+                                    controller=controller)
+                   for s in specs]
+        started: List[VtpuDevicePlugin] = []
+        for p in plugins:
+            try:
+                p.start(register=True)
+                started.append(p)
+            except Exception as e:  # noqa: BLE001 - kubelet may be down
+                log.error("plugin %s failed to start: %s",
+                          p.spec.resource_name, e)
+                for q in started:
+                    q.stop()
+                return False
+        self.plugins = started
+        self._start_health_loop(chips)
+        return True
+
+    def stop_plugins(self) -> None:
+        if self._health_stop is not None:
+            self._health_stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=2)
+            self._health_thread = None
+        for p in self.plugins:
+            p.stop()
+        self.plugins = []
+
+    # -- health --------------------------------------------------------------
+
+    def _start_health_loop(self, chips: List[TpuChip]) -> None:
+        """Backend health loop -> vdevice health flips -> ListAndWatch
+        refresh (reference nvidia.go:139-141, 166-237).  Disable with
+        VTPU_DISABLE_HEALTHCHECKS=all (reference DP_DISABLE_HEALTHCHECKS)."""
+        if os.environ.get("VTPU_DISABLE_HEALTHCHECKS", "") == "all":
+            return
+        stop = threading.Event()
+        self._health_stop = stop
+        plugins = list(self.plugins)
+
+        def on_unhealthy(chip: TpuChip, reason: str):
+            for p in plugins:
+                p.set_chip_health(chip.uuid, Health.UNHEALTHY, reason)
+
+        def run():
+            try:
+                self.backend.check_health(stop, chips, on_unhealthy)
+            except Exception as e:  # noqa: BLE001
+                # A dead health loop must not take the daemon down; mark
+                # everything unhealthy instead (reference marks all devices
+                # unhealthy when the event watcher fails, nvidia.go:183-192).
+                log.error("health loop failed: %s", e)
+                for p in plugins:
+                    p.set_all_unhealthy(f"health loop failed: {e}")
+
+        self._health_thread = threading.Thread(target=run, daemon=True,
+                                               name="vtpu-health")
+        self._health_thread.start()
+
+
+def run(cfg: Config, backend: Optional[ChipBackend] = None,
+        max_restarts: Optional[int] = None) -> int:
+    """The restart loop (reference main.go:212-292).  ``max_restarts``
+    bounds the loop for tests; None = run forever."""
+    log.info("vtpu-device-plugin starting (split=%d, strategy=%s, "
+             "memory-scaling=%.2f)", cfg.device_split_count,
+             cfg.split_strategy, cfg.device_memory_scaling)
+
+    daemon = Daemon(cfg, backend)
+    kubelet_sock = os.path.join(cfg.device_plugin_path, "kubelet.sock")
+    fs = FsWatcher(kubelet_sock).start()
+    sigs = SignalWatcher().install()
+    restarts = 0
+    try:
+        while True:
+            ok = daemon.start_plugins()
+            if not ok:
+                if cfg.fail_on_init_error:
+                    log.error("init failed; exiting (--fail-on-init-error)")
+                    return 1
+                log.warn("init failed; idling until kubelet restart/signal "
+                         "(--fail-on-init-error=false)")
+
+            # Event wait: kubelet restart or signal.
+            restart = False
+            while not restart:
+                try:
+                    ev = fs.events.get(timeout=0.5)
+                    if ev.op == "create":
+                        log.info("kubelet socket recreated; restarting "
+                                 "plugins")
+                        restart = True
+                except queue.Empty:
+                    pass
+                while not sigs.events.empty():
+                    signum = sigs.events.get_nowait()
+                    if signum == signal.SIGHUP:
+                        log.info("SIGHUP; restarting plugins")
+                        restart = True
+                    else:
+                        log.info("signal %d; shutting down", signum)
+                        return 0
+
+            daemon.stop_plugins()
+            restarts += 1
+            if max_restarts is not None and restarts >= max_restarts:
+                return 0
+            time.sleep(0.2)
+    finally:
+        daemon.stop_plugins()
+        fs.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    cfg = parse_args(argv)
+    if cfg.verbose:
+        os.environ.setdefault("VTPU_LOG_LEVEL", "4")
+    return run(cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

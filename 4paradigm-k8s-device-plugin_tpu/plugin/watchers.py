@@ -1,0 +1,89 @@
+"""Filesystem + signal watchers for the daemon event loop.
+
+The reference uses fsnotify on the kubelet device-plugin dir and a signal
+channel (reference watchers.go:9-31); Python's stdlib has no inotify, so the
+fs watcher is a polling thread that emits create/delete events for one path —
+sufficient for the only event the daemon cares about: kubelet.sock being
+recreated on kubelet restart (reference main.go:253-263).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import signal
+import threading
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class FsEvent:
+    path: str
+    op: str          # "create" | "delete"
+
+
+class FsWatcher:
+    """Polls one path; emits FsEvent("create") when it appears (or its
+    inode changes) and FsEvent("delete") when it vanishes."""
+
+    def __init__(self, path: str, interval: float = 1.0):
+        self.path = path
+        self.interval = interval
+        self.events: "queue.Queue[FsEvent]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _ino(self):
+        # Inode alone is not enough: tmpfs reuses a freed inode number
+        # immediately, so an unlink+recreate between two polls can look
+        # unchanged.  ctime disambiguates.
+        try:
+            st = os.stat(self.path)
+            return (st.st_dev, st.st_ino, st.st_ctime_ns)
+        except OSError:
+            return None
+
+    def start(self) -> "FsWatcher":
+        last = self._ino()
+
+        def run():
+            nonlocal last
+            while not self._stop.wait(self.interval):
+                cur = self._ino()
+                if cur == last:
+                    continue
+                if cur is None:
+                    self.events.put(FsEvent(self.path, "delete"))
+                else:
+                    # Appeared, or replaced in place (inode changed) — both
+                    # mean a kubelet restart.
+                    self.events.put(FsEvent(self.path, "create"))
+                last = cur
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="vtpu-fswatch")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class SignalWatcher:
+    """Queues SIGHUP/SIGINT/SIGTERM/SIGQUIT like the reference's
+    signal.Notify channel (reference watchers.go:27-31)."""
+
+    SIGNALS = (signal.SIGHUP, signal.SIGINT, signal.SIGTERM, signal.SIGQUIT)
+
+    def __init__(self):
+        self.events: "queue.Queue[int]" = queue.Queue()
+
+    def install(self) -> "SignalWatcher":
+        for sig in self.SIGNALS:
+            signal.signal(sig, self._handler)
+        return self
+
+    def _handler(self, signum, frame):
+        self.events.put(signum)

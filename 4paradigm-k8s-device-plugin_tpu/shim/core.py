@@ -1,0 +1,200 @@
+"""ctypes bindings for the native vtpucore shared-region library.
+
+Every consumer of the cross-process accounting state goes through here: the
+Python shim's CPU-backend enforcement, the runtime broker's per-tenant
+quotas, the vtpu-smi monitor.  The native library itself is the contract —
+see native/vtpucore/vtpu_core.h for semantics (reference analogue:
+src/multiprocess/multiprocess_memory_limit.c in vgpu/libvgpu.so).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence
+
+from ..utils.envspec import MAX_DEVICES_PER_NODE
+
+_SEARCH_PATHS = (
+    os.environ.get("VTPU_CORE_LIB", ""),
+    # container-side mount injected at Allocate
+    "/usr/local/vtpu/libvtpucore.so",
+    # repo build tree (tests / dev)
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native", "build", "libvtpucore.so"),
+)
+
+
+class DeviceStats(ctypes.Structure):
+    _fields_ = [
+        ("limit_bytes", ctypes.c_uint64),
+        ("used_bytes", ctypes.c_uint64),
+        ("peak_bytes", ctypes.c_uint64),
+        ("core_limit_pct", ctypes.c_int32),
+        ("n_procs", ctypes.c_int32),
+    ]
+
+
+class ProcStats(ctypes.Structure):
+    _fields_ = [
+        ("pid", ctypes.c_int),
+        ("host_pid", ctypes.c_int),
+        ("used_bytes", ctypes.c_uint64 * MAX_DEVICES_PER_NODE),
+    ]
+
+
+MAX_PROCS = 64
+
+
+def _find_lib() -> str:
+    for p in _SEARCH_PATHS:
+        if p and os.path.exists(p):
+            return p
+    raise FileNotFoundError(
+        "libvtpucore.so not found (build with `make -C native` or set "
+        "VTPU_CORE_LIB)")
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_find_lib())
+    lib.vtpu_region_open.restype = ctypes.c_void_p
+    lib.vtpu_region_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_uint64),
+                                     ctypes.POINTER(ctypes.c_int32)]
+    lib.vtpu_region_close.argtypes = [ctypes.c_void_p]
+    lib.vtpu_proc_register.restype = ctypes.c_int
+    lib.vtpu_proc_register.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.vtpu_proc_deregister.argtypes = [ctypes.c_void_p]
+    lib.vtpu_sweep_dead.restype = ctypes.c_int
+    lib.vtpu_sweep_dead.argtypes = [ctypes.c_void_p]
+    lib.vtpu_sweep_dead_host.restype = ctypes.c_int
+    lib.vtpu_sweep_dead_host.argtypes = [ctypes.c_void_p]
+    lib.vtpu_mem_acquire.restype = ctypes.c_int
+    lib.vtpu_mem_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_uint64, ctypes.c_int]
+    lib.vtpu_mem_release.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_uint64]
+    lib.vtpu_mem_info.restype = ctypes.c_int
+    lib.vtpu_mem_info.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_uint64),
+                                  ctypes.POINTER(ctypes.c_uint64)]
+    lib.vtpu_device_get_stats.restype = ctypes.c_int
+    lib.vtpu_device_get_stats.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.POINTER(DeviceStats)]
+    lib.vtpu_proc_get_stats.restype = ctypes.c_int
+    lib.vtpu_proc_get_stats.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.POINTER(ProcStats)]
+    lib.vtpu_rate_acquire.restype = ctypes.c_uint64
+    lib.vtpu_rate_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_uint64, ctypes.c_int]
+    lib.vtpu_rate_adjust.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.c_int64]
+    lib.vtpu_rate_block.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_uint64, ctypes.c_int]
+    lib.vtpu_set_core_limit.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_int32]
+    lib.vtpu_region_ndevices.restype = ctypes.c_int
+    lib.vtpu_region_ndevices.argtypes = [ctypes.c_void_p]
+    lib.vtpu_core_version.restype = ctypes.c_char_p
+    _lib = lib
+    return lib
+
+
+class SharedRegion:
+    """One mmap'd accounting region shared by all processes of a vTPU
+    allocation."""
+
+    def __init__(self, path: str, limits: Sequence[int] = (),
+                 core_pcts: Sequence[int] = ()):
+        self.lib = load()
+        n = max(len(limits), len(core_pcts))
+        arr_l = (ctypes.c_uint64 * max(n, 1))(*limits) if limits else None
+        arr_c = (ctypes.c_int32 * max(n, 1))(*core_pcts) if core_pcts else None
+        self.handle = self.lib.vtpu_region_open(
+            path.encode(), n, arr_l, arr_c)
+        if not self.handle:
+            raise OSError(f"vtpu_region_open({path!r}) failed")
+        self.path = path
+
+    # -- lifecycle --
+    def close(self) -> None:
+        if self.handle:
+            self.lib.vtpu_region_close(self.handle)
+            self.handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def register(self, host_pid: int = 0) -> int:
+        return self.lib.vtpu_proc_register(self.handle, host_pid)
+
+    def deregister(self) -> None:
+        self.lib.vtpu_proc_deregister(self.handle)
+
+    def sweep_dead(self) -> int:
+        return self.lib.vtpu_sweep_dead(self.handle)
+
+    def sweep_dead_host(self) -> int:
+        """Host-namespace sweep by host_pid — node monitor only."""
+        return self.lib.vtpu_sweep_dead_host(self.handle)
+
+    # -- memory --
+    def mem_acquire(self, dev: int, nbytes: int,
+                    oversubscribe: bool = False) -> bool:
+        return self.lib.vtpu_mem_acquire(self.handle, dev, nbytes,
+                                         1 if oversubscribe else 0) == 0
+
+    def mem_release(self, dev: int, nbytes: int) -> None:
+        self.lib.vtpu_mem_release(self.handle, dev, nbytes)
+
+    def mem_info(self, dev: int):
+        free = ctypes.c_uint64()
+        total = ctypes.c_uint64()
+        if self.lib.vtpu_mem_info(self.handle, dev, ctypes.byref(free),
+                                  ctypes.byref(total)) != 0:
+            raise OSError(f"vtpu_mem_info({dev}) failed")
+        return free.value, total.value
+
+    def device_stats(self, dev: int) -> DeviceStats:
+        out = DeviceStats()
+        if self.lib.vtpu_device_get_stats(self.handle, dev,
+                                          ctypes.byref(out)) != 0:
+            raise OSError(f"vtpu_device_get_stats({dev}) failed")
+        return out
+
+    def proc_stats(self) -> List[ProcStats]:
+        out = []
+        for slot in range(MAX_PROCS):
+            st = ProcStats()
+            if self.lib.vtpu_proc_get_stats(self.handle, slot,
+                                            ctypes.byref(st)) == 0:
+                out.append(st)
+        return out
+
+    # -- rate limiting --
+    def rate_acquire(self, dev: int, cost_us: int, priority: int = 1) -> int:
+        """0 = admitted; else nanoseconds to sleep before retry."""
+        return self.lib.vtpu_rate_acquire(self.handle, dev, cost_us,
+                                          priority)
+
+    def rate_block(self, dev: int, cost_us: int, priority: int = 1) -> None:
+        self.lib.vtpu_rate_block(self.handle, dev, cost_us, priority)
+
+    def rate_adjust(self, dev: int, delta_us: int) -> None:
+        self.lib.vtpu_rate_adjust(self.handle, dev, delta_us)
+
+    def set_core_limit(self, dev: int, pct: int) -> None:
+        self.lib.vtpu_set_core_limit(self.handle, dev, pct)
+
+    @property
+    def ndevices(self) -> int:
+        return self.lib.vtpu_region_ndevices(self.handle)

@@ -1,0 +1,281 @@
+/* A minimal in-memory PJRT backend for testing the vtpu interposer without
+ * TPU hardware — the "fake driver" seam of the native test strategy
+ * (SURVEY.md §4: the reference has no such thing; its interceptor is only
+ * testable against real CUDA).
+ *
+ * Implements just enough of the PJRT C API for the interposer's wrapped
+ * paths: client/device enumeration, host->device buffer creation with
+ * realistic on-device sizes, compile/execute (execute burns MOCK_EXEC_US
+ * microseconds of fake device time and produces one output buffer of
+ * MOCK_OUT_BYTES), completion events, and a MemoryStats that reports
+ * UNIMPLEMENTED like real libtpu does.
+ *
+ * Controlled by env: MOCK_PJRT_DEVICES (default 2), MOCK_EXEC_US (default
+ * 1000), MOCK_OUT_BYTES (default 1024).
+ */
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct MockError {
+  PJRT_Error_Code code;
+  std::string msg;
+};
+
+PJRT_Error* err(PJRT_Error_Code code, const char* msg) {
+  return reinterpret_cast<PJRT_Error*>(new MockError{code, msg});
+}
+
+struct MockDevice {
+  int id;
+};
+
+struct MockClient {
+  std::vector<MockDevice*> devices;
+  std::vector<PJRT_Device*> device_ptrs;
+};
+
+struct MockBuffer {
+  uint64_t bytes;
+  MockDevice* device;
+};
+
+struct MockExecutable {
+  int dummy;
+};
+
+struct MockEvent {
+  /* Mock executions are synchronous, so events are born ready. */
+  int ready;
+};
+
+uint64_t elem_bytes(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+    case PJRT_Buffer_Type_PRED:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+      return 8;
+    default:
+      return 4;
+  }
+}
+
+/* ---- errors ---- */
+
+void M_Error_Destroy(PJRT_Error_Destroy_Args* a) {
+  delete reinterpret_cast<MockError*>(a->error);
+}
+void M_Error_Message(PJRT_Error_Message_Args* a) {
+  auto* e = reinterpret_cast<MockError*>(const_cast<PJRT_Error*>(a->error));
+  a->message = e->msg.c_str();
+  a->message_size = e->msg.size();
+}
+PJRT_Error* M_Error_GetCode(PJRT_Error_GetCode_Args* a) {
+  a->code = reinterpret_cast<MockError*>(
+                const_cast<PJRT_Error*>(a->error))->code;
+  return nullptr;
+}
+
+/* ---- plugin ---- */
+
+PJRT_Error* M_Plugin_Initialize(PJRT_Plugin_Initialize_Args*) {
+  return nullptr;
+}
+PJRT_Error* M_Plugin_Attributes(PJRT_Plugin_Attributes_Args* a) {
+  a->attributes = nullptr;
+  a->num_attributes = 0;
+  return nullptr;
+}
+
+/* ---- client ---- */
+
+PJRT_Error* M_Client_Create(PJRT_Client_Create_Args* a) {
+  const char* n = getenv("MOCK_PJRT_DEVICES");
+  int nd = n ? atoi(n) : 2;
+  auto* c = new MockClient();
+  for (int i = 0; i < nd; i++) {
+    auto* d = new MockDevice{i};
+    c->devices.push_back(d);
+    c->device_ptrs.push_back(reinterpret_cast<PJRT_Device*>(d));
+  }
+  a->client = reinterpret_cast<PJRT_Client*>(c);
+  return nullptr;
+}
+
+PJRT_Error* M_Client_Destroy(PJRT_Client_Destroy_Args* a) {
+  auto* c = reinterpret_cast<MockClient*>(a->client);
+  for (auto* d : c->devices) delete d;
+  delete c;
+  return nullptr;
+}
+
+PJRT_Error* M_Client_Devices(PJRT_Client_Devices_Args* a) {
+  auto* c = reinterpret_cast<MockClient*>(a->client);
+  a->devices = c->device_ptrs.data();
+  a->num_devices = c->device_ptrs.size();
+  return nullptr;
+}
+
+PJRT_Error* M_Client_AddressableDevices(
+    PJRT_Client_AddressableDevices_Args* a) {
+  auto* c = reinterpret_cast<MockClient*>(a->client);
+  a->addressable_devices = c->device_ptrs.data();
+  a->num_addressable_devices = c->device_ptrs.size();
+  return nullptr;
+}
+
+PJRT_Error* M_Client_Compile(PJRT_Client_Compile_Args* a) {
+  a->executable = reinterpret_cast<PJRT_LoadedExecutable*>(
+      new MockExecutable{0});
+  return nullptr;
+}
+
+/* ---- buffers ---- */
+
+PJRT_Error* M_BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* a) {
+  uint64_t n = 1;
+  for (size_t i = 0; i < a->num_dims; i++) n *= (uint64_t)a->dims[i];
+  auto* b = new MockBuffer{n * elem_bytes(a->type),
+                           reinterpret_cast<MockDevice*>(a->device)};
+  a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  a->done_with_host_buffer =
+      reinterpret_cast<PJRT_Event*>(new MockEvent{1});
+  return nullptr;
+}
+
+PJRT_Error* M_Buffer_OnDeviceSizeInBytes(
+    PJRT_Buffer_OnDeviceSizeInBytes_Args* a) {
+  a->on_device_size_in_bytes =
+      reinterpret_cast<MockBuffer*>(a->buffer)->bytes;
+  return nullptr;
+}
+
+PJRT_Error* M_Buffer_Destroy(PJRT_Buffer_Destroy_Args* a) {
+  delete reinterpret_cast<MockBuffer*>(a->buffer);
+  return nullptr;
+}
+
+PJRT_Error* M_Buffer_Device(PJRT_Buffer_Device_Args* a) {
+  a->device = reinterpret_cast<PJRT_Device*>(
+      reinterpret_cast<MockBuffer*>(a->buffer)->device);
+  return nullptr;
+}
+
+/* ---- executables ---- */
+
+PJRT_Error* M_LoadedExecutable_GetExecutable(
+    PJRT_LoadedExecutable_GetExecutable_Args* a) {
+  a->executable = reinterpret_cast<PJRT_Executable*>(a->loaded_executable);
+  return nullptr;
+}
+
+PJRT_Error* M_Executable_NumOutputs(PJRT_Executable_NumOutputs_Args* a) {
+  a->num_outputs = 1;
+  return nullptr;
+}
+
+PJRT_Error* M_LoadedExecutable_Destroy(
+    PJRT_LoadedExecutable_Destroy_Args* a) {
+  delete reinterpret_cast<MockExecutable*>(a->executable);
+  return nullptr;
+}
+
+PJRT_Error* M_Execute(PJRT_LoadedExecutable_Execute_Args* a) {
+  const char* us = getenv("MOCK_EXEC_US");
+  long burn = us ? atol(us) : 1000;
+  struct timespec ts;
+  ts.tv_sec = burn / 1000000;
+  ts.tv_nsec = (burn % 1000000) * 1000;
+  nanosleep(&ts, nullptr);
+
+  const char* ob = getenv("MOCK_OUT_BYTES");
+  uint64_t out_bytes = ob ? strtoull(ob, nullptr, 10) : 1024;
+  if (a->output_lists) {
+    for (size_t d = 0; d < a->num_devices; d++) {
+      a->output_lists[d][0] = reinterpret_cast<PJRT_Buffer*>(
+          new MockBuffer{out_bytes, nullptr});
+    }
+  }
+  if (a->device_complete_events) {
+    for (size_t d = 0; d < a->num_devices; d++)
+      a->device_complete_events[d] =
+          reinterpret_cast<PJRT_Event*>(new MockEvent{1});
+  }
+  return nullptr;
+}
+
+/* ---- events ---- */
+
+PJRT_Error* M_Event_Destroy(PJRT_Event_Destroy_Args* a) {
+  delete reinterpret_cast<MockEvent*>(a->event);
+  return nullptr;
+}
+
+PJRT_Error* M_Event_OnReady(PJRT_Event_OnReady_Args* a) {
+  /* Synchronous backend: fire immediately. */
+  a->callback(nullptr, a->user_arg);
+  return nullptr;
+}
+
+/* ---- device ---- */
+
+PJRT_Error* M_Device_MemoryStats(PJRT_Device_MemoryStats_Args*) {
+  return err(PJRT_Error_Code_UNIMPLEMENTED,
+             "mock backend has no memory stats (like real libtpu)");
+}
+
+PJRT_Api make_api() {
+  PJRT_Api api;
+  memset(&api, 0, sizeof(api));
+  api.struct_size = sizeof(PJRT_Api);
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  api.PJRT_Error_Destroy = M_Error_Destroy;
+  api.PJRT_Error_Message = M_Error_Message;
+  api.PJRT_Error_GetCode = M_Error_GetCode;
+  api.PJRT_Plugin_Initialize = M_Plugin_Initialize;
+  api.PJRT_Plugin_Attributes = M_Plugin_Attributes;
+  api.PJRT_Client_Create = M_Client_Create;
+  api.PJRT_Client_Destroy = M_Client_Destroy;
+  api.PJRT_Client_Devices = M_Client_Devices;
+  api.PJRT_Client_AddressableDevices = M_Client_AddressableDevices;
+  api.PJRT_Client_Compile = M_Client_Compile;
+  api.PJRT_Client_BufferFromHostBuffer = M_BufferFromHostBuffer;
+  api.PJRT_Buffer_OnDeviceSizeInBytes = M_Buffer_OnDeviceSizeInBytes;
+  api.PJRT_Buffer_Destroy = M_Buffer_Destroy;
+  api.PJRT_Buffer_Device = M_Buffer_Device;
+  api.PJRT_LoadedExecutable_GetExecutable = M_LoadedExecutable_GetExecutable;
+  api.PJRT_Executable_NumOutputs = M_Executable_NumOutputs;
+  api.PJRT_LoadedExecutable_Destroy = M_LoadedExecutable_Destroy;
+  api.PJRT_LoadedExecutable_Execute = M_Execute;
+  api.PJRT_Event_Destroy = M_Event_Destroy;
+  api.PJRT_Event_OnReady = M_Event_OnReady;
+  api.PJRT_Device_MemoryStats = M_Device_MemoryStats;
+  return api;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static PJRT_Api api = make_api();
+  return &api;
+}

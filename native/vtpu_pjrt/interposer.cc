@@ -1,0 +1,644 @@
+/* libvtpu_pjrt — a PJRT wrapper plugin enforcing vTPU quotas.
+ *
+ * The TPU-native rebuild of the reference's LD_PRELOAD CUDA interceptor
+ * (reference vgpu/libvgpu.so).  CUDA interception needs dlsym hijack
+ * gymnastics (reference src/cuda/hook.c); PJRT has a sanctioned seam: the
+ * whole driver surface is one table of function pointers obtained via
+ * GetPjrtApi().  We export GetPjrtApi(), dlopen the *real* libtpu
+ * (VTPU_REAL_LIBTPU or default install locations), copy its table, and
+ * replace the entries where policy lives:
+ *
+ *   PJRT_Client_Create            -> attach shared accounting region (env)
+ *   PJRT_Client_BufferFromHostBuffer -> HBM quota check (OOM before alloc)
+ *   PJRT_Buffer_Destroy           -> release accounted bytes
+ *   PJRT_LoadedExecutable_Execute -> device-time token bucket + output
+ *                                    buffer accounting + latency metering
+ *   PJRT_Device_MemoryStats       -> quota-adjusted memory view (the
+ *                                    nvidia-smi-lying analogue, reference
+ *                                    nvmlDeviceGetMemoryInfo hook)
+ *   PJRT_Error_{Destroy,Message,GetCode} -> also service synthetic errors
+ *
+ * Injection channel: the device plugin sets TPU_LIBRARY_PATH to this .so in
+ * every allocated container (jax honors it: jax/_src/cloud_tpu_init.py), the
+ * analogue of the reference's /etc/ld.so.preload mount (server.go:511-515).
+ *
+ * Quota env contract: see vtpu/utils/envspec.py (producer: plugin server
+ * Allocate; the reference's CUDA_DEVICE_MEMORY_LIMIT_* family).
+ */
+#include <dlfcn.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cinttypes>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+#include "../vtpucore/vtpu_core.h"
+
+/* ------------------------------------------------------------------ */
+/* logging                                                            */
+/* ------------------------------------------------------------------ */
+
+static int log_level() {
+  static int lvl = -1;
+  if (lvl < 0) {
+    const char* s = getenv("VTPU_LOG_LEVEL");
+    lvl = s ? atoi(s) : 1;
+  }
+  return lvl;
+}
+
+#define VTPU_LOG(level, ...)                          \
+  do {                                                \
+    if (log_level() >= (level)) {                     \
+      fprintf(stderr, "[libvtpu] " __VA_ARGS__);      \
+      fputc('\n', stderr);                            \
+    }                                                 \
+  } while (0)
+
+/* ------------------------------------------------------------------ */
+/* state                                                              */
+/* ------------------------------------------------------------------ */
+
+static const PJRT_Api* g_real = nullptr;
+static PJRT_Api g_wrapped;
+
+static vtpu_region* g_region = nullptr;
+static int g_oversubscribe = 0;
+static int g_priority = 1;
+static int g_rate_disabled = 0;
+static uint64_t g_default_exec_cost_us = 5000;
+/* Floor on the per-execute charge.  Some transports complete the PJRT
+ * device event at enqueue rather than at true device completion (e.g.
+ * relayed/pipelined backends), which would train the EMA toward ~0 and
+ * disable throttling; the floor keeps the limiter meaningful as a
+ * dispatch-rate cap in that case. */
+static uint64_t g_min_exec_cost_us = 0;
+
+static std::mutex g_mu;
+struct BufInfo {
+  int dev;
+  uint64_t bytes;
+};
+static std::unordered_map<PJRT_Buffer*, BufInfo>& buf_map() {
+  static auto* m = new std::unordered_map<PJRT_Buffer*, BufInfo>();
+  return *m;
+}
+static std::unordered_map<PJRT_Device*, int>& dev_ord() {
+  static auto* m = new std::unordered_map<PJRT_Device*, int>();
+  return *m;
+}
+/* Per-executable device-time estimate (EMA of measured latencies). */
+static std::unordered_map<PJRT_LoadedExecutable*, double>& exe_cost() {
+  static auto* m = new std::unordered_map<PJRT_LoadedExecutable*, double>();
+  return *m;
+}
+static std::unordered_map<PJRT_LoadedExecutable*, size_t>& exe_nout() {
+  static auto* m = new std::unordered_map<PJRT_LoadedExecutable*, size_t>();
+  return *m;
+}
+
+static uint64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000ull + (uint64_t)ts.tv_nsec / 1000ull;
+}
+
+/* ------------------------------------------------------------------ */
+/* synthetic errors                                                   */
+/* ------------------------------------------------------------------ */
+
+#define VTPU_ERR_MAGIC 0x76455252u /* "vERR" */
+
+struct VtpuError {
+  uint32_t magic;
+  PJRT_Error_Code code;
+  std::string msg;
+};
+
+static PJRT_Error* make_error(PJRT_Error_Code code, const std::string& msg) {
+  auto* e = new VtpuError{VTPU_ERR_MAGIC, code, msg};
+  return reinterpret_cast<PJRT_Error*>(e);
+}
+
+static VtpuError* as_vtpu_error(const PJRT_Error* e) {
+  if (!e) return nullptr;
+  auto* v = reinterpret_cast<VtpuError*>(const_cast<PJRT_Error*>(e));
+  /* Heuristically safe: our errors start with the magic word; real PJRT
+   * errors are C++ objects whose first word is a vtable pointer (never a
+   * small constant). */
+  return v->magic == VTPU_ERR_MAGIC ? v : nullptr;
+}
+
+static void w_Error_Destroy(PJRT_Error_Destroy_Args* args) {
+  if (VtpuError* v = as_vtpu_error(args->error)) {
+    delete v;
+    return;
+  }
+  g_real->PJRT_Error_Destroy(args);
+}
+
+static void w_Error_Message(PJRT_Error_Message_Args* args) {
+  if (VtpuError* v = as_vtpu_error(args->error)) {
+    args->message = v->msg.c_str();
+    args->message_size = v->msg.size();
+    return;
+  }
+  g_real->PJRT_Error_Message(args);
+}
+
+static PJRT_Error* w_Error_GetCode(PJRT_Error_GetCode_Args* args) {
+  if (VtpuError* v = as_vtpu_error(args->error)) {
+    args->code = v->code;
+    return nullptr;
+  }
+  return g_real->PJRT_Error_GetCode(args);
+}
+
+/* ------------------------------------------------------------------ */
+/* env parsing (mirrors vtpu/utils/envspec.py parse_quantity)          */
+/* ------------------------------------------------------------------ */
+
+static int64_t parse_quantity(const char* s) {
+  if (!s || !*s) return -1;
+  char* end = nullptr;
+  double v = strtod(s, &end);
+  if (end == s) return -1;
+  while (*end == ' ') end++;
+  uint64_t mult = 1;
+  if (*end) {
+    char c = *end | 0x20; /* lowercase */
+    int binary = (end[1] == 'i' || end[1] == 'I');
+    switch (c) {
+      case 'k': mult = binary ? (1ull << 10) : 1000ull; break;
+      case 'm': mult = binary ? (1ull << 20) : 1000000ull; break;
+      case 'g': mult = binary ? (1ull << 30) : 1000000000ull; break;
+      case 't': mult = binary ? (1ull << 40) : 1000000000000ull; break;
+      case 'b': mult = 1; break;
+      default: return -1;
+    }
+  }
+  return (int64_t)(v * (double)mult);
+}
+
+/* ------------------------------------------------------------------ */
+/* element sizes                                                      */
+/* ------------------------------------------------------------------ */
+
+static uint64_t elem_bits(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+    case PJRT_Buffer_Type_F8E5M2:
+    case PJRT_Buffer_Type_F8E4M3FN:
+    case PJRT_Buffer_Type_F8E4M3B11FNUZ:
+    case PJRT_Buffer_Type_F8E5M2FNUZ:
+    case PJRT_Buffer_Type_F8E4M3FNUZ:
+      return 8;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 16;
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+    case PJRT_Buffer_Type_F32:
+      return 32;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_C64:
+      return 64;
+    case PJRT_Buffer_Type_C128:
+      return 128;
+    case PJRT_Buffer_Type_S4:
+    case PJRT_Buffer_Type_U4:
+      return 4;
+    default:
+      return 8; /* conservative floor for exotic/token types */
+  }
+}
+
+static uint64_t estimate_bytes(PJRT_Buffer_Type type, const int64_t* dims,
+                               size_t num_dims) {
+  uint64_t n = 1;
+  for (size_t i = 0; i < num_dims; i++)
+    n *= (dims[i] > 0 ? (uint64_t)dims[i] : 0);
+  return (n * elem_bits(type) + 7) / 8;
+}
+
+/* ------------------------------------------------------------------ */
+/* region bootstrap                                                   */
+/* ------------------------------------------------------------------ */
+
+static int ordinal_of(PJRT_Device* d) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = dev_ord().find(d);
+  return it == dev_ord().end() ? 0 : it->second;
+}
+
+static void init_region_for_client(PJRT_Client* client) {
+  /* Enumerate addressable devices through the real API to build the
+   * ordinal map (container ordinal = position in the addressable list,
+   * matching VTPU_DEVICE_MAP order from the daemon). */
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = client;
+  if (PJRT_Error* err = g_real->PJRT_Client_AddressableDevices(&da)) {
+    PJRT_Error_Destroy_Args dd;
+    memset(&dd, 0, sizeof(dd));
+    dd.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    dd.error = err;
+    g_real->PJRT_Error_Destroy(&dd);
+    VTPU_LOG(0, "cannot enumerate devices; quotas disabled");
+    return;
+  }
+  int n = (int)da.num_addressable_devices;
+  if (n > VTPU_MAX_DEVICES) n = VTPU_MAX_DEVICES;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    for (int i = 0; i < n; i++) dev_ord()[da.addressable_devices[i]] = i;
+  }
+
+  if (g_region != nullptr) {
+    /* Region already attached (multi-client process): only the ordinal
+     * map refresh above was needed. */
+    return;
+  }
+  const char* cache = getenv("VTPU_DEVICE_MEMORY_SHARED_CACHE");
+  std::string path = cache && *cache ? cache : "/tmp/vtpushr.cache";
+
+  /* Per-ordinal HBM limits: VTPU_DEVICE_HBM_LIMIT_<i>, with the unsuffixed
+   * form as the default for all ordinals. */
+  uint64_t limits[VTPU_MAX_DEVICES];
+  int32_t pcts[VTPU_MAX_DEVICES];
+  int64_t def = parse_quantity(getenv("VTPU_DEVICE_HBM_LIMIT"));
+  const char* pct_s = getenv("VTPU_DEVICE_CORE_LIMIT");
+  int32_t pct = pct_s ? atoi(pct_s) : 0;
+  const char* policy = getenv("VTPU_CORE_UTILIZATION_POLICY");
+  if (policy && strcmp(policy, "DISABLE") == 0) g_rate_disabled = 1;
+  int any_limit = 0;
+  for (int i = 0; i < n; i++) {
+    char key[64];
+    snprintf(key, sizeof(key), "VTPU_DEVICE_HBM_LIMIT_%d", i);
+    int64_t v = parse_quantity(getenv(key));
+    limits[i] = v > 0 ? (uint64_t)v : (def > 0 ? (uint64_t)def : 0);
+    pcts[i] = pct;
+    if (limits[i] || pcts[i]) any_limit = 1;
+  }
+  const char* over = getenv("VTPU_OVERSUBSCRIBE");
+  g_oversubscribe = over && (strcmp(over, "true") == 0 ||
+                             strcmp(over, "1") == 0);
+  const char* prio = getenv("VTPU_TASK_PRIORITY");
+  if (prio) g_priority = atoi(prio);
+  const char* cost = getenv("VTPU_EXEC_COST_US");
+  if (cost) g_default_exec_cost_us = strtoull(cost, nullptr, 10);
+  const char* mincost = getenv("VTPU_MIN_EXEC_COST_US");
+  if (mincost) g_min_exec_cost_us = strtoull(mincost, nullptr, 10);
+
+  if (!any_limit) {
+    VTPU_LOG(3, "no quota env present; running unrestricted");
+    return;
+  }
+  g_region = vtpu_region_open(path.c_str(), n, limits, pcts);
+  if (!g_region) {
+    VTPU_LOG(0, "failed to open shared region %s; quotas disabled",
+             path.c_str());
+    return;
+  }
+  const char* host_pid = getenv("VTPU_HOST_PID");
+  vtpu_proc_register(g_region, host_pid ? atoi(host_pid) : 0);
+  VTPU_LOG(3, "attached region %s (%d devices, limit[0]=%" PRIu64
+           ", core=%d%%)", path.c_str(), n, limits[0], (int)pct);
+}
+
+/* ------------------------------------------------------------------ */
+/* wrapped entry points                                               */
+/* ------------------------------------------------------------------ */
+
+static PJRT_Error* w_Client_Create(PJRT_Client_Create_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Client_Create(args);
+  if (err == nullptr) {
+    if (g_region != nullptr) {
+      /* Second client in one process (or create-destroy-create): keep the
+       * existing region, refresh the device->ordinal map and our slot. */
+      std::lock_guard<std::mutex> lk(g_mu);
+      dev_ord().clear();
+    }
+    init_region_for_client(args->client);
+  }
+  return err;
+}
+
+static PJRT_Error* w_Client_Destroy(PJRT_Client_Destroy_Args* args) {
+  /* Keep the proc slot: live buffers of other clients (and the process
+   * itself) remain accountable; the slot drops at exit or via sweep. */
+  return g_real->PJRT_Client_Destroy(args);
+}
+
+static PJRT_Error* w_BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  if (!g_region) return g_real->PJRT_Client_BufferFromHostBuffer(args);
+
+  int dev = args->device ? ordinal_of(args->device) : 0;
+  uint64_t est = estimate_bytes(args->type, args->dims, args->num_dims);
+
+  if (vtpu_mem_acquire(g_region, dev, est, g_oversubscribe) != 0) {
+    uint64_t freeb = 0, total = 0;
+    vtpu_mem_info(g_region, dev, &freeb, &total);
+    char msg[160];
+    snprintf(msg, sizeof(msg),
+             "vTPU device %d OOM: requested %" PRIu64 " bytes, quota %"
+             PRIu64 " (free %" PRIu64 ")", dev, est, total, freeb);
+    VTPU_LOG(1, "%s", msg);
+    return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
+  }
+
+  PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
+  if (err != nullptr) {
+    vtpu_mem_release(g_region, dev, est);
+    return err;
+  }
+
+  /* Correct the estimate to the device's actual (tiled/padded) size. */
+  uint64_t actual = est;
+  PJRT_Buffer_OnDeviceSizeInBytes_Args sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  sa.buffer = args->buffer;
+  if (g_real->PJRT_Buffer_OnDeviceSizeInBytes(&sa) == nullptr &&
+      sa.on_device_size_in_bytes > 0) {
+    actual = sa.on_device_size_in_bytes;
+    if (actual > est)
+      vtpu_mem_acquire(g_region, dev, actual - est, /*oversubscribe=*/1);
+    else if (actual < est)
+      vtpu_mem_release(g_region, dev, est - actual);
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    buf_map()[args->buffer] = BufInfo{dev, actual};
+  }
+  return nullptr;
+}
+
+static void account_buffer(PJRT_Buffer* buf, int dev) {
+  PJRT_Buffer_OnDeviceSizeInBytes_Args sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  sa.buffer = buf;
+  uint64_t bytes = 0;
+  if (g_real->PJRT_Buffer_OnDeviceSizeInBytes(&sa) == nullptr)
+    bytes = sa.on_device_size_in_bytes;
+  if (bytes == 0) return;
+  /* Outputs of an already-running program can't be refused; account with
+   * oversubscribe so usage is visible and later allocations hit the cap. */
+  vtpu_mem_acquire(g_region, dev, bytes, /*oversubscribe=*/1);
+  std::lock_guard<std::mutex> lk(g_mu);
+  buf_map()[buf] = BufInfo{dev, bytes};
+}
+
+static PJRT_Error* w_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
+  if (g_region) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = buf_map().find(args->buffer);
+    if (it != buf_map().end()) {
+      vtpu_mem_release(g_region, it->second.dev, it->second.bytes);
+      buf_map().erase(it);
+    }
+  }
+  return g_real->PJRT_Buffer_Destroy(args);
+}
+
+/* Latency metering context for one execute. */
+struct ExecMeter {
+  uint64_t t0_us;
+  uint64_t est_us;
+  int dev;
+  PJRT_LoadedExecutable* exe;
+};
+
+static void on_exec_done(PJRT_Error* error, void* user_arg) {
+  ExecMeter* m = (ExecMeter*)user_arg;
+  uint64_t actual = now_us() - m->t0_us;
+  if (g_region) {
+    /* The floor also applies to the correction, else an optimistic
+     * completion event would credit the floor charge straight back. */
+    uint64_t charged = actual > g_min_exec_cost_us ? actual
+                                                   : g_min_exec_cost_us;
+    vtpu_rate_adjust(g_region, m->dev,
+                     (int64_t)charged - (int64_t)m->est_us);
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    double& ema = exe_cost()[m->exe];
+    ema = ema <= 0 ? (double)actual : ema * 0.7 + (double)actual * 0.3;
+  }
+  if (error) {
+    PJRT_Error_Destroy_Args dd;
+    memset(&dd, 0, sizeof(dd));
+    dd.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    dd.error = error;
+    g_wrapped.PJRT_Error_Destroy(&dd);
+  }
+  delete m;
+}
+
+static size_t num_outputs_of(PJRT_LoadedExecutable* lexe) {
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = exe_nout().find(lexe);
+    if (it != exe_nout().end()) return it->second;
+  }
+  PJRT_LoadedExecutable_GetExecutable_Args ga;
+  memset(&ga, 0, sizeof(ga));
+  ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ga.loaded_executable = lexe;
+  if (g_real->PJRT_LoadedExecutable_GetExecutable(&ga) != nullptr) return 0;
+  PJRT_Executable_NumOutputs_Args na;
+  memset(&na, 0, sizeof(na));
+  na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  na.executable = ga.executable;
+  size_t n = 0;
+  if (g_real->PJRT_Executable_NumOutputs(&na) == nullptr) n = na.num_outputs;
+  std::lock_guard<std::mutex> lk(g_mu);
+  exe_nout()[lexe] = n;
+  return n;
+}
+
+static PJRT_Error* w_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  if (!g_region || g_rate_disabled)
+    return g_real->PJRT_LoadedExecutable_Execute(args);
+
+  int dev = args->execute_device ? ordinal_of(args->execute_device) : 0;
+  uint64_t est;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    double ema = exe_cost()[args->executable];
+    est = ema > 0 ? (uint64_t)ema : g_default_exec_cost_us;
+    if (est < g_min_exec_cost_us) est = g_min_exec_cost_us;
+  }
+
+  /* Gate on the device-time bucket (reference rate_limiter gating
+   * cuLaunchKernel).  Charged up front, corrected on completion. */
+  VTPU_LOG(4, "execute gate: dev=%d est=%" PRIu64 "us", dev, est);
+  vtpu_rate_block(g_region, dev, est, g_priority);
+
+  uint64_t t0 = now_us();
+  PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
+  if (err != nullptr) return err;
+
+  /* Account output buffers (they occupy HBM until destroyed). */
+  size_t nout = num_outputs_of(args->executable);
+  if (args->output_lists && nout > 0) {
+    for (size_t d = 0; d < args->num_devices; d++) {
+      int odev = args->execute_device ? dev : (int)d;
+      for (size_t o = 0; o < nout; o++) {
+        PJRT_Buffer* b = args->output_lists[d][o];
+        if (b) account_buffer(b, odev);
+      }
+    }
+  }
+
+  /* Meter real device time via the completion event when available. */
+  if (args->device_complete_events && args->num_devices > 0 &&
+      args->device_complete_events[0]) {
+    auto* m = new ExecMeter{t0, est, dev, args->executable};
+    PJRT_Event_OnReady_Args oa;
+    memset(&oa, 0, sizeof(oa));
+    oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+    oa.event = args->device_complete_events[0];
+    oa.callback = on_exec_done;
+    oa.user_arg = m;
+    if (PJRT_Error* oerr = g_real->PJRT_Event_OnReady(&oa)) {
+      PJRT_Error_Destroy_Args dd;
+      memset(&dd, 0, sizeof(dd));
+      dd.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      dd.error = oerr;
+      g_real->PJRT_Error_Destroy(&dd);
+      delete m;
+    }
+  }
+  return nullptr;
+}
+
+static PJRT_Error* w_LoadedExecutable_Destroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  /* Drop cached cost/num-output entries so a reallocated executable
+   * pointer cannot inherit stale values (and the maps stay bounded). */
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    exe_cost().erase(args->executable);
+    exe_nout().erase(args->executable);
+  }
+  return g_real->PJRT_LoadedExecutable_Destroy(args);
+}
+
+static PJRT_Error* w_Device_MemoryStats(PJRT_Device_MemoryStats_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Device_MemoryStats(args);
+  if (!g_region) return err;
+  int dev = ordinal_of(args->device);
+  vtpu_device_stats st;
+  if (vtpu_device_get_stats(g_region, dev, &st) != 0 || st.limit_bytes == 0)
+    return err;
+  if (err != nullptr) {
+    /* Real backend has no stats (TPU memory_stats is often absent) — we
+     * still present the quota view. */
+    PJRT_Error_Destroy_Args dd;
+    memset(&dd, 0, sizeof(dd));
+    dd.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    dd.error = err;
+    g_real->PJRT_Error_Destroy(&dd);
+    memset((char*)args + offsetof(PJRT_Device_MemoryStats_Args, bytes_in_use),
+           0, args->struct_size -
+              offsetof(PJRT_Device_MemoryStats_Args, bytes_in_use));
+  }
+  args->bytes_in_use = (int64_t)st.used_bytes;
+  args->peak_bytes_in_use = (int64_t)st.peak_bytes;
+  args->peak_bytes_in_use_is_set = true;
+  args->bytes_limit = (int64_t)st.limit_bytes;
+  args->bytes_limit_is_set = true;
+  return nullptr;
+}
+
+/* ------------------------------------------------------------------ */
+/* bootstrap                                                          */
+/* ------------------------------------------------------------------ */
+
+static const char* const kRealPaths[] = {
+    "/usr/local/vtpu/libtpu_real.so",
+    "/opt/venv/lib/python3.12/site-packages/libtpu/libtpu.so",
+    "/usr/lib/python3/dist-packages/libtpu/libtpu.so",
+    "/lib/libtpu.so",
+    "/usr/lib/libtpu.so",
+};
+
+static void init_once() {
+  const char* path = getenv("VTPU_REAL_LIBTPU");
+  void* h = nullptr;
+  if (path && *path) {
+    h = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+    if (!h) VTPU_LOG(0, "dlopen(%s): %s", path, dlerror());
+  } else {
+    for (const char* p : kRealPaths) {
+      if (access(p, R_OK) == 0) {
+        h = dlopen(p, RTLD_NOW | RTLD_LOCAL);
+        if (h) {
+          path = p;
+          break;
+        }
+        VTPU_LOG(0, "dlopen(%s): %s", p, dlerror());
+      }
+    }
+  }
+  if (!h) {
+    VTPU_LOG(0, "real libtpu not found (set VTPU_REAL_LIBTPU)");
+    return;
+  }
+  auto get = (const PJRT_Api* (*)())dlsym(h, "GetPjrtApi");
+  if (!get) {
+    VTPU_LOG(0, "GetPjrtApi missing in %s", path);
+    return;
+  }
+  g_real = get();
+  if (!g_real) return;
+
+  /* Copy the real table, then splice in policy.  The PJRT_Api struct is
+   * append-only (pjrt_c_api.h ABI rules), so copying struct_size bytes and
+   * keeping the real struct_size preserves compatibility with whatever
+   * minor version the real libtpu implements. */
+  memset(&g_wrapped, 0, sizeof(g_wrapped));
+  size_t sz = g_real->struct_size < sizeof(PJRT_Api) ? g_real->struct_size
+                                                     : sizeof(PJRT_Api);
+  memcpy(&g_wrapped, g_real, sz);
+
+  g_wrapped.PJRT_Error_Destroy = w_Error_Destroy;
+  g_wrapped.PJRT_Error_Message = w_Error_Message;
+  g_wrapped.PJRT_Error_GetCode = w_Error_GetCode;
+  g_wrapped.PJRT_Client_Create = w_Client_Create;
+  g_wrapped.PJRT_Client_Destroy = w_Client_Destroy;
+  g_wrapped.PJRT_Client_BufferFromHostBuffer = w_BufferFromHostBuffer;
+  g_wrapped.PJRT_Buffer_Destroy = w_Buffer_Destroy;
+  g_wrapped.PJRT_LoadedExecutable_Execute = w_Execute;
+  g_wrapped.PJRT_LoadedExecutable_Destroy = w_LoadedExecutable_Destroy;
+  g_wrapped.PJRT_Device_MemoryStats = w_Device_MemoryStats;
+
+  VTPU_LOG(3, "wrapping real PJRT api v%d.%d from %s",
+           g_real->pjrt_api_version.major_version,
+           g_real->pjrt_api_version.minor_version, path);
+}
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static std::once_flag once;
+  std::call_once(once, init_once);
+  return g_real ? &g_wrapped : nullptr;
+}

@@ -1,0 +1,481 @@
+/* vtpucore implementation — see vtpu_core.h for the design contract.
+ *
+ * Shared-memory layout notes:
+ *  - The backing file is created with a magic+version header and a robust
+ *    process-shared mutex.  First-creator initialisation is serialised by
+ *    an flock on the file so two racing openers cannot both initialise
+ *    (the reference serialises with sem_open + retries; flock is simpler
+ *    and cannot leak named semaphores).
+ *  - All mutation happens under the robust mutex; if a holder dies the
+ *    next locker gets EOWNERDEAD, marks the state consistent, and runs a
+ *    dead-process sweep (replacing the reference's fix_lock_shrreg
+ *    timeout heuristic).
+ */
+#include "vtpu_core.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/file.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#define VTPU_MAGIC 0x76545055u /* "vTPU" */
+#define VTPU_VERSION 1u
+
+/* Burst cap for the token bucket: how much device time may be "saved up".
+ * 250ms keeps bursts short enough that a co-tenant is never starved for
+ * longer than a human-noticeable beat, while letting XLA program latencies
+ * (~ms) through without quantisation. */
+static const int64_t kBurstCapUs = 250 * 1000;
+
+typedef struct {
+  pid_t pid;
+  pid_t host_pid;
+  int32_t active;
+  /* PID-namespace identity (inode of /proc/self/ns/pid) of the slot
+   * owner: a co-tenant in another container cannot judge this slot's
+   * liveness by kill(pid, 0) — its namespace may not contain the pid, or
+   * the number may name an unrelated process. */
+  uint64_t ns_id;
+  uint64_t used_bytes[VTPU_MAX_DEVICES];
+  uint64_t last_seen_ns;
+} ProcSlot;
+
+typedef struct {
+  uint64_t limit_bytes;
+  uint64_t used_bytes;
+  uint64_t peak_bytes;
+  int32_t core_limit_pct;
+  int32_t pad_;
+  /* token bucket (device-time microseconds) */
+  int64_t tokens_us;
+  uint64_t last_refill_ns;
+} DeviceState;
+
+typedef struct {
+  uint32_t magic;
+  uint32_t version;
+  uint32_t initialized;
+  int32_t ndevices;
+  pthread_mutex_t mu;
+  DeviceState dev[VTPU_MAX_DEVICES];
+  ProcSlot proc[VTPU_MAX_PROCS];
+} Region;
+
+struct vtpu_region {
+  Region* shm;
+  int fd;
+  int my_slot;
+};
+
+static uint64_t now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+/* Lock with robust-mutex recovery: on EOWNERDEAD adopt the state and sweep
+ * the dead owner's slot. */
+static int lock_region(Region* g) {
+  int rc = pthread_mutex_lock(&g->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&g->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+static void unlock_region(Region* g) { pthread_mutex_unlock(&g->mu); }
+
+static int proc_alive(pid_t pid) {
+  if (pid <= 0) return 0;
+  return kill(pid, 0) == 0 || errno != ESRCH;
+}
+
+static uint64_t my_ns_id(void) {
+  static uint64_t cached = 0;
+  if (cached == 0) {
+    struct stat st;
+    cached = (stat("/proc/self/ns/pid", &st) == 0) ? (uint64_t)st.st_ino : 1;
+  }
+  return cached;
+}
+
+/* Sweep under lock: reclaim usage of dead processes (reference
+ * rm_quitted_process / proc_alive).  host_mode sweeps by host_pid across
+ * namespaces (node monitor only); otherwise only same-namespace slots are
+ * judged — a foreign container's pids are not visible/meaningful here. */
+static int sweep_locked(Region* g, int host_mode) {
+  int reclaimed = 0;
+  for (int s = 0; s < VTPU_MAX_PROCS; s++) {
+    ProcSlot* p = &g->proc[s];
+    if (!p->active) continue;
+    if (host_mode) {
+      if (proc_alive(p->host_pid)) continue;
+    } else {
+      if (p->ns_id != my_ns_id() || proc_alive(p->pid)) continue;
+    }
+    for (int d = 0; d < g->ndevices && d < VTPU_MAX_DEVICES; d++) {
+      uint64_t u = p->used_bytes[d];
+      if (u > g->dev[d].used_bytes)
+        g->dev[d].used_bytes = 0; /* never underflow */
+      else
+        g->dev[d].used_bytes -= u;
+      p->used_bytes[d] = 0;
+    }
+    p->active = 0;
+    p->pid = 0;
+    p->host_pid = 0;
+    reclaimed++;
+  }
+  return reclaimed;
+}
+
+vtpu_region* vtpu_region_open(const char* path, int ndevices,
+                              const uint64_t* limit_bytes,
+                              const int32_t* core_limit_pct) {
+  if (ndevices < 0 || ndevices > VTPU_MAX_DEVICES) {
+    errno = EINVAL;
+    return NULL;
+  }
+  int fd = open(path, O_RDWR | O_CREAT, 0666);
+  if (fd < 0) return NULL;
+
+  /* Serialise first-time init. */
+  if (flock(fd, LOCK_EX) != 0) {
+    close(fd);
+    return NULL;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  int fresh = st.st_size < (off_t)sizeof(Region);
+  if (fresh && ftruncate(fd, sizeof(Region)) != 0) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  Region* g = (Region*)mmap(NULL, sizeof(Region), PROT_READ | PROT_WRITE,
+                            MAP_SHARED, fd, 0);
+  if (g == MAP_FAILED) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  if (fresh || g->magic != VTPU_MAGIC || !g->initialized) {
+    memset(g, 0, sizeof(Region));
+    pthread_mutexattr_t at;
+    pthread_mutexattr_init(&at);
+    pthread_mutexattr_setpshared(&at, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&at, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&g->mu, &at);
+    pthread_mutexattr_destroy(&at);
+    g->ndevices = ndevices;
+    for (int d = 0; d < ndevices; d++) {
+      g->dev[d].limit_bytes = limit_bytes ? limit_bytes[d] : 0;
+      g->dev[d].core_limit_pct = core_limit_pct ? core_limit_pct[d] : 0;
+      g->dev[d].tokens_us = kBurstCapUs;
+      g->dev[d].last_refill_ns = now_ns();
+    }
+    g->magic = VTPU_MAGIC;
+    g->version = VTPU_VERSION;
+    __sync_synchronize();
+    g->initialized = 1;
+  } else if (g->version != VTPU_VERSION) {
+    flock(fd, LOCK_UN);
+    munmap(g, sizeof(Region));
+    close(fd);
+    errno = EPROTO;
+    return NULL;
+  }
+  flock(fd, LOCK_UN);
+
+  vtpu_region* r = (vtpu_region*)calloc(1, sizeof(vtpu_region));
+  if (!r) {
+    munmap(g, sizeof(Region));
+    close(fd);
+    return NULL;
+  }
+  r->shm = g;
+  r->fd = fd;
+  r->my_slot = -1;
+  return r;
+}
+
+void vtpu_region_close(vtpu_region* r) {
+  if (!r) return;
+  munmap(r->shm, sizeof(Region));
+  close(r->fd);
+  free(r);
+}
+
+int vtpu_proc_register(vtpu_region* r, pid_t host_pid) {
+  Region* g = r->shm;
+  pid_t me = getpid();
+  if (lock_region(g) != 0) return -1;
+  sweep_locked(g, 0);
+  int slot = -1;
+  for (int s = 0; s < VTPU_MAX_PROCS; s++) {
+    if (g->proc[s].active && g->proc[s].pid == me) {
+      slot = s; /* idempotent */
+      break;
+    }
+  }
+  if (slot < 0) {
+    for (int s = 0; s < VTPU_MAX_PROCS; s++) {
+      if (!g->proc[s].active) {
+        slot = s;
+        memset(&g->proc[s], 0, sizeof(ProcSlot));
+        g->proc[s].pid = me;
+        g->proc[s].host_pid = host_pid > 0 ? host_pid : me;
+        g->proc[s].ns_id = my_ns_id();
+        g->proc[s].active = 1;
+        break;
+      }
+    }
+  }
+  if (slot >= 0) g->proc[slot].last_seen_ns = now_ns();
+  unlock_region(g);
+  r->my_slot = slot;
+  return slot;
+}
+
+void vtpu_proc_deregister(vtpu_region* r) {
+  Region* g = r->shm;
+  if (r->my_slot < 0) return;
+  if (lock_region(g) != 0) return;
+  ProcSlot* p = &g->proc[r->my_slot];
+  if (p->active && p->pid == getpid()) {
+    for (int d = 0; d < g->ndevices; d++) {
+      uint64_t u = p->used_bytes[d];
+      g->dev[d].used_bytes = u > g->dev[d].used_bytes
+                                 ? 0
+                                 : g->dev[d].used_bytes - u;
+      p->used_bytes[d] = 0;
+    }
+    p->active = 0;
+    p->pid = 0;
+  }
+  unlock_region(g);
+  r->my_slot = -1;
+}
+
+int vtpu_sweep_dead(vtpu_region* r) {
+  Region* g = r->shm;
+  if (lock_region(g) != 0) return 0;
+  int n = sweep_locked(g, 0);
+  unlock_region(g);
+  return n;
+}
+
+int vtpu_sweep_dead_host(vtpu_region* r) {
+  Region* g = r->shm;
+  if (lock_region(g) != 0) return 0;
+  int n = sweep_locked(g, 1);
+  unlock_region(g);
+  return n;
+}
+
+static ProcSlot* my_slot_locked(vtpu_region* r, Region* g) {
+  if (r->my_slot >= 0 && g->proc[r->my_slot].active &&
+      g->proc[r->my_slot].pid == getpid())
+    return &g->proc[r->my_slot];
+  return NULL;
+}
+
+int vtpu_mem_acquire(vtpu_region* r, int dev, uint64_t bytes,
+                     int oversubscribe) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) {
+    errno = EINVAL;
+    return -1;
+  }
+  if (lock_region(g) != 0) return -1;
+  DeviceState* ds = &g->dev[dev];
+  if (ds->limit_bytes > 0 && !oversubscribe &&
+      ds->used_bytes + bytes > ds->limit_bytes) {
+    /* Opportunistic sweep, then re-check: a freshly-dead co-tenant may be
+     * holding the quota. */
+    sweep_locked(g, 0);
+    if (ds->used_bytes + bytes > ds->limit_bytes) {
+      uint64_t used = ds->used_bytes, lim = ds->limit_bytes;
+      unlock_region(g);
+      fprintf(stderr, "[vtpucore] device %d OOM: requested %llu, used %llu"
+              " / limit %llu\n", dev, (unsigned long long)bytes,
+              (unsigned long long)used, (unsigned long long)lim);
+      errno = ENOMEM;
+      return -1;
+    }
+  }
+  ds->used_bytes += bytes;
+  if (ds->used_bytes > ds->peak_bytes) ds->peak_bytes = ds->used_bytes;
+  ProcSlot* p = my_slot_locked(r, g);
+  if (p) {
+    p->used_bytes[dev] += bytes;
+    p->last_seen_ns = now_ns();
+  }
+  unlock_region(g);
+  return 0;
+}
+
+void vtpu_mem_release(vtpu_region* r, int dev, uint64_t bytes) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) return;
+  if (lock_region(g) != 0) return;
+  DeviceState* ds = &g->dev[dev];
+  ds->used_bytes = bytes > ds->used_bytes ? 0 : ds->used_bytes - bytes;
+  ProcSlot* p = my_slot_locked(r, g);
+  if (p)
+    p->used_bytes[dev] =
+        bytes > p->used_bytes[dev] ? 0 : p->used_bytes[dev] - bytes;
+  unlock_region(g);
+}
+
+int vtpu_mem_info(vtpu_region* r, int dev, uint64_t* free_bytes,
+                  uint64_t* total_bytes) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) {
+    errno = EINVAL;
+    return -1;
+  }
+  if (lock_region(g) != 0) return -1;
+  DeviceState* ds = &g->dev[dev];
+  uint64_t total = ds->limit_bytes;
+  uint64_t used = ds->used_bytes;
+  unlock_region(g);
+  if (total_bytes) *total_bytes = total;
+  if (free_bytes) *free_bytes = used > total ? 0 : total - used;
+  return 0;
+}
+
+int vtpu_device_get_stats(vtpu_region* r, int dev, vtpu_device_stats* out) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices || !out) {
+    errno = EINVAL;
+    return -1;
+  }
+  if (lock_region(g) != 0) return -1;
+  DeviceState* ds = &g->dev[dev];
+  out->limit_bytes = ds->limit_bytes;
+  out->used_bytes = ds->used_bytes;
+  out->peak_bytes = ds->peak_bytes;
+  out->core_limit_pct = ds->core_limit_pct;
+  int n = 0;
+  for (int s = 0; s < VTPU_MAX_PROCS; s++)
+    if (g->proc[s].active && g->proc[s].used_bytes[dev] > 0) n++;
+  out->n_procs = n;
+  unlock_region(g);
+  return 0;
+}
+
+int vtpu_proc_get_stats(vtpu_region* r, int slot, vtpu_proc_stats* out) {
+  Region* g = r->shm;
+  if (slot < 0 || slot >= VTPU_MAX_PROCS || !out) {
+    errno = EINVAL;
+    return -1;
+  }
+  if (lock_region(g) != 0) return -1;
+  ProcSlot* p = &g->proc[slot];
+  int active = p->active;
+  if (active) {
+    out->pid = p->pid;
+    out->host_pid = p->host_pid;
+    memcpy(out->used_bytes, p->used_bytes, sizeof(out->used_bytes));
+  }
+  unlock_region(g);
+  return active ? 0 : -1;
+}
+
+/* ---- rate limiting ------------------------------------------------------ */
+
+static void refill_locked(DeviceState* ds, uint64_t t) {
+  if (ds->last_refill_ns == 0) ds->last_refill_ns = t;
+  uint64_t elapsed_ns = t - ds->last_refill_ns;
+  ds->last_refill_ns = t;
+  /* pct% of wall time accrues as device-time budget. */
+  int64_t gained_us =
+      (int64_t)(elapsed_ns / 1000ull) * ds->core_limit_pct / 100;
+  ds->tokens_us += gained_us;
+  if (ds->tokens_us > kBurstCapUs) ds->tokens_us = kBurstCapUs;
+}
+
+uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
+                           int priority) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) return 0;
+  if (lock_region(g) != 0) return 0;
+  DeviceState* ds = &g->dev[dev];
+  int32_t pct = ds->core_limit_pct;
+  if (pct <= 0 || pct >= 100) {
+    unlock_region(g);
+    return 0;
+  }
+  refill_locked(ds, now_ns());
+  uint64_t wait_ns = 0;
+  /* A cost larger than the burst cap could never be admitted by a
+   * tokens >= cost test (tokens are clamped at the cap); admit it once
+   * the bucket is full and let it run deeply negative — later acquires
+   * then wait while the debt is paid back, which keeps the long-run
+   * average at the cap. */
+  int64_t need = (int64_t)cost_us < kBurstCapUs ? (int64_t)cost_us
+                                                : kBurstCapUs;
+  if (priority <= 0 || ds->tokens_us >= need) {
+    /* High-priority tasks may borrow (run the bucket negative); they still
+     * consume, so background tenants pay it back later. */
+    ds->tokens_us -= (int64_t)cost_us;
+  } else {
+    int64_t deficit_us = need - ds->tokens_us;
+    wait_ns = (uint64_t)deficit_us * 1000ull * 100ull / (uint64_t)pct;
+    /* Cap a single sleep so limit changes are picked up promptly. */
+    if (wait_ns > 50ull * 1000 * 1000) wait_ns = 50ull * 1000 * 1000;
+  }
+  unlock_region(g);
+  return wait_ns;
+}
+
+void vtpu_rate_adjust(vtpu_region* r, int dev, int64_t delta_us) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) return;
+  if (lock_region(g) != 0) return;
+  DeviceState* ds = &g->dev[dev];
+  if (ds->core_limit_pct > 0) {
+    ds->tokens_us -= delta_us;
+    if (ds->tokens_us > kBurstCapUs) ds->tokens_us = kBurstCapUs;
+  }
+  unlock_region(g);
+}
+
+void vtpu_rate_block(vtpu_region* r, int dev, uint64_t cost_us,
+                     int priority) {
+  for (;;) {
+    uint64_t wait_ns = vtpu_rate_acquire(r, dev, cost_us, priority);
+    if (wait_ns == 0) return;
+    struct timespec ts;
+    ts.tv_sec = (time_t)(wait_ns / 1000000000ull);
+    ts.tv_nsec = (long)(wait_ns % 1000000000ull);
+    nanosleep(&ts, NULL);
+  }
+}
+
+void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) return;
+  if (lock_region(g) != 0) return;
+  g->dev[dev].core_limit_pct = pct;
+  g->dev[dev].last_refill_ns = now_ns();
+  unlock_region(g);
+}
+
+int vtpu_region_ndevices(vtpu_region* r) { return r->shm->ndevices; }
+
+const char* vtpu_core_version(void) { return "vtpucore 0.1.0"; }

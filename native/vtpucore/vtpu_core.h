@@ -1,0 +1,138 @@
+/* vtpucore — cross-process HBM accounting + device-time rate limiting.
+ *
+ * The native heart of the in-container enforcement layer: a file-backed
+ * shared region mmap'd by every process sharing a vTPU, holding per-device
+ * usage counters, per-process slots with liveness tracking, and a
+ * token-bucket device-time limiter.  This is the TPU-native rebuild of the
+ * reference's shrreg protocol (reference vgpu/libvgpu.so,
+ * src/multiprocess/multiprocess_memory_limit.c: try_create_shrreg,
+ * lock_shrreg, add/rm_gpu_device_memory_usage, proc_alive,
+ * rm_quitted_process; src/multiprocess/multiprocess_utilization_watcher.c:
+ * rate_limiter) with two deliberate changes:
+ *
+ *  - the lock is a robust PTHREAD_PROCESS_SHARED mutex (EOWNERDEAD
+ *    recovery) instead of the reference's semaphore + "fix_lock_shrreg"
+ *    staleness heuristic;
+ *  - the rate limiter meters *device time* (microseconds of execution),
+ *    not kernel-launch count, because XLA dispatches whole programs
+ *    asynchronously (SURVEY.md §7 hard part (c)).
+ *
+ * Consumers: the PJRT interposer (native/vtpu_pjrt), the Python shim via
+ * ctypes (vtpu/shim/core.py), and the node monitor (vtpu-smi).
+ */
+#ifndef VTPU_CORE_H_
+#define VTPU_CORE_H_
+
+#include <stdint.h>
+#include <sys/types.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Hard caps, mirrored in vtpu/utils/envspec.py (the reference embeds
+ * "Max Gpus Per Node can't excced 16"). */
+#define VTPU_MAX_DEVICES 16
+#define VTPU_MAX_PROCS 64
+
+typedef struct vtpu_region vtpu_region; /* opaque; lives in shared memory */
+
+typedef struct {
+  uint64_t limit_bytes;   /* 0 = unlimited */
+  uint64_t used_bytes;
+  uint64_t peak_bytes;
+  int32_t core_limit_pct; /* 0 = no compute cap */
+  int32_t n_procs;        /* live processes touching this device */
+} vtpu_device_stats;
+
+typedef struct {
+  pid_t pid;
+  pid_t host_pid; /* pid in the host namespace when known, else == pid */
+  uint64_t used_bytes[VTPU_MAX_DEVICES];
+} vtpu_proc_stats;
+
+/* ---- region lifecycle -------------------------------------------------- */
+
+/* Open (create if absent) the shared region at `path`; idempotent and safe
+ * to race from many processes (first creator initialises under an flock).
+ * `ndevices` and `limits`/`core_pcts` seed the per-device quota on first
+ * creation; later openers adopt the existing values (and may pass NULL).
+ * Returns NULL on error (errno set). */
+vtpu_region* vtpu_region_open(const char* path, int ndevices,
+                              const uint64_t* limit_bytes,
+                              const int32_t* core_limit_pct);
+
+/* Unmap (does not delete the backing file). */
+void vtpu_region_close(vtpu_region* r);
+
+/* Register the calling process in a slot (idempotent per pid).
+ * host_pid: pass 0 to default to getpid(). Returns slot index or -1. */
+int vtpu_proc_register(vtpu_region* r, pid_t host_pid);
+
+/* Drop the calling process's slot, releasing its accounted usage. */
+void vtpu_proc_deregister(vtpu_region* r);
+
+/* Reclaim slots of processes that died without deregistering (SIGKILL);
+ * returns number of slots reclaimed.  Called opportunistically by every
+ * allocation and by the monitor (reference rm_quitted_process).  Only
+ * judges slots registered from the caller's own PID namespace — a
+ * co-tenant container cannot assess a foreign namespace's pids. */
+int vtpu_sweep_dead(vtpu_region* r);
+
+/* Host-namespace sweep: judges every slot by its host_pid.  For the
+ * node-level monitor only (it sees all pids); calling it from inside a
+ * container would mis-reclaim live co-tenants. */
+int vtpu_sweep_dead_host(vtpu_region* r);
+
+/* ---- HBM accounting ---------------------------------------------------- */
+
+/* Try to account `bytes` against device `dev` for the calling process.
+ * Returns 0 on success, -1 when it would exceed the limit (the caller
+ * surfaces OOM; reference oom_check "Device %d OOM %lu / %lu").
+ * oversubscribe!=0 admits past the cap but reports it (spill path). */
+int vtpu_mem_acquire(vtpu_region* r, int dev, uint64_t bytes,
+                     int oversubscribe);
+
+/* Release `bytes` previously acquired on `dev` by this process. */
+void vtpu_mem_release(vtpu_region* r, int dev, uint64_t bytes);
+
+/* Quota-adjusted view for the virtualized memory-info surface:
+ * free = limit - used (reference hooks cuMemGetInfo_v2). */
+int vtpu_mem_info(vtpu_region* r, int dev, uint64_t* free_bytes,
+                  uint64_t* total_bytes);
+
+int vtpu_device_get_stats(vtpu_region* r, int dev, vtpu_device_stats* out);
+int vtpu_proc_get_stats(vtpu_region* r, int slot, vtpu_proc_stats* out);
+
+/* ---- device-time rate limiting ----------------------------------------- */
+
+/* Ask to spend `cost_us` of device time on `dev` under that device's
+ * core_limit_pct.  Returns 0 when admitted immediately; otherwise the
+ * number of nanoseconds the caller should sleep before retrying.
+ * priority==0 tasks may run the bucket negative (borrow) instead of
+ * waiting (reference CUDA_TASK_PRIORITY).  A zero/absent limit admits
+ * everything. */
+uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
+                           int priority);
+
+/* Post-execution correction: charge the difference between actual and
+ * estimated device time (actual_us may be smaller -> credit back). */
+void vtpu_rate_adjust(vtpu_region* r, int dev, int64_t delta_us);
+
+/* Convenience: acquire with sleep-retry until admitted. */
+void vtpu_rate_block(vtpu_region* r, int dev, uint64_t cost_us,
+                     int priority);
+
+/* Set/read the core limit at runtime (monitor / tests). */
+void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct);
+
+/* ---- introspection ----------------------------------------------------- */
+
+int vtpu_region_ndevices(vtpu_region* r);
+const char* vtpu_core_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* VTPU_CORE_H_ */

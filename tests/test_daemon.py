@@ -1,0 +1,109 @@
+"""Daemon lifecycle: subprocess daemon registers with a simulated kubelet,
+re-registers when kubelet.sock is recreated, honors health-fault injection,
+and exits cleanly on SIGTERM."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubelet_sim import KubeletSim, collect_stream
+from vtpu.proto import pb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_daemon(tmp_path, fault_dir, extra=()):
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "VTPU_FAKE_CHIPS": "2",
+        "VTPU_FAKE_FAULT_DIR": str(fault_dir),
+        "VTPU_LOG_LEVEL": "4",
+    })
+    return subprocess.Popen(
+        [sys.executable, "-m", "vtpu.plugin.main",
+         "--discovery", "fake",
+         "--device-plugin-path", str(tmp_path) + "/",
+         "--device-split-count", "2",
+         *extra],
+        env=env, stderr=subprocess.PIPE, text=True)
+
+
+@pytest.fixture()
+def daemon(tmp_path):
+    fault_dir = tmp_path / "faults"
+    fault_dir.mkdir()
+    sim = KubeletSim(str(tmp_path)).start()
+    proc = spawn_daemon(tmp_path, fault_dir)
+    yield sim, proc, tmp_path, fault_dir
+    if proc.poll() is None:
+        proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    sim.stop()
+
+
+def test_daemon_registers_and_survives_kubelet_restart(daemon):
+    sim, proc, tmp_path, _ = daemon
+    reg = sim.wait_registration(timeout=10)
+    assert reg.resource_name == "4paradigm.com/vtpu"
+
+    stub, ch = sim.plugin_stub(reg.endpoint)
+    got = collect_stream(stub.ListAndWatch(pb.Empty()), 1)
+    assert len(got[0].devices) == 4
+    ch.close()
+
+    # Simulate kubelet restart: recreate kubelet.sock -> daemon must
+    # rebuild plugins and register again (reference main.go:253-263).
+    sim.stop()
+    sim2 = KubeletSim(str(tmp_path)).start()
+    try:
+        reg2 = sim2.wait_registration(timeout=15)
+        assert reg2.resource_name == "4paradigm.com/vtpu"
+    finally:
+        sim2.stop()
+
+
+def test_daemon_health_fault_injection(daemon):
+    sim, proc, tmp_path, fault_dir = daemon
+    reg = sim.wait_registration(timeout=10)
+    stub, ch = sim.plugin_stub(reg.endpoint)
+    stream = stub.ListAndWatch(pb.Empty())
+    first = collect_stream(stream, 1)
+    assert all(d.health == "Healthy" for d in first[0].devices)
+
+    # Inject a fault; the 5s-poll health loop should flip the chip.
+    (fault_dir / "TPU-fake-v5e-00").write_text("injected for test")
+    upd = collect_stream(stream, 1, timeout=10)
+    assert upd, "expected health refresh"
+    bad = [d for d in upd[-1].devices if d.health == "Unhealthy"]
+    assert len(bad) == 2
+    ch.close()
+
+
+def test_daemon_clean_shutdown_removes_socket(daemon):
+    sim, proc, tmp_path, _ = daemon
+    reg = sim.wait_registration(timeout=10)
+    sock = os.path.join(str(tmp_path), reg.endpoint)
+    assert os.path.exists(sock)
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=10) == 0
+    assert not os.path.exists(sock)
+
+
+def test_daemon_fail_on_init_error(tmp_path):
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "VTPU_FAKE_CHIPS": "0"})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "vtpu.plugin.main",
+         "--discovery", "fake",
+         "--device-plugin-path", str(tmp_path) + "/",
+         "--fail-on-init-error", "true"],
+        env=env, stderr=subprocess.PIPE, text=True)
+    assert proc.wait(timeout=15) == 1

@@ -1,0 +1,171 @@
+"""Native shared-region tests: quota accounting, OOM, cross-process
+invariants, dead-process reclamation, device-time rate limiting.
+
+These exercise libvtpucore.so through the ctypes bindings — the same path
+the shim, runtime broker, and monitor use in production.
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import time
+
+import pytest
+
+from vtpu.shim.core import SharedRegion
+
+MB = 10**6
+
+
+@pytest.fixture()
+def region_path(tmp_path):
+    return str(tmp_path / "shr.cache")
+
+
+def test_basic_accounting_and_oom(region_path):
+    with SharedRegion(region_path, limits=[100 * MB], core_pcts=[0]) as r:
+        r.register()
+        assert r.mem_acquire(0, 60 * MB)
+        assert r.mem_acquire(0, 30 * MB)
+        # 10 MB left; 20 MB must OOM cleanly.
+        assert not r.mem_acquire(0, 20 * MB)
+        free, total = r.mem_info(0)
+        assert total == 100 * MB
+        assert free == 10 * MB
+        r.mem_release(0, 30 * MB)
+        assert r.mem_acquire(0, 20 * MB)
+        st = r.device_stats(0)
+        assert st.used_bytes == 80 * MB
+        assert st.peak_bytes == 90 * MB
+        r.deregister()
+        st = r.device_stats(0)
+        assert st.used_bytes == 0, "deregister releases the proc's usage"
+
+
+def test_oversubscribe_admits_past_quota(region_path):
+    with SharedRegion(region_path, limits=[50 * MB]) as r:
+        r.register()
+        assert r.mem_acquire(0, 40 * MB)
+        assert not r.mem_acquire(0, 20 * MB)
+        assert r.mem_acquire(0, 20 * MB, oversubscribe=True)
+        st = r.device_stats(0)
+        assert st.used_bytes == 60 * MB
+
+
+def test_second_opener_adopts_existing_limits(region_path):
+    r1 = SharedRegion(region_path, limits=[100 * MB], core_pcts=[40])
+    # Second opener passes nothing; must see the creator's quota.
+    r2 = SharedRegion(region_path)
+    assert r2.ndevices == 1
+    st = r2.device_stats(0)
+    assert st.limit_bytes == 100 * MB
+    assert st.core_limit_pct == 40
+    r1.close()
+    r2.close()
+
+
+def _worker(path, n_iter, chunk, ok_q):
+    r = SharedRegion(path)
+    r.register()
+    violations = 0
+    held = 0
+    for _ in range(n_iter):
+        if r.mem_acquire(0, chunk):
+            held += chunk
+            st = r.device_stats(0)
+            if st.used_bytes > st.limit_bytes:
+                violations += 1
+            time.sleep(0)
+            r.mem_release(0, chunk)
+            held -= chunk
+    r.deregister()
+    r.close()
+    ok_q.put(violations)
+
+
+def test_multiprocess_never_exceeds_limit(region_path):
+    limit = 10 * MB
+    SharedRegion(region_path, limits=[limit]).close()
+    q = mp.Queue()
+    procs = [mp.Process(target=_worker, args=(region_path, 200, 3 * MB, q))
+             for _ in range(6)]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=60)
+    total_violations = sum(q.get(timeout=5) for _ in procs)
+    assert total_violations == 0
+    with SharedRegion(region_path) as r:
+        assert r.device_stats(0).used_bytes == 0
+
+
+def _hoarder(path, ready_ev):
+    r = SharedRegion(path)
+    r.register()
+    r.mem_acquire(0, 80 * MB)
+    ready_ev.set()
+    time.sleep(60)  # killed long before this
+
+
+def test_sigkill_reclaim(region_path):
+    SharedRegion(region_path, limits=[100 * MB]).close()
+    ev = mp.Event()
+    p = mp.Process(target=_hoarder, args=(region_path, ev))
+    p.start()
+    assert ev.wait(timeout=15)
+    with SharedRegion(region_path) as r:
+        assert r.device_stats(0).used_bytes == 80 * MB
+        # Quota exhausted by the hoarder.
+        r.register()
+        assert not r.mem_acquire(0, 50 * MB)
+        # SIGKILL it — no exit handler runs (the case the reference handles
+        # with rm_quitted_process).
+        os.kill(p.pid, signal.SIGKILL)
+        p.join(timeout=10)
+        # The OOM path sweeps dead procs before failing, so this succeeds.
+        assert r.mem_acquire(0, 50 * MB)
+        st = r.device_stats(0)
+        assert st.used_bytes == 50 * MB
+
+
+def test_rate_limiter_throttles(region_path):
+    with SharedRegion(region_path, limits=[0], core_pcts=[50]) as r:
+        r.register()
+        # Drain the initial burst allowance.
+        r.rate_block(0, 250_000)
+        # 200ms of device time at a 50% cap needs >= ~400ms of wall time.
+        t0 = time.monotonic()
+        for _ in range(4):
+            r.rate_block(0, 50_000)
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.3, f"throttle too weak: {elapsed:.3f}s"
+
+
+def test_rate_limiter_unlimited_is_free(region_path):
+    with SharedRegion(region_path, limits=[0], core_pcts=[0]) as r:
+        t0 = time.monotonic()
+        for _ in range(100):
+            r.rate_block(0, 50_000)
+        assert time.monotonic() - t0 < 0.1
+
+
+def test_high_priority_borrows(region_path):
+    with SharedRegion(region_path, limits=[0], core_pcts=[10]) as r:
+        r.rate_block(0, 250_000)  # drain burst
+        t0 = time.monotonic()
+        for _ in range(5):
+            r.rate_block(0, 100_000, priority=0)
+        assert time.monotonic() - t0 < 0.1, "priority-0 must not wait"
+        # ...but the borrowed time is owed: a normal task now waits longer.
+        assert r.rate_acquire(0, 10_000, priority=1) > 0
+
+
+def test_rate_adjust_credits_back(region_path):
+    with SharedRegion(region_path, limits=[0], core_pcts=[50]) as r:
+        r.rate_block(0, 250_000)  # drain burst
+        # Estimate 100ms, actual 10ms -> credit 90ms back.
+        r.rate_block(0, 100_000)
+        r.rate_adjust(0, -90_000)
+        t0 = time.monotonic()
+        r.rate_block(0, 80_000)
+        assert time.monotonic() - t0 < 0.05

@@ -1,0 +1,68 @@
+"""Drives the native PJRT interposer test binary (mock-backed) and checks
+the interposer loads as a PJRT plugin.  The heavy assertions live in
+native/tests/interposer_test.cc; this wrapper makes them part of the
+Python suite and keeps the native build fresh."""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+BUILD = os.path.join(NATIVE, "build")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    r = subprocess.run(["make", "-C", NATIVE, "all",
+                        os.path.join("build", "interposer_test")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_interposer_end_to_end():
+    r = subprocess.run([os.path.join(BUILD, "interposer_test"), BUILD],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"stdout:{r.stdout}\nstderr:{r.stderr}"
+    assert "ALL OK" in r.stdout
+
+
+def test_interposer_reports_wrapped_api(tmp_path):
+    """GetPjrtApi returns the mock's version numbers (table copied), and a
+    second GetPjrtApi call returns the same table (call_once)."""
+    src = r"""
+#include <dlfcn.h>
+#include <stdio.h>
+#include "xla/pjrt/c/pjrt_c_api.h"
+int main(int argc, char** argv) {
+  void* h = dlopen(argv[1], RTLD_NOW);
+  if (!h) { fprintf(stderr, "%s\n", dlerror()); return 1; }
+  auto get = (const PJRT_Api* (*)())dlsym(h, "GetPjrtApi");
+  const PJRT_Api* a = get();
+  const PJRT_Api* b = get();
+  if (!a || a != b) return 2;
+  printf("%d.%d\n", a->pjrt_api_version.major_version,
+         a->pjrt_api_version.minor_version);
+  return 0;
+}
+"""
+    cc = tmp_path / "t.cc"
+    cc.write_text(src)
+    exe = tmp_path / "t"
+    import sysconfig  # noqa: F401  (tensorflow include discovery below)
+    inc = subprocess.run(
+        ["python3", "-c",
+         "import tensorflow, os;"
+         "print(os.path.join(os.path.dirname(tensorflow.__file__),"
+         "'include'))"], capture_output=True, text=True).stdout.strip()
+    r = subprocess.run(["g++", "-std=c++17", f"-I{inc}", "-o", str(exe),
+                        str(cc), "-ldl"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["VTPU_REAL_LIBTPU"] = os.path.join(BUILD, "libmockpjrt.so")
+    r = subprocess.run([str(exe), os.path.join(BUILD, "libvtpu_pjrt.so")],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    major, minor = r.stdout.strip().split(".")
+    assert int(major) == 0 and int(minor) > 0
