@@ -1,0 +1,75 @@
+"""Minimal in-cluster Kubernetes REST client (pod list only).
+
+The reference vendors all of client-go for two calls: a node-filtered pod
+LIST for monitor-mode pod matching (reference server.go:369-379) and the
+legacy controller's pod lister (reference vdevice-controller.go:162-223).
+This is the 60-line equivalent: serviceaccount token + CA, GET
+/api/v1/pods with a spec.nodeName fieldSelector.  No watch — the legacy
+controller reconciles from the kubelet checkpoint on every Allocate, so a
+list-on-demand is enough (resync semantics; the reference's informer
+handlers are commented out upstream anyway, vdevice-controller.go:191-219).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class K8sClient:
+    def __init__(self, host: Optional[str] = None,
+                 port: Optional[str] = None,
+                 token: Optional[str] = None,
+                 ca_file: Optional[str] = None):
+        self.host = host or os.environ.get("KUBERNETES_SERVICE_HOST")
+        self.port = port or os.environ.get("KUBERNETES_SERVICE_PORT",
+                                           "443")
+        self.token = token
+        if self.token is None and os.path.exists(f"{SA_DIR}/token"):
+            with open(f"{SA_DIR}/token") as f:
+                self.token = f.read().strip()
+        self.ca_file = ca_file or (f"{SA_DIR}/ca.crt"
+                                   if os.path.exists(f"{SA_DIR}/ca.crt")
+                                   else None)
+
+    @property
+    def available(self) -> bool:
+        return bool(self.host and self.token)
+
+    def _get(self, path: str, params: Dict[str, str]) -> Dict[str, Any]:
+        qs = urllib.parse.urlencode(params)
+        url = f"https://{self.host}:{self.port}{path}?{qs}"
+        req = urllib.request.Request(url, headers={
+            "Authorization": f"Bearer {self.token}",
+            "Accept": "application/json",
+        })
+        if self.ca_file:
+            ctx = ssl.create_default_context(cafile=self.ca_file)
+        else:
+            ctx = ssl.create_default_context()
+        with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+            return json.load(resp)
+
+    def list_pods(self, node_name: Optional[str] = None) -> List[Dict]:
+        params: Dict[str, str] = {}
+        if node_name:
+            params["fieldSelector"] = f"spec.nodeName={node_name}"
+        return self._get("/api/v1/pods", params).get("items", [])
+
+
+def pod_lister(client: Optional[K8sClient] = None):
+    """callable(node_name) -> [pod dict], for plugin server monitor mode."""
+    c = client or K8sClient()
+
+    def lister(node_name: Optional[str]) -> List[Dict]:
+        if not c.available:
+            return []
+        return c.list_pods(node_name)
+
+    return lister

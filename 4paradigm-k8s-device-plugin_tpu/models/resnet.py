@@ -1,0 +1,92 @@
+"""ResNet-V2 (pre-activation) in flax — the ai-benchmark parity workload.
+
+The reference's published benchmark suite runs ResNet-V2-50/152 inference
+and training under its vGPU quotas (reference README.md:58-71,
+benchmarks/ai-benchmark/); these are the matching TPU client models that
+bench.py drives under vTPU quotas.  bf16 activations, f32 batch-norm
+statistics — the standard TPU recipe; NHWC layout (XLA:TPU's native conv
+layout).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckV2(nn.Module):
+    """Pre-activation bottleneck (BN-ReLU-conv x3 + projection)."""
+
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+
+        preact = nn.relu(norm()(x))
+        shortcut = x
+        if x.shape[-1] != self.filters * 4 or self.strides != 1:
+            shortcut = conv(self.filters * 4, (1, 1),
+                            strides=self.strides)(preact)
+
+        y = conv(self.filters, (1, 1))(preact)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=self.strides,
+                 padding=[(1, 1), (1, 1)])(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        return shortcut + y
+
+
+class ResNetV2(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=2, padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckV2(64 * 2 ** i, strides=strides,
+                                 dtype=self.dtype)(x, train=train)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 dtype=jnp.float32)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def resnet_v2_50(**kw) -> ResNetV2:
+    return ResNetV2(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def resnet_v2_152(**kw) -> ResNetV2:
+    return ResNetV2(stage_sizes=(3, 8, 36, 3), **kw)
+
+
+def make_inference_fn(model: ResNetV2, image_size: int = 224,
+                      batch: int = 8) -> Tuple[Any, Any]:
+    """(jitted_fn, example_args) for quota-enforced inference benchmarks."""
+    key = jax.random.PRNGKey(0)
+    x = jnp.ones((batch, image_size, image_size, 3), jnp.float32)
+    variables = model.init(key, x, train=False)
+
+    @jax.jit
+    def infer(variables, x):
+        return model.apply(variables, x, train=False)
+
+    return infer, (variables, x)

@@ -1,0 +1,235 @@
+"""Decoder-only transformer (Llama-style) — the flagship JAX workload.
+
+Exists as a *client* of the vTPU framework (the reference validates its
+interceptor against TensorFlow/torch workloads, README.md:213-222; our
+equivalents are JAX models): bench.py runs it under quota enforcement, and
+__graft_entry__ uses it for the single-chip forward and the multi-chip
+sharded training dry-run.
+
+TPU-first choices: bf16 activations/weights with f32 RMSNorm accumulation,
+RoPE, SwiGLU, GQA; weights carry ('dp','tp') PartitionSpecs laid out so
+tensor-parallel collectives (psum over 'tp') ride ICI — attention heads
+and MLP hidden are split over 'tp', embeddings replicated, batch over
+'dp'.  Static shapes throughout; the decode cache is a fixed-size buffer
+updated with lax.dynamic_update_slice so jit never retraces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    hidden: int = 1408          # SwiGLU hidden (~2.75x dim)
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def tiny() -> "TransformerConfig":
+        return TransformerConfig(vocab=256, dim=64, n_layers=2, n_heads=4,
+                                 n_kv_heads=2, hidden=192, max_seq=128)
+
+    @staticmethod
+    def llama_8b_proportions(layers: int = 4) -> "TransformerConfig":
+        """Llama-3-8B shapes with a truncated layer stack (single-chip
+        bench keeps HBM bounded; full depth = 32)."""
+        return TransformerConfig(vocab=128256, dim=4096, n_layers=layers,
+                                 n_heads=32, n_kv_heads=8, hidden=14336,
+                                 max_seq=2048)
+
+    @staticmethod
+    def bench() -> "TransformerConfig":
+        """Llama-3-8B layer geometry, reduced vocab + depth so 4 tenant
+        replicas (~1 GB bf16 each) co-reside on one 16 GB v5e chip with
+        activations and params upload in reasonable time — matmul-
+        dominant, MXU-bound."""
+        return TransformerConfig(vocab=8192, dim=4096, n_layers=2,
+                                 n_heads=32, n_kv_heads=8, hidden=14336,
+                                 max_seq=2048)
+
+
+# Parameter PartitionSpecs: dim-sharded over 'tp' on the contraction-free
+# axis, replicated elsewhere.  (The scaling-book recipe: annotate, let XLA
+# insert the collectives.)
+def param_specs(cfg: TransformerConfig) -> Dict[str, Any]:
+    layer = {
+        "attn_norm": P(),
+        "mlp_norm": P(),
+        "wq": P(None, "tp"),
+        "wk": P(None, "tp"),
+        "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "w_gate": P(None, "tp"),
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+    }
+    return {
+        "embed": P(),
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, Any]:
+    k_embed, k_head, *k_layers = jax.random.split(key, 2 + cfg.n_layers)
+    scale = cfg.dim ** -0.5
+    dt = cfg.dtype
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    params: Dict[str, Any] = {
+        "embed": (jax.random.normal(k_embed, (cfg.vocab, cfg.dim),
+                                    jnp.float32) * scale).astype(dt),
+        "final_norm": jnp.ones((cfg.dim,), jnp.float32),
+        "lm_head": dense(k_head, (cfg.dim, cfg.vocab), cfg.dim),
+        "layers": [],
+    }
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    for kl in k_layers:
+        ks = jax.random.split(kl, 7)
+        params["layers"].append({
+            "attn_norm": jnp.ones((cfg.dim,), jnp.float32),
+            "mlp_norm": jnp.ones((cfg.dim,), jnp.float32),
+            "wq": dense(ks[0], (cfg.dim, cfg.dim), cfg.dim),
+            "wk": dense(ks[1], (cfg.dim, kv_dim), cfg.dim),
+            "wv": dense(ks[2], (cfg.dim, kv_dim), cfg.dim),
+            "wo": dense(ks[3], (cfg.dim, cfg.dim), cfg.dim),
+            "w_gate": dense(ks[4], (cfg.dim, cfg.hidden), cfg.dim),
+            "w_up": dense(ks[5], (cfg.dim, cfg.hidden), cfg.dim),
+            "w_down": dense(ks[6], (cfg.hidden, cfg.dim), cfg.hidden),
+        })
+    return params
+
+
+def rmsnorm(x: jax.Array, w: jax.Array) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return ((xf * rms) * w).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _rope_tables(theta: float, dtype, seq: int, head_dim: int):
+    pos = jnp.arange(seq, dtype=jnp.float32)[:, None]
+    freq = theta ** (-jnp.arange(0, head_dim, 2, jnp.float32) / head_dim)
+    ang = pos * freq[None, :]
+    return jnp.cos(ang).astype(dtype), jnp.sin(ang).astype(dtype)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    # x: [b, s, h, d]; tables: [s, d/2]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+
+
+def attention(x: jax.Array, lp: Dict[str, jax.Array],
+              cfg: TransformerConfig, cos, sin,
+              mask: Optional[jax.Array]) -> jax.Array:
+    b, s, _ = x.shape
+    q = (x @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (x @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (x @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    # [b, h, s, d]: MXU-friendly contraction layout.
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (cfg.head_dim ** -0.5)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.dim)
+    return out @ lp["wo"]
+
+
+def mlp(x: jax.Array, lp: Dict[str, jax.Array]) -> jax.Array:
+    return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            cfg: TransformerConfig) -> jax.Array:
+    """tokens [b, s] int32 -> logits [b, s, vocab] (causal LM)."""
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    cos, sin = _rope_tables(cfg.rope_theta, cfg.dtype, s, cfg.head_dim)
+    causal = jnp.tril(jnp.ones((s, s), bool))[None, None, :, :]
+    for lp in params["layers"]:
+        x = x + attention(rmsnorm(x, lp["attn_norm"]), lp, cfg, cos, sin,
+                          causal)
+        x = x + mlp(rmsnorm(x, lp["mlp_norm"]), lp)
+    x = rmsnorm(x, params["final_norm"])
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg: TransformerConfig) -> jax.Array:
+    """Next-token cross-entropy over the shifted sequence."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def shard_params(params, mesh: Mesh, cfg: TransformerConfig):
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, jnp.ndarray) or hasattr(x, "shape"))
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+                    lr: float = 1e-3):
+    """Adam training step; with a mesh, inputs are dp-sharded and params
+    tp-sharded per param_specs — XLA inserts the psums over ICI."""
+    import optax
+
+    opt = optax.adam(lr)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg))(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step), opt
+
+    specs = param_specs(cfg)
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    data_sh = NamedSharding(mesh, P("dp", None))
+    jitted = jax.jit(
+        step,
+        in_shardings=(param_sh, None, data_sh),
+        out_shardings=(param_sh, None, None),
+    )
+    return jitted, opt

@@ -1,0 +1,46 @@
+"""Device-mesh helpers for the benchmark workloads and the multi-chip
+dry-run path.
+
+The device plugin itself is cluster infrastructure (SURVEY.md §5: the
+reference contains no parallelism layer) — these helpers exist for the
+JAX *client workloads* this repo ships (vtpu.models, bench.py): they pick
+a data/tensor-parallel mesh over whatever vTPU grant the container got,
+with axes laid out so tensor-parallel collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              tp: Optional[int] = None) -> Mesh:
+    """A ('dp','tp') mesh over the first ``n_devices`` devices.  ``tp``
+    defaults to the largest power of two <= 8 dividing the device count —
+    tensor parallelism wants the tightly-coupled (ICI-adjacent) axis,
+    which is how jax orders a freshly created device list."""
+    devs = jax.devices()[: (n_devices or len(jax.devices()))]
+    n = len(devs)
+    if tp is None:
+        tp = 1
+        for cand in (8, 4, 2):
+            if n % cand == 0:
+                tp = cand
+                break
+    if n % tp != 0:
+        raise ValueError(f"{n} devices not divisible by tp={tp}")
+    import numpy as np
+
+    arr = np.array(devs).reshape(n // tp, tp)
+    return Mesh(arr, axis_names=("dp", "tp"))
+
+
+def shard(mesh: Mesh, *spec: Optional[str]) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,2 @@
+"""Node-level vTPU runtime: the chip-sharing broker (server) and the
+tenant client library.  See runtime/protocol.py for the why."""

@@ -1,0 +1,190 @@
+"""Tenant-side client for the vTPU runtime multiplexer.
+
+Workloads trace/lower locally (tracing needs no TPU: the CPU backend can
+abstract-eval any jittable function) and ship a serialized ``jax.export``
+artifact; tensors move as raw bytes.  The ergonomic entry point is
+``remote_jit``:
+
+    rt = RuntimeClient.from_env()           # VTPU_RUNTIME_SOCKET
+    f = rt.remote_jit(lambda a, b: a @ b)
+    y = f(x_np, w_np)                       # runs on the brokered chip
+
+Every quota violation surfaces as ``VtpuQuotaError`` with the broker's
+RESOURCE_EXHAUSTED message (the reference shim's early-OOM contract).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import socket
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import envspec
+from ..utils.dtypes import np_dtype as _np_dtype
+from . import protocol as P
+
+
+class VtpuQuotaError(MemoryError):
+    pass
+
+
+class RuntimeError_(RuntimeError):
+    pass
+
+
+class RemoteArray:
+    """Handle to a tenant-owned device array living in the broker."""
+
+    def __init__(self, client: "RuntimeClient", aid: str, shape, dtype):
+        self.client = client
+        self.id = aid
+        self.shape = tuple(shape)
+        self.dtype = _np_dtype(dtype) if isinstance(dtype, str) \
+            else np.dtype(dtype)
+
+    def fetch(self) -> np.ndarray:
+        return self.client.get(self.id)
+
+    def delete(self) -> None:
+        self.client.delete(self.id)
+
+    def __repr__(self):
+        return f"RemoteArray({self.id}, {self.shape}, {self.dtype})"
+
+
+class RemoteExecutable:
+    def __init__(self, client: "RuntimeClient", eid: str):
+        self.client = client
+        self.id = eid
+
+    def __call__(self, *args: "RemoteArray") -> List[RemoteArray]:
+        return self.client.execute(self.id, args)
+
+
+class RuntimeClient:
+    def __init__(self, socket_path: str, tenant: Optional[str] = None,
+                 priority: Optional[int] = None):
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(socket_path)
+        self._ids = itertools.count()
+        spec = envspec.quota_from_env()
+        self.tenant = tenant or os.environ.get(
+            "VTPU_TENANT", f"pid{os.getpid()}")
+        self.priority = spec.task_priority if priority is None else priority
+        resp = self._rpc({"kind": P.HELLO, "tenant": self.tenant,
+                          "priority": self.priority})
+        self.tenant_index = resp["tenant_index"]
+
+    @classmethod
+    def from_env(cls, **kw) -> "RuntimeClient":
+        spec = envspec.quota_from_env()
+        path = spec.runtime_socket or "/usr/local/vtpu/vtpu-runtime.sock"
+        return cls(path, **kw)
+
+    # -- plumbing --
+    def _rpc(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        P.send_msg(self.sock, msg)
+        resp = P.recv_msg(self.sock)
+        if not resp.get("ok"):
+            code = resp.get("code", "")
+            if code == "RESOURCE_EXHAUSTED":
+                raise VtpuQuotaError(resp.get("error", code))
+            raise RuntimeError_(f"{code}: {resp.get('error', '')}")
+        return resp
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- data --
+    def put(self, arr: np.ndarray, aid: Optional[str] = None) -> RemoteArray:
+        arr = np.ascontiguousarray(arr)
+        aid = aid or f"a{next(self._ids)}"
+        # dtype by NAME: extended types (bfloat16, fp8) have no portable
+        # .str encoding; ml_dtypes registers the names on both ends.
+        self._rpc({"kind": P.PUT, "id": aid, "shape": list(arr.shape),
+                   "dtype": arr.dtype.name, "data": arr.tobytes()})
+        return RemoteArray(self, aid, arr.shape, arr.dtype)
+
+    def get(self, aid: str) -> np.ndarray:
+        r = self._rpc({"kind": P.GET, "id": aid})
+        return np.frombuffer(r["data"], dtype=_np_dtype(r["dtype"])).reshape(
+            r["shape"]).copy()
+
+    def delete(self, aid: str) -> None:
+        self._rpc({"kind": P.DELETE, "id": aid})
+
+    # -- compute --
+    def compile(self, fn, example_args: Sequence[np.ndarray]) -> RemoteExecutable:
+        """Trace+lower `fn` locally and register it remotely.  Lowered for
+        both cpu and tpu so a CPU-only tenant (tracing needs no chip) can
+        target a TPU-backed broker and vice versa."""
+        import jax
+        exported = jax.export.export(jax.jit(fn),
+                                     platforms=("cpu", "tpu"))(
+            *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in example_args])
+        blob = exported.serialize()
+        eid = f"e{next(self._ids)}"
+        self._rpc({"kind": P.COMPILE, "id": eid, "exported": bytes(blob)})
+        return RemoteExecutable(self, eid)
+
+    def execute(self, eid: str,
+                args: Sequence[RemoteArray]) -> List[RemoteArray]:
+        out_ids = [f"o{next(self._ids)}" for _ in range(8)]
+        r = self._rpc({"kind": P.EXECUTE, "exe": eid,
+                       "args": [a.id for a in args], "outs": out_ids})
+        return [RemoteArray(self, m["id"], m["shape"], m["dtype"])
+                for m in r["outs"]]
+
+    def stats(self) -> Dict[str, Any]:
+        return self._rpc({"kind": P.STATS})["tenants"]
+
+    # -- pipelined execution (throughput mode) --
+    # Replies are FIFO per connection, so a caller may keep several
+    # executes in flight (hiding transport latency) as long as send/recv
+    # counts are paired.  Reusing one out-id set makes the server free the
+    # previous round's outputs on overwrite — bounded memory, no DELETE
+    # round trips.
+    def execute_send(self, eid: str, args: Sequence[RemoteArray],
+                     out_ids: Sequence[str]) -> None:
+        P.send_msg(self.sock, {"kind": P.EXECUTE, "exe": eid,
+                               "args": [a.id for a in args],
+                               "outs": list(out_ids)})
+
+    def execute_recv(self) -> List[RemoteArray]:
+        resp = P.recv_msg(self.sock)
+        if not resp.get("ok"):
+            code = resp.get("code", "")
+            if code == "RESOURCE_EXHAUSTED":
+                raise VtpuQuotaError(resp.get("error", code))
+            raise RuntimeError_(f"{code}: {resp.get('error', '')}")
+        return [RemoteArray(self, m["id"], m["shape"], m["dtype"])
+                for m in resp["outs"]]
+
+    # -- ergonomics --
+    def remote_jit(self, fn):
+        """Returns a callable taking/returning numpy arrays, running `fn`
+        on the brokered device under this tenant's quotas.  Compiles once
+        per argument-shape signature."""
+        cache: Dict[tuple, RemoteExecutable] = {}
+
+        def call(*np_args: np.ndarray):
+            arrs = [np.asarray(a) for a in np_args]
+            sig = tuple((a.shape, a.dtype.str) for a in arrs)
+            exe = cache.get(sig)
+            if exe is None:
+                exe = self.compile(fn, arrs)
+                cache[sig] = exe
+            handles = [self.put(a) for a in arrs]
+            outs = exe(*handles)
+            res = [o.fetch() for o in outs]
+            for h in handles + outs:
+                h.delete()
+            return res[0] if len(res) == 1 else res
+
+        return call

@@ -1,0 +1,66 @@
+"""Wire protocol for the vTPU runtime multiplexer.
+
+Length-prefixed msgpack frames over a unix stream socket.  Binary tensor
+payloads ride as msgpack bin fields (zero-copy on the numpy side).
+
+Why this exists: libtpu admits ONE process per chip, so the reference's
+approach — every tenant process talks to the device directly and an
+LD_PRELOAD shim polices it — cannot work for time-sharing a TPU chip.
+The TPU-native answer is a node-level broker that owns the PJRT client
+and schedules tenant submissions (the NVIDIA-MPS/Pathways shape).  The
+plugin daemon injects VTPU_RUNTIME_SOCKET (plugin/server.py) and mounts
+the socket into containers.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any, Dict
+
+import msgpack
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 30
+
+# message kinds (client -> server)
+HELLO = "hello"          # {tenant, priority} -> {ok, tenant_index}
+PUT = "put"              # {id, shape, dtype, data} -> {ok, nbytes}
+GET = "get"              # {id} -> {ok, shape, dtype, data}
+DELETE = "delete"        # {id} -> {ok, freed}
+COMPILE = "compile"      # {id, exported} -> {ok}
+EXECUTE = "execute"      # {exe, args: [ids], outs: [ids]} -> {ok, outs:[...]}
+STATS = "stats"          # {} -> {ok, tenants: {...}}
+SHUTDOWN = "shutdown"    # {} -> {ok}  (admin)
+
+
+class ProtocolError(RuntimeError):
+    pass
+
+
+def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
+    payload = msgpack.packb(msg, use_bin_type=True)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> Dict[str, Any]:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise ProtocolError(f"frame too large: {n}")
+    return msgpack.unpackb(_recv_exact(sock, n), raw=False)
+
+
+def reply_err(sock: socket.socket, code: str, msg: str) -> None:
+    send_msg(sock, {"ok": False, "code": code, "error": msg})

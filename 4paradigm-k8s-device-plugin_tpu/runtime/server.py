@@ -1,0 +1,359 @@
+"""The vTPU runtime multiplexer: one daemon per shared chip (set), owning
+the JAX/PJRT client and time-slicing tenant work.
+
+Replaces direct-device multiprocess sharing (impossible on TPU: libtpu
+holds a per-process chip lock) with brokered execution:
+
+  tenant container                      runtime daemon (this file)
+  ---------------------                 ---------------------------
+  vtpu.runtime.client  --unix socket--> TenantSession (thread)
+    put ndarray                           quota check -> device_put
+    compile jax.export blob               jax.export.deserialize
+    execute(exe, args)                    token-bucket gate -> run -> account
+    get/delete                            transfer back / free
+
+Per-tenant HBM quotas and device-time budgets use the SAME native shared
+region as the interposer path (tenant index = region device index), so
+`vtpu-smi` shows both paths identically and kill-cleanup (sweep) applies.
+
+Priorities: tenants created with priority 0 borrow from the bucket
+instead of waiting (reference CUDA_TASK_PRIORITY semantics).
+
+Run: python -m vtpu.runtime.server --socket /tmp/vtpu-rt.sock \
+        --hbm-limit 8Gi --core-limit 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..shim.core import SharedRegion
+from ..utils.dtypes import np_dtype as _np_dtype
+from ..utils import envspec
+from ..utils import logging as log
+from . import protocol as P
+
+MAX_TENANTS = 16
+
+
+class Tenant:
+    def __init__(self, name: str, index: int, priority: int):
+        self.name = name
+        self.index = index          # region device index for accounting
+        self.priority = priority
+        self.arrays: Dict[str, Any] = {}
+        self.nbytes: Dict[str, int] = {}
+        self.executables: Dict[str, Any] = {}
+        self.cost_ema: Dict[str, float] = {}
+        self.executions = 0
+        # Live connections sharing this tenant (a pod may open several);
+        # state is torn down when the last one closes.
+        self.connections = 0
+
+
+class RuntimeState:
+    """Shared across tenant sessions; owns the jax client and the region."""
+
+    def __init__(self, region_path: str, hbm_limit: int, core_limit: int,
+                 min_exec_cost_us: int = 0):
+        import jax
+        self.jax = jax
+        self.device = jax.devices()[0]
+        limits = [hbm_limit] * MAX_TENANTS
+        pcts = [core_limit] * MAX_TENANTS
+        self.region = SharedRegion(region_path, limits=limits,
+                                   core_pcts=pcts)
+        self.region.register()
+        self.min_exec_cost_us = min_exec_cost_us
+        self.tenants: Dict[str, Tenant] = {}
+        self.blob_cache: Dict[str, Any] = {}
+        self.mu = threading.Lock()
+        # Serialises device execution: one program on the chip at a time,
+        # so a throttled tenant cannot sneak concurrency past the bucket.
+        self.exec_mu = threading.Lock()
+
+    def tenant(self, name: str, priority: int) -> Tenant:
+        with self.mu:
+            t = self.tenants.get(name)
+            if t is None:
+                used = {x.index for x in self.tenants.values()}
+                index = next((i for i in range(MAX_TENANTS)
+                              if i not in used), None)
+                if index is None:
+                    raise RuntimeError("tenant slots exhausted")
+                t = Tenant(name, index, priority)
+                self.tenants[name] = t
+            t.connections += 1
+            return t
+
+    def release_tenant(self, t: Tenant) -> bool:
+        """Drop one connection; True when the tenant's state should be
+        torn down (last connection gone) — the slot index recycles."""
+        with self.mu:
+            t.connections -= 1
+            if t.connections > 0:
+                return False
+            self.tenants.pop(t.name, None)
+            return True
+
+
+class TenantSession(socketserver.BaseRequestHandler):
+    state: RuntimeState  # injected by make_server
+
+    # -- helpers --
+    def _charge(self, t: Tenant, nbytes: int) -> None:
+        if not self.state.region.mem_acquire(t.index, nbytes, False):
+            free, total = self.state.region.mem_info(t.index)
+            raise MemoryError(
+                f"RESOURCE_EXHAUSTED: tenant {t.name} over HBM quota: "
+                f"requested {nbytes}, quota {total} (free {free})")
+
+    def handle(self):  # noqa: C901 - protocol dispatch
+        sock = self.request
+        tenant: Optional[Tenant] = None
+        import numpy as np
+        jax = self.state.jax
+        while True:
+            try:
+                msg = P.recv_msg(sock)
+            except (ConnectionError, P.ProtocolError):
+                break
+            kind = msg.get("kind")
+            try:
+                if kind == P.HELLO:
+                    tenant = self.state.tenant(
+                        str(msg["tenant"]), int(msg.get("priority", 1)))
+                    P.send_msg(sock, {"ok": True,
+                                      "tenant_index": tenant.index})
+                    continue
+                if tenant is None:
+                    P.reply_err(sock, "NO_HELLO", "hello required")
+                    continue
+
+                if kind == P.PUT:
+                    arr = np.frombuffer(
+                        msg["data"], dtype=_np_dtype(msg["dtype"])
+                    ).reshape(msg["shape"])
+                    nbytes = int(arr.nbytes)
+                    self._charge(tenant, nbytes)
+                    try:
+                        dev_arr = jax.device_put(arr, self.state.device)
+                        dev_arr.block_until_ready()
+                    except Exception:
+                        self.state.region.mem_release(tenant.index, nbytes)
+                        raise
+                    aid = str(msg["id"])
+                    self._drop_array(tenant, aid)
+                    tenant.arrays[aid] = dev_arr
+                    tenant.nbytes[aid] = nbytes
+                    P.send_msg(sock, {"ok": True, "nbytes": nbytes})
+
+                elif kind == P.GET:
+                    aid = str(msg["id"])
+                    if aid not in tenant.arrays:
+                        P.reply_err(sock, "NOT_FOUND", aid)
+                        continue
+                    host = np.asarray(tenant.arrays[aid])
+                    P.send_msg(sock, {
+                        "ok": True, "shape": list(host.shape),
+                        "dtype": host.dtype.name, "data": host.tobytes()})
+
+                elif kind == P.DELETE:
+                    freed = self._drop_array(tenant, str(msg["id"]))
+                    P.send_msg(sock, {"ok": True, "freed": freed})
+
+                elif kind == P.COMPILE:
+                    blob = bytes(msg["exported"])
+                    # Dedup identical programs across tenants: same blob ->
+                    # same jitted callable -> one XLA compilation.
+                    import hashlib
+                    h = hashlib.sha256(blob).hexdigest()
+                    with self.state.mu:
+                        fn = self.state.blob_cache.get(h)
+                        if fn is None:
+                            exported = jax.export.deserialize(
+                                bytearray(blob))
+                            fn = jax.jit(exported.call)
+                            self.state.blob_cache[h] = fn
+                    tenant.executables[str(msg["id"])] = fn
+                    P.send_msg(sock, {"ok": True})
+
+                elif kind == P.EXECUTE:
+                    self._execute(sock, tenant, msg)
+
+                elif kind == P.STATS:
+                    P.send_msg(sock, {"ok": True,
+                                      "tenants": self._stats()})
+
+                else:
+                    P.reply_err(sock, "BAD_KIND", str(kind))
+            except MemoryError as e:
+                P.reply_err(sock, "RESOURCE_EXHAUSTED", str(e))
+            except Exception as e:  # noqa: BLE001 - session must survive
+                log.warn("tenant %s request failed: %s",
+                         tenant.name if tenant else "?", e)
+                P.reply_err(sock, "INTERNAL", f"{type(e).__name__}: {e}")
+        if tenant is not None and self.state.release_tenant(tenant):
+            self._cleanup(tenant)
+
+    def _drop_array(self, t: Tenant, aid: str) -> int:
+        if aid in t.arrays:
+            nbytes = t.nbytes.pop(aid, 0)
+            del t.arrays[aid]
+            self.state.region.mem_release(t.index, nbytes)
+            return nbytes
+        return 0
+
+    def _execute(self, sock, t: Tenant, msg):
+        jax = self.state.jax
+        exe = t.executables.get(str(msg["exe"]))
+        if exe is None:
+            P.reply_err(sock, "NOT_FOUND", str(msg["exe"]))
+            return
+        args = []
+        for aid in msg["args"]:
+            a = t.arrays.get(str(aid))
+            if a is None:
+                P.reply_err(sock, "NOT_FOUND", str(aid))
+                return
+            args.append(a)
+
+        key = str(msg["exe"])
+        est = max(t.cost_ema.get(key, 5000.0), self.state.min_exec_cost_us)
+        self.state.region.rate_block(t.index, int(est), t.priority)
+
+        # Two dispatch modes:
+        #  - metered (a compute quota is active): execute under the lock
+        #    and block for completion so the charge reflects real device
+        #    time and a throttled tenant can't stack async work;
+        #  - passthrough (no quota): dispatch asynchronously and let XLA's
+        #    per-device queue serialize — the broker is then just a
+        #    multiplexer and transport latency pipelines away.
+        metered = (self.state.region.device_stats(t.index).core_limit_pct
+                   > 0) or self.state.min_exec_cost_us > 0
+        if metered:
+            with self.state.exec_mu:
+                t0 = time.monotonic()
+                outs = exe(*args)
+                outs = jax.block_until_ready(outs)
+                actual_us = (time.monotonic() - t0) * 1e6
+        else:
+            t0 = time.monotonic()
+            outs = exe(*args)
+            actual_us = (time.monotonic() - t0) * 1e6
+
+        charged = max(actual_us, float(self.state.min_exec_cost_us))
+        self.state.region.rate_adjust(t.index, int(charged - est))
+        prev = t.cost_ema.get(key)
+        t.cost_ema[key] = (actual_us if prev is None
+                           else prev * 0.7 + actual_us * 0.3)
+        t.executions += 1
+
+        out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        out_ids = [str(x) for x in msg.get("outs", [])]
+        metas = []
+        total_out = 0
+        for i, o in enumerate(out_list):
+            total_out += int(o.nbytes)
+        # Outputs can't be refused post-hoc; account as oversubscribe so
+        # the next put/execute hits the cap (interposer does the same).
+        if total_out:
+            self.state.region.mem_acquire(t.index, total_out, True)
+        for i, o in enumerate(out_list):
+            oid = out_ids[i] if i < len(out_ids) else f"_out{i}"
+            self._drop_array(t, oid)
+            t.arrays[oid] = o
+            t.nbytes[oid] = int(o.nbytes)
+            metas.append({"id": oid, "shape": list(o.shape),
+                          "dtype": str(o.dtype)})
+        P.send_msg(sock, {"ok": True, "outs": metas,
+                          "device_time_us": actual_us})
+
+    def _stats(self):
+        out = {}
+        for name, t in self.state.tenants.items():
+            st = self.state.region.device_stats(t.index)
+            out[name] = {
+                "index": t.index,
+                "used_bytes": int(st.used_bytes),
+                "limit_bytes": int(st.limit_bytes),
+                "peak_bytes": int(st.peak_bytes),
+                "core_limit_pct": int(st.core_limit_pct),
+                "arrays": len(t.arrays),
+                "executions": t.executions,
+            }
+        return out
+
+    def _cleanup(self, t: Tenant):
+        for aid in list(t.arrays):
+            self._drop_array(t, aid)
+        t.executables.clear()
+
+
+class _Server(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def make_server(socket_path: str, hbm_limit: int, core_limit: int,
+                region_path: Optional[str] = None,
+                min_exec_cost_us: int = 0) -> _Server:
+    if os.path.exists(socket_path):
+        os.unlink(socket_path)
+    os.makedirs(os.path.dirname(socket_path) or ".", exist_ok=True)
+    # The region is broker-owned state: a stale file from a previous run
+    # would silently keep the OLD quotas (vtpu_region_open only seeds
+    # limits on first creation).
+    rpath = region_path or socket_path + ".shr"
+    if os.path.exists(rpath):
+        os.unlink(rpath)
+    state = RuntimeState(rpath, hbm_limit, core_limit, min_exec_cost_us)
+    handler = type("BoundSession", (TenantSession,), {"state": state})
+    srv = _Server(socket_path, handler)
+    srv.state = state  # type: ignore[attr-defined]
+    return srv
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="vtpu-runtime")
+    p.add_argument("--socket", default=os.environ.get(
+        "VTPU_RUNTIME_SOCKET", "/usr/local/vtpu/vtpu-runtime.sock"))
+    p.add_argument("--hbm-limit", default=os.environ.get(
+        envspec.ENV_HBM_LIMIT, "0"),
+        help="per-tenant HBM quota (K8s quantity; 0 = unlimited)")
+    p.add_argument("--core-limit", type=int, default=int(os.environ.get(
+        envspec.ENV_CORE_LIMIT, "0")),
+        help="per-tenant device-time %% (0 = unlimited)")
+    p.add_argument("--min-exec-cost-us", type=int,
+                   default=int(os.environ.get("VTPU_MIN_EXEC_COST_US", "0")))
+    p.add_argument("--region", default=None)
+    ns = p.parse_args(argv)
+    # Some images register a TPU plugin at interpreter startup and override
+    # JAX_PLATFORMS; re-assert the env's explicit choice.
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except RuntimeError:
+            pass
+    hbm = envspec.parse_quantity(ns.hbm_limit) if ns.hbm_limit != "0" else 0
+    srv = make_server(ns.socket, hbm, ns.core_limit, ns.region,
+                      ns.min_exec_cost_us)
+    log.info("vtpu-runtime serving on %s (hbm=%d core=%d%%)",
+             ns.socket, hbm, ns.core_limit)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

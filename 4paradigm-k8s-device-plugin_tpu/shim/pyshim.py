@@ -1,0 +1,234 @@
+"""Python-level quota enforcement for JAX processes.
+
+Two jobs:
+
+1. **Native bootstrap** (`bootstrap()`): translate the allocate-time env
+   contract into the native injection channel — point ``TPU_LIBRARY_PATH``
+   at the PJRT interposer, resolve the real driver for it, translate
+   ``VTPU_VISIBLE_DEVICES`` chip UUIDs into ``TPU_VISIBLE_CHIPS`` indices
+   via the mounted inventory file.  On TPU nodes this is all that's
+   needed; the interposer does the enforcement natively.
+
+2. **Pure-Python fallback** (`install_py_enforcement()`): on backends with
+   no wrappable PJRT plugin (notably ``JAX_PLATFORMS=cpu`` in CI) patch
+   ``jax.device_put`` and jitted-function dispatch to run the same
+   shared-region accounting + token bucket through ctypes.  Quota
+   semantics become testable anywhere; the reference has no equivalent
+   (its interceptor only works against real CUDA).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Dict, Optional
+
+from ..utils import envspec
+from ..utils import logging as log
+
+_installed = False
+
+
+def _default_interposer() -> Optional[str]:
+    cands = [
+        os.environ.get("VTPU_INTERPOSER_LIB", ""),
+        "/usr/local/vtpu/libvtpu_pjrt.so",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "native", "build",
+            "libvtpu_pjrt.so"),
+    ]
+    for c in cands:
+        if c and os.path.exists(c):
+            return c
+    return None
+
+
+def _find_real_libtpu() -> Optional[str]:
+    if os.environ.get("VTPU_REAL_LIBTPU"):
+        return os.environ["VTPU_REAL_LIBTPU"]
+    try:
+        import libtpu  # type: ignore
+        p = os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")
+        if os.path.exists(p):
+            return p
+    except ImportError:
+        pass
+    for p in ("/lib/libtpu.so", "/usr/lib/libtpu.so"):
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _chip_index_map() -> Dict[str, int]:
+    """uuid -> node chip index, from the mounted inventory file
+    (written by plugin/main.py write_chip_inventory)."""
+    path = os.environ.get(envspec.ENV_PCIBUS_FILE)
+    out: Dict[str, int] = {}
+    if not path or not os.path.exists(path):
+        return out
+    try:
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    out[parts[1]] = int(parts[0])
+    except (OSError, ValueError) as e:
+        log.warn("bad chip inventory %s: %s", path, e)
+    return out
+
+
+def bootstrap() -> None:
+    """Configure native injection from the env contract.  Idempotent,
+    must run before jax imports (sitecustomize guarantees that)."""
+    spec = envspec.quota_from_env()
+    if not (spec.hbm_limit_bytes or spec.core_limit_pct
+            or spec.visible_devices):
+        return
+
+    # Chip visibility -> libtpu's own chip filter.
+    if spec.visible_devices and "TPU_VISIBLE_CHIPS" not in os.environ:
+        idx = _chip_index_map()
+        indices = []
+        for tok in spec.visible_devices:
+            if tok in idx:
+                indices.append(str(idx[tok]))
+            elif tok.isdigit():
+                indices.append(tok)
+        if indices:
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(indices)
+
+    # Native interposer injection (unless the daemon already set it).
+    interposer = _default_interposer()
+    if interposer and "TPU_LIBRARY_PATH" not in os.environ:
+        os.environ["TPU_LIBRARY_PATH"] = interposer
+    if interposer and "VTPU_REAL_LIBTPU" not in os.environ:
+        real = _find_real_libtpu()
+        if real and os.path.realpath(real) != os.path.realpath(interposer):
+            os.environ["VTPU_REAL_LIBTPU"] = real
+
+    log.debug("shim bootstrap: limits=%s core=%d%% interposer=%s",
+              spec.hbm_limit_bytes, spec.core_limit_pct, interposer)
+
+
+class _PyEnforcer:
+    """Shared-region accounting for the pure-Python path."""
+
+    def __init__(self, spec: envspec.QuotaSpec):
+        from .core import SharedRegion
+        self.spec = spec
+        n = max([o for o in spec.hbm_limit_bytes if o >= 0], default=0) + 1
+        n = max(n, 1)
+        limits = [spec.limit_for(i) for i in range(n)]
+        pcts = [spec.core_limit_pct] * n
+        path = spec.shared_cache or "/tmp/vtpushr.cache"
+        self.region = SharedRegion(path, limits=limits, core_pcts=pcts)
+        self.region.register()
+        # Same floor the native interposer honors: keeps throttling
+        # meaningful when measured latencies are tiny/unreliable.
+        self.min_cost_us = float(os.environ.get("VTPU_MIN_EXEC_COST_US",
+                                                "0") or 0)
+        # array id -> (dev, nbytes); identity keyed, pruned on __del__ via
+        # weakrefs is overkill — jax arrays call block_until_ready paths
+        # through us, and tests drive explicit deletes.
+        self._cost_ema: Dict[int, float] = {}
+
+    def charge(self, nbytes: int, dev: int = 0) -> None:
+        ok = self.region.mem_acquire(dev, nbytes, self.spec.oversubscribe)
+        if not ok:
+            free, total = self.region.mem_info(dev)
+            if self.spec.active_oom_killer:
+                log.error("active OOM killer: quota exceeded on device %d",
+                          dev)
+                os.kill(os.getpid(), 9)
+            raise MemoryError(
+                f"RESOURCE_EXHAUSTED: vTPU device {dev} OOM: requested "
+                f"{nbytes} bytes, quota {total} (free {free})")
+
+    def release(self, nbytes: int, dev: int = 0) -> None:
+        self.region.mem_release(dev, nbytes)
+
+    def gate(self, key: int, dev: int = 0) -> float:
+        """Block per the token bucket; returns the cost estimate used."""
+        est = max(self._cost_ema.get(key, 5000.0), self.min_cost_us)
+        self.region.rate_block(dev, int(est), self.spec.task_priority)
+        return est
+
+    def observe(self, key: int, est: float, actual_us: float,
+                dev: int = 0) -> None:
+        charged = max(actual_us, self.min_cost_us)
+        self.region.rate_adjust(dev, int(charged - est))
+        prev = self._cost_ema.get(key)
+        self._cost_ema[key] = (actual_us if prev is None
+                               else prev * 0.7 + actual_us * 0.3)
+
+
+_enforcer: Optional[_PyEnforcer] = None
+
+
+def install_py_enforcement() -> bool:
+    """Patch jax.device_put + jitted dispatch with quota checks.  Returns
+    True when installed.  Used on CPU/dev backends; real TPU paths use the
+    native interposer instead."""
+    global _installed, _enforcer
+    if _installed:
+        return True
+    spec = envspec.quota_from_env()
+    if not spec.hbm_limit_bytes and not spec.core_limit_pct:
+        return False
+
+    import jax
+    import numpy as np
+
+    enf = _PyEnforcer(spec)
+    _enforcer = enf
+
+    real_device_put = jax.device_put
+
+    @functools.wraps(real_device_put)
+    def device_put(x, device=None, *args, **kwargs):
+        for leaf in jax.tree_util.tree_leaves(x):
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes is None and np.isscalar(leaf):
+                nbytes = 8
+            if nbytes:
+                enf.charge(int(nbytes))
+        return real_device_put(x, device, *args, **kwargs)
+
+    jax.device_put = device_put
+
+    real_jit = jax.jit
+
+    @functools.wraps(real_jit)
+    def jit(fun, *jit_args, **jit_kwargs):
+        compiled = real_jit(fun, *jit_args, **jit_kwargs)
+
+        @functools.wraps(compiled)
+        def call(*args, **kwargs):
+            key = id(compiled)
+            est = enf.gate(key)
+            t0 = time.monotonic()
+            out = compiled(*args, **kwargs)
+            out = jax.block_until_ready(out)
+            actual_us = (time.monotonic() - t0) * 1e6
+            enf.observe(key, est, actual_us)
+            for leaf in jax.tree_util.tree_leaves(out):
+                nbytes = getattr(leaf, "nbytes", 0)
+                if nbytes:
+                    # Outputs occupy "device" memory until deleted; account
+                    # with oversubscribe (can't refuse a finished program).
+                    enf.region.mem_acquire(0, int(nbytes), True)
+            return out
+
+        call._vtpu_wrapped = True  # noqa: SLF001
+        return call
+
+    jax.jit = jit
+    _installed = True
+    log.info("python quota enforcement installed (limits=%s, core=%d%%)",
+             spec.hbm_limit_bytes, spec.core_limit_pct)
+    return True
+
+
+def enforcer() -> Optional["_PyEnforcer"]:
+    return _enforcer

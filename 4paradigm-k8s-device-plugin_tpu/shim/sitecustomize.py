@@ -1,0 +1,73 @@
+"""In-container preload bootstrap — the Python half of the injection story.
+
+The device plugin mounts the staged shim directory into every allocated
+container and points ``PYTHONPATH`` at it (plugin/server.py Allocate), so
+the interpreter imports this module before any user code — the Python
+analogue of the reference's ``/etc/ld.so.preload`` mount (reference
+server.go:511-515, vgpu/ld.so.preload).
+
+Responsibilities:
+  - restore any PYTHONPATH the container image had (ours replaced it; the
+    original is recoverable from /proc/1/environ),
+  - run the vtpu shim bootstrap (native interposer env wiring),
+  - on non-TPU backends, install the pure-Python enforcement.
+
+Never raises: a broken shim must not take down user containers.
+"""
+
+import os
+import sys
+
+_SHIM_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _restore_pythonpath():
+    try:
+        with open("/proc/1/environ", "rb") as f:
+            env1 = f.read().split(b"\0")
+        for entry in env1:
+            if entry.startswith(b"PYTHONPATH="):
+                orig = entry.split(b"=", 1)[1].decode()
+                for p in orig.split(os.pathsep):
+                    if p and p != _SHIM_DIR and p not in sys.path:
+                        sys.path.append(p)
+                current = os.environ.get("PYTHONPATH", "")
+                if orig and orig not in current:
+                    os.environ["PYTHONPATH"] = current + os.pathsep + orig
+                break
+    except OSError:
+        pass
+
+
+def _main():
+    _restore_pythonpath()
+    if _SHIM_DIR not in sys.path:
+        sys.path.insert(0, _SHIM_DIR)
+    try:
+        from vtpu.shim import pyshim
+    except ImportError:
+        # Staged copy keeps the package next to this file.
+        return
+    pyshim.bootstrap()
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    try:
+        from vtpu.utils.envspec import quota_from_env
+        has_quota = bool(quota_from_env().hbm_limit_bytes
+                         or quota_from_env().core_limit_pct)
+    except Exception:  # noqa: BLE001 - malformed env must not kill startup
+        has_quota = False
+    if os.environ.get("VTPU_FORCE_PY_ENFORCEMENT") == "1" or (
+            platforms == "cpu" and has_quota):
+        # Defer until jax is importable *and* quota env exists; swallow
+        # everything — user workloads must start regardless.
+        try:
+            pyshim.install_py_enforcement()
+        except Exception as e:  # noqa: BLE001
+            print(f"[vtpu shim] enforcement install failed: {e}",
+                  file=sys.stderr)
+
+
+try:
+    _main()
+except Exception as _e:  # noqa: BLE001
+    print(f"[vtpu shim] bootstrap failed: {_e}", file=sys.stderr)

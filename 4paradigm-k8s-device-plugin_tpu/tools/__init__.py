@@ -1,0 +1,1 @@
+"""Operator tooling: vtpu-smi (quota/usage monitor)."""

@@ -1,0 +1,135 @@
+"""vtpu-smi — quota/usage monitor over vTPU shared accounting regions.
+
+The reference virtualizes NVML so in-container ``nvidia-smi`` shows the
+quota-adjusted view (reference §2.9f: nvmlDeviceGetMemoryInfo hook,
+``get_gpu_memory_monitor``); node operators read every container's shrreg
+via the VGPU_MONITOR_MODE shared dirs (reference server.go:494-501).
+vtpu-smi is both of those: run it inside a container (it finds the
+region from VTPU_DEVICE_MEMORY_SHARED_CACHE) or on the node against
+``/usr/local/vtpu/shared`` to see every pod.
+
+  vtpu-smi                      # in-container view
+  vtpu-smi --scan /usr/local/vtpu/shared   # node monitor view
+  vtpu-smi --json               # machine-readable
+  vtpu-smi --sweep-host         # reclaim slots of dead host pids (node)
+
+Run as: python -m vtpu.tools.vtpu_smi
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from ..shim.core import SharedRegion
+from ..utils import envspec
+
+
+def find_regions(scan: Optional[str]) -> List[str]:
+    if scan:
+        pats = [os.path.join(scan, "*", "vtpushr.cache"),
+                os.path.join(scan, "*.cache")]
+        out: List[str] = []
+        for pat in pats:
+            out.extend(sorted(glob.glob(pat)))
+        return out
+    env_path = os.environ.get(envspec.ENV_SHARED_CACHE)
+    if env_path and os.path.exists(env_path):
+        return [env_path]
+    return sorted(glob.glob("/tmp/vtpu*.cache"))
+
+
+def read_region(path: str, sweep_host: bool = False) -> Dict:
+    r = SharedRegion(path)
+    try:
+        if sweep_host:
+            r.sweep_dead_host()
+        devices = []
+        for d in range(r.ndevices):
+            st = r.device_stats(d)
+            devices.append({
+                "device": d,
+                "limit_bytes": int(st.limit_bytes),
+                "used_bytes": int(st.used_bytes),
+                "peak_bytes": int(st.peak_bytes),
+                "core_limit_pct": int(st.core_limit_pct),
+                "n_procs": int(st.n_procs),
+            })
+        procs = []
+        for st in r.proc_stats():
+            procs.append({
+                "pid": int(st.pid),
+                "host_pid": int(st.host_pid),
+                "used_bytes": [int(b) for b in
+                               st.used_bytes[:r.ndevices]],
+            })
+        return {"region": path, "devices": devices, "procs": procs}
+    finally:
+        r.close()
+
+
+def _mb(n: int) -> str:
+    return f"{n / 2**20:,.0f}MiB"
+
+
+def render(infos: List[Dict]) -> str:
+    lines = []
+    lines.append("+" + "-" * 74 + "+")
+    lines.append("| vtpu-smi — virtual TPU quota monitor" + " " * 37 + "|")
+    lines.append("+" + "-" * 74 + "+")
+    for info in infos:
+        lines.append(f"| region: {info['region'][:64]:<64} |")
+        lines.append("| dev |       used /      limit (      peak) "
+                     "| core% | procs |" + " " * 12 + "|")
+        for d in info["devices"]:
+            if d["limit_bytes"] == 0 and d["used_bytes"] == 0 \
+                    and d["n_procs"] == 0:
+                continue
+            lim = _mb(d["limit_bytes"]) if d["limit_bytes"] else "unlimited"
+            core = f"{d['core_limit_pct']}%" if d["core_limit_pct"] else "-"
+            row = (f"| {d['device']:>3} | {_mb(d['used_bytes']):>10} / "
+                   f"{lim:>10} ({_mb(d['peak_bytes']):>10}) "
+                   f"| {core:>5} | {d['n_procs']:>5} |")
+            lines.append(row + " " * max(0, 76 - len(row)) + "|")
+        for p in info["procs"]:
+            used = sum(p["used_bytes"])
+            row = (f"|   pid {p['pid']:>7} (host {p['host_pid']:>7}) "
+                   f"uses {_mb(used):>10}")
+            lines.append(row + " " * max(0, 75 - len(row)) + "|")
+        lines.append("+" + "-" * 74 + "+")
+    if not infos:
+        lines.append("no vTPU accounting regions found")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="vtpu-smi")
+    ap.add_argument("--scan", default=None,
+                    help="directory of per-pod shared regions (node mode)")
+    ap.add_argument("--region", action="append", default=[],
+                    help="explicit region file (repeatable)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--sweep-host", action="store_true",
+                    help="reclaim slots of dead host pids (node mode only)")
+    ns = ap.parse_args(argv)
+
+    paths = ns.region or find_regions(ns.scan)
+    infos = []
+    for p in paths:
+        try:
+            infos.append(read_region(p, ns.sweep_host))
+        except OSError as e:
+            print(f"skipping {p}: {e}", file=sys.stderr)
+    if ns.json:
+        print(json.dumps(infos, indent=2))
+    else:
+        print(render(infos))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

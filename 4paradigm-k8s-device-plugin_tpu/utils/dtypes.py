@@ -1,0 +1,18 @@
+"""Dtype-name resolution shared by the runtime wire protocol endpoints.
+
+Extended accelerator dtypes (bfloat16, fp8 variants) have no portable
+numpy ``.str`` encoding; both sides of the protocol ship dtype *names*
+and resolve them here (ml_dtypes registers the extended ones)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))

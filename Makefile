@@ -1,0 +1,27 @@
+# Top-level targets (the reference ships a Makefile for its Go builds;
+# here: native layer, protobuf gencode, tests, bench smoke).
+PKG := 4paradigm-k8s-device-plugin_tpu
+
+all: native proto
+
+native:
+	$(MAKE) -C native all
+
+native-test:
+	$(MAKE) -C native test
+
+proto: $(PKG)/proto/deviceplugin_pb2.py
+
+$(PKG)/proto/deviceplugin_pb2.py: $(PKG)/proto/deviceplugin.proto
+	cd $(PKG)/proto && protoc --python_out=. deviceplugin.proto
+
+test: native
+	python -m pytest tests/ -q
+
+bench-smoke:
+	JAX_PLATFORMS=cpu python bench.py --quick
+
+clean:
+	$(MAKE) -C native clean
+
+.PHONY: all native native-test proto test bench-smoke clean

@@ -1,0 +1,3 @@
+{{- define "vtpu-device-plugin.fullname" -}}
+{{- printf "%s-%s" .Release.Name "vtpu-device-plugin" | trunc 63 | trimSuffix "-" -}}
+{{- end -}}

@@ -1,0 +1,16 @@
+#!/bin/sh
+# Stage the in-container enforcement artifacts onto the node hostPath, then
+# exec the device-plugin daemon.  The reference's entrypoint does exactly
+# this for libvgpu.so (/etc/vgpu -> /usr/local/vgpu); here the staged set
+# is the PJRT interposer, the accounting core, and the Python shim package
+# that Allocate() later mounts into every vTPU container
+# (vtpu/plugin/server.py).
+set -e
+
+VTPU_STAGE_SRC="${VTPU_STAGE_SRC:-/etc/vtpu}"
+VTPU_HOST_LIB_DIR="${VTPU_HOST_LIB_DIR:-/usr/local/vtpu}"
+
+mkdir -p "$VTPU_HOST_LIB_DIR" "$VTPU_HOST_LIB_DIR/shared"
+cp -r "$VTPU_STAGE_SRC"/* "$VTPU_HOST_LIB_DIR/" 2>/dev/null || true
+
+exec python3 -m vtpu.plugin.main "$@"

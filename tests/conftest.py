@@ -1,6 +1,10 @@
 """Test env: force the CPU backend with 8 virtual devices so multi-chip
-sharding paths are exercised without TPU hardware.  Must run before any
-jax import."""
+sharding paths are exercised without TPU hardware.
+
+Note: this image registers a TPU PJRT plugin from an interpreter-startup
+sitecustomize, which imports jax before conftest runs — so mutating
+os.environ["JAX_PLATFORMS"] here is too late; the config update below is
+what actually selects the backend (it works until first backend use)."""
 
 import os
 import sys
@@ -9,9 +13,16 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORMS"] = "cpu"   # for subprocesses spawned by tests
 os.environ.setdefault("VTPU_LOG_LEVEL", "0")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass

@@ -1,0 +1,119 @@
+"""Legacy-preferred controller: checkpoint reconciliation, pod-liveness
+release, allocate-path re-pick, end-to-end through the plugin server."""
+
+import base64
+import json
+import os
+
+import pytest
+
+from kubelet_sim import KubeletSim
+from vtpu.discovery.fake import FakeChipBackend
+from vtpu.plugin.config import Config
+from vtpu.plugin.controller import (ANNOTATION_REQUEST, ANNOTATION_USING,
+                                    VDeviceController)
+from vtpu.plugin.server import VtpuDevicePlugin
+from vtpu.plugin.split import build_plugin_specs
+from vtpu.proto import pb
+
+
+def make_checkpoint(path, entries):
+    data = {"Data": {"PodDeviceEntries": entries, "RegisteredDevices": {}},
+            "Checksum": 0}
+    with open(path, "w") as f:
+        json.dump(data, f)
+
+
+def alloc_resp_b64(request_ids, using_ids):
+    car = pb.ContainerAllocateResponse()
+    car.annotations[ANNOTATION_REQUEST] = ",".join(request_ids)
+    car.annotations[ANNOTATION_USING] = ",".join(using_ids)
+    return base64.b64encode(car.SerializeToString()).decode()
+
+
+@pytest.fixture()
+def setup(tmp_path):
+    cfg = Config(device_plugin_path=str(tmp_path) + "/",
+                 enable_legacy_preferred=True, node_name="node1",
+                 host_lib_dir=str(tmp_path / "vtpu"),
+                 runtime_socket=str(tmp_path / "vtpu" / "rt.sock"))
+    backend = FakeChipBackend(num_chips=2)
+    spec = build_plugin_specs(cfg, backend)[0]
+    return cfg, backend, spec, tmp_path
+
+
+def test_checkpoint_reconciliation(setup):
+    cfg, backend, spec, tmp_path = setup
+    vids = [v.id for v in spec.vdevices]
+    ctl = VDeviceController(cfg)
+    ctl.initialize(vids)
+
+    make_checkpoint(ctl.checkpoint_path, [{
+        "PodUID": "pod-1", "ContainerName": "c",
+        "ResourceName": cfg.resource_name,
+        "DeviceIDs": [vids[0]],
+        "AllocResp": alloc_resp_b64([vids[0]], [vids[1]]),
+    }])
+    ctl.update_from_checkpoint()
+    assert vids[1] not in ctl.available()
+    assert vids[0] in ctl.available()
+
+
+def test_dead_pod_releases(setup):
+    cfg, backend, spec, tmp_path = setup
+    vids = [v.id for v in spec.vdevices]
+
+    pods = [{"metadata": {"uid": "pod-1"},
+             "status": {"phase": "Succeeded"}}]
+    ctl = VDeviceController(cfg, pod_lister=lambda node: pods)
+    ctl.initialize(vids)
+    make_checkpoint(ctl.checkpoint_path, [{
+        "PodUID": "pod-1", "ResourceName": cfg.resource_name,
+        "DeviceIDs": [vids[0]],
+        "AllocResp": alloc_resp_b64([vids[0]], [vids[1]]),
+    }])
+    ctl.update_from_checkpoint()
+    assert vids[1] in ctl.available(), "terminal pod's grant is freed"
+
+
+def test_foreign_resource_ignored(setup):
+    cfg, backend, spec, tmp_path = setup
+    ctl = VDeviceController(cfg)
+    ctl.initialize([v.id for v in spec.vdevices])
+    make_checkpoint(ctl.checkpoint_path, [{
+        "PodUID": "x", "ResourceName": "nvidia.com/gpu",
+        "DeviceIDs": ["GPU-0"],
+        "AllocResp": alloc_resp_b64(["GPU-0"], ["GPU-0"]),
+    }])
+    ctl.update_from_checkpoint()
+    assert len(ctl.available()) == len(spec.vdevices)
+
+
+def test_legacy_allocate_end_to_end(setup):
+    cfg, backend, spec, tmp_path = setup
+    ctl = VDeviceController(cfg)
+    plugin = VtpuDevicePlugin(spec, cfg, topology=backend.topology(),
+                              controller=ctl)
+    sim = KubeletSim(str(tmp_path)).start()
+    plugin.start()
+    try:
+        reg = sim.wait_registration()
+        # Legacy mode must NOT advertise preferred allocation (reference
+        # server.go:233-235).
+        assert not reg.options.get_preferred_allocation_available
+
+        stub, ch = sim.plugin_stub(reg.endpoint)
+        req = pb.AllocateRequest()
+        req.container_requests.add(
+            devicesIDs=[plugin.vdevices[0].id, plugin.vdevices[2].id])
+        resp = stub.Allocate(req)
+        car = resp.container_responses[0]
+        assert car.annotations[ANNOTATION_REQUEST]
+        using = car.annotations[ANNOTATION_USING].split(",")
+        assert len(using) == 2
+        chips = {u.rsplit("-vtpu-", 1)[0] for u in using}
+        assert len(chips) == 2, "re-pick chooses distinct chips"
+        ch.close()
+    finally:
+        plugin.stop()
+        sim.stop()

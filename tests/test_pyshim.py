@@ -1,0 +1,97 @@
+"""Pure-Python enforcement path (CPU backend): device_put OOM at quota,
+jit dispatch throttling, sitecustomize bootstrap in a subprocess."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SHIM_DIR = os.path.join(REPO, "4paradigm-k8s-device-plugin_tpu", "shim")
+
+
+def run_py(code, extra_env, timeout=180):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": SHIM_DIR + os.pathsep + REPO,
+    })
+    env.update(extra_env)
+    return subprocess.run([sys.executable, "-c", textwrap.dedent(code)],
+                         capture_output=True, text=True, env=env,
+                         timeout=timeout)
+
+
+def test_device_put_oom(tmp_path):
+    r = run_py("""
+        import jax, numpy as np
+        x = jax.device_put(np.ones((64, 64), np.float32))   # 16 KB: fits
+        print("small ok", x.shape)
+        try:
+            y = jax.device_put(np.ones((1024, 1024), np.float32))  # 4 MB
+            print("BIG OK (bad)")
+        except MemoryError as e:
+            print("OOM:", str(e)[:60])
+    """, {
+        "VTPU_DEVICE_HBM_LIMIT_0": "1Mi",
+        "VTPU_DEVICE_MEMORY_SHARED_CACHE": str(tmp_path / "shr.cache"),
+    })
+    assert r.returncode == 0, r.stderr
+    assert "small ok" in r.stdout
+    assert "OOM: RESOURCE_EXHAUSTED" in r.stdout
+    assert "BIG OK" not in r.stdout
+
+
+def test_jit_throttled(tmp_path):
+    r = run_py("""
+        import time, jax, jax.numpy as jnp
+        f = jax.jit(lambda a: a @ a)
+        assert getattr(f, "_vtpu_wrapped", False), "jit not wrapped"
+        x = jnp.ones((128, 128), jnp.float32)
+        f(x)  # compile
+        # Drain burst + train EMA with enough calls, then measure.
+        for _ in range(80):
+            f(x)
+        t0 = time.monotonic()
+        for _ in range(20):
+            f(x)
+        print("elapsed %.3f" % (time.monotonic() - t0))
+    """, {
+        "VTPU_DEVICE_HBM_LIMIT_0": "1Gi",
+        "VTPU_DEVICE_CORE_LIMIT": "20",
+        "VTPU_MIN_EXEC_COST_US": "5000",
+        "VTPU_DEVICE_MEMORY_SHARED_CACHE": str(tmp_path / "shr.cache"),
+    })
+    assert r.returncode == 0, r.stderr
+    elapsed = float(r.stdout.split("elapsed")[-1])
+    # 20 tiny matmuls unthrottled: ~ms. At a 20% cap with ~5ms EMA floor…
+    # the py path has no floor env; EMA tracks actual latency, so steady
+    # state wall ~= actual/0.2. Just assert visible slowdown.
+    assert elapsed > 0.2, f"no throttle: {elapsed}"
+
+
+def test_sitecustomize_never_breaks_user_code(tmp_path):
+    # No quota env at all: shim must be a no-op and user code runs.
+    r = run_py("""
+        import jax, numpy as np
+        print("ok", jax.device_put(np.ones(4)).sum())
+    """, {})
+    assert r.returncode == 0, r.stderr
+    assert "ok" in r.stdout
+
+
+def test_sitecustomize_bootstrap_sets_visible_chips(tmp_path):
+    inv = tmp_path / "tpuinfo.vtpu"
+    inv.write_text("0 TPU-abc 0000:00:01.0 17179869184 v5e 0,0\n"
+                   "1 TPU-def 0000:00:02.0 17179869184 v5e 0,1\n")
+    r = run_py("""
+        import os
+        print("chips:", os.environ.get("TPU_VISIBLE_CHIPS"))
+    """, {
+        "VTPU_VISIBLE_DEVICES": "TPU-def",
+        "VTPU_PCIINFO_FILE": str(inv),
+        "VTPU_DEVICE_HBM_LIMIT_0": "1Gi",
+        "VTPU_DEVICE_MEMORY_SHARED_CACHE": str(tmp_path / "shr.cache"),
+    })
+    assert r.returncode == 0, r.stderr
+    assert "chips: 1" in r.stdout

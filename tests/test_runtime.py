@@ -1,0 +1,163 @@
+"""Runtime broker tests (CPU backend): put/get round trip, remote
+compile+execute via jax.export, per-tenant HBM quota OOM, tenant isolation,
+execute throttling, stats, cleanup on disconnect."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vtpu.runtime.client import RuntimeClient, VtpuQuotaError
+from vtpu.runtime.server import make_server
+
+MB = 10**6
+
+
+@pytest.fixture()
+def broker(tmp_path):
+    sock = str(tmp_path / "rt.sock")
+    srv = make_server(sock, hbm_limit=8 * MB, core_limit=0,
+                      region_path=str(tmp_path / "rt.shr"))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield sock
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_put_get_roundtrip(broker):
+    c = RuntimeClient(broker, tenant="t1")
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = c.put(x)
+    np.testing.assert_array_equal(h.fetch(), x)
+    h.delete()
+    c.close()
+
+
+def test_remote_compile_execute(broker):
+    c = RuntimeClient(broker, tenant="t1")
+    f = c.remote_jit(lambda a, b: a @ b + 1.0)
+    a = np.random.rand(8, 16).astype(np.float32)
+    b = np.random.rand(16, 4).astype(np.float32)
+    got = f(a, b)
+    np.testing.assert_allclose(got, a @ b + 1.0, rtol=1e-5)
+    c.close()
+
+
+def test_hbm_quota_oom_and_isolation(broker):
+    c1 = RuntimeClient(broker, tenant="alpha")
+    c2 = RuntimeClient(broker, tenant="beta")
+    # alpha fills its 8 MB quota
+    h = c1.put(np.ones(6 * MB // 4, np.float32))  # 6 MB
+    with pytest.raises(VtpuQuotaError) as ei:
+        c1.put(np.ones(4 * MB // 4, np.float32))  # 4 MB -> over
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    # beta is unaffected (separate quota)
+    h2 = c2.put(np.ones(6 * MB // 4, np.float32))
+    np.testing.assert_array_equal(h2.fetch()[:3], [1, 1, 1])
+    # alpha can allocate again after freeing
+    h.delete()
+    c1.put(np.ones(4 * MB // 4, np.float32))
+    st = c1.stats()
+    assert st["alpha"]["used_bytes"] == 4 * MB
+    assert st["beta"]["used_bytes"] == 6 * MB
+    c1.close()
+    c2.close()
+
+
+def test_execute_outputs_accounted(broker):
+    c = RuntimeClient(broker, tenant="t1")
+    exe = c.compile(lambda a: a * 2.0,
+                    [np.ones((256, 256), np.float32)])
+    h = c.put(np.ones((256, 256), np.float32))   # 256 KB
+    outs = exe(h)
+    st = c.stats()["t1"]
+    assert st["used_bytes"] >= 2 * 256 * 1024
+    outs[0].delete()
+    h.delete()
+    assert c.stats()["t1"]["used_bytes"] == 0
+    c.close()
+
+
+def test_disconnect_frees_tenant_memory(broker):
+    c = RuntimeClient(broker, tenant="gone")
+    c.put(np.ones(MB // 4, np.float32))
+    c.close()
+    time.sleep(0.3)  # session cleanup runs on handler exit
+    c2 = RuntimeClient(broker, tenant="watcher")
+    st = c2.stats()
+    # Last connection gone -> tenant torn down entirely, slot recycled.
+    assert "gone" not in st
+    c2.close()
+
+
+def test_tenant_slots_recycle(broker):
+    # Far more than MAX_TENANTS sequential tenants must all be served.
+    for i in range(40):
+        c = RuntimeClient(broker, tenant=f"ephemeral-{i}")
+        c.put(np.ones(4, np.float32))
+        c.close()
+        time.sleep(0.02)
+    c = RuntimeClient(broker, tenant="final")
+    assert c.tenant_index < 16
+    c.close()
+
+
+def test_shared_tenant_survives_one_disconnect(broker):
+    a = RuntimeClient(broker, tenant="shared")
+    b = RuntimeClient(broker, tenant="shared")
+    h = a.put(np.arange(4, dtype=np.float32))
+    a.close()
+    time.sleep(0.3)
+    # b still sees the tenant's arrays: cleanup waits for the last conn.
+    np.testing.assert_array_equal(b.get(h.id), [0, 1, 2, 3])
+    b.close()
+
+
+def test_execute_throttling(tmp_path):
+    sock = str(tmp_path / "rt2.sock")
+    srv = make_server(sock, hbm_limit=0, core_limit=25,
+                      region_path=str(tmp_path / "rt2.shr"),
+                      min_exec_cost_us=10_000)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = RuntimeClient(sock, tenant="slow")
+        exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+        h = c.put(np.ones(4, np.float32))
+        for _ in range(30):     # drain the 250ms burst at 10ms/charge
+            exe(h)
+        t0 = time.monotonic()
+        for _ in range(10):     # 100ms charged at 25% -> >= ~0.4s
+            exe(h)
+        elapsed = time.monotonic() - t0
+        assert elapsed > 0.3, f"no throttle: {elapsed:.3f}"
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_priority_zero_borrows(tmp_path):
+    sock = str(tmp_path / "rt3.sock")
+    srv = make_server(sock, hbm_limit=0, core_limit=10,
+                      region_path=str(tmp_path / "rt3.shr"),
+                      min_exec_cost_us=10_000)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = RuntimeClient(sock, tenant="vip", priority=0)
+        exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+        h = c.put(np.ones(4, np.float32))
+        for _ in range(30):
+            exe(h)
+        t0 = time.monotonic()
+        for _ in range(10):
+            exe(h)
+        assert time.monotonic() - t0 < 1.0, "priority 0 must not throttle"
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()

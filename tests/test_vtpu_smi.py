@@ -1,0 +1,67 @@
+"""vtpu-smi monitor: JSON + table rendering over live regions."""
+
+import json
+import subprocess
+import sys
+import os
+
+from vtpu.shim.core import SharedRegion
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MB = 10**6
+
+
+def run_smi(*args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    return subprocess.run(
+        [sys.executable, "-m", "vtpu.tools.vtpu_smi", *args],
+        capture_output=True, text=True, env=env)
+
+
+def test_smi_json_view(tmp_path):
+    path = str(tmp_path / "a.cache")
+    r = SharedRegion(path, limits=[100 * MB, 50 * MB], core_pcts=[30, 0])
+    r.register()
+    r.mem_acquire(0, 20 * MB)
+    r.mem_acquire(1, 5 * MB)
+
+    out = run_smi("--region", path, "--json")
+    assert out.returncode == 0, out.stderr
+    data = json.loads(out.stdout)
+    assert len(data) == 1
+    devs = data[0]["devices"]
+    assert devs[0]["used_bytes"] == 20 * MB
+    assert devs[0]["limit_bytes"] == 100 * MB
+    assert devs[0]["core_limit_pct"] == 30
+    assert devs[1]["used_bytes"] == 5 * MB
+    assert data[0]["procs"][0]["pid"] == os.getpid()
+    r.close()
+
+
+def test_smi_table_and_scan(tmp_path):
+    d = tmp_path / "podA_ctr_12345678"
+    d.mkdir()
+    path = str(d / "vtpushr.cache")
+    r = SharedRegion(path, limits=[64 * MB])
+    r.register()
+    r.mem_acquire(0, 10 * MB)
+
+    out = run_smi("--scan", str(tmp_path))
+    assert out.returncode == 0, out.stderr
+    assert "vtpushr.cache" in out.stdout or "podA" in out.stdout
+    assert "10MiB" in out.stdout.replace(",", "")
+    r.close()
+
+
+def test_smi_env_discovery(tmp_path):
+    path = str(tmp_path / "b.cache")
+    SharedRegion(path, limits=[MB]).close()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["VTPU_DEVICE_MEMORY_SHARED_CACHE"] = path
+    out = subprocess.run(
+        [sys.executable, "-m", "vtpu.tools.vtpu_smi", "--json"],
+        capture_output=True, text=True, env=env)
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout)[0]["region"] == path
