@@ -36,6 +36,10 @@ class TransformerConfig:
     max_seq: int = 1024
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
+    # Pallas fused-attention kernel (vtpu.ops.flash_attention); the jnp
+    # reference path stays default for sharded training (the kernel is a
+    # single-device op — round-2: shard_map it over 'tp').
+    use_flash: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -155,6 +159,11 @@ def attention(x: jax.Array, lp: Dict[str, jax.Array],
     rep = cfg.n_heads // cfg.n_kv_heads
     k = jnp.repeat(k, rep, axis=2)
     v = jnp.repeat(v, rep, axis=2)
+    if cfg.use_flash:
+        from ..ops.flash_attention import attention_bshd
+
+        out = attention_bshd(q, k, v, causal=True).reshape(b, s, cfg.dim)
+        return out @ lp["wo"]
     # [b, h, s, d]: MXU-friendly contraction layout.
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,

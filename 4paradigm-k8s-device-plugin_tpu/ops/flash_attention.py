@@ -1,0 +1,103 @@
+"""Fused causal attention as a Pallas TPU kernel.
+
+The transformer's hot op (vtpu.models.transformer).  The jnp reference
+path materialises the full [b, h, s, s] score tensor in HBM; this kernel
+streams one q-block at a time through VMEM and never writes scores back —
+HBM traffic drops from O(s²) to O(s·d), and the two matmuls stay on the
+MXU with an f32 accumulator.
+
+Design notes (per the TPU kernel playbook):
+- grid = (batch·heads, s/block_q): both axes parallel; no cross-step
+  state, so no "arbitrary" dimension semantics needed.
+- K/V for one (batch, head) live whole in VMEM: s·d·2B ≤ ~512 KB at the
+  shapes this repo runs (s ≤ 2048, d ≤ 128) — well inside the ~16 MB
+  budget, so online-softmax streaming of K is unnecessary complexity.
+- causal mask from 2D broadcasted iota (TPU requires ≥2D iota).
+- softmax in f32 (VPU), matmuls with preferred_element_type=f32 (MXU).
+
+Falls back to interpreter mode off-TPU so CPU tests exercise the same
+code path numerically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float,
+                 block_q: int, causal: bool):
+    qi = pl.program_id(1)
+    q = q_ref[0]                       # [block_q, d]
+    k = k_ref[0]                       # [s, d]
+    v = v_ref[0]                       # [s, d]
+
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [block_q, s]
+
+    if causal:
+        s = k.shape[0]
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, s), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, s), 1)
+        scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - m)
+    probs = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(v.dtype)
+
+    o_ref[0] = jax.lax.dot_general(
+        probs, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q",
+                                             "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 128,
+                    interpret: bool | None = None) -> jax.Array:
+    """Fused attention over [bh, s, d] tensors (kv already head-repeated).
+
+    q, k, v: [batch*heads, seq, head_dim]; returns [bh, s, d] in q.dtype.
+    """
+    bh, s, d = q.shape
+    if s % block_q != 0:
+        # Shapes in this repo are powers of two >= 128; degrade gracefully
+        # for odd test sizes.
+        block_q = s
+    scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    kernel = functools.partial(_attn_kernel, scale=scale,
+                               block_q=block_q, causal=causal)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def attention_bshd(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True) -> jax.Array:
+    """[b, s, h, d] convenience wrapper matching the model's layout."""
+    b, s, h, d = q.shape
+    fold = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    out = flash_attention(fold(q), fold(k), fold(v), causal=causal)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

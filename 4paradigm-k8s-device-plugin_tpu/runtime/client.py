@@ -75,7 +75,8 @@ class RuntimeClient:
             "VTPU_TENANT", f"pid{os.getpid()}")
         self.priority = spec.task_priority if priority is None else priority
         resp = self._rpc({"kind": P.HELLO, "tenant": self.tenant,
-                          "priority": self.priority})
+                          "priority": self.priority,
+                          "oversubscribe": spec.oversubscribe})
         self.tenant_index = resp["tenant_index"]
 
     @classmethod

@@ -43,11 +43,17 @@ MAX_TENANTS = 16
 
 
 class Tenant:
-    def __init__(self, name: str, index: int, priority: int):
+    def __init__(self, name: str, index: int, priority: int,
+                 oversubscribe: bool = False):
         self.name = name
         self.index = index          # region device index for accounting
         self.priority = priority
+        self.oversubscribe = oversubscribe
         self.arrays: Dict[str, Any] = {}
+        # ids currently spilled to host RAM (oversubscribe): staged onto
+        # the device transiently at execute time.
+        self.host_arrays: Dict[str, Any] = {}
+        self.host_bytes = 0
         self.nbytes: Dict[str, int] = {}
         self.executables: Dict[str, Any] = {}
         self.cost_ema: Dict[str, float] = {}
@@ -55,6 +61,10 @@ class Tenant:
         # Live connections sharing this tenant (a pod may open several);
         # state is torn down when the last one closes.
         self.connections = 0
+        # Sequence for server-assigned output ids (when the client sent
+        # fewer out-ids than the program has outputs) — must be unique
+        # per tenant or successive executes would clobber each other.
+        self.anon_seq = 0
 
 
 class RuntimeState:
@@ -78,7 +88,8 @@ class RuntimeState:
         # so a throttled tenant cannot sneak concurrency past the bucket.
         self.exec_mu = threading.Lock()
 
-    def tenant(self, name: str, priority: int) -> Tenant:
+    def tenant(self, name: str, priority: int,
+               oversubscribe: bool = False) -> Tenant:
         with self.mu:
             t = self.tenants.get(name)
             if t is None:
@@ -87,7 +98,7 @@ class RuntimeState:
                               if i not in used), None)
                 if index is None:
                     raise RuntimeError("tenant slots exhausted")
-                t = Tenant(name, index, priority)
+                t = Tenant(name, index, priority, oversubscribe)
                 self.tenants[name] = t
             t.connections += 1
             return t
@@ -106,14 +117,6 @@ class RuntimeState:
 class TenantSession(socketserver.BaseRequestHandler):
     state: RuntimeState  # injected by make_server
 
-    # -- helpers --
-    def _charge(self, t: Tenant, nbytes: int) -> None:
-        if not self.state.region.mem_acquire(t.index, nbytes, False):
-            free, total = self.state.region.mem_info(t.index)
-            raise MemoryError(
-                f"RESOURCE_EXHAUSTED: tenant {t.name} over HBM quota: "
-                f"requested {nbytes}, quota {total} (free {free})")
-
     def handle(self):  # noqa: C901 - protocol dispatch
         sock = self.request
         tenant: Optional[Tenant] = None
@@ -128,7 +131,8 @@ class TenantSession(socketserver.BaseRequestHandler):
             try:
                 if kind == P.HELLO:
                     tenant = self.state.tenant(
-                        str(msg["tenant"]), int(msg.get("priority", 1)))
+                        str(msg["tenant"]), int(msg.get("priority", 1)),
+                        bool(msg.get("oversubscribe", False)))
                     P.send_msg(sock, {"ok": True,
                                       "tenant_index": tenant.index})
                     continue
@@ -141,25 +145,52 @@ class TenantSession(socketserver.BaseRequestHandler):
                         msg["data"], dtype=_np_dtype(msg["dtype"])
                     ).reshape(msg["shape"])
                     nbytes = int(arr.nbytes)
-                    self._charge(tenant, nbytes)
-                    try:
-                        dev_arr = jax.device_put(arr, self.state.device)
-                        dev_arr.block_until_ready()
-                    except Exception:
-                        self.state.region.mem_release(tenant.index, nbytes)
-                        raise
                     aid = str(msg["id"])
+                    # Replacement semantics: free the old copy before the
+                    # quota check so an exact-fit re-PUT succeeds.
                     self._drop_array(tenant, aid)
-                    tenant.arrays[aid] = dev_arr
-                    tenant.nbytes[aid] = nbytes
-                    P.send_msg(sock, {"ok": True, "nbytes": nbytes})
+                    spilled = False
+                    if not self.state.region.mem_acquire(tenant.index,
+                                                         nbytes, False):
+                        if not tenant.oversubscribe:
+                            free, total = self.state.region.mem_info(
+                                tenant.index)
+                            raise MemoryError(
+                                f"RESOURCE_EXHAUSTED: tenant {tenant.name}"
+                                f" over HBM quota: requested {nbytes}, "
+                                f"quota {total} (free {free})")
+                        # Oversubscribe: the excess lives in host RAM and
+                        # is staged onto the device per execute (the
+                        # reference's unified-memory spill, reference
+                        # README.md:104, done TPU-style: explicit staging).
+                        spilled = True
+                    self._drop_array(tenant, aid)
+                    if spilled:
+                        tenant.host_arrays[aid] = np.array(arr)
+                        tenant.host_bytes += nbytes
+                        tenant.nbytes[aid] = 0
+                    else:
+                        try:
+                            dev_arr = jax.device_put(arr, self.state.device)
+                            dev_arr.block_until_ready()
+                        except Exception:
+                            self.state.region.mem_release(tenant.index,
+                                                          nbytes)
+                            raise
+                        tenant.arrays[aid] = dev_arr
+                        tenant.nbytes[aid] = nbytes
+                    P.send_msg(sock, {"ok": True, "nbytes": nbytes,
+                                      "spilled": spilled})
 
                 elif kind == P.GET:
                     aid = str(msg["id"])
-                    if aid not in tenant.arrays:
+                    if aid in tenant.host_arrays:
+                        host = tenant.host_arrays[aid]
+                    elif aid in tenant.arrays:
+                        host = np.asarray(tenant.arrays[aid])
+                    else:
                         P.reply_err(sock, "NOT_FOUND", aid)
                         continue
-                    host = np.asarray(tenant.arrays[aid])
                     P.send_msg(sock, {
                         "ok": True, "shape": list(host.shape),
                         "dtype": host.dtype.name, "data": host.tobytes()})
@@ -203,6 +234,11 @@ class TenantSession(socketserver.BaseRequestHandler):
             self._cleanup(tenant)
 
     def _drop_array(self, t: Tenant, aid: str) -> int:
+        if aid in t.host_arrays:
+            arr = t.host_arrays.pop(aid)
+            t.nbytes.pop(aid, None)
+            t.host_bytes -= int(arr.nbytes)
+            return int(arr.nbytes)
         if aid in t.arrays:
             nbytes = t.nbytes.pop(aid, 0)
             del t.arrays[aid]
@@ -218,9 +254,15 @@ class TenantSession(socketserver.BaseRequestHandler):
             return
         args = []
         for aid in msg["args"]:
-            a = t.arrays.get(str(aid))
+            aid = str(aid)
+            a = t.arrays.get(aid)
+            if a is None and aid in t.host_arrays:
+                # Spilled operand: staged onto the device for this execute
+                # only (the transient overshoot is the cost of
+                # oversubscription; it is freed right after dispatch).
+                a = jax.device_put(t.host_arrays[aid], self.state.device)
             if a is None:
-                P.reply_err(sock, "NOT_FOUND", str(aid))
+                P.reply_err(sock, "NOT_FOUND", aid)
                 return
             args.append(a)
 
@@ -266,7 +308,11 @@ class TenantSession(socketserver.BaseRequestHandler):
         if total_out:
             self.state.region.mem_acquire(t.index, total_out, True)
         for i, o in enumerate(out_list):
-            oid = out_ids[i] if i < len(out_ids) else f"_out{i}"
+            if i < len(out_ids):
+                oid = out_ids[i]
+            else:
+                t.anon_seq += 1
+                oid = f"_anon{t.anon_seq}"
             self._drop_array(t, oid)
             t.arrays[oid] = o
             t.nbytes[oid] = int(o.nbytes)
@@ -286,12 +332,13 @@ class TenantSession(socketserver.BaseRequestHandler):
                 "peak_bytes": int(st.peak_bytes),
                 "core_limit_pct": int(st.core_limit_pct),
                 "arrays": len(t.arrays),
+                "host_spill_bytes": int(t.host_bytes),
                 "executions": t.executions,
             }
         return out
 
     def _cleanup(self, t: Tenant):
-        for aid in list(t.arrays):
+        for aid in list(t.arrays) + list(t.host_arrays):
             self._drop_array(t, aid)
         t.executables.clear()
 

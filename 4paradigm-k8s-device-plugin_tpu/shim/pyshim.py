@@ -177,23 +177,45 @@ def install_py_enforcement() -> bool:
     if not spec.hbm_limit_bytes and not spec.core_limit_pct:
         return False
 
+    import weakref
+
     import jax
     import numpy as np
 
     enf = _PyEnforcer(spec)
     _enforcer = enf
 
+    def _charge_tracked(out_leaf, nbytes: int) -> None:
+        """Charge now, release when the device array is collected — the
+        lifetime coupling the native interposer gets from
+        PJRT_Buffer_Destroy."""
+        enf.charge(nbytes)
+        try:
+            weakref.finalize(out_leaf, enf.release, nbytes)
+        except TypeError:
+            # Non-weakreferenceable leaf (plain scalar): release now, the
+            # charge was only an admission check.
+            enf.release(nbytes)
+
     real_device_put = jax.device_put
 
     @functools.wraps(real_device_put)
     def device_put(x, device=None, *args, **kwargs):
+        sizes = []
         for leaf in jax.tree_util.tree_leaves(x):
             nbytes = getattr(leaf, "nbytes", None)
             if nbytes is None and np.isscalar(leaf):
                 nbytes = 8
+            sizes.append(int(nbytes or 0))
             if nbytes:
                 enf.charge(int(nbytes))
-        return real_device_put(x, device, *args, **kwargs)
+        out = real_device_put(x, device, *args, **kwargs)
+        # Transfer the charges onto the device-side leaves' lifetimes.
+        for leaf, nbytes in zip(jax.tree_util.tree_leaves(out), sizes):
+            if nbytes:
+                enf.release(nbytes)
+                _charge_tracked(leaf, nbytes)
+        return out
 
     jax.device_put = device_put
 
@@ -215,9 +237,16 @@ def install_py_enforcement() -> bool:
             for leaf in jax.tree_util.tree_leaves(out):
                 nbytes = getattr(leaf, "nbytes", 0)
                 if nbytes:
-                    # Outputs occupy "device" memory until deleted; account
-                    # with oversubscribe (can't refuse a finished program).
+                    # Outputs occupy "device" memory until collected;
+                    # admitted with oversubscribe (can't refuse a finished
+                    # program), released by finalizer on GC.
                     enf.region.mem_acquire(0, int(nbytes), True)
+                    import weakref
+
+                    try:
+                        weakref.finalize(leaf, enf.release, int(nbytes))
+                    except TypeError:
+                        enf.release(int(nbytes))
             return out
 
         call._vtpu_wrapped = True  # noqa: SLF001

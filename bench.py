@@ -62,17 +62,28 @@ def run_tenant(sock, tenant, steps, cfg_name, batch, seq):
     cfg = getattr(tr.TransformerConfig, cfg_name)()
     c = RuntimeClient(sock, tenant=tenant)
 
-    params = tr.init_params(cfg, jax.random.PRNGKey(0))
-    flat, treedef = jax.tree_util.tree_flatten(params)
+    # Abstract init (no real params on the client): leaves materialise on
+    # the broker's device via a no-arg init program — ~1 GB of weights
+    # never crosses the socket.
+    shapes = jax.eval_shape(
+        lambda: tr.init_params(cfg, jax.random.PRNGKey(0)))
+    flat_shapes, treedef = jax.tree_util.tree_flatten(shapes)
     tokens = np.zeros((batch, seq), np.int32)
+
+    def init_flat():
+        params = tr.init_params(cfg, jax.random.PRNGKey(0))
+        return tuple(jax.tree_util.tree_flatten(params)[0])
 
     def fwd_flat(tokens, *leaves):
         return tr.forward(jax.tree_util.tree_unflatten(treedef, leaves),
                           tokens, cfg)
 
-    example = [tokens] + [np.asarray(leaf) for leaf in flat]
-    exe = c.compile(fwd_flat, example)
-    handles = [c.put(a) for a in example]
+    init_exe = c.compile(init_flat, [])
+    param_handles = init_exe()
+    tok_handle = c.put(tokens)
+    # ShapeDtypeStructs are enough for compile (it only reads shape/dtype).
+    exe = c.compile(fwd_flat, [tokens] + flat_shapes)
+    handles = [tok_handle] + param_handles
 
     # Warmup: server-side compile + steady-state token buckets.
     outs = exe(*handles)

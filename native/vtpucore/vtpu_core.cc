@@ -138,6 +138,58 @@ static int sweep_locked(Region* g, int host_mode) {
   return reclaimed;
 }
 
+/* Fork handling (the reference's child_reinit machinery, §2.9g): a forked
+ * child inherits the mapping but NOT the parent's proc slot — it must
+ * re-register under its own pid so its allocations are attributable and
+ * reclaimable.  Tracked via a registry of open regions + pthread_atfork. */
+#define VTPU_MAX_OPEN_REGIONS 64
+static vtpu_region* g_open_regions[VTPU_MAX_OPEN_REGIONS];
+static pthread_mutex_t g_open_mu = PTHREAD_MUTEX_INITIALIZER;
+
+/* The prepare/parent/child trio keeps g_open_mu consistent across fork in
+ * multithreaded processes: without `prepare`, a fork racing another
+ * thread's track/untrack would leave the child's copy of the mutex locked
+ * forever. */
+static void atfork_prepare(void) { pthread_mutex_lock(&g_open_mu); }
+static void atfork_parent(void) { pthread_mutex_unlock(&g_open_mu); }
+
+static void atfork_child(void) {
+  for (int i = 0; i < VTPU_MAX_OPEN_REGIONS; i++) {
+    vtpu_region* r = g_open_regions[i];
+    if (r) {
+      r->my_slot = -1;
+      vtpu_proc_register(r, 0);
+    }
+  }
+  pthread_mutex_unlock(&g_open_mu);
+}
+
+static void track_region(vtpu_region* r) {
+  static pthread_once_t once = PTHREAD_ONCE_INIT;
+  struct Init {
+    static void install(void) {
+      pthread_atfork(atfork_prepare, atfork_parent, atfork_child);
+    }
+  };
+  pthread_once(&once, Init::install);
+  pthread_mutex_lock(&g_open_mu);
+  for (int i = 0; i < VTPU_MAX_OPEN_REGIONS; i++) {
+    if (!g_open_regions[i]) {
+      g_open_regions[i] = r;
+      break;
+    }
+  }
+  pthread_mutex_unlock(&g_open_mu);
+}
+
+static void untrack_region(vtpu_region* r) {
+  pthread_mutex_lock(&g_open_mu);
+  for (int i = 0; i < VTPU_MAX_OPEN_REGIONS; i++) {
+    if (g_open_regions[i] == r) g_open_regions[i] = NULL;
+  }
+  pthread_mutex_unlock(&g_open_mu);
+}
+
 vtpu_region* vtpu_region_open(const char* path, int ndevices,
                               const uint64_t* limit_bytes,
                               const int32_t* core_limit_pct) {
@@ -209,11 +261,13 @@ vtpu_region* vtpu_region_open(const char* path, int ndevices,
   r->shm = g;
   r->fd = fd;
   r->my_slot = -1;
+  track_region(r);
   return r;
 }
 
 void vtpu_region_close(vtpu_region* r) {
   if (!r) return;
+  untrack_region(r);
   munmap(r->shm, sizeof(Region));
   close(r->fd);
   free(r);

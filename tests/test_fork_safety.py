@@ -1,0 +1,32 @@
+"""Fork handling: a forked child auto-re-registers its own proc slot
+(native pthread_atfork — the reference's child_reinit machinery, §2.9g),
+and its usage is reclaimable after exit without touching the parent's."""
+
+import os
+import tempfile
+
+from vtpu.shim.core import SharedRegion
+
+MB = 10**6
+
+
+def test_forked_child_gets_own_slot(tmp_path):
+    r = SharedRegion(str(tmp_path / "f.cache"), limits=[100 * MB])
+    r.register()
+    assert r.mem_acquire(0, 1 * MB)
+
+    pid = os.fork()
+    if pid == 0:
+        # Child: the atfork hook re-registered us under our own pid;
+        # this acquire must be attributed to the child's slot.
+        ok = r.mem_acquire(0, 2 * MB)
+        os._exit(0 if ok else 1)
+    _, status = os.waitpid(pid, 0)
+    assert status == 0, "child acquire failed"
+
+    # Child exited without deregistering; sweep reclaims ONLY its usage.
+    r.sweep_dead()
+    st = r.device_stats(0)
+    assert st.used_bytes == 1 * MB
+    r.deregister()
+    r.close()
