@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import queue
 import signal
+import subprocess
 import sys
 import threading
 import time
@@ -24,7 +25,7 @@ from ..discovery.factory import make_backend
 from ..discovery.types import Health, TpuChip
 from ..utils import logging as log
 from .config import Config, parse_args
-from .server import VtpuDevicePlugin
+from .server import VtpuDevicePlugin, socket_alive as _socket_alive
 from .split import build_plugin_specs
 from .watchers import FsWatcher, SignalWatcher
 
@@ -48,15 +49,134 @@ def write_chip_inventory(cfg: Config, chips: List[TpuChip]) -> None:
 class Daemon:
     """Owns the plugin set + health loop across restarts."""
 
-    def __init__(self, cfg: Config, backend: Optional[ChipBackend] = None):
+    def __init__(self, cfg: Config, backend: Optional[ChipBackend] = None,
+                 pod_lister=None):
         self.cfg = cfg
         self.backend = backend
         self.plugins: List[VtpuDevicePlugin] = []
+        # Injected in tests; in production built lazily from the
+        # in-cluster serviceaccount when monitor/legacy mode needs it
+        # (reference wires client-go at server.go:365-406 and
+        # vdevice-controller.go:162-223).
+        self.pod_lister = pod_lister
+        # Broker subprocess (one per node, survives plugin restarts so
+        # tenant state outlives a kubelet flap).
+        self._runtime_proc: Optional[subprocess.Popen] = None
+        self._runtime_specs: list = []
+        # Respawn damping: a broker that dies on startup must not be
+        # forked twice a second from the event loop.
+        self._runtime_next_attempt = 0.0
+        self._runtime_backoff = 1.0
         # Fresh per generation: a slow probe can outlive stop_plugins()'s
         # bounded join, and reusing one Event would un-stop that stale
         # loop on the next start.
         self._health_stop: Optional[threading.Event] = None
         self._health_thread: Optional[threading.Thread] = None
+
+    def _make_pod_lister(self):
+        if self.pod_lister is not None:
+            return self.pod_lister
+        if not (self.cfg.monitor_mode or self.cfg.enable_legacy_preferred):
+            return None
+        from ..k8s.client import K8sClient, pod_lister as make_lister
+        client = K8sClient()
+        if not client.available:
+            log.warn("monitor/legacy mode requested but no in-cluster "
+                     "credentials; pod matching disabled")
+            return None
+        self.pod_lister = make_lister(client)
+        return self.pod_lister
+
+    # -- runtime broker ------------------------------------------------------
+
+    def ensure_runtime(self, specs) -> None:
+        """Spawn the node broker when time-share splitting is on, so the
+        socket Allocate mounts actually exists before any pod starts.
+        Idempotent; the broker survives plugin restarts."""
+        if not self.cfg.enable_runtime:
+            return
+        shared = [s for s in specs if s.time_shared and s.vdevices]
+        if not shared:
+            return
+        self._runtime_specs = shared  # for poll_runtime respawn
+        if self._runtime_proc is not None \
+                and self._runtime_proc.poll() is None:
+            return
+        if time.monotonic() < self._runtime_next_attempt:
+            return
+        # Exponential backoff up to 30s; reset on a successful socket.
+        self._runtime_next_attempt = (time.monotonic()
+                                      + self._runtime_backoff)
+        self._runtime_backoff = min(self._runtime_backoff * 2, 30.0)
+        sock = self.cfg.runtime_socket
+        if os.path.exists(sock):
+            if _socket_alive(sock):
+                # Externally-managed broker (sidecar deployment): use it.
+                log.info("external vtpu-runtime broker on %s", sock)
+                return
+            # Stale file from a dead broker: a bind mount of it would hand
+            # pods a permanently-dead inode.
+            try:
+                os.unlink(sock)
+            except OSError:
+                pass
+        v = shared[0].vdevices[0]
+        cmd = [sys.executable, "-m", "vtpu.runtime.server",
+               "--socket", self.cfg.runtime_socket,
+               "--hbm-limit", str(v.hbm_bytes),
+               "--core-limit", str(v.core_pct)]
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        try:
+            self._runtime_proc = subprocess.Popen(cmd, env=env)
+        except OSError as e:
+            log.error("cannot start vtpu-runtime broker: %s", e)
+            return
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if os.path.exists(self.cfg.runtime_socket):
+                log.info("vtpu-runtime broker up on %s (pid %d)",
+                         self.cfg.runtime_socket, self._runtime_proc.pid)
+                self._runtime_backoff = 1.0
+                return
+            if self._runtime_proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        log.error("vtpu-runtime broker failed to create %s; pods fall "
+                  "back to interposer-only enforcement",
+                  self.cfg.runtime_socket)
+
+    def poll_runtime(self) -> None:
+        """Retry/respawn the broker from the daemon event loop — covers a
+        crashed broker (OOM-kill) and a spawn that failed outright; both
+        damped by ensure_runtime's backoff so a crash-looping broker is
+        forked at most every backoff interval, not per loop tick."""
+        if not (self.cfg.enable_runtime and self._runtime_specs):
+            return
+        if self._runtime_proc is not None \
+                and self._runtime_proc.poll() is not None:
+            log.warn("vtpu-runtime broker died (rc=%s); respawning",
+                     self._runtime_proc.returncode)
+            self._runtime_proc = None
+        if self._runtime_proc is None:
+            self.ensure_runtime(self._runtime_specs)
+
+    def stop_runtime(self) -> None:
+        if self._runtime_proc is not None:
+            self._runtime_proc.terminate()
+            try:
+                self._runtime_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._runtime_proc.kill()
+            self._runtime_proc = None
+            # Remove the socket file so a later start can't mistake it for
+            # a live broker (the broker's SIGTERM death skips cleanup).
+            try:
+                os.unlink(self.cfg.runtime_socket)
+            except OSError:
+                pass
 
     # -- plugin set lifecycle ------------------------------------------------
 
@@ -73,15 +193,18 @@ class Daemon:
             return False
         write_chip_inventory(self.cfg, chips)
 
+        lister = self._make_pod_lister()
         controller = None
         if self.cfg.enable_legacy_preferred:
             from .controller import VDeviceController
-            controller = VDeviceController(self.cfg)
+            controller = VDeviceController(self.cfg, pod_lister=lister)
 
         specs = build_plugin_specs(self.cfg, self.backend)
+        self.ensure_runtime(specs)
         topo = self.backend.topology()
         plugins = [VtpuDevicePlugin(s, self.cfg, topology=topo,
-                                    controller=controller)
+                                    controller=controller,
+                                    pod_lister=lister)
                    for s in specs]
         started: List[VtpuDevicePlugin] = []
         for p in plugins:
@@ -166,6 +289,7 @@ def run(cfg: Config, backend: Optional[ChipBackend] = None,
             # Event wait: kubelet restart or signal.
             restart = False
             while not restart:
+                daemon.poll_runtime()
                 try:
                     ev = fs.events.get(timeout=0.5)
                     if ev.op == "create":
@@ -190,6 +314,7 @@ def run(cfg: Config, backend: Optional[ChipBackend] = None,
             time.sleep(0.2)
     finally:
         daemon.stop_plugins()
+        daemon.stop_runtime()
         fs.stop()
 
 

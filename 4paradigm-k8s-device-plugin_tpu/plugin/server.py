@@ -46,6 +46,23 @@ _CRASH_BUDGET = 5
 _CRASH_WINDOW_S = 3600.0
 
 
+def socket_alive(path: str) -> bool:
+    """True when a unix socket at `path` accepts connections — existence
+    of the file is not enough (a dead broker leaves a stale inode)."""
+    import socket as socketmod
+    if not os.path.exists(path):
+        return False
+    s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+    s.settimeout(1.0)
+    try:
+        s.connect(path)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
 class VtpuDevicePlugin(rpc.DevicePluginServicer):
     """One device-plugin service instance (resource name + unix socket)."""
 
@@ -70,6 +87,13 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         self._health_version = 0
         self._health_cond = threading.Condition()
         self._crash_times: List[float] = []
+        # Monitor mode: (pod uid, container name) -> claim time for
+        # containers already matched to an Allocate, so two same-sized
+        # pending pods on one node get distinct shared dirs (reference
+        # server.go:365-406 matches per-call and collides).  Guarded by
+        # _matched_mu: Allocate runs on a thread pool.
+        self._matched_pods: Dict[tuple, float] = {}
+        self._matched_mu = threading.Lock()
 
     # ------------------------------------------------------------------
     # Lifecycle (reference server.go:132-243)
@@ -255,33 +279,67 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
                 ns, pod, container, uid = match
                 # Namespace + UID keep distinct same-named pods from
                 # colliding on one accounting region.
-                d = os.path.join(CONTAINER_LIB_DIR, "shared",
-                                 f"{ns}_{pod}_{container}_{uid[:8]}")
+                name = f"{ns}_{pod}_{container}_{uid[:8]}"
+                # The region open (open+O_CREAT) cannot create intermediate
+                # directories — pre-create the host-side dir the container
+                # path maps onto via the `shared` mount.
+                try:
+                    os.makedirs(os.path.join(self.cfg.host_lib_dir,
+                                             "shared", name), exist_ok=True)
+                except OSError as e:
+                    log.warn("cannot create shared dir for %s: %s", name, e)
+                    # Release the claim: the pod was not actually served.
+                    with self._matched_mu:
+                        self._matched_pods.pop((uid, container), None)
+                    return f"/tmp/vtpu_{uuidlib.uuid4().hex[:12]}.cache"
+                d = os.path.join(CONTAINER_LIB_DIR, "shared", name)
                 return os.path.join(d, "vtpushr.cache")
         return f"/tmp/vtpu_{uuidlib.uuid4().hex[:12]}.cache"
 
     def _match_pending_pod(self, n_vdevices: int):
-        """Identify the pod this Allocate serves by matching a pending
-        pod's vtpu limit against the request size — crude, but Allocate
-        carries no pod identity (reference server.go:365-406)."""
+        """Identify the pod this Allocate serves by matching pending pods'
+        per-container vtpu limits against the request size — crude, but
+        Allocate carries no pod identity (reference server.go:365-406).
+        Containers already matched in this plugin generation are skipped so
+        two same-sized pending pods resolve to distinct shared dirs."""
         try:
             pods = self.pod_lister(self.cfg.node_name)
         except Exception as e:  # noqa: BLE001 - monitor mode is best-effort
             log.warn("monitor mode pod list failed: %s", e)
             return None
+        candidates = []
+        live = set()
         for pod in pods:
+            meta = pod.get("metadata", {})
+            uid = meta.get("uid", "nouid")
+            for ctr in pod.get("spec", {}).get("containers", []):
+                live.add((uid, ctr.get("name", "ctr")))
             if pod.get("status", {}).get("phase") != "Pending":
                 continue
-            meta = pod.get("metadata", {})
             for ctr in pod.get("spec", {}).get("containers", []):
                 limits = ctr.get("resources", {}).get("limits", {})
                 want = limits.get(self.spec.resource_name)
-                if want is not None and int(want) == n_vdevices:
-                    return (meta.get("namespace", "default"),
-                            meta.get("name", "pod"),
-                            ctr.get("name", "ctr"),
-                            meta.get("uid", "nouid"))
-        return None
+                cname = ctr.get("name", "ctr")
+                if want is None or int(want) != n_vdevices:
+                    continue
+                candidates.append((meta.get("namespace", "default"),
+                                   meta.get("name", "pod"), cname, uid))
+        with self._matched_mu:
+            # Prune claims of pods no longer on the node (bounds the map).
+            for key in [k for k in self._matched_pods if k not in live]:
+                del self._matched_pods[key]
+            if not candidates:
+                return None
+            # Prefer a not-yet-claimed candidate; when all are claimed
+            # (e.g. a kubelet Allocate retry after a container-create
+            # failure), reuse the oldest claim — that pod is the most
+            # likely retry subject and its shared dir stays stable.
+            unclaimed = [c for c in candidates
+                         if (c[3], c[2]) not in self._matched_pods]
+            chosen = unclaimed[0] if unclaimed else min(
+                candidates, key=lambda c: self._matched_pods[(c[3], c[2])])
+            self._matched_pods[(chosen[3], chosen[2])] = time.monotonic()
+            return chosen
 
     def _fill_allocate_response(self, car, vdevs: Sequence[VDevice],
                                 ids: Sequence[str]) -> None:
@@ -322,7 +380,17 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         envs[envspec.ENV_SHARED_CACHE] = self._shared_cache_path(len(vdevs))
         if self.cfg.oversubscribe:
             envs[envspec.ENV_OVERSUBSCRIBE] = "true"
-        if self.cfg.enable_runtime and self.spec.time_shared:
+        # Only advertise/mount the broker socket when it answers: a bind
+        # mount with a missing source fails container creation outright
+        # (containerd/runc), and a stale socket file from a dead broker
+        # would hand the pod a permanently-dead inode.
+        runtime_on = (self.cfg.enable_runtime and self.spec.time_shared
+                      and socket_alive(self.cfg.runtime_socket))
+        if self.cfg.enable_runtime and self.spec.time_shared \
+                and not runtime_on:
+            log.warn("runtime socket %s missing; pod gets interposer-only "
+                     "enforcement", self.cfg.runtime_socket)
+        if runtime_on:
             envs[envspec.ENV_RUNTIME_SOCKET] = os.path.join(
                 CONTAINER_LIB_DIR, os.path.basename(self.cfg.runtime_socket))
         if self.cfg.pcibus_file:
@@ -337,8 +405,9 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
                                                 "libvtpu_pjrt.so")
         # Python-level preload for CPU-backend fallback + runtime client
         # bootstrap.  Allocate cannot see the image's own PYTHONPATH, so
-        # this overrides it; sitecustomize re-appends the original value
-        # from /proc/1/environ when present.
+        # this REPLACES it (kubelet merges plugin envs over image ENV) —
+        # images needing extra paths use VTPU_EXTRA_PYTHONPATH, which the
+        # shim's sitecustomize appends to sys.path (docs/FLAGS.md).
         envs["PYTHONPATH"] = os.path.join(CONTAINER_LIB_DIR, "shim")
 
         for k, v in envs.items():
@@ -358,7 +427,7 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         if self.cfg.pcibus_file:
             mounts.append((os.path.join(CONTAINER_LIB_DIR, "tpuinfo.vtpu"),
                            self.cfg.pcibus_file, True))
-        if self.cfg.enable_runtime and self.spec.time_shared:
+        if runtime_on:
             mounts.append(
                 (os.path.join(CONTAINER_LIB_DIR,
                               os.path.basename(self.cfg.runtime_socket)),

@@ -7,10 +7,16 @@ analogue of the reference's ``/etc/ld.so.preload`` mount (reference
 server.go:511-515, vgpu/ld.so.preload).
 
 Responsibilities:
-  - restore any PYTHONPATH the container image had (ours replaced it; the
-    original is recoverable from /proc/1/environ),
   - run the vtpu shim bootstrap (native interposer env wiring),
   - on non-TPU backends, install the pure-Python enforcement.
+
+Known limitation (documented in docs/FLAGS.md): the device plugin's env
+injection REPLACES any ``PYTHONPATH`` the image set via Dockerfile ENV —
+the kubelet merges plugin envs over image envs at container creation, so
+the image's value is unrecoverable here (pid 1 already sees ours).
+``VTPU_EXTRA_PYTHONPATH`` set on the pod spec composes: its entries are
+appended to sys.path below.  PYTHONPATH set at *runtime* (shell, pod env)
+is unaffected because the kubelet applies pod-spec envs after plugin envs.
 
 Never raises: a broken shim must not take down user containers.
 """
@@ -21,28 +27,23 @@ import sys
 _SHIM_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
-def _restore_pythonpath():
-    try:
-        with open("/proc/1/environ", "rb") as f:
-            env1 = f.read().split(b"\0")
-        for entry in env1:
-            if entry.startswith(b"PYTHONPATH="):
-                orig = entry.split(b"=", 1)[1].decode()
-                for p in orig.split(os.pathsep):
-                    if p and p != _SHIM_DIR and p not in sys.path:
-                        sys.path.append(p)
-                current = os.environ.get("PYTHONPATH", "")
-                if orig and orig not in current:
-                    os.environ["PYTHONPATH"] = current + os.pathsep + orig
-                break
-    except OSError:
-        pass
+def _insert_extra_paths():
+    """VTPU_EXTRA_PYTHONPATH entries go to the FRONT of sys.path (after
+    the shim dir), preserving normal PYTHONPATH precedence over
+    site-packages — an image that shadowed an installed package keeps
+    shadowing it."""
+    extra = os.environ.get("VTPU_EXTRA_PYTHONPATH", "")
+    at = 1
+    for p in extra.split(os.pathsep):
+        if p and p not in sys.path:
+            sys.path.insert(at, p)
+            at += 1
 
 
 def _main():
-    _restore_pythonpath()
     if _SHIM_DIR not in sys.path:
         sys.path.insert(0, _SHIM_DIR)
+    _insert_extra_paths()
     try:
         from vtpu.shim import pyshim
     except ImportError:

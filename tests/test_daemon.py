@@ -29,6 +29,9 @@ def spawn_daemon(tmp_path, fault_dir, extra=()):
          "--discovery", "fake",
          "--device-plugin-path", str(tmp_path) + "/",
          "--device-split-count", "2",
+         # Lifecycle tests run without the broker; test_daemon_spawns_runtime
+         # exercises it explicitly.
+         "--enable-runtime", "false",
          *extra],
         env=env, stderr=subprocess.PIPE, text=True)
 
@@ -95,6 +98,37 @@ def test_daemon_clean_shutdown_removes_socket(daemon):
     proc.send_signal(signal.SIGTERM)
     assert proc.wait(timeout=10) == 0
     assert not os.path.exists(sock)
+
+
+def test_daemon_spawns_runtime_broker(tmp_path):
+    """With --enable-runtime, the daemon must launch the broker and wait
+    for its socket before registering, so Allocate's socket bind mount has
+    an existing source (a missing source fails container creation)."""
+    fault_dir = tmp_path / "faults"
+    fault_dir.mkdir()
+    rt_sock = tmp_path / "vtpu" / "rt.sock"
+    sim = KubeletSim(str(tmp_path)).start()
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "VTPU_FAKE_CHIPS": "1",
+                "VTPU_FAKE_FAULT_DIR": str(fault_dir)})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "vtpu.plugin.main",
+         "--discovery", "fake",
+         "--device-plugin-path", str(tmp_path) + "/",
+         "--device-split-count", "2",
+         "--enable-runtime", "true",
+         "--runtime-socket", str(rt_sock)],
+        env=env, stderr=subprocess.PIPE, text=True)
+    try:
+        sim.wait_registration(timeout=30)
+        assert os.path.exists(rt_sock), "broker socket missing"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        sim.stop()
 
 
 def test_daemon_fail_on_init_error(tmp_path):

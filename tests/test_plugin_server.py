@@ -143,6 +143,116 @@ def test_allocate_unknown_id_errors(env):
     ch.close()
 
 
+def _pending_pod(name, uid, n_vtpus, resource="4paradigm.com/vtpu"):
+    return {
+        "metadata": {"namespace": "default", "name": name, "uid": uid},
+        "status": {"phase": "Pending"},
+        "spec": {"containers": [{
+            "name": "main",
+            "resources": {"limits": {resource: str(n_vtpus)}},
+        }]},
+    }
+
+
+def test_monitor_mode_distinct_shared_dirs(tmp_path):
+    """Two same-sized pending pods must land in different per-pod shared
+    dirs (reference server.go:365-406's crude matcher collides; ours
+    claims each matched container)."""
+    cfg = Config(
+        device_plugin_path=str(tmp_path) + "/",
+        device_split_count=2,
+        host_lib_dir=str(tmp_path / "vtpu"),
+        runtime_socket=str(tmp_path / "vtpu" / "rt.sock"),
+        monitor_mode=True,
+        node_name="node1",
+    )
+    pods = [_pending_pod("job-a", "uid-aaaa0000", 1),
+            _pending_pod("job-b", "uid-bbbb0000", 1)]
+    backend = FakeChipBackend(num_chips=2)
+    specs = build_plugin_specs(cfg, backend)
+    plugin = VtpuDevicePlugin(specs[0], cfg, topology=backend.topology(),
+                              pod_lister=lambda node: pods)
+    sim = KubeletSim(str(tmp_path)).start()
+    plugin.start()
+    try:
+        reg = sim.wait_registration()
+        stub, ch = sim.plugin_stub(reg.endpoint)
+        caches = []
+        for i in (0, 1):
+            req = pb.AllocateRequest()
+            req.container_requests.add(devicesIDs=[plugin.vdevices[i].id])
+            resp = stub.Allocate(req)
+            caches.append(dict(resp.container_responses[0].envs)
+                          [envspec.ENV_SHARED_CACHE])
+        assert caches[0] != caches[1]
+        assert "job-a" in caches[0] and "job-b" in caches[1]
+        # Host-side dirs pre-created so the in-container region open
+        # (open+O_CREAT, no mkdir) succeeds through the shared mount.
+        for c in caches:
+            name = os.path.basename(os.path.dirname(c))
+            assert os.path.isdir(tmp_path / "vtpu" / "shared" / name)
+        ch.close()
+    finally:
+        plugin.stop()
+        sim.stop()
+
+
+def test_runtime_socket_mount_gated_on_existence(tmp_path):
+    """No broker socket on the node -> Allocate must not bind-mount it
+    (missing bind-mount source fails container creation)."""
+    rt = tmp_path / "vtpu" / "rt.sock"
+    cfg = Config(
+        device_plugin_path=str(tmp_path) + "/",
+        device_split_count=2,
+        host_lib_dir=str(tmp_path / "vtpu"),
+        runtime_socket=str(rt),
+    )
+    backend = FakeChipBackend(num_chips=1)
+    specs = build_plugin_specs(cfg, backend)
+    plugin = VtpuDevicePlugin(specs[0], cfg, topology=backend.topology())
+    sim = KubeletSim(str(tmp_path)).start()
+    plugin.start()
+    try:
+        reg = sim.wait_registration()
+        stub, ch = sim.plugin_stub(reg.endpoint)
+
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=[plugin.vdevices[0].id])
+        resp = stub.Allocate(req)
+        car = resp.container_responses[0]
+        assert envspec.ENV_RUNTIME_SOCKET not in dict(car.envs)
+        assert not any(m.host_path == str(rt) for m in car.mounts)
+
+        # A stale (non-answering) socket file must not count as a broker.
+        rt.parent.mkdir(parents=True, exist_ok=True)
+        rt.touch()
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=[plugin.vdevices[1].id])
+        resp = stub.Allocate(req)
+        assert envspec.ENV_RUNTIME_SOCKET not in dict(
+            resp.container_responses[0].envs)
+        rt.unlink()
+
+        # A live listener -> next Allocate mounts it.
+        import socket as socketmod
+        lsock = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        lsock.bind(str(rt))
+        lsock.listen(1)
+        try:
+            req = pb.AllocateRequest()
+            req.container_requests.add(devicesIDs=[plugin.vdevices[1].id])
+            resp = stub.Allocate(req)
+            car = resp.container_responses[0]
+            assert envspec.ENV_RUNTIME_SOCKET in dict(car.envs)
+            assert any(m.host_path == str(rt) for m in car.mounts)
+        finally:
+            lsock.close()
+        ch.close()
+    finally:
+        plugin.stop()
+        sim.stop()
+
+
 def test_pass_device_specs(tmp_path):
     cfg = Config(
         device_plugin_path=str(tmp_path) + "/",
