@@ -67,6 +67,7 @@ class Daemon:
         # forked twice a second from the event loop.
         self._runtime_next_attempt = 0.0
         self._runtime_backoff = 1.0
+        self._runtime_up_logged = False
         # Fresh per generation: a slow probe can outlive stop_plugins()'s
         # bounded join, and reusing one Event would un-stop that stale
         # loop on the next start.
@@ -89,10 +90,13 @@ class Daemon:
 
     # -- runtime broker ------------------------------------------------------
 
-    def ensure_runtime(self, specs) -> None:
+    def ensure_runtime(self, specs, wait: bool = True) -> None:
         """Spawn the node broker when time-share splitting is on, so the
         socket Allocate mounts actually exists before any pod starts.
-        Idempotent; the broker survives plugin restarts."""
+        Idempotent; the broker survives plugin restarts.  wait=False
+        (event-loop respawns) returns right after the spawn — readiness
+        is observed on later poll_runtime ticks, so a failing broker
+        cannot stall kubelet-restart handling."""
         if not self.cfg.enable_runtime:
             return
         shared = [s for s in specs if s.time_shared and s.vdevices]
@@ -134,12 +138,12 @@ class Daemon:
         except OSError as e:
             log.error("cannot start vtpu-runtime broker: %s", e)
             return
+        self._runtime_up_logged = False
+        if not wait:
+            return
         deadline = time.monotonic() + 15.0
         while time.monotonic() < deadline:
-            if os.path.exists(self.cfg.runtime_socket):
-                log.info("vtpu-runtime broker up on %s (pid %d)",
-                         self.cfg.runtime_socket, self._runtime_proc.pid)
-                self._runtime_backoff = 1.0
+            if self._check_runtime_up():
                 return
             if self._runtime_proc.poll() is not None:
                 break
@@ -148,20 +152,34 @@ class Daemon:
                   "back to interposer-only enforcement",
                   self.cfg.runtime_socket)
 
+    def _check_runtime_up(self) -> bool:
+        if not os.path.exists(self.cfg.runtime_socket):
+            return False
+        if not self._runtime_up_logged:
+            log.info("vtpu-runtime broker up on %s (pid %d)",
+                     self.cfg.runtime_socket,
+                     self._runtime_proc.pid if self._runtime_proc else -1)
+            self._runtime_up_logged = True
+        self._runtime_backoff = 1.0
+        return True
+
     def poll_runtime(self) -> None:
         """Retry/respawn the broker from the daemon event loop — covers a
         crashed broker (OOM-kill) and a spawn that failed outright; both
         damped by ensure_runtime's backoff so a crash-looping broker is
-        forked at most every backoff interval, not per loop tick."""
+        forked at most every backoff interval.  Never blocks: respawns
+        use wait=False and readiness is picked up on later ticks."""
         if not (self.cfg.enable_runtime and self._runtime_specs):
             return
-        if self._runtime_proc is not None \
-                and self._runtime_proc.poll() is not None:
-            log.warn("vtpu-runtime broker died (rc=%s); respawning",
-                     self._runtime_proc.returncode)
-            self._runtime_proc = None
+        if self._runtime_proc is not None:
+            if self._runtime_proc.poll() is not None:
+                log.warn("vtpu-runtime broker died (rc=%s); respawning",
+                         self._runtime_proc.returncode)
+                self._runtime_proc = None
+            else:
+                self._check_runtime_up()
         if self._runtime_proc is None:
-            self.ensure_runtime(self._runtime_specs)
+            self.ensure_runtime(self._runtime_specs, wait=False)
 
     def stop_runtime(self) -> None:
         if self._runtime_proc is not None:

@@ -301,7 +301,15 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         per-container vtpu limits against the request size — crude, but
         Allocate carries no pod identity (reference server.go:365-406).
         Containers already matched in this plugin generation are skipped so
-        two same-sized pending pods resolve to distinct shared dirs."""
+        two same-sized pending pods resolve to distinct shared dirs.
+
+        Known limit (shared with the reference): identification is
+        heuristic.  The kubelet calls Allocate once per admitted
+        container, so claims are effectively one-shot; should a
+        double-Allocate ever race a second same-sized pending pod, the
+        two pods' dirs can swap.  Consequence is misattributed
+        *monitoring* only — quota enforcement itself keys off the region
+        file the container actually receives."""
         try:
             pods = self.pod_lister(self.cfg.node_name)
         except Exception as e:  # noqa: BLE001 - monitor mode is best-effort

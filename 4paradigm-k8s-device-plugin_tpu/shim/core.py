@@ -101,6 +101,8 @@ def load() -> ctypes.CDLL:
                                         ctypes.c_int32]
     lib.vtpu_region_ndevices.restype = ctypes.c_int
     lib.vtpu_region_ndevices.argtypes = [ctypes.c_void_p]
+    lib.vtpu_region_active_procs.restype = ctypes.c_int
+    lib.vtpu_region_active_procs.argtypes = [ctypes.c_void_p]
     lib.vtpu_core_version.restype = ctypes.c_char_p
     _lib = lib
     return lib
@@ -198,3 +200,7 @@ class SharedRegion:
     @property
     def ndevices(self) -> int:
         return self.lib.vtpu_region_ndevices(self.handle)
+
+    def active_procs(self) -> int:
+        """Live registered processes (sweeps dead ones first)."""
+        return self.lib.vtpu_region_active_procs(self.handle)

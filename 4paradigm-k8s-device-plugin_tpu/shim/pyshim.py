@@ -132,6 +132,24 @@ class _PyEnforcer:
         # weakrefs is overkill — jax arrays call block_until_ready paths
         # through us, and tests drive explicit deletes.
         self._cost_ema: Dict[int, float] = {}
+        # Contention probe cache for the DEFAULT policy (mirrors the
+        # native interposer): sole tenant runs ungated.
+        self._contention_at = 0.0
+        self._contended = True
+
+    def _gating_active(self) -> bool:
+        """Policy switch (reference GPU_CORE_UTILIZATION_POLICY): DISABLE
+        never gates, FORCE always, DEFAULT only under contention."""
+        policy = self.spec.utilization_policy
+        if policy == "DISABLE":
+            return False
+        if policy == "FORCE":
+            return True
+        now = time.monotonic()
+        if now - self._contention_at > 0.1:
+            self._contention_at = now
+            self._contended = self.region.active_procs() > 1
+        return self._contended
 
     def charge(self, nbytes: int, dev: int = 0) -> None:
         ok = self.region.mem_acquire(dev, nbytes, self.spec.oversubscribe)
@@ -149,15 +167,21 @@ class _PyEnforcer:
         self.region.mem_release(dev, nbytes)
 
     def gate(self, key: int, dev: int = 0) -> float:
-        """Block per the token bucket; returns the cost estimate used."""
+        """Block per the token bucket; returns the cost estimate used
+        (negative: ungated, skip the completion-time correction)."""
         est = max(self._cost_ema.get(key, 5000.0), self.min_cost_us)
+        if not self._gating_active():
+            return -est
         self.region.rate_block(dev, int(est), self.spec.task_priority)
         return est
 
     def observe(self, key: int, est: float, actual_us: float,
                 dev: int = 0) -> None:
-        charged = max(actual_us, self.min_cost_us)
-        self.region.rate_adjust(dev, int(charged - est))
+        if est >= 0:
+            # Only correct the bucket when the estimate was charged; an
+            # ungated run must not bank debt against future co-tenants.
+            charged = max(actual_us, self.min_cost_us)
+            self.region.rate_adjust(dev, int(charged - est))
         prev = self._cost_ema.get(key)
         self._cost_ema[key] = (actual_us if prev is None
                                else prev * 0.7 + actual_us * 0.3)

@@ -35,18 +35,32 @@ PJRT_Error* err(PJRT_Error_Code code, const char* msg) {
   return reinterpret_cast<PJRT_Error*>(new MockError{code, msg});
 }
 
+struct MockDevice;
+
+struct MockMemory {
+  int id;
+  std::string kind;       /* "tpu_hbm" per device, one "unpinned_host" */
+  MockDevice* device;     /* nullptr for the host memory */
+  std::vector<PJRT_Device*> addressable_by;
+};
+
 struct MockDevice {
   int id;
+  MockMemory* hbm = nullptr;
 };
 
 struct MockClient {
   std::vector<MockDevice*> devices;
   std::vector<PJRT_Device*> device_ptrs;
+  std::vector<MockMemory*> memories;
+  std::vector<PJRT_Memory*> memory_ptrs;
 };
 
 struct MockBuffer {
   uint64_t bytes;
   MockDevice* device;
+  MockMemory* memory = nullptr;  /* non-null when host-resident */
+  bool deleted = false;          /* donated to an execution */
 };
 
 struct MockExecutable {
@@ -116,6 +130,19 @@ PJRT_Error* M_Client_Create(PJRT_Client_Create_Args* a) {
     c->devices.push_back(d);
     c->device_ptrs.push_back(reinterpret_cast<PJRT_Device*>(d));
   }
+  /* One HBM memory per device + one shared host memory (like real
+   * libtpu's tpu_hbm / unpinned_host memory spaces). */
+  for (int i = 0; i < nd; i++) {
+    auto* m = new MockMemory{i, "tpu_hbm", c->devices[i], {}};
+    m->addressable_by.push_back(c->device_ptrs[i]);
+    c->devices[i]->hbm = m;
+    c->memories.push_back(m);
+    c->memory_ptrs.push_back(reinterpret_cast<PJRT_Memory*>(m));
+  }
+  auto* host = new MockMemory{nd, "unpinned_host", nullptr,
+                              c->device_ptrs};
+  c->memories.push_back(host);
+  c->memory_ptrs.push_back(reinterpret_cast<PJRT_Memory*>(host));
   a->client = reinterpret_cast<PJRT_Client*>(c);
   return nullptr;
 }
@@ -123,7 +150,37 @@ PJRT_Error* M_Client_Create(PJRT_Client_Create_Args* a) {
 PJRT_Error* M_Client_Destroy(PJRT_Client_Destroy_Args* a) {
   auto* c = reinterpret_cast<MockClient*>(a->client);
   for (auto* d : c->devices) delete d;
+  for (auto* m : c->memories) delete m;
   delete c;
+  return nullptr;
+}
+
+PJRT_Error* M_Client_AddressableMemories(
+    PJRT_Client_AddressableMemories_Args* a) {
+  auto* c = reinterpret_cast<MockClient*>(a->client);
+  a->addressable_memories = c->memory_ptrs.data();
+  a->num_addressable_memories = c->memory_ptrs.size();
+  return nullptr;
+}
+
+PJRT_Error* M_Memory_Kind(PJRT_Memory_Kind_Args* a) {
+  auto* m = reinterpret_cast<MockMemory*>(a->memory);
+  a->kind = m->kind.c_str();
+  a->kind_size = m->kind.size();
+  return nullptr;
+}
+
+PJRT_Error* M_Memory_AddressableByDevices(
+    PJRT_Memory_AddressableByDevices_Args* a) {
+  auto* m = reinterpret_cast<MockMemory*>(a->memory);
+  a->devices = m->addressable_by.data();
+  a->num_devices = m->addressable_by.size();
+  return nullptr;
+}
+
+PJRT_Error* M_Device_DefaultMemory(PJRT_Device_DefaultMemory_Args* a) {
+  auto* d = reinterpret_cast<MockDevice*>(a->device);
+  a->memory = reinterpret_cast<PJRT_Memory*>(d->hbm);
   return nullptr;
 }
 
@@ -156,9 +213,53 @@ PJRT_Error* M_BufferFromHostBuffer(
   for (size_t i = 0; i < a->num_dims; i++) n *= (uint64_t)a->dims[i];
   auto* b = new MockBuffer{n * elem_bytes(a->type),
                            reinterpret_cast<MockDevice*>(a->device)};
+  if (a->memory) {
+    auto* m = reinterpret_cast<MockMemory*>(a->memory);
+    b->memory = m;
+    b->device = m->device;  /* nullptr for host memory */
+  }
   a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
   a->done_with_host_buffer =
       reinterpret_cast<PJRT_Event*>(new MockEvent{1});
+  return nullptr;
+}
+
+PJRT_Error* M_CreateUninitializedBuffer(
+    PJRT_Client_CreateUninitializedBuffer_Args* a) {
+  uint64_t n = 1;
+  for (size_t i = 0; i < a->shape_num_dims; i++)
+    n *= (uint64_t)a->shape_dims[i];
+  auto* b = new MockBuffer{n * elem_bytes(a->shape_element_type),
+                           reinterpret_cast<MockDevice*>(a->device)};
+  a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  return nullptr;
+}
+
+PJRT_Error* M_Buffer_CopyToDevice(PJRT_Buffer_CopyToDevice_Args* a) {
+  auto* src = reinterpret_cast<MockBuffer*>(a->buffer);
+  auto* b = new MockBuffer{src->bytes,
+                           reinterpret_cast<MockDevice*>(a->dst_device)};
+  a->dst_buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  return nullptr;
+}
+
+PJRT_Error* M_Buffer_CopyToMemory(PJRT_Buffer_CopyToMemory_Args* a) {
+  auto* src = reinterpret_cast<MockBuffer*>(a->buffer);
+  auto* m = reinterpret_cast<MockMemory*>(a->dst_memory);
+  auto* b = new MockBuffer{src->bytes, m->device};
+  b->memory = m;
+  a->dst_buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  return nullptr;
+}
+
+PJRT_Error* M_Buffer_IsDeleted(PJRT_Buffer_IsDeleted_Args* a) {
+  a->is_deleted = reinterpret_cast<MockBuffer*>(a->buffer)->deleted;
+  return nullptr;
+}
+
+PJRT_Error* M_Buffer_Memory(PJRT_Buffer_Memory_Args* a) {
+  a->memory = reinterpret_cast<PJRT_Memory*>(
+      reinterpret_cast<MockBuffer*>(a->buffer)->memory);
   return nullptr;
 }
 
@@ -188,6 +289,15 @@ PJRT_Error* M_LoadedExecutable_GetExecutable(
   return nullptr;
 }
 
+/* The mock has no per-executable device binding; report no addressable
+ * devices so the interposer falls back to ordinal 0 / execute_device. */
+PJRT_Error* M_LoadedExecutable_AddressableDevices(
+    PJRT_LoadedExecutable_AddressableDevices_Args* a) {
+  a->addressable_devices = nullptr;
+  a->num_addressable_devices = 0;
+  return nullptr;
+}
+
 PJRT_Error* M_Executable_NumOutputs(PJRT_Executable_NumOutputs_Args* a) {
   a->num_outputs = 1;
   return nullptr;
@@ -206,6 +316,19 @@ PJRT_Error* M_Execute(PJRT_LoadedExecutable_Execute_Args* a) {
   ts.tv_sec = burn / 1000000;
   ts.tv_nsec = (burn % 1000000) * 1000;
   nanosleep(&ts, nullptr);
+
+  /* Donation simulation: the execution consumes its input buffers
+   * (MOCK_DONATE_ARGS=1), like XLA aliasing donated params to outputs. */
+  if (getenv("MOCK_DONATE_ARGS") && a->argument_lists) {
+    for (size_t d = 0; d < a->num_devices; d++) {
+      if (!a->argument_lists[d]) continue;
+      for (size_t i = 0; i < a->num_args; i++) {
+        if (a->argument_lists[d][i])
+          reinterpret_cast<MockBuffer*>(a->argument_lists[d][i])->deleted =
+              true;
+      }
+    }
+  }
 
   const char* ob = getenv("MOCK_OUT_BYTES");
   uint64_t out_bytes = ob ? strtoull(ob, nullptr, 10) : 1024;
@@ -258,12 +381,23 @@ PJRT_Api make_api() {
   api.PJRT_Client_Destroy = M_Client_Destroy;
   api.PJRT_Client_Devices = M_Client_Devices;
   api.PJRT_Client_AddressableDevices = M_Client_AddressableDevices;
+  api.PJRT_Client_AddressableMemories = M_Client_AddressableMemories;
   api.PJRT_Client_Compile = M_Client_Compile;
   api.PJRT_Client_BufferFromHostBuffer = M_BufferFromHostBuffer;
+  api.PJRT_Client_CreateUninitializedBuffer = M_CreateUninitializedBuffer;
+  api.PJRT_Memory_Kind = M_Memory_Kind;
+  api.PJRT_Memory_AddressableByDevices = M_Memory_AddressableByDevices;
+  api.PJRT_Device_DefaultMemory = M_Device_DefaultMemory;
   api.PJRT_Buffer_OnDeviceSizeInBytes = M_Buffer_OnDeviceSizeInBytes;
   api.PJRT_Buffer_Destroy = M_Buffer_Destroy;
   api.PJRT_Buffer_Device = M_Buffer_Device;
+  api.PJRT_Buffer_Memory = M_Buffer_Memory;
+  api.PJRT_Buffer_IsDeleted = M_Buffer_IsDeleted;
+  api.PJRT_Buffer_CopyToDevice = M_Buffer_CopyToDevice;
+  api.PJRT_Buffer_CopyToMemory = M_Buffer_CopyToMemory;
   api.PJRT_LoadedExecutable_GetExecutable = M_LoadedExecutable_GetExecutable;
+  api.PJRT_LoadedExecutable_AddressableDevices =
+      M_LoadedExecutable_AddressableDevices;
   api.PJRT_Executable_NumOutputs = M_Executable_NumOutputs;
   api.PJRT_LoadedExecutable_Destroy = M_LoadedExecutable_Destroy;
   api.PJRT_LoadedExecutable_Execute = M_Execute;

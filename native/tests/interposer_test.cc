@@ -1,19 +1,39 @@
-/* End-to-end native test: drives libvtpu_pjrt.so (backed by the mock PJRT
+/* End-to-end native tests: drive libvtpu_pjrt.so (backed by the mock PJRT
  * plugin) through the PJRT C API exactly as a client framework would, and
- * asserts the vTPU policy surface: HBM quota OOM, release-on-destroy,
- * device-time throttling, quota-adjusted memory stats.
+ * assert the vTPU policy surface:
  *
+ *   mem       - HBM quota OOM, per-device limits, release-on-destroy,
+ *               quota-adjusted memory stats
+ *   throttle  - FORCE utilization policy: device-time token bucket gates
+ *               executes even for a sole tenant
+ *   sole_fast - DEFAULT policy: a sole tenant runs ungated (reference
+ *               GPU_CORE_UTILIZATION_POLICY semantics)
+ *   spill     - oversubscribe: past-cap allocations land in host memory
+ *               and are staged onto the device per execute (reference
+ *               virtual device memory, README.md:104)
+ *   killer    - VTPU_ACTIVE_OOM_KILLER kills the offender (exit by
+ *               SIGKILL) instead of returning RESOURCE_EXHAUSTED
+ *   coresplit - VTPU_CORE_INDICES subsets + renumbers the device view
+ *               (core-split isolation, the MIG analogue)
+ *   donation  - donated inputs release their books at execute
+ *   copyalloc - CreateUninitializedBuffer / CopyToDevice are quota-checked
+ *
+ * Each scenario runs in a fresh process (env is parsed at client create);
+ * with no scenario argument the binary re-execs itself per scenario.
  * Exit code 0 = all checks pass.  Run via `make -C native test` (also
  * invoked from tests/test_pjrt_interposer.py).
  */
 #include <dlfcn.h>
+#include <signal.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/wait.h>
 #include <time.h>
 #include <unistd.h>
 
 #include <string>
+#include <vector>
 
 #include "xla/pjrt/c/pjrt_c_api.h"
 
@@ -81,94 +101,58 @@ static void destroy_buffer(PJRT_Buffer* b) {
   CHECK(api->PJRT_Buffer_Destroy(&a) == nullptr);
 }
 
+static int64_t bytes_in_use(PJRT_Device* d) {
+  PJRT_Device_MemoryStats_Args ms;
+  memset(&ms, 0, sizeof(ms));
+  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms.device = d;
+  CHECK(api->PJRT_Device_MemoryStats(&ms) == nullptr);
+  return ms.bytes_in_use;
+}
+
 static double mono_s() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
 }
 
-int main(int argc, char** argv) {
-  const char* self_dir = argc > 1 ? argv[1] : "build";
-  std::string interposer = std::string(self_dir) + "/libvtpu_pjrt.so";
-  std::string mock = std::string(self_dir) + "/libmockpjrt.so";
-  std::string shr = "/tmp/vtpu_interposer_test_" +
-                    std::to_string(getpid()) + ".cache";
+struct Env {
+  PJRT_Client* client = nullptr;
+  std::vector<PJRT_Device*> devices;
+  PJRT_LoadedExecutable* exe = nullptr;
+};
 
+static Env setup(const char* dir, const char* shr) {
+  std::string interposer = std::string(dir) + "/libvtpu_pjrt.so";
+  std::string mock = std::string(dir) + "/libmockpjrt.so";
   setenv("VTPU_REAL_LIBTPU", mock.c_str(), 1);
-  setenv("MOCK_PJRT_DEVICES", "2", 1);
-  /* 1 MB quota on ordinal 0, 2 MB on ordinal 1; 50% core limit. */
-  setenv("VTPU_DEVICE_HBM_LIMIT_0", "1Mi", 1);
-  setenv("VTPU_DEVICE_HBM_LIMIT_1", "2Mi", 1);
-  setenv("VTPU_DEVICE_CORE_LIMIT", "50", 1);
-  setenv("VTPU_DEVICE_MEMORY_SHARED_CACHE", shr.c_str(), 1);
-  setenv("MOCK_EXEC_US", "10000", 1);
-  setenv("MOCK_OUT_BYTES", "4096", 1);
+  setenv("VTPU_DEVICE_MEMORY_SHARED_CACHE", shr, 1);
 
   void* h = dlopen(interposer.c_str(), RTLD_NOW);
   if (!h) {
     fprintf(stderr, "dlopen: %s\n", dlerror());
-    return 1;
+    exit(1);
   }
   auto get = (const PJRT_Api* (*)())dlsym(h, "GetPjrtApi");
   CHECK(get != nullptr);
   api = get();
   CHECK(api != nullptr);
 
-  /* client + devices */
+  Env env;
   PJRT_Client_Create_Args ca;
   memset(&ca, 0, sizeof(ca));
   ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
   CHECK(api->PJRT_Client_Create(&ca) == nullptr);
-  PJRT_Client* client = ca.client;
+  env.client = ca.client;
 
   PJRT_Client_AddressableDevices_Args da;
   memset(&da, 0, sizeof(da));
   da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
-  da.client = client;
+  da.client = env.client;
   CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr);
-  CHECK(da.num_addressable_devices == 2);
-  PJRT_Device* d0 = da.addressable_devices[0];
-  PJRT_Device* d1 = da.addressable_devices[1];
+  env.devices.assign(da.addressable_devices,
+                     da.addressable_devices + da.num_addressable_devices);
 
-  /* within quota: 128 KiB of floats on dev0 (1 MiB quota) */
-  PJRT_Error* e = nullptr;
-  PJRT_Buffer* b1 = make_buffer(client, d0, 32 * 1024, &e);
-  CHECK(e == nullptr && b1 != nullptr);
-
-  /* beyond quota: 2 MiB on dev0 must OOM with RESOURCE_EXHAUSTED */
-  PJRT_Buffer* b2 = make_buffer(client, d0, 512 * 1024, &e);
-  CHECK(b2 == nullptr && e != nullptr);
-  CHECK(error_code(e) == PJRT_Error_Code_RESOURCE_EXHAUSTED);
-  std::string msg = error_message(e);
-  CHECK(msg.find("OOM") != std::string::npos);
-  destroy_error(e);
-  printf("oom message: %s\n", msg.c_str());
-
-  /* same size fits on dev1 (2 MiB quota) -> per-device limits work */
-  PJRT_Buffer* b3 = make_buffer(client, d1, 400 * 1024, &e);
-  CHECK(e == nullptr && b3 != nullptr);
-  destroy_buffer(b3);
-
-  /* free b1, then a near-quota alloc fits again */
-  destroy_buffer(b1);
-  PJRT_Buffer* b4 = make_buffer(client, d0, 200 * 1024, &e);
-  CHECK(e == nullptr && b4 != nullptr);
-  destroy_buffer(b4);
-
-  /* memory stats: quota view even though the mock reports UNIMPLEMENTED */
-  PJRT_Device_MemoryStats_Args ms;
-  memset(&ms, 0, sizeof(ms));
-  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
-  ms.device = d0;
-  CHECK(api->PJRT_Device_MemoryStats(&ms) == nullptr);
-  CHECK(ms.bytes_limit_is_set && ms.bytes_limit == 1024 * 1024);
-  CHECK(ms.bytes_in_use == 0);
-
-  /* compile + execute under a 50% core limit: 15 executions x 10ms of
-   * device time = 150ms, needing >= 300ms of wall time; the 250ms initial
-   * burst covers part, so elapsed must exceed ~(150*2 - 250) = 50ms ...
-   * drain the burst first with a few warmup rounds to make the bound
-   * sharp. */
   PJRT_Program prog;
   memset(&prog, 0, sizeof(prog));
   prog.struct_size = PJRT_Program_STRUCT_SIZE;
@@ -181,41 +165,108 @@ int main(int argc, char** argv) {
   PJRT_Client_Compile_Args cc;
   memset(&cc, 0, sizeof(cc));
   cc.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
-  cc.client = client;
+  cc.client = env.client;
   cc.program = &prog;
   CHECK(api->PJRT_Client_Compile(&cc) == nullptr);
-  PJRT_LoadedExecutable* exe = cc.executable;
+  env.exe = cc.executable;
+  return env;
+}
 
-  auto run_once = [&](bool with_events) {
-    PJRT_LoadedExecutable_Execute_Args ea;
-    memset(&ea, 0, sizeof(ea));
-    ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
-    ea.executable = exe;
-    ea.num_devices = 1;
-    ea.num_args = 0;
-    PJRT_Buffer* const* arg_list[1] = {nullptr};
-    ea.argument_lists = arg_list;
-    PJRT_Buffer* outs[1] = {nullptr};
-    PJRT_Buffer** out_list[1] = {outs};
-    ea.output_lists = out_list;
-    PJRT_Event* evs[1] = {nullptr};
-    ea.device_complete_events = with_events ? evs : nullptr;
-    CHECK(api->PJRT_LoadedExecutable_Execute(&ea) == nullptr);
-    if (outs[0]) destroy_buffer(outs[0]);
-    if (with_events && evs[0]) {
-      PJRT_Event_Destroy_Args ed;
-      memset(&ed, 0, sizeof(ed));
-      ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
-      ed.event = evs[0];
-      api->PJRT_Event_Destroy(&ed);
-    }
-  };
+/* One execute; args optional.  Destroys the output buffer unless
+ * keep_output. */
+static void run_once(Env& env, PJRT_Buffer* arg = nullptr,
+                     bool with_events = true, bool keep_output = false,
+                     PJRT_Buffer** out = nullptr) {
+  PJRT_LoadedExecutable_Execute_Args ea;
+  memset(&ea, 0, sizeof(ea));
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = env.exe;
+  ea.num_devices = 1;
+  ea.num_args = arg ? 1 : 0;
+  PJRT_Buffer* one_arg[1] = {arg};
+  PJRT_Buffer* const* arg_list[1] = {arg ? one_arg : nullptr};
+  ea.argument_lists = arg_list;
+  ea.execute_device = env.devices[0];
+  PJRT_Buffer* outs[1] = {nullptr};
+  PJRT_Buffer** out_list[1] = {outs};
+  ea.output_lists = out_list;
+  PJRT_Event* evs[1] = {nullptr};
+  ea.device_complete_events = with_events ? evs : nullptr;
+  CHECK(api->PJRT_LoadedExecutable_Execute(&ea) == nullptr);
+  if (out) *out = outs[0];
+  if (outs[0] && !keep_output) destroy_buffer(outs[0]);
+  if (with_events && evs[0]) {
+    PJRT_Event_Destroy_Args ed;
+    memset(&ed, 0, sizeof(ed));
+    ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    ed.event = evs[0];
+    api->PJRT_Event_Destroy(&ed);
+  }
+}
+
+/* ---- scenarios ---------------------------------------------------- */
+
+static int sc_mem(const char* dir, const char* shr) {
+  setenv("MOCK_PJRT_DEVICES", "2", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "1Mi", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_1", "2Mi", 1);
+  Env env = setup(dir, shr);
+  CHECK(env.devices.size() == 2);
+  PJRT_Device* d0 = env.devices[0];
+  PJRT_Device* d1 = env.devices[1];
+
+  /* within quota: 128 KiB of floats on dev0 (1 MiB quota) */
+  PJRT_Error* e = nullptr;
+  PJRT_Buffer* b1 = make_buffer(env.client, d0, 32 * 1024, &e);
+  CHECK(e == nullptr && b1 != nullptr);
+
+  /* beyond quota: 2 MiB on dev0 must OOM with RESOURCE_EXHAUSTED */
+  PJRT_Buffer* b2 = make_buffer(env.client, d0, 512 * 1024, &e);
+  CHECK(b2 == nullptr && e != nullptr);
+  CHECK(error_code(e) == PJRT_Error_Code_RESOURCE_EXHAUSTED);
+  std::string msg = error_message(e);
+  CHECK(msg.find("OOM") != std::string::npos);
+  destroy_error(e);
+  printf("oom message: %s\n", msg.c_str());
+
+  /* same size fits on dev1 (2 MiB quota) -> per-device limits work */
+  PJRT_Buffer* b3 = make_buffer(env.client, d1, 400 * 1024, &e);
+  CHECK(e == nullptr && b3 != nullptr);
+  destroy_buffer(b3);
+
+  /* free b1, then a near-quota alloc fits again */
+  destroy_buffer(b1);
+  PJRT_Buffer* b4 = make_buffer(env.client, d0, 200 * 1024, &e);
+  CHECK(e == nullptr && b4 != nullptr);
+  destroy_buffer(b4);
+
+  /* memory stats: quota view even though the mock reports UNIMPLEMENTED */
+  PJRT_Device_MemoryStats_Args ms;
+  memset(&ms, 0, sizeof(ms));
+  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms.device = d0;
+  CHECK(api->PJRT_Device_MemoryStats(&ms) == nullptr);
+  CHECK(ms.bytes_limit_is_set && ms.bytes_limit == 1024 * 1024);
+  CHECK(ms.bytes_in_use == 0);
+  return 0;
+}
+
+static int sc_throttle(const char* dir, const char* shr) {
+  setenv("MOCK_PJRT_DEVICES", "1", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "4Mi", 1);
+  setenv("VTPU_DEVICE_CORE_LIMIT", "50", 1);
+  /* FORCE: gate even as the sole registered process (reference
+   * GPU_CORE_UTILIZATION_POLICY=FORCE). */
+  setenv("VTPU_CORE_UTILIZATION_POLICY", "FORCE", 1);
+  setenv("MOCK_EXEC_US", "10000", 1);
+  setenv("MOCK_OUT_BYTES", "4096", 1);
+  Env env = setup(dir, shr);
 
   /* Warmup drains the 250ms burst allowance (net drain is cost*(1-pct)
    * = 5ms/exec, so ~50 rounds) and trains the latency EMA. */
-  for (int i = 0; i < 55; i++) run_once(true);
+  for (int i = 0; i < 55; i++) run_once(env);
   double t0 = mono_s();
-  for (int i = 0; i < 15; i++) run_once(true);
+  for (int i = 0; i < 15; i++) run_once(env);
   double elapsed = mono_s() - t0;
   /* 150ms of device time at 50%: wall must be >= ~250ms even with some
    * leftover burst. */
@@ -223,20 +274,225 @@ int main(int argc, char** argv) {
   CHECK(elapsed > 0.25);
 
   /* output buffers were accounted and then released on destroy */
-  PJRT_Device_MemoryStats_Args ms2;
-  memset(&ms2, 0, sizeof(ms2));
-  ms2.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
-  ms2.device = d0;
-  CHECK(api->PJRT_Device_MemoryStats(&ms2) == nullptr);
-  CHECK(ms2.bytes_in_use == 0);
-
-  PJRT_Client_Destroy_Args cd;
-  memset(&cd, 0, sizeof(cd));
-  cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
-  cd.client = client;
-  CHECK(api->PJRT_Client_Destroy(&cd) == nullptr);
-
-  unlink(shr.c_str());
-  printf("interposer_test: ALL OK\n");
+  CHECK(bytes_in_use(env.devices[0]) == 0);
   return 0;
+}
+
+static int sc_sole_fast(const char* dir, const char* shr) {
+  setenv("MOCK_PJRT_DEVICES", "1", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "4Mi", 1);
+  setenv("VTPU_DEVICE_CORE_LIMIT", "50", 1);
+  /* DEFAULT policy: sole tenant runs ungated. */
+  setenv("MOCK_EXEC_US", "1000", 1);
+  Env env = setup(dir, shr);
+  double t0 = mono_s();
+  for (int i = 0; i < 30; i++) run_once(env);
+  double elapsed = mono_s() - t0;
+  /* 30ms of device time; gating at 50% would need >= 60ms wall after the
+   * burst — ungated must stay close to the raw 30ms. */
+  printf("sole-tenant elapsed: %.3fs (30 x 1ms, DEFAULT policy)\n",
+         elapsed);
+  CHECK(elapsed < 0.12);
+  return 0;
+}
+
+static int sc_spill(const char* dir, const char* shr) {
+  setenv("MOCK_PJRT_DEVICES", "1", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "1Mi", 1);
+  setenv("VTPU_OVERSUBSCRIBE", "true", 1);
+  setenv("MOCK_OUT_BYTES", "4096", 1);
+  Env env = setup(dir, shr);
+  PJRT_Device* d0 = env.devices[0];
+
+  /* 2 MiB on a 1 MiB quota with oversubscribe: admitted via host spill,
+   * device books stay within quota (reference: "the excess part will be
+   * put in the RAM"). */
+  PJRT_Error* e = nullptr;
+  PJRT_Buffer* big = make_buffer(env.client, d0, 512 * 1024, &e);
+  CHECK(e == nullptr && big != nullptr);
+  CHECK(bytes_in_use(d0) == 0);  /* host-resident: no HBM charged */
+
+  /* Executing with the spilled operand stages it onto the device for the
+   * call and frees the staged copy afterwards. */
+  run_once(env, big);
+  CHECK(bytes_in_use(d0) == 0);
+
+  destroy_buffer(big);
+  CHECK(bytes_in_use(d0) == 0);
+  printf("spill: 2MiB over 1MiB quota admitted via host, books clean\n");
+  return 0;
+}
+
+static int sc_killer(const char* dir, const char* shr) {
+  setenv("MOCK_PJRT_DEVICES", "1", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "1Mi", 1);
+  setenv("VTPU_ACTIVE_OOM_KILLER", "true", 1);
+  Env env = setup(dir, shr);
+  PJRT_Error* e = nullptr;
+  /* Must not return: the killer SIGKILLs us. */
+  make_buffer(env.client, env.devices[0], 512 * 1024, &e);
+  fprintf(stderr, "killer did not fire\n");
+  return 1;
+}
+
+static int sc_coresplit(const char* dir, const char* shr) {
+  setenv("MOCK_PJRT_DEVICES", "2", 1);
+  /* Granted TensorCore 1 only: the container must see exactly one
+   * device, renumbered to ordinal 0 (reference MIG-slice isolation). */
+  setenv("VTPU_CORE_INDICES", "1", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "1Mi", 1);
+  Env env = setup(dir, shr);
+  CHECK(env.devices.size() == 1);
+
+  PJRT_Client_Devices_Args dv;
+  memset(&dv, 0, sizeof(dv));
+  dv.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
+  dv.client = env.client;
+  CHECK(api->PJRT_Client_Devices(&dv) == nullptr);
+  CHECK(dv.num_devices == 1);
+  CHECK(dv.devices[0] == env.devices[0]);
+
+  /* The visible device is charged as ordinal 0 (limit_0 applies). */
+  PJRT_Error* e = nullptr;
+  PJRT_Buffer* big = make_buffer(env.client, env.devices[0],
+                                 512 * 1024, &e);
+  CHECK(big == nullptr && e != nullptr);
+  CHECK(error_code(e) == PJRT_Error_Code_RESOURCE_EXHAUSTED);
+  CHECK(error_message(e).find("device 0") != std::string::npos);
+  destroy_error(e);
+  printf("coresplit: 1 of 2 devices visible, renumbered to ordinal 0\n");
+  return 0;
+}
+
+static int sc_donation(const char* dir, const char* shr) {
+  setenv("MOCK_PJRT_DEVICES", "1", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "1Mi", 1);
+  setenv("MOCK_DONATE_ARGS", "1", 1);
+  setenv("MOCK_OUT_BYTES", "4096", 1);
+  Env env = setup(dir, shr);
+  PJRT_Device* d0 = env.devices[0];
+
+  PJRT_Error* e = nullptr;
+  PJRT_Buffer* in = make_buffer(env.client, d0, 32 * 1024, &e);
+  CHECK(e == nullptr && in != nullptr);
+  CHECK(bytes_in_use(d0) == 128 * 1024);
+
+  /* The execution donates (consumes) the input: its books must be
+   * released at execute, not at the client's eventual Destroy. */
+  PJRT_Buffer* out = nullptr;
+  run_once(env, in, true, true, &out);
+  CHECK(bytes_in_use(d0) == 4096);  /* output only; input released */
+
+  destroy_buffer(out);
+  CHECK(bytes_in_use(d0) == 0);
+  destroy_buffer(in);  /* handle destroy of donated buffer: no effect */
+  CHECK(bytes_in_use(d0) == 0);
+  printf("donation: input released at execute, no double release\n");
+  return 0;
+}
+
+static int sc_copyalloc(const char* dir, const char* shr) {
+  setenv("MOCK_PJRT_DEVICES", "2", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "1Mi", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_1", "1Mi", 1);
+  Env env = setup(dir, shr);
+  PJRT_Device* d0 = env.devices[0];
+  PJRT_Device* d1 = env.devices[1];
+
+  /* CreateUninitializedBuffer past quota OOMs like BufferFromHostBuffer */
+  PJRT_Client_CreateUninitializedBuffer_Args ua;
+  memset(&ua, 0, sizeof(ua));
+  ua.struct_size = PJRT_Client_CreateUninitializedBuffer_Args_STRUCT_SIZE;
+  ua.client = env.client;
+  int64_t big_dims[1] = {512 * 1024};
+  ua.shape_dims = big_dims;
+  ua.shape_num_dims = 1;
+  ua.shape_element_type = PJRT_Buffer_Type_F32;
+  ua.device = d0;
+  PJRT_Error* e = api->PJRT_Client_CreateUninitializedBuffer(&ua);
+  CHECK(e != nullptr);
+  CHECK(error_code(e) == PJRT_Error_Code_RESOURCE_EXHAUSTED);
+  destroy_error(e);
+
+  int64_t small_dims[1] = {32 * 1024};
+  ua.shape_dims = small_dims;
+  e = api->PJRT_Client_CreateUninitializedBuffer(&ua);
+  CHECK(e == nullptr && ua.buffer != nullptr);
+  CHECK(bytes_in_use(d0) == 128 * 1024);
+
+  /* Device-to-device copy charges the destination device. */
+  PJRT_Buffer_CopyToDevice_Args cda;
+  memset(&cda, 0, sizeof(cda));
+  cda.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+  cda.buffer = ua.buffer;
+  cda.dst_device = d1;
+  CHECK(api->PJRT_Buffer_CopyToDevice(&cda) == nullptr);
+  CHECK(bytes_in_use(d1) == 128 * 1024);
+
+  destroy_buffer(cda.dst_buffer);
+  destroy_buffer(ua.buffer);
+  CHECK(bytes_in_use(d0) == 0 && bytes_in_use(d1) == 0);
+  printf("copyalloc: uninitialized + d2d copy quota-checked\n");
+  return 0;
+}
+
+/* ---- driver ------------------------------------------------------- */
+
+struct Scenario {
+  const char* name;
+  int (*fn)(const char*, const char*);
+  int expect_sigkill;
+};
+
+static const Scenario kScenarios[] = {
+    {"mem", sc_mem, 0},
+    {"throttle", sc_throttle, 0},
+    {"sole_fast", sc_sole_fast, 0},
+    {"spill", sc_spill, 0},
+    {"killer", sc_killer, 1},
+    {"coresplit", sc_coresplit, 0},
+    {"donation", sc_donation, 0},
+    {"copyalloc", sc_copyalloc, 0},
+};
+
+int main(int argc, char** argv) {
+  const char* dir = argc > 1 ? argv[1] : "build";
+  std::string shr = "/tmp/vtpu_interposer_test_" +
+                    std::to_string(getpid()) + ".cache";
+
+  if (argc > 2) {
+    for (const Scenario& s : kScenarios) {
+      if (strcmp(s.name, argv[2]) == 0) {
+        int rc = s.fn(dir, shr.c_str());
+        unlink(shr.c_str());
+        if (rc == 0) printf("scenario %s: OK\n", s.name);
+        return rc;
+      }
+    }
+    fprintf(stderr, "unknown scenario %s\n", argv[2]);
+    return 2;
+  }
+
+  /* Driver: each scenario in a fresh process (env parsed at init). */
+  int failures = 0;
+  for (const Scenario& s : kScenarios) {
+    pid_t pid = fork();
+    if (pid == 0) {
+      execl(argv[0], argv[0], dir, s.name, (char*)nullptr);
+      _exit(127);
+    }
+    int st = 0;
+    waitpid(pid, &st, 0);
+    bool ok;
+    if (s.expect_sigkill)
+      ok = WIFSIGNALED(st) && WTERMSIG(st) == SIGKILL;
+    else
+      ok = WIFEXITED(st) && WEXITSTATUS(st) == 0;
+    if (!ok) {
+      fprintf(stderr, "scenario %s FAILED (status %d)\n", s.name, st);
+      failures++;
+    }
+  }
+  if (failures == 0) printf("interposer_test: ALL OK\n");
+  return failures == 0 ? 0 : 1;
 }

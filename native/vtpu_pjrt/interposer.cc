@@ -9,10 +9,24 @@
  * replace the entries where policy lives:
  *
  *   PJRT_Client_Create            -> attach shared accounting region (env)
- *   PJRT_Client_BufferFromHostBuffer -> HBM quota check (OOM before alloc)
+ *   PJRT_Client_{Devices,AddressableDevices} -> core-split filtered view
+ *                                    (VTPU_CORE_INDICES subset+renumber;
+ *                                    the reference's device virtualization,
+ *                                    map_cuda_visible_devices §2.9e)
+ *   PJRT_Client_BufferFromHostBuffer -> HBM quota check (OOM before
+ *                                    alloc), host-RAM spill on
+ *                                    oversubscribe (reference
+ *                                    cuMemAllocManaged path, README:104)
+ *   PJRT_Client_CreateUninitializedBuffer, PJRT_Buffer_CopyToDevice,
+ *   PJRT_Buffer_CopyToMemory, PJRT_Client_CreateViewOfDeviceBuffer,
+ *   PJRT_Client_CreateBuffersForAsyncHostToDevice
+ *                                 -> the remaining allocation surface
+ *                                    (reference hooks all 40+ cuMem*)
  *   PJRT_Buffer_Destroy           -> release accounted bytes
- *   PJRT_LoadedExecutable_Execute -> device-time token bucket + output
- *                                    buffer accounting + latency metering
+ *   PJRT_LoadedExecutable_Execute -> device-time token bucket (policy
+ *                                    DEFAULT/FORCE/DISABLE) + spilled-arg
+ *                                    staging + output accounting +
+ *                                    donation release + latency metering
  *   PJRT_Device_MemoryStats       -> quota-adjusted memory view (the
  *                                    nvidia-smi-lying analogue, reference
  *                                    nvmlDeviceGetMemoryInfo hook)
@@ -27,12 +41,14 @@
  */
 #include <dlfcn.h>
 #include <pthread.h>
+#include <signal.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <time.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cinttypes>
 #include <mutex>
 #include <string>
@@ -68,13 +84,25 @@ static int log_level() {
 /* state                                                              */
 /* ------------------------------------------------------------------ */
 
-static const PJRT_Api* g_real = nullptr;
+static const PJRT_Api* g_real_tbl = nullptr;
+/* Zero-padded full-size copy of the real table: the real backend may
+ * implement an older (smaller) PJRT_Api, so reading fields through the
+ * raw pointer past its struct_size is out of bounds.  Absent entries are
+ * null here — every call site must (and does) check before calling. */
+static PJRT_Api g_realv;
+static PJRT_Api* const g_real = &g_realv;
 static PJRT_Api g_wrapped;
 
 static vtpu_region* g_region = nullptr;
 static int g_oversubscribe = 0;
 static int g_priority = 1;
-static int g_rate_disabled = 0;
+/* Reference GPU_CORE_UTILIZATION_POLICY: DEFAULT gates only under
+ * contention (>1 live proc on the region), FORCE always, DISABLE never. */
+enum { POLICY_DEFAULT = 0, POLICY_FORCE = 1, POLICY_DISABLE = 2 };
+static int g_policy = POLICY_DEFAULT;
+/* Reference ACTIVE_OOM_KILLER: kill the offending process instead of
+ * returning RESOURCE_EXHAUSTED. */
+static int g_active_oom_killer = 0;
 static uint64_t g_default_exec_cost_us = 5000;
 /* Floor on the per-execute charge.  Some transports complete the PJRT
  * device event at enqueue rather than at true device completion (e.g.
@@ -87,6 +115,9 @@ static std::mutex g_mu;
 struct BufInfo {
   int dev;
   uint64_t bytes;
+  /* Buffer lives in host memory (oversubscribe spill): bytes are NOT
+   * charged to the device quota; staged onto the device per execute. */
+  bool host = false;
 };
 static std::unordered_map<PJRT_Buffer*, BufInfo>& buf_map() {
   static auto* m = new std::unordered_map<PJRT_Buffer*, BufInfo>();
@@ -94,6 +125,38 @@ static std::unordered_map<PJRT_Buffer*, BufInfo>& buf_map() {
 }
 static std::unordered_map<PJRT_Device*, int>& dev_ord() {
   static auto* m = new std::unordered_map<PJRT_Device*, int>();
+  return *m;
+}
+/* Core-split filter: positions (into the real addressable-device list)
+ * this container may see, from VTPU_CORE_INDICES.  Empty = no filter. */
+static std::vector<int>& core_filter() {
+  static auto* v = new std::vector<int>();
+  return *v;
+}
+/* Per-client filtered device views (stable storage for the out-arrays we
+ * hand to the caller). */
+static std::unordered_map<PJRT_Client*, std::vector<PJRT_Device*>>&
+filtered_devs() {
+  static auto* m =
+      new std::unordered_map<PJRT_Client*, std::vector<PJRT_Device*>>();
+  return *m;
+}
+/* Per-client host memory (kind contains "host") for the spill path;
+ * nullptr = probed and absent. */
+static std::unordered_map<PJRT_Client*, PJRT_Memory*>& host_mem_cache() {
+  static auto* m = new std::unordered_map<PJRT_Client*, PJRT_Memory*>();
+  return *m;
+}
+/* Async H2D transfer managers: remaining per-buffer charges, released as
+ * buffers are retrieved (ownership moves to buf_map) or at Destroy. */
+struct XferInfo {
+  int dev;
+  std::vector<uint64_t> pending;  /* per-spec bytes not yet retrieved */
+};
+static std::unordered_map<PJRT_AsyncHostToDeviceTransferManager*, XferInfo>&
+xfer_map() {
+  static auto* m = new std::unordered_map<
+      PJRT_AsyncHostToDeviceTransferManager*, XferInfo>();
   return *m;
 }
 /* Per-executable device-time estimate (EMA of measured latencies). */
@@ -246,29 +309,78 @@ static int ordinal_of(PJRT_Device* d) {
   return it == dev_ord().end() ? 0 : it->second;
 }
 
-static void init_region_for_client(PJRT_Client* client) {
-  /* Enumerate addressable devices through the real API to build the
-   * ordinal map (container ordinal = position in the addressable list,
-   * matching VTPU_DEVICE_MAP order from the daemon). */
+static void destroy_real_error(PJRT_Error* err) {
+  if (!err) return;
+  PJRT_Error_Destroy_Args dd;
+  memset(&dd, 0, sizeof(dd));
+  dd.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dd.error = err;
+  g_real->PJRT_Error_Destroy(&dd);
+}
+
+static void parse_core_filter() {
+  core_filter().clear();
+  const char* s = getenv("VTPU_CORE_INDICES");
+  if (!s || !*s) return;
+  while (*s) {
+    char* end = nullptr;
+    long v = strtol(s, &end, 10);
+    if (end == s) break;
+    if (v >= 0) core_filter().push_back((int)v);
+    s = (*end == ',') ? end + 1 : end;
+  }
+}
+
+/* The container-visible device list: the real addressable list, subset to
+ * VTPU_CORE_INDICES positions when a core-split grant pins TensorCores
+ * (reference initial_virtual_devices/map_cuda_visible_devices, §2.9e).
+ * Also (re)builds the device->container-ordinal map.  Returns the visible
+ * list (stable per client). */
+static const std::vector<PJRT_Device*>* visible_devices(PJRT_Client* client) {
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = filtered_devs().find(client);
+    if (it != filtered_devs().end()) return &it->second;
+  }
   PJRT_Client_AddressableDevices_Args da;
   memset(&da, 0, sizeof(da));
   da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
   da.client = client;
   if (PJRT_Error* err = g_real->PJRT_Client_AddressableDevices(&da)) {
-    PJRT_Error_Destroy_Args dd;
-    memset(&dd, 0, sizeof(dd));
-    dd.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-    dd.error = err;
-    g_real->PJRT_Error_Destroy(&dd);
+    destroy_real_error(err);
+    return nullptr;
+  }
+  std::vector<PJRT_Device*> vis;
+  if (core_filter().empty()) {
+    vis.assign(da.addressable_devices,
+               da.addressable_devices + da.num_addressable_devices);
+  } else {
+    for (int idx : core_filter())
+      if (idx >= 0 && (size_t)idx < da.num_addressable_devices)
+        vis.push_back(da.addressable_devices[idx]);
+    if (vis.empty()) {
+      VTPU_LOG(0, "VTPU_CORE_INDICES selects no devices; showing all");
+      vis.assign(da.addressable_devices,
+                 da.addressable_devices + da.num_addressable_devices);
+    }
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& slot = filtered_devs()[client];
+  slot = std::move(vis);
+  for (size_t i = 0; i < slot.size() && i < VTPU_MAX_DEVICES; i++)
+    dev_ord()[slot[i]] = (int)i;
+  return &slot;
+}
+
+static void init_region_for_client(PJRT_Client* client) {
+  parse_core_filter();
+  const std::vector<PJRT_Device*>* vis = visible_devices(client);
+  if (!vis) {
     VTPU_LOG(0, "cannot enumerate devices; quotas disabled");
     return;
   }
-  int n = (int)da.num_addressable_devices;
+  int n = (int)vis->size();
   if (n > VTPU_MAX_DEVICES) n = VTPU_MAX_DEVICES;
-  {
-    std::lock_guard<std::mutex> lk(g_mu);
-    for (int i = 0; i < n; i++) dev_ord()[da.addressable_devices[i]] = i;
-  }
 
   if (g_region != nullptr) {
     /* Region already attached (multi-client process): only the ordinal
@@ -286,7 +398,14 @@ static void init_region_for_client(PJRT_Client* client) {
   const char* pct_s = getenv("VTPU_DEVICE_CORE_LIMIT");
   int32_t pct = pct_s ? atoi(pct_s) : 0;
   const char* policy = getenv("VTPU_CORE_UTILIZATION_POLICY");
-  if (policy && strcmp(policy, "DISABLE") == 0) g_rate_disabled = 1;
+  if (policy) {
+    if (strcmp(policy, "DISABLE") == 0) g_policy = POLICY_DISABLE;
+    else if (strcmp(policy, "FORCE") == 0) g_policy = POLICY_FORCE;
+    else g_policy = POLICY_DEFAULT;
+  }
+  const char* killer = getenv("VTPU_ACTIVE_OOM_KILLER");
+  g_active_oom_killer = killer && (strcmp(killer, "true") == 0 ||
+                                   strcmp(killer, "1") == 0);
   int any_limit = 0;
   for (int i = 0; i < n; i++) {
     char key[64];
@@ -334,6 +453,7 @@ static PJRT_Error* w_Client_Create(PJRT_Client_Create_Args* args) {
        * existing region, refresh the device->ordinal map and our slot. */
       std::lock_guard<std::mutex> lk(g_mu);
       dev_ord().clear();
+      filtered_devs().erase(args->client);
     }
     init_region_for_client(args->client);
   }
@@ -343,8 +463,117 @@ static PJRT_Error* w_Client_Create(PJRT_Client_Create_Args* args) {
 static PJRT_Error* w_Client_Destroy(PJRT_Client_Destroy_Args* args) {
   /* Keep the proc slot: live buffers of other clients (and the process
    * itself) remain accountable; the slot drops at exit or via sweep. */
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    filtered_devs().erase(args->client);
+    host_mem_cache().erase(args->client);
+  }
   return g_real->PJRT_Client_Destroy(args);
 }
+
+/* Core-split device virtualization: a pod granted specific TensorCores
+ * sees ONLY those devices, renumbered from 0 (reference
+ * nvmlDeviceGetCount/initial_virtual_devices, §2.9e/f; the MIG-slice
+ * isolation analogue, mig.go:187-226). */
+static PJRT_Error* w_Client_Devices(PJRT_Client_Devices_Args* args) {
+  if (core_filter().empty()) return g_real->PJRT_Client_Devices(args);
+  const std::vector<PJRT_Device*>* vis = visible_devices(args->client);
+  if (!vis) return g_real->PJRT_Client_Devices(args);
+  args->devices = vis->data();
+  args->num_devices = vis->size();
+  return nullptr;
+}
+
+static PJRT_Error* w_Client_AddressableDevices(
+    PJRT_Client_AddressableDevices_Args* args) {
+  if (core_filter().empty())
+    return g_real->PJRT_Client_AddressableDevices(args);
+  const std::vector<PJRT_Device*>* vis = visible_devices(args->client);
+  if (!vis) return g_real->PJRT_Client_AddressableDevices(args);
+  args->addressable_devices = vis->data();
+  args->num_addressable_devices = vis->size();
+  return nullptr;
+}
+
+/* OOM surfaced to the caller — or, with VTPU_ACTIVE_OOM_KILLER, to the
+ * process itself (reference active_oom_killer, §2.9c). */
+static PJRT_Error* oom_error(int dev, uint64_t bytes) {
+  uint64_t freeb = 0, total = 0;
+  vtpu_mem_info(g_region, dev, &freeb, &total);
+  char msg[160];
+  snprintf(msg, sizeof(msg),
+           "vTPU device %d OOM: requested %" PRIu64 " bytes, quota %"
+           PRIu64 " (free %" PRIu64 ")", dev, bytes, total, freeb);
+  VTPU_LOG(1, "%s", msg);
+  if (g_active_oom_killer) {
+    fprintf(stderr, "[libvtpu] active OOM killer: %s\n", msg);
+    kill(getpid(), SIGKILL);
+  }
+  return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
+}
+
+static uint64_t on_device_size(PJRT_Buffer* buf) {
+  PJRT_Buffer_OnDeviceSizeInBytes_Args sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  sa.buffer = buf;
+  if (g_real->PJRT_Buffer_OnDeviceSizeInBytes(&sa) == nullptr)
+    return sa.on_device_size_in_bytes;
+  return 0;
+}
+
+/* Correct an up-front estimate to the device's actual (tiled/padded) size
+ * and register the buffer for release-on-destroy. */
+static void settle_charge(PJRT_Buffer* buf, int dev, uint64_t est) {
+  uint64_t actual = on_device_size(buf);
+  if (actual == 0) actual = est;
+  if (actual > est)
+    vtpu_mem_acquire(g_region, dev, actual - est, /*oversubscribe=*/1);
+  else if (actual < est)
+    vtpu_mem_release(g_region, dev, est - actual);
+  std::lock_guard<std::mutex> lk(g_mu);
+  buf_map()[buf] = BufInfo{dev, actual, false};
+}
+
+/* A memory space whose kind names host RAM ("unpinned_host"/"pinned_host"),
+ * for the oversubscribe spill; nullptr when the backend has none. */
+static PJRT_Memory* find_host_memory(PJRT_Client* client) {
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = host_mem_cache().find(client);
+    if (it != host_mem_cache().end()) return it->second;
+  }
+  PJRT_Memory* found = nullptr;
+  if (g_real->PJRT_Client_AddressableMemories &&
+      g_real->PJRT_Memory_Kind) {
+    PJRT_Client_AddressableMemories_Args ma;
+    memset(&ma, 0, sizeof(ma));
+    ma.struct_size = PJRT_Client_AddressableMemories_Args_STRUCT_SIZE;
+    ma.client = client;
+    if (PJRT_Error* err = g_real->PJRT_Client_AddressableMemories(&ma)) {
+      destroy_real_error(err);
+    } else {
+      for (size_t i = 0; i < ma.num_addressable_memories && !found; i++) {
+        PJRT_Memory_Kind_Args ka;
+        memset(&ka, 0, sizeof(ka));
+        ka.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+        ka.memory = ma.addressable_memories[i];
+        if (PJRT_Error* kerr = g_real->PJRT_Memory_Kind(&ka)) {
+          destroy_real_error(kerr);
+          continue;
+        }
+        std::string kind(ka.kind, ka.kind_size);
+        if (kind.find("host") != std::string::npos)
+          found = ma.addressable_memories[i];
+      }
+    }
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  host_mem_cache()[client] = found;
+  return found;
+}
+
+static int is_host_memory(PJRT_Memory* mem);
 
 static PJRT_Error* w_BufferFromHostBuffer(
     PJRT_Client_BufferFromHostBuffer_Args* args) {
@@ -353,15 +582,41 @@ static PJRT_Error* w_BufferFromHostBuffer(
   int dev = args->device ? ordinal_of(args->device) : 0;
   uint64_t est = estimate_bytes(args->type, args->dims, args->num_dims);
 
-  if (vtpu_mem_acquire(g_region, dev, est, g_oversubscribe) != 0) {
-    uint64_t freeb = 0, total = 0;
-    vtpu_mem_info(g_region, dev, &freeb, &total);
-    char msg[160];
-    snprintf(msg, sizeof(msg),
-             "vTPU device %d OOM: requested %" PRIu64 " bytes, quota %"
-             PRIu64 " (free %" PRIu64 ")", dev, est, total, freeb);
-    VTPU_LOG(1, "%s", msg);
-    return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
+  /* Caller-directed host placement (JAX memory_kind offloading) uses no
+   * HBM: track as host-resident, never charge or OOM. */
+  if (args->memory && is_host_memory(args->memory)) {
+    PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
+    if (err == nullptr) {
+      std::lock_guard<std::mutex> lk(g_mu);
+      buf_map()[args->buffer] = BufInfo{dev, est, true};
+    }
+    return err;
+  }
+
+  if (vtpu_mem_acquire(g_region, dev, est, /*oversubscribe=*/0) != 0) {
+    if (!g_oversubscribe) return oom_error(dev, est);
+    /* Oversubscribe: place the buffer in host RAM via the memories API
+     * (the reference's cuMemAllocManaged spill, README.md:104 "the excess
+     * part will be put in the RAM").  It is staged onto the device per
+     * execute (w_Execute).  Backends without host memory admit past the
+     * cap instead — visible in stats, enforced on the next tenant. */
+    PJRT_Memory* host = args->memory ? nullptr
+                                     : find_host_memory(args->client);
+    if (host != nullptr) {
+      PJRT_Memory* saved = args->memory;
+      args->memory = host;
+      PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
+      if (err == nullptr) {
+        VTPU_LOG(3, "spilled %" PRIu64 " bytes to host (dev %d over quota)",
+                 est, dev);
+        std::lock_guard<std::mutex> lk(g_mu);
+        buf_map()[args->buffer] = BufInfo{dev, est, true};
+        return nullptr;
+      }
+      destroy_real_error(err);
+      args->memory = saved;  /* fall through to admit-past-cap */
+    }
+    vtpu_mem_acquire(g_region, dev, est, /*oversubscribe=*/1);
   }
 
   PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
@@ -369,42 +624,207 @@ static PJRT_Error* w_BufferFromHostBuffer(
     vtpu_mem_release(g_region, dev, est);
     return err;
   }
+  settle_charge(args->buffer, dev, est);
+  return nullptr;
+}
 
-  /* Correct the estimate to the device's actual (tiled/padded) size. */
-  uint64_t actual = est;
-  PJRT_Buffer_OnDeviceSizeInBytes_Args sa;
-  memset(&sa, 0, sizeof(sa));
-  sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
-  sa.buffer = args->buffer;
-  if (g_real->PJRT_Buffer_OnDeviceSizeInBytes(&sa) == nullptr &&
-      sa.on_device_size_in_bytes > 0) {
-    actual = sa.on_device_size_in_bytes;
-    if (actual > est)
-      vtpu_mem_acquire(g_region, dev, actual - est, /*oversubscribe=*/1);
-    else if (actual < est)
-      vtpu_mem_release(g_region, dev, est - actual);
+/* ---- the rest of the allocation surface (reference hooks all 40+
+ * cuMem* entry points; PJRT's surface is these) --------------------- */
+
+static PJRT_Error* w_CreateUninitializedBuffer(
+    PJRT_Client_CreateUninitializedBuffer_Args* args) {
+  if (!g_region || !g_real->PJRT_Client_CreateUninitializedBuffer)
+    return g_real->PJRT_Client_CreateUninitializedBuffer(args);
+  int dev = args->device ? ordinal_of(args->device) : 0;
+  uint64_t est = estimate_bytes(args->shape_element_type, args->shape_dims,
+                                args->shape_num_dims);
+  if (vtpu_mem_acquire(g_region, dev, est, g_oversubscribe) != 0)
+    return oom_error(dev, est);
+  PJRT_Error* err = g_real->PJRT_Client_CreateUninitializedBuffer(args);
+  if (err != nullptr) {
+    vtpu_mem_release(g_region, dev, est);
+    return err;
   }
-  {
+  settle_charge(args->buffer, dev, est);
+  return nullptr;
+}
+
+static PJRT_Error* w_Buffer_CopyToDevice(
+    PJRT_Buffer_CopyToDevice_Args* args) {
+  if (!g_region) return g_real->PJRT_Buffer_CopyToDevice(args);
+  int dev = ordinal_of(args->dst_device);
+  uint64_t est = on_device_size(args->buffer);
+  if (vtpu_mem_acquire(g_region, dev, est, g_oversubscribe) != 0)
+    return oom_error(dev, est);
+  PJRT_Error* err = g_real->PJRT_Buffer_CopyToDevice(args);
+  if (err != nullptr) {
+    vtpu_mem_release(g_region, dev, est);
+    return err;
+  }
+  settle_charge(args->dst_buffer, dev, est);
+  return nullptr;
+}
+
+static int is_host_memory(PJRT_Memory* mem) {
+  if (!mem || !g_real->PJRT_Memory_Kind) return 0;
+  PJRT_Memory_Kind_Args ka;
+  memset(&ka, 0, sizeof(ka));
+  ka.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+  ka.memory = mem;
+  if (PJRT_Error* err = g_real->PJRT_Memory_Kind(&ka)) {
+    destroy_real_error(err);
+    return 0;
+  }
+  return std::string(ka.kind, ka.kind_size).find("host") !=
+         std::string::npos;
+}
+
+/* Device ordinal a memory space belongs to (first addressing device). */
+static int ordinal_of_memory(PJRT_Memory* mem) {
+  if (!g_real->PJRT_Memory_AddressableByDevices) return 0;
+  PJRT_Memory_AddressableByDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Memory_AddressableByDevices_Args_STRUCT_SIZE;
+  da.memory = mem;
+  if (PJRT_Error* err = g_real->PJRT_Memory_AddressableByDevices(&da)) {
+    destroy_real_error(err);
+    return 0;
+  }
+  return da.num_devices > 0 ? ordinal_of(da.devices[0]) : 0;
+}
+
+static PJRT_Error* w_Buffer_CopyToMemory(
+    PJRT_Buffer_CopyToMemory_Args* args) {
+  if (!g_region) return g_real->PJRT_Buffer_CopyToMemory(args);
+  if (is_host_memory(args->dst_memory)) {
+    /* Host-bound copy consumes no HBM; track as host-resident. */
+    PJRT_Error* err = g_real->PJRT_Buffer_CopyToMemory(args);
+    if (err == nullptr) {
+      uint64_t est = on_device_size(args->buffer);
+      std::lock_guard<std::mutex> lk(g_mu);
+      buf_map()[args->dst_buffer] = BufInfo{0, est, true};
+    }
+    return err;
+  }
+  int dev = ordinal_of_memory(args->dst_memory);
+  uint64_t est = on_device_size(args->buffer);
+  if (vtpu_mem_acquire(g_region, dev, est, g_oversubscribe) != 0)
+    return oom_error(dev, est);
+  PJRT_Error* err = g_real->PJRT_Buffer_CopyToMemory(args);
+  if (err != nullptr) {
+    vtpu_mem_release(g_region, dev, est);
+    return err;
+  }
+  settle_charge(args->dst_buffer, dev, est);
+  return nullptr;
+}
+
+static PJRT_Error* w_CreateViewOfDeviceBuffer(
+    PJRT_Client_CreateViewOfDeviceBuffer_Args* args) {
+  if (!g_region || !g_real->PJRT_Client_CreateViewOfDeviceBuffer)
+    return g_real->PJRT_Client_CreateViewOfDeviceBuffer(args);
+  PJRT_Error* err = g_real->PJRT_Client_CreateViewOfDeviceBuffer(args);
+  if (err != nullptr) return err;
+  /* The underlying memory was allocated outside PJRT (dlpack import
+   * etc.): it occupies real HBM, so it must be visible in the books —
+   * admitted with oversubscribe (refusing a view of memory that already
+   * exists would not free anything). */
+  int dev = args->device ? ordinal_of(args->device) : 0;
+  uint64_t est = on_device_size(args->buffer);
+  if (est > 0) {
+    vtpu_mem_acquire(g_region, dev, est, /*oversubscribe=*/1);
     std::lock_guard<std::mutex> lk(g_mu);
-    buf_map()[args->buffer] = BufInfo{dev, actual};
+    buf_map()[args->buffer] = BufInfo{dev, est, false};
   }
   return nullptr;
 }
 
+static PJRT_Error* w_CreateBuffersForAsyncHostToDevice(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args* args) {
+  if (!g_region ||
+      !g_real->PJRT_Client_CreateBuffersForAsyncHostToDevice)
+    return g_real->PJRT_Client_CreateBuffersForAsyncHostToDevice(args);
+  int dev = args->memory ? ordinal_of_memory(args->memory) : 0;
+  int host = args->memory ? is_host_memory(args->memory) : 0;
+  std::vector<uint64_t> sizes;
+  uint64_t total = 0;
+  for (size_t i = 0; i < args->num_shape_specs; i++) {
+    uint64_t b = estimate_bytes(args->shape_specs[i].element_type,
+                                args->shape_specs[i].dims,
+                                args->shape_specs[i].num_dims);
+    sizes.push_back(b);
+    total += b;
+  }
+  if (!host && total > 0 &&
+      vtpu_mem_acquire(g_region, dev, total, g_oversubscribe) != 0)
+    return oom_error(dev, total);
+  PJRT_Error* err =
+      g_real->PJRT_Client_CreateBuffersForAsyncHostToDevice(args);
+  if (err != nullptr) {
+    if (!host && total > 0) vtpu_mem_release(g_region, dev, total);
+    return err;
+  }
+  if (!host && total > 0) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    xfer_map()[args->transfer_manager] = XferInfo{dev, std::move(sizes)};
+  }
+  return nullptr;
+}
+
+static PJRT_Error* w_AsyncXfer_RetrieveBuffer(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args* args) {
+  PJRT_Error* err =
+      g_real->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer(args);
+  if (err != nullptr || !g_region) return err;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = xfer_map().find(args->transfer_manager);
+  if (it == xfer_map().end()) return nullptr;
+  size_t i = args->buffer_index;
+  if (i < it->second.pending.size() && it->second.pending[i] > 0) {
+    /* Ownership of the charge moves onto the buffer itself. */
+    buf_map()[args->buffer_out] =
+        BufInfo{it->second.dev, it->second.pending[i], false};
+    it->second.pending[i] = 0;
+  }
+  return nullptr;
+}
+
+static PJRT_Error* w_AsyncXfer_Destroy(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args* args) {
+  if (g_region) {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = xfer_map().find(args->transfer_manager);
+    if (it != xfer_map().end()) {
+      for (uint64_t b : it->second.pending)
+        if (b > 0) vtpu_mem_release(g_region, it->second.dev, b);
+      xfer_map().erase(it);
+    }
+  }
+  return g_real->PJRT_AsyncHostToDeviceTransferManager_Destroy(args);
+}
+
 static void account_buffer(PJRT_Buffer* buf, int dev) {
-  PJRT_Buffer_OnDeviceSizeInBytes_Args sa;
-  memset(&sa, 0, sizeof(sa));
-  sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
-  sa.buffer = buf;
-  uint64_t bytes = 0;
-  if (g_real->PJRT_Buffer_OnDeviceSizeInBytes(&sa) == nullptr)
-    bytes = sa.on_device_size_in_bytes;
+  uint64_t bytes = on_device_size(buf);
   if (bytes == 0) return;
+  /* Resolve the owning device when the caller couldn't (portable /
+   * multi-device executions, ADVICE r1 #5). */
+  if (dev < 0) {
+    PJRT_Buffer_Device_Args bd;
+    memset(&bd, 0, sizeof(bd));
+    bd.struct_size = PJRT_Buffer_Device_Args_STRUCT_SIZE;
+    bd.buffer = buf;
+    if (PJRT_Error* err = g_real->PJRT_Buffer_Device(&bd)) {
+      destroy_real_error(err);
+      dev = 0;
+    } else {
+      dev = ordinal_of(bd.device);
+    }
+  }
   /* Outputs of an already-running program can't be refused; account with
    * oversubscribe so usage is visible and later allocations hit the cap. */
   vtpu_mem_acquire(g_region, dev, bytes, /*oversubscribe=*/1);
   std::lock_guard<std::mutex> lk(g_mu);
-  buf_map()[buf] = BufInfo{dev, bytes};
+  buf_map()[buf] = BufInfo{dev, bytes, false};
 }
 
 static PJRT_Error* w_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
@@ -412,7 +832,9 @@ static PJRT_Error* w_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
     std::lock_guard<std::mutex> lk(g_mu);
     auto it = buf_map().find(args->buffer);
     if (it != buf_map().end()) {
-      vtpu_mem_release(g_region, it->second.dev, it->second.bytes);
+      /* Host-spilled buffers were never charged to the device quota. */
+      if (!it->second.host)
+        vtpu_mem_release(g_region, it->second.dev, it->second.bytes);
       buf_map().erase(it);
     }
   }
@@ -423,25 +845,51 @@ static PJRT_Error* w_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
 struct ExecMeter {
   uint64_t t0_us;
   uint64_t est_us;
-  int dev;
+  bool gated = false;                 /* tokens were charged up front */
+  std::vector<int> devs;              /* gated/charged ordinals */
   PJRT_LoadedExecutable* exe;
+  std::vector<PJRT_Buffer*> staged;   /* spill copies, freed on done */
+  PJRT_Event** own_events = nullptr;  /* we substituted the event array */
 };
 
 static void on_exec_done(PJRT_Error* error, void* user_arg) {
   ExecMeter* m = (ExecMeter*)user_arg;
   uint64_t actual = now_us() - m->t0_us;
-  if (g_region) {
-    /* The floor also applies to the correction, else an optimistic
-     * completion event would credit the floor charge straight back. */
+  if (g_region && m->gated) {
+    /* Correct the up-front charge to measured time.  Ungated runs (sole
+     * tenant under DEFAULT policy) charge nothing — they must not bank
+     * debt against a co-tenant that arrives later.  The floor also
+     * applies to the correction, else an optimistic completion event
+     * would credit the floor charge straight back. */
     uint64_t charged = actual > g_min_exec_cost_us ? actual
                                                    : g_min_exec_cost_us;
-    vtpu_rate_adjust(g_region, m->dev,
-                     (int64_t)charged - (int64_t)m->est_us);
+    for (int dev : m->devs)
+      vtpu_rate_adjust(g_region, dev,
+                       (int64_t)charged - (int64_t)m->est_us);
   }
   {
     std::lock_guard<std::mutex> lk(g_mu);
     double& ema = exe_cost()[m->exe];
     ema = ema <= 0 ? (double)actual : ema * 0.7 + (double)actual * 0.3;
+  }
+  /* Execution is over: the staged device copies of host-spilled args can
+   * go (w_Buffer_Destroy releases their accounting). */
+  for (PJRT_Buffer* b : m->staged) {
+    PJRT_Buffer_Destroy_Args bd;
+    memset(&bd, 0, sizeof(bd));
+    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    bd.buffer = b;
+    w_Buffer_Destroy(&bd);
+  }
+  if (m->own_events) {
+    if (m->own_events[0]) {
+      PJRT_Event_Destroy_Args ed;
+      memset(&ed, 0, sizeof(ed));
+      ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+      ed.event = m->own_events[0];
+      g_real->PJRT_Event_Destroy(&ed);
+    }
+    delete[] m->own_events;
   }
   if (error) {
     PJRT_Error_Destroy_Args dd;
@@ -475,11 +923,106 @@ static size_t num_outputs_of(PJRT_LoadedExecutable* lexe) {
   return n;
 }
 
-static PJRT_Error* w_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
-  if (!g_region || g_rate_disabled)
-    return g_real->PJRT_LoadedExecutable_Execute(args);
+/* Ordinals the execution touches: execute_device when given, else the
+ * executable's addressable devices (ADVICE r1 #5: a portable execution
+ * must not charge everything to ordinal 0). */
+static std::vector<int> exec_ordinals(
+    PJRT_LoadedExecutable_Execute_Args* args) {
+  std::vector<int> devs;
+  if (args->execute_device) {
+    devs.push_back(ordinal_of(args->execute_device));
+    return devs;
+  }
+  if (g_real->PJRT_LoadedExecutable_AddressableDevices) {
+    PJRT_LoadedExecutable_AddressableDevices_Args la;
+    memset(&la, 0, sizeof(la));
+    la.struct_size =
+        PJRT_LoadedExecutable_AddressableDevices_Args_STRUCT_SIZE;
+    la.executable = args->executable;
+    if (PJRT_Error* err =
+            g_real->PJRT_LoadedExecutable_AddressableDevices(&la)) {
+      destroy_real_error(err);
+    } else {
+      for (size_t i = 0; i < la.num_addressable_devices &&
+                         i < args->num_devices; i++)
+        devs.push_back(ordinal_of(la.addressable_devices[i]));
+    }
+  }
+  if (devs.empty()) devs.push_back(0);
+  return devs;
+}
 
-  int dev = args->execute_device ? ordinal_of(args->execute_device) : 0;
+/* Stage a host-spilled buffer onto `target`'s default memory for one
+ * execution (the TPU-explicit form of the reference's managed-memory
+ * spill).  Returns nullptr on failure (caller passes the host buffer
+ * through unstaged). */
+static PJRT_Buffer* stage_to_device(PJRT_Buffer* host_buf,
+                                    PJRT_Device* target) {
+  if (!g_real->PJRT_Device_DefaultMemory ||
+      !g_real->PJRT_Buffer_CopyToMemory)
+    return nullptr;
+  PJRT_Device_DefaultMemory_Args dm;
+  memset(&dm, 0, sizeof(dm));
+  dm.struct_size = PJRT_Device_DefaultMemory_Args_STRUCT_SIZE;
+  dm.device = target;
+  if (PJRT_Error* err = g_real->PJRT_Device_DefaultMemory(&dm)) {
+    destroy_real_error(err);
+    return nullptr;
+  }
+  PJRT_Buffer_CopyToMemory_Args cm;
+  memset(&cm, 0, sizeof(cm));
+  cm.struct_size = PJRT_Buffer_CopyToMemory_Args_STRUCT_SIZE;
+  cm.buffer = host_buf;
+  cm.dst_memory = dm.memory;
+  if (PJRT_Error* err = g_real->PJRT_Buffer_CopyToMemory(&cm)) {
+    destroy_real_error(err);
+    return nullptr;
+  }
+  /* Transient overshoot of the cap, visible in stats (the cost of
+   * oversubscription; freed again right after the execution). */
+  account_buffer(cm.dst_buffer, ordinal_of(target));
+  return cm.dst_buffer;
+}
+
+/* The execute target device for staging: execute_device, else the
+ * executable's (single) addressable device. */
+static PJRT_Device* exec_target_device(
+    PJRT_LoadedExecutable_Execute_Args* args) {
+  if (args->execute_device) return args->execute_device;
+  if (!g_real->PJRT_LoadedExecutable_AddressableDevices) return nullptr;
+  PJRT_LoadedExecutable_AddressableDevices_Args la;
+  memset(&la, 0, sizeof(la));
+  la.struct_size =
+      PJRT_LoadedExecutable_AddressableDevices_Args_STRUCT_SIZE;
+  la.executable = args->executable;
+  if (PJRT_Error* err =
+          g_real->PJRT_LoadedExecutable_AddressableDevices(&la)) {
+    destroy_real_error(err);
+    return nullptr;
+  }
+  return la.num_addressable_devices > 0 ? la.addressable_devices[0]
+                                        : nullptr;
+}
+
+/* Cheap cached contention probe for the DEFAULT policy (sole tenant runs
+ * ungated; the probe sweeps + counts under the region lock, so damp it). */
+static int under_contention() {
+  static std::atomic<uint64_t> next_probe_us{0};
+  static std::atomic<int> cached{1};
+  uint64_t now = now_us();
+  uint64_t next = next_probe_us.load(std::memory_order_relaxed);
+  if (now >= next &&
+      next_probe_us.compare_exchange_strong(next, now + 100000)) {
+    cached.store(vtpu_region_active_procs(g_region) > 1,
+                 std::memory_order_relaxed);
+  }
+  return cached.load(std::memory_order_relaxed);
+}
+
+static PJRT_Error* w_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  if (!g_region) return g_real->PJRT_LoadedExecutable_Execute(args);
+
+  std::vector<int> devs = exec_ordinals(args);
   uint64_t est;
   {
     std::lock_guard<std::mutex> lk(g_mu);
@@ -489,19 +1032,142 @@ static PJRT_Error* w_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   }
 
   /* Gate on the device-time bucket (reference rate_limiter gating
-   * cuLaunchKernel).  Charged up front, corrected on completion. */
-  VTPU_LOG(4, "execute gate: dev=%d est=%" PRIu64 "us", dev, est);
-  vtpu_rate_block(g_region, dev, est, g_priority);
+   * cuLaunchKernel).  Policy: DISABLE never gates, FORCE always,
+   * DEFAULT only under multi-process contention (reference
+   * GPU_CORE_UTILIZATION_POLICY, §2.9d).  Charged up front, corrected on
+   * completion. */
+  bool gate = g_policy != POLICY_DISABLE &&
+              (g_policy == POLICY_FORCE || under_contention());
+  if (gate) {
+    VTPU_LOG(4, "execute gate: dev=%d est=%" PRIu64 "us", devs[0], est);
+    for (int dev : devs) vtpu_rate_block(g_region, dev, est, g_priority);
+  }
 
-  uint64_t t0 = now_us();
+  /* Host-spilled arguments are staged onto the device for this execution
+   * (single-device executions; a multi-device program over spilled
+   * buffers is passed through untouched). */
+  auto* m = new ExecMeter();
+  m->est_us = est;
+  m->gated = gate;
+  m->devs = devs;
+  m->exe = args->executable;
+  std::vector<PJRT_Buffer*> patched_args;
+  PJRT_Buffer* const* patched_list[1];
+  PJRT_Buffer* const* const* saved_lists = args->argument_lists;
+  PJRT_Event** saved_events = args->device_complete_events;
+  if (args->num_devices == 1 && args->argument_lists &&
+      args->argument_lists[0] && args->num_args > 0) {
+    bool any_host = false;
+    {
+      std::lock_guard<std::mutex> lk(g_mu);
+      for (size_t a = 0; a < args->num_args && !any_host; a++) {
+        auto it = buf_map().find(args->argument_lists[0][a]);
+        any_host = it != buf_map().end() && it->second.host;
+      }
+    }
+    if (any_host) {
+      PJRT_Device* target = exec_target_device(args);
+      if (target) {
+        patched_args.assign(args->argument_lists[0],
+                            args->argument_lists[0] + args->num_args);
+        for (size_t a = 0; a < args->num_args; a++) {
+          bool host;
+          {
+            std::lock_guard<std::mutex> lk(g_mu);
+            auto it = buf_map().find(patched_args[a]);
+            host = it != buf_map().end() && it->second.host;
+          }
+          if (!host) continue;
+          if (PJRT_Buffer* dcopy = stage_to_device(patched_args[a],
+                                                   target)) {
+            patched_args[a] = dcopy;
+            m->staged.push_back(dcopy);
+          }
+        }
+        if (!m->staged.empty()) {
+          patched_list[0] = patched_args.data();
+          args->argument_lists = patched_list;
+          VTPU_LOG(3, "staged %zu spilled args for execute",
+                   m->staged.size());
+        }
+      }
+    }
+  }
+
+  /* We need a completion event for metering and staged-copy teardown;
+   * substitute our own array when the caller didn't ask for events
+   * (single-device only). */
+  bool own_events = false;
+  if (!args->device_complete_events && args->num_devices == 1 &&
+      (gate || !m->staged.empty())) {
+    m->own_events = new PJRT_Event*[1];
+    m->own_events[0] = nullptr;
+    args->device_complete_events = m->own_events;
+    own_events = true;
+  }
+
+  m->t0_us = now_us();
   PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
-  if (err != nullptr) return err;
+  args->argument_lists = saved_lists;
+  if (err != nullptr) {
+    /* Dispatch failed: nothing is running, drop staged copies now. */
+    for (PJRT_Buffer* b : m->staged) {
+      PJRT_Buffer_Destroy_Args bd;
+      memset(&bd, 0, sizeof(bd));
+      bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      bd.buffer = b;
+      w_Buffer_Destroy(&bd);
+    }
+    if (own_events) {
+      args->device_complete_events = saved_events;
+      delete[] m->own_events;
+      m->own_events = nullptr;
+    }
+    delete m;
+    return err;
+  }
+
+  /* Donated inputs are consumed by the execution: release their books
+   * now rather than waiting for the client's (no-op) Destroy (reference
+   * honors donation implicitly via the driver; SURVEY §2.9c). */
+  if (g_real->PJRT_Buffer_IsDeleted && saved_lists) {
+    for (size_t d = 0; d < args->num_devices; d++) {
+      if (!saved_lists[d]) continue;
+      for (size_t a = 0; a < args->num_args; a++) {
+        PJRT_Buffer* in = saved_lists[d][a];
+        bool tracked;
+        {
+          std::lock_guard<std::mutex> lk(g_mu);
+          auto it = buf_map().find(in);
+          tracked = it != buf_map().end() && !it->second.host;
+        }
+        if (!tracked) continue;
+        PJRT_Buffer_IsDeleted_Args ia;
+        memset(&ia, 0, sizeof(ia));
+        ia.struct_size = PJRT_Buffer_IsDeleted_Args_STRUCT_SIZE;
+        ia.buffer = in;
+        if (PJRT_Error* ierr = g_real->PJRT_Buffer_IsDeleted(&ia)) {
+          destroy_real_error(ierr);
+          continue;
+        }
+        if (ia.is_deleted) {
+          std::lock_guard<std::mutex> lk(g_mu);
+          auto it = buf_map().find(in);
+          if (it != buf_map().end()) {
+            vtpu_mem_release(g_region, it->second.dev, it->second.bytes);
+            buf_map().erase(it);
+          }
+        }
+      }
+    }
+  }
 
   /* Account output buffers (they occupy HBM until destroyed). */
   size_t nout = num_outputs_of(args->executable);
   if (args->output_lists && nout > 0) {
     for (size_t d = 0; d < args->num_devices; d++) {
-      int odev = args->execute_device ? dev : (int)d;
+      /* -1: resolve each buffer's own device (portable executions). */
+      int odev = args->execute_device ? devs[0] : -1;
       for (size_t o = 0; o < nout; o++) {
         PJRT_Buffer* b = args->output_lists[d][o];
         if (b) account_buffer(b, odev);
@@ -510,23 +1176,25 @@ static PJRT_Error* w_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   }
 
   /* Meter real device time via the completion event when available. */
-  if (args->device_complete_events && args->num_devices > 0 &&
-      args->device_complete_events[0]) {
-    auto* m = new ExecMeter{t0, est, dev, args->executable};
+  PJRT_Event* ev = nullptr;
+  if (args->device_complete_events && args->num_devices > 0)
+    ev = args->device_complete_events[0];
+  if (own_events) args->device_complete_events = saved_events;
+  if (ev) {
     PJRT_Event_OnReady_Args oa;
     memset(&oa, 0, sizeof(oa));
     oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
-    oa.event = args->device_complete_events[0];
+    oa.event = ev;
     oa.callback = on_exec_done;
     oa.user_arg = m;
     if (PJRT_Error* oerr = g_real->PJRT_Event_OnReady(&oa)) {
-      PJRT_Error_Destroy_Args dd;
-      memset(&dd, 0, sizeof(dd));
-      dd.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-      dd.error = oerr;
-      g_real->PJRT_Error_Destroy(&dd);
-      delete m;
+      destroy_real_error(oerr);
+      on_exec_done(nullptr, m);  /* settle immediately */
     }
+  } else {
+    /* No event to hook: settle immediately (staged copies freed; the
+     * charge stands as the estimate). */
+    on_exec_done(nullptr, m);
   }
   return nullptr;
 }
@@ -609,28 +1277,56 @@ static void init_once() {
     VTPU_LOG(0, "GetPjrtApi missing in %s", path);
     return;
   }
-  g_real = get();
-  if (!g_real) return;
+  g_real_tbl = get();
+  if (!g_real_tbl) return;
 
-  /* Copy the real table, then splice in policy.  The PJRT_Api struct is
-   * append-only (pjrt_c_api.h ABI rules), so copying struct_size bytes and
-   * keeping the real struct_size preserves compatibility with whatever
-   * minor version the real libtpu implements. */
-  memset(&g_wrapped, 0, sizeof(g_wrapped));
-  size_t sz = g_real->struct_size < sizeof(PJRT_Api) ? g_real->struct_size
-                                                     : sizeof(PJRT_Api);
-  memcpy(&g_wrapped, g_real, sz);
+  /* Copy the real table into a full-size, zero-padded struct (g_realv):
+   * the PJRT_Api struct is append-only (pjrt_c_api.h ABI rules), so an
+   * older backend's smaller table reads as "newer entries = null".  All
+   * interposer code calls through g_realv, never the raw pointer —
+   * reading the raw pointer past its struct_size would be out of
+   * bounds. */
+  memset(&g_realv, 0, sizeof(g_realv));
+  size_t sz = g_real_tbl->struct_size < sizeof(PJRT_Api)
+                  ? g_real_tbl->struct_size
+                  : sizeof(PJRT_Api);
+  memcpy(&g_realv, g_real_tbl, sz);
+  g_wrapped = g_realv;
 
   g_wrapped.PJRT_Error_Destroy = w_Error_Destroy;
   g_wrapped.PJRT_Error_Message = w_Error_Message;
   g_wrapped.PJRT_Error_GetCode = w_Error_GetCode;
   g_wrapped.PJRT_Client_Create = w_Client_Create;
   g_wrapped.PJRT_Client_Destroy = w_Client_Destroy;
+  g_wrapped.PJRT_Client_Devices = w_Client_Devices;
+  g_wrapped.PJRT_Client_AddressableDevices = w_Client_AddressableDevices;
   g_wrapped.PJRT_Client_BufferFromHostBuffer = w_BufferFromHostBuffer;
   g_wrapped.PJRT_Buffer_Destroy = w_Buffer_Destroy;
   g_wrapped.PJRT_LoadedExecutable_Execute = w_Execute;
   g_wrapped.PJRT_LoadedExecutable_Destroy = w_LoadedExecutable_Destroy;
   g_wrapped.PJRT_Device_MemoryStats = w_Device_MemoryStats;
+  /* The remaining allocation surface — only wrapped when the real
+   * backend implements the entry point (append-only table copy keeps
+   * absent slots null). */
+  if (g_real->PJRT_Client_CreateUninitializedBuffer)
+    g_wrapped.PJRT_Client_CreateUninitializedBuffer =
+        w_CreateUninitializedBuffer;
+  if (g_real->PJRT_Buffer_CopyToDevice)
+    g_wrapped.PJRT_Buffer_CopyToDevice = w_Buffer_CopyToDevice;
+  if (g_real->PJRT_Buffer_CopyToMemory)
+    g_wrapped.PJRT_Buffer_CopyToMemory = w_Buffer_CopyToMemory;
+  if (g_real->PJRT_Client_CreateViewOfDeviceBuffer)
+    g_wrapped.PJRT_Client_CreateViewOfDeviceBuffer =
+        w_CreateViewOfDeviceBuffer;
+  if (g_real->PJRT_Client_CreateBuffersForAsyncHostToDevice)
+    g_wrapped.PJRT_Client_CreateBuffersForAsyncHostToDevice =
+        w_CreateBuffersForAsyncHostToDevice;
+  if (g_real->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer)
+    g_wrapped.PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer =
+        w_AsyncXfer_RetrieveBuffer;
+  if (g_real->PJRT_AsyncHostToDeviceTransferManager_Destroy)
+    g_wrapped.PJRT_AsyncHostToDeviceTransferManager_Destroy =
+        w_AsyncXfer_Destroy;
 
   VTPU_LOG(3, "wrapping real PJRT api v%d.%d from %s",
            g_real->pjrt_api_version.major_version,
@@ -640,5 +1336,5 @@ static void init_once() {
 extern "C" const PJRT_Api* GetPjrtApi() {
   static std::once_flag once;
   std::call_once(once, init_once);
-  return g_real ? &g_wrapped : nullptr;
+  return g_real_tbl ? &g_wrapped : nullptr;
 }

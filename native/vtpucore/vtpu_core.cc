@@ -468,6 +468,10 @@ uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
   Region* g = r->shm;
   if (dev < 0 || dev >= g->ndevices) return 0;
   if (lock_region(g) != 0) return 0;
+  /* Heartbeat: foreign-namespace liveness (active_procs) is judged by
+   * recency of this stamp. */
+  ProcSlot* me = my_slot_locked(r, g);
+  if (me) me->last_seen_ns = now_ns();
   DeviceState* ds = &g->dev[dev];
   int32_t pct = ds->core_limit_pct;
   if (pct <= 0 || pct >= 100) {
@@ -531,5 +535,31 @@ void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct) {
 }
 
 int vtpu_region_ndevices(vtpu_region* r) { return r->shm->ndevices; }
+
+int vtpu_region_active_procs(vtpu_region* r) {
+  Region* g = r->shm;
+  if (lock_region(g) != 0) return 0;
+  sweep_locked(g, 0);
+  /* Same-namespace slots are judged by pid liveness (just swept).  A
+   * foreign namespace's pids are not visible here, so judge those by
+   * heartbeat: slots touch last_seen_ns on every acquire/gate, so a
+   * crashed (or idle) co-tenant container stops counting as contention
+   * within the window and the DEFAULT policy un-gates the survivor. */
+  static const uint64_t kForeignLiveWindowNs = 30ull * 1000000000ull;
+  uint64_t now = now_ns();
+  uint64_t mine = my_ns_id();
+  ProcSlot* me = my_slot_locked(r, g);
+  if (me) me->last_seen_ns = now;  /* probing == actively executing */
+  int n = 0;
+  for (int s = 0; s < VTPU_MAX_PROCS; s++) {
+    ProcSlot* p = &g->proc[s];
+    if (!p->active) continue;
+    if (p->ns_id == mine ||
+        now - p->last_seen_ns <= kForeignLiveWindowNs)
+      n++;
+  }
+  unlock_region(g);
+  return n;
+}
 
 const char* vtpu_core_version(void) { return "vtpucore 0.1.0"; }

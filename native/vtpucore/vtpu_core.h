@@ -129,6 +129,12 @@ void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct);
 /* ---- introspection ----------------------------------------------------- */
 
 int vtpu_region_ndevices(vtpu_region* r);
+
+/* Number of live registered processes (after a same-namespace sweep).
+ * Used by the DEFAULT utilization policy: a sole tenant runs ungated;
+ * gating starts under contention (reference GPU_CORE_UTILIZATION_POLICY
+ * DEFAULT vs FORCE semantics). */
+int vtpu_region_active_procs(vtpu_region* r);
 const char* vtpu_core_version(void);
 
 #ifdef __cplusplus

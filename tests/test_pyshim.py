@@ -60,6 +60,9 @@ def test_jit_throttled(tmp_path):
         "VTPU_DEVICE_HBM_LIMIT_0": "1Gi",
         "VTPU_DEVICE_CORE_LIMIT": "20",
         "VTPU_MIN_EXEC_COST_US": "5000",
+        # FORCE: gate even as the sole process (DEFAULT exempts a sole
+        # tenant — tested separately below).
+        "VTPU_CORE_UTILIZATION_POLICY": "FORCE",
         "VTPU_DEVICE_MEMORY_SHARED_CACHE": str(tmp_path / "shr.cache"),
     })
     assert r.returncode == 0, r.stderr
@@ -68,6 +71,30 @@ def test_jit_throttled(tmp_path):
     # the py path has no floor env; EMA tracks actual latency, so steady
     # state wall ~= actual/0.2. Just assert visible slowdown.
     assert elapsed > 0.2, f"no throttle: {elapsed}"
+
+
+def test_jit_sole_tenant_ungated(tmp_path):
+    """DEFAULT policy: the only process on the region runs at full speed
+    (reference GPU_CORE_UTILIZATION_POLICY DEFAULT-vs-FORCE semantics)."""
+    r = run_py("""
+        import time, jax, jax.numpy as jnp
+        f = jax.jit(lambda a: a @ a)
+        x = jnp.ones((128, 128), jnp.float32)
+        f(x)  # compile
+        t0 = time.monotonic()
+        for _ in range(20):
+            f(x)
+        print("elapsed %.3f" % (time.monotonic() - t0))
+    """, {
+        "VTPU_DEVICE_HBM_LIMIT_0": "1Gi",
+        "VTPU_DEVICE_CORE_LIMIT": "20",
+        "VTPU_MIN_EXEC_COST_US": "5000",
+        "VTPU_DEVICE_MEMORY_SHARED_CACHE": str(tmp_path / "shr.cache"),
+    })
+    assert r.returncode == 0, r.stderr
+    elapsed = float(r.stdout.split("elapsed")[-1])
+    # Gated this would need >= 20 * 5ms / 0.2 = 0.5s; ungated is ~ms.
+    assert elapsed < 0.3, f"sole tenant was throttled: {elapsed}"
 
 
 def test_sitecustomize_never_breaks_user_code(tmp_path):
