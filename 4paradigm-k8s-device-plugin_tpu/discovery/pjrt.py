@@ -25,7 +25,16 @@ from .types import (CORES_PER_CHIP, HBM_BYTES, TpuChip, TpuCore, TpuTopology,
 
 _ENUM_SNIPPET = r"""
 import json
+import os
 import jax
+
+# Images that register a PJRT plugin at interpreter startup lock the
+# platform before env vars are consulted; re-assert an explicit choice.
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except RuntimeError:
+        pass
 
 devs = jax.devices()
 out = []

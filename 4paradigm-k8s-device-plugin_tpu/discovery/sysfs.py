@@ -85,8 +85,11 @@ class SysfsChipBackend(ChipBackend):
         if accels:
             for i, node in enumerate(accels):
                 pci = self._pci_for_accel(node)
-                chips.append(self._build(i, pci, [node.replace(self.root, "/", 1)
-                                                  if self.root != "/" else node]))
+                # device_paths are container-visible (/dev/accelN), not
+                # fixture-rooted.
+                cpath = (node if self.root == "/" else
+                         os.path.join("/", os.path.relpath(node, self.root)))
+                chips.append(self._build(i, pci, [cpath]))
         else:
             for i, pci in enumerate(self._scan_pci()):
                 chips.append(self._build(i, pci, []))

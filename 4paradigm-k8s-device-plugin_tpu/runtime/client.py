@@ -153,8 +153,15 @@ class RuntimeClient:
     # round trips.
     def execute_send(self, eid: str, args: Sequence[RemoteArray],
                      out_ids: Sequence[str]) -> None:
+        self.execute_send_ids(eid, [a.id for a in args], out_ids)
+
+    def execute_send_ids(self, eid: str, arg_ids: Sequence[str],
+                         out_ids: Sequence[str]) -> None:
+        """Id-based send: lets a chained pipeline name a prior in-flight
+        step's output id as an argument (the broker resolves ids at
+        dispatch time)."""
         P.send_msg(self.sock, {"kind": P.EXECUTE, "exe": eid,
-                               "args": [a.id for a in args],
+                               "args": list(arg_ids),
                                "outs": list(out_ids)})
 
     def execute_recv(self) -> List[RemoteArray]:

@@ -32,6 +32,7 @@ class DeviceStats(ctypes.Structure):
         ("peak_bytes", ctypes.c_uint64),
         ("core_limit_pct", ctypes.c_int32),
         ("n_procs", ctypes.c_int32),
+        ("busy_us", ctypes.c_uint64),
     ]
 
 
@@ -99,6 +100,8 @@ def load() -> ctypes.CDLL:
                                     ctypes.c_uint64, ctypes.c_int]
     lib.vtpu_set_core_limit.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.c_int32]
+    lib.vtpu_busy_add.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_uint64]
     lib.vtpu_region_ndevices.restype = ctypes.c_int
     lib.vtpu_region_ndevices.argtypes = [ctypes.c_void_p]
     lib.vtpu_region_active_procs.restype = ctypes.c_int
@@ -196,6 +199,10 @@ class SharedRegion:
 
     def set_core_limit(self, dev: int, pct: int) -> None:
         self.lib.vtpu_set_core_limit(self.handle, dev, pct)
+
+    def busy_add(self, dev: int, us: int) -> None:
+        """Record completed device time (duty-cycle source)."""
+        self.lib.vtpu_busy_add(self.handle, dev, int(us))
 
     @property
     def ndevices(self) -> int:

@@ -177,6 +177,7 @@ class _PyEnforcer:
 
     def observe(self, key: int, est: float, actual_us: float,
                 dev: int = 0) -> None:
+        self.region.busy_add(dev, int(actual_us))
         if est >= 0:
             # Only correct the bucket when the estimate was charged; an
             # ungated run must not bank debt against future co-tenants.

@@ -1,0 +1,182 @@
+"""vtpu-metrics — HTTP metrics endpoint over vTPU accounting regions.
+
+The reference exposes observability by lying to NVML so DCGM/nvidia-smi
+see virtual devices (reference §2.9f).  libtpu's equivalent surface is
+its localhost metrics service (which ``tpu-info`` reads) — but that
+speaks about the RAW chip.  This server is the quota-adjusted stand-in:
+it serves the shared-region view as
+
+  GET /metrics   Prometheus text format (scrapeable; the reference has
+                 no Prometheus endpoint at all — SURVEY §5)
+  GET /json      machine-readable dump (regions -> devices -> procs)
+  GET /healthz   liveness
+
+Run in-container (region from the env contract) or on the node with
+--scan over the monitor-mode shared dirs:
+
+  python -m vtpu.tools.metrics_server --port 8431
+  python -m vtpu.tools.metrics_server --scan /usr/local/vtpu/shared
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..shim.core import SharedRegion
+from ..utils import envspec
+from ..utils import logging as log
+from .vtpu_smi import find_regions
+
+
+class MetricsState:
+    def __init__(self, scan: Optional[str], regions: List[str]):
+        self.scan = scan
+        self.explicit = regions
+        # Duty cycle: previous (busy_us, t) sample per (region, device).
+        self._prev: Dict[tuple, tuple] = {}
+        self.mu = threading.Lock()
+
+    def paths(self) -> List[str]:
+        return self.explicit or find_regions(self.scan)
+
+    def collect(self) -> List[Dict]:
+        out = []
+        for path in self.paths():
+            try:
+                region = SharedRegion(path)
+            except OSError:
+                continue
+            try:
+                devices = []
+                now = time.monotonic()
+                for d in range(region.ndevices):
+                    st = region.device_stats(d)
+                    key = (path, d)
+                    with self.mu:
+                        prev = self._prev.get(key)
+                        self._prev[key] = (st.busy_us, now)
+                    duty = 0.0
+                    if prev is not None and now > prev[1]:
+                        duty = min(
+                            (st.busy_us - prev[0])
+                            / ((now - prev[1]) * 1e6) * 100.0, 100.0)
+                    if st.limit_bytes == 0 and st.used_bytes == 0 \
+                            and st.n_procs == 0:
+                        continue
+                    devices.append({
+                        "device": d,
+                        "hbm_used_bytes": int(st.used_bytes),
+                        "hbm_limit_bytes": int(st.limit_bytes),
+                        "hbm_peak_bytes": int(st.peak_bytes),
+                        "core_limit_pct": int(st.core_limit_pct),
+                        "duty_cycle_pct": round(max(duty, 0.0), 2),
+                        "n_procs": int(st.n_procs),
+                        "busy_us_total": int(st.busy_us),
+                    })
+                procs = [{
+                    "pid": int(p.pid), "host_pid": int(p.host_pid),
+                    "used_bytes": [int(b) for b in
+                                   p.used_bytes[:region.ndevices]],
+                } for p in region.proc_stats()]
+                out.append({"region": path, "devices": devices,
+                            "procs": procs})
+            finally:
+                region.close()
+        return out
+
+
+def to_prometheus(infos: List[Dict]) -> str:
+    lines = [
+        "# HELP vtpu_hbm_used_bytes Accounted HBM usage per vTPU device.",
+        "# TYPE vtpu_hbm_used_bytes gauge",
+        "# HELP vtpu_hbm_limit_bytes HBM quota per vTPU device.",
+        "# TYPE vtpu_hbm_limit_bytes gauge",
+        "# HELP vtpu_duty_cycle_pct Device busy percentage since last "
+        "scrape.",
+        "# TYPE vtpu_duty_cycle_pct gauge",
+        "# HELP vtpu_busy_us_total Cumulative device busy microseconds.",
+        "# TYPE vtpu_busy_us_total counter",
+        "# HELP vtpu_procs Live processes accounted on the device.",
+        "# TYPE vtpu_procs gauge",
+    ]
+    for info in infos:
+        region = os.path.basename(os.path.dirname(info["region"])) or \
+            os.path.basename(info["region"])
+        for d in info["devices"]:
+            labels = f'{{region="{region}",device="{d["device"]}"}}'
+            lines.append(f'vtpu_hbm_used_bytes{labels} '
+                         f'{d["hbm_used_bytes"]}')
+            lines.append(f'vtpu_hbm_limit_bytes{labels} '
+                         f'{d["hbm_limit_bytes"]}')
+            lines.append(f'vtpu_duty_cycle_pct{labels} '
+                         f'{d["duty_cycle_pct"]}')
+            lines.append(f'vtpu_busy_us_total{labels} '
+                         f'{d["busy_us_total"]}')
+            lines.append(f'vtpu_procs{labels} {d["n_procs"]}')
+    return "\n".join(lines) + "\n"
+
+
+def make_handler(state: MetricsState):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: D401 - quiet
+            pass
+
+        def _reply(self, code: int, body: str, ctype: str):
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 - stdlib API
+            if self.path.startswith("/metrics"):
+                self._reply(200, to_prometheus(state.collect()),
+                            "text/plain; version=0.0.4")
+            elif self.path.startswith("/json"):
+                self._reply(200, json.dumps(state.collect(), indent=2),
+                            "application/json")
+            elif self.path.startswith("/healthz"):
+                self._reply(200, "ok\n", "text/plain")
+            else:
+                self._reply(404, "not found\n", "text/plain")
+
+    return Handler
+
+
+def make_server(port: int, scan: Optional[str] = None,
+                regions: Optional[List[str]] = None,
+                host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    state = MetricsState(scan, regions or [])
+    srv = ThreadingHTTPServer((host, port), make_handler(state))
+    srv.state = state  # type: ignore[attr-defined]
+    return srv
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="vtpu-metrics")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("VTPU_METRICS_PORT", "8431")))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--scan", default=None,
+                    help="directory of per-pod shared regions (node mode)")
+    ap.add_argument("--region", action="append", default=[])
+    ns = ap.parse_args(argv)
+    srv = make_server(ns.port, ns.scan, ns.region, ns.host)
+    log.info("vtpu-metrics serving on %s:%d (/metrics /json /healthz)",
+             ns.host, ns.port)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

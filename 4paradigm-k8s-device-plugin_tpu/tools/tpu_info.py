@@ -1,0 +1,114 @@
+"""vtpu tpu-info — quota-adjusted chip table, tpu-info style.
+
+The real ``tpu-info`` CLI reads libtpu's localhost metrics service and
+prints per-chip HBM usage and duty cycle — against the RAW chip, so a
+time-share tenant would see the full 16 GB and its co-tenants' load.
+This replacement presents the CONTAINER's view: HBM totals are the vTPU
+quota, usage is the tenant's accounted usage, and duty cycle is sampled
+from the shared region's cumulative busy time (reference §2.9f — the
+nvidia-smi virtualization analogue, ``nvmlDeviceGetMemoryInfo`` /
+``nvmlDeviceGetUtilizationRates`` hooks).
+
+  python -m vtpu.tools.tpu_info            # in-container (env region)
+  python -m vtpu.tools.tpu_info --region /path/to/vtpushr.cache
+  python -m vtpu.tools.tpu_info --json
+
+The duty cycle needs two samples; --interval sets the window.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..shim.core import SharedRegion
+from ..utils import envspec
+
+
+def sample(region: SharedRegion, interval: float) -> List[Dict]:
+    before = [region.device_stats(d) for d in range(region.ndevices)]
+    t0 = time.monotonic()
+    time.sleep(interval)
+    elapsed_us = (time.monotonic() - t0) * 1e6
+    out = []
+    for d in range(region.ndevices):
+        st = region.device_stats(d)
+        busy_delta = st.busy_us - before[d].busy_us
+        duty = min(busy_delta / elapsed_us * 100.0, 100.0) \
+            if elapsed_us > 0 else 0.0
+        if st.limit_bytes == 0 and st.used_bytes == 0 and st.n_procs == 0 \
+                and busy_delta == 0:
+            continue
+        out.append({
+            "device": d,
+            "hbm_used_bytes": int(st.used_bytes),
+            "hbm_limit_bytes": int(st.limit_bytes),
+            "hbm_peak_bytes": int(st.peak_bytes),
+            "duty_cycle_pct": round(duty, 1),
+            "core_limit_pct": int(st.core_limit_pct),
+            "n_procs": int(st.n_procs),
+        })
+    return out
+
+
+def _gib(n: int) -> str:
+    return f"{n / 2**30:.2f} GiB"
+
+
+def render(devs: List[Dict]) -> str:
+    lines = [
+        "TPU (vTPU quota view)",
+        f"{'Chip':<6} {'HBM usage':<24} {'Duty cycle':<12} "
+        f"{'Core cap':<10} {'Procs':<5}",
+    ]
+    for d in devs:
+        lim = _gib(d["hbm_limit_bytes"]) if d["hbm_limit_bytes"] \
+            else "unlimited"
+        lines.append(
+            f"{d['device']:<6} "
+            f"{_gib(d['hbm_used_bytes']) + ' / ' + lim:<24} "
+            f"{str(d['duty_cycle_pct']) + '%':<12} "
+            f"{(str(d['core_limit_pct']) + '%') if d['core_limit_pct'] else '-':<10} "
+            f"{d['n_procs']:<5}")
+    if len(lines) == 2:
+        lines.append("(no active vTPU devices)")
+    return "\n".join(lines)
+
+
+def find_region() -> Optional[str]:
+    env_path = os.environ.get(envspec.ENV_SHARED_CACHE)
+    if env_path and os.path.exists(env_path):
+        return env_path
+    return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu-info (vtpu)")
+    ap.add_argument("--region", default=None)
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="duty-cycle sampling window (s)")
+    ap.add_argument("--json", action="store_true")
+    ns = ap.parse_args(argv)
+
+    path = ns.region or find_region()
+    if not path:
+        print("no vTPU accounting region "
+              f"(set {envspec.ENV_SHARED_CACHE} or --region)")
+        return 1
+    region = SharedRegion(path)
+    try:
+        devs = sample(region, ns.interval)
+    finally:
+        region.close()
+    if ns.json:
+        print(json.dumps(devs, indent=2))
+    else:
+        print(render(devs))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -2,29 +2,27 @@
 
 Measures the framework's north-star metric (BASELINE.json): aggregate
 throughput of N quota-isolated tenants time-sharing ONE TPU chip through
-the vtpu runtime broker, relative to a single tenant running alone under
-the same per-tenant quota.  The reference's equivalent is its
+the vtpu runtime broker, relative to the SAME model run **directly** on
+the whole chip in-process — no broker, no quotas.  The direct phase is
+the honest denominator (VERDICT r1 #1): it sees none of the framework's
+transport or enforcement overhead, so the ratio measures exactly what
+multi-tenant sharing costs.  The reference's equivalent is its
 ai-benchmark suite on a split vGPU (reference benchmarks/ai-benchmark/,
 README.md:58-71).
 
 Workload: the flagship decoder-only transformer forward pass
 (vtpu.models.transformer, bf16, matmul-dominant — MXU-bound on TPU).
 Params upload once per tenant; per-step traffic is a token batch handle,
-so socket bandwidth does not distort the measurement.  The final output
-of each tenant's run is fetched to force materialisation.
+so socket bandwidth does not distort the measurement.
 
-Metric design: the denominator is the SAME N tenants with quotas
-disabled (hbm=0, no core cap).  That isolates what this framework adds —
-enforcement overhead — with identical transport parallelism on both
-sides; a naive "one solo tenant" denominator under-measures whenever the
-path to the chip has per-session latency (remote relays), inflating the
-ratio meaninglessly.  The reference's >=90%-of-whole-chip target
-(BASELINE.md) maps directly: quota-enforced sharing must keep >=90% of
-unrestricted sharing's aggregate throughput.
+Reported per phase: steps/s, model TFLOP/step (analytic), and MFU
+against the chip's peak bf16 TFLOP/s.  The headline value is
+quota-enforced aggregate / direct whole-chip (target >= 0.90,
+BASELINE.md); the free-sharing aggregate is also printed so enforcement
+cost and brokering cost are separable.
 
 Prints ONE JSON line, e.g.:
-  {"metric": "quota_enforcement_throughput_ratio_4tenant", "value": 0.97,
-   "unit": "ratio", "vs_baseline": 1.08, ...}
+  {"metric": "vtpu_4tenant_vs_direct_throughput", "value": 0.93, ...}
 """
 
 from __future__ import annotations
@@ -33,6 +31,7 @@ import argparse
 import json
 import multiprocessing as mp
 import os
+import statistics
 import subprocess
 import sys
 import tempfile
@@ -41,8 +40,93 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
+# Peak dense bf16 TFLOP/s per chip, for MFU (public figures).
+PEAK_TFLOPS = {
+    "v5e": 197e12, "v5litepod": 197e12, "v5": 197e12,
+    "v4": 275e12, "v5p": 459e12, "v6e": 918e12,
+}
 
-def run_tenant(sock, tenant, steps, cfg_name, batch, seq):
+
+def model_flops_per_step(cfg, batch: int, seq: int) -> float:
+    """Analytic forward-pass FLOPs: 2*MACs over every matmul + the two
+    attention einsums (vtpu.models.transformer.forward)."""
+    d, h = cfg.dim, cfg.hidden
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    per_layer = (d * d) + 2 * (d * kv_dim) + (d * d) + 3 * (d * h)
+    matmul_params = cfg.n_layers * per_layer + d * cfg.vocab  # + lm_head
+    matmul_flops = 2.0 * batch * seq * matmul_params
+    attn_flops = cfg.n_layers * 4.0 * batch * seq * seq * d
+    return matmul_flops + attn_flops
+
+
+def detect_peak_tflops() -> float:
+    import jax
+    kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+    for key, peak in PEAK_TFLOPS.items():
+        if key in kind:
+            return peak
+    return 0.0  # unknown (CPU smoke): MFU reported as 0
+
+
+def _peak_entry(q):
+    """Chip peak probe, in a subprocess (the bench main process must not
+    claim the chip)."""
+    try:
+        q.put(detect_peak_tflops())
+    except Exception:  # noqa: BLE001
+        q.put(0.0)
+
+
+def run_direct(steps: int, warmup: int, cfg_name: str, batch: int,
+               seq: int, reps: int, quick: bool, q) -> None:
+    """The honest whole-chip baseline: same model, in-process, async
+    dispatch pipelined by XLA's device queue, no broker, no quotas.
+    Runs in a subprocess so the chip is free for the broker phases."""
+    import jax
+
+    if quick:
+        # CPU smoke must not claim the real chip.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+    import numpy as np
+
+    from vtpu.models import transformer as tr
+
+    import jax.numpy as jnp
+
+    cfg = getattr(tr.TransformerConfig, cfg_name)()
+    params = tr.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.device_put(np.zeros((batch, seq), np.int32))
+
+    # Each step CONSUMES the previous step's output (greedy next-token
+    # feedback), so the timed loop is a true on-device dependency chain:
+    # transports whose completion events fire optimistically (before the
+    # device finishes) cannot fake throughput — fetching the final
+    # tokens forces every step to have really run.
+    @jax.jit
+    def step_fn(p, t):
+        logits = tr.forward(p, t, cfg)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    tokens = step_fn(params, tokens)
+    _ = jax.device_get(tokens)
+    rates = []
+    for _ in range(reps):
+        for _ in range(warmup):
+            tokens = step_fn(params, tokens)
+        _ = jax.device_get(tokens)
+        t0 = time.monotonic()
+        for _ in range(steps):
+            tokens = step_fn(params, tokens)
+        _ = jax.device_get(tokens)
+        rates.append(steps / (time.monotonic() - t0))
+    q.put(("direct", rates))
+
+
+def run_tenant(sock, tenant, steps, warmup, cfg_name, batch, seq,
+               core_limit):
     """Runs inside a spawned subprocess; returns (steps, elapsed_s).
 
     Tenants never touch the accelerator: tracing/lowering runs on the CPU
@@ -70,62 +154,78 @@ def run_tenant(sock, tenant, steps, cfg_name, batch, seq):
     flat_shapes, treedef = jax.tree_util.tree_flatten(shapes)
     tokens = np.zeros((batch, seq), np.int32)
 
+    import jax.numpy as jnp
+
     def init_flat():
         params = tr.init_params(cfg, jax.random.PRNGKey(0))
         return tuple(jax.tree_util.tree_flatten(params)[0])
 
     def fwd_flat(tokens, *leaves):
-        return tr.forward(jax.tree_util.tree_unflatten(treedef, leaves),
-                          tokens, cfg)
+        logits = tr.forward(
+            jax.tree_util.tree_unflatten(treedef, leaves), tokens, cfg)
+        # Greedy next-token feedback: each step consumes the previous
+        # step's output, making the benchmark a true on-device dependency
+        # chain (optimistic completion events cannot fake throughput).
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     init_exe = c.compile(init_flat, [])
     param_handles = init_exe()
-    tok_handle = c.put(tokens)
+    tok_handle = c.put(tokens, "tokA")
     # ShapeDtypeStructs are enough for compile (it only reads shape/dtype).
     exe = c.compile(fwd_flat, [tokens] + flat_shapes)
-    handles = [tok_handle] + param_handles
+    param_ids = [h.id for h in param_handles]
+
+    # Chained pipelining: step k's output id is step k+1's input id; the
+    # broker resolves arguments at dispatch, so `depth` chained steps
+    # ride in flight and XLA links them on the device.
+    depth = 4
+    cur, nxt = "tokA", "tokB"
+    inflight = 0
+
+    def send_step():
+        nonlocal cur, nxt, inflight
+        c.execute_send_ids(exe.id, [cur] + param_ids, [nxt])
+        cur, nxt = nxt, cur
+        inflight += 1
 
     # Warmup: server-side compile + steady-state token buckets.
-    outs = exe(*handles)
-    out_ids = [o.id for o in outs]
-    arg_ids = handles
-
-    # Pipelined steady-state: keep `depth` executes in flight so transport
-    # round-trip latency doesn't masquerade as device time (a synchronous
-    # loop would under-measure solo throughput and overstate the sharing
-    # ratio).  Reused out-ids keep server memory bounded.
-    depth = 4
-    t0 = time.monotonic()
-    inflight = 0
-    last = None
-    for _ in range(steps):
-        c.execute_send(exe.id, arg_ids, out_ids)
-        inflight += 1
+    for _ in range(warmup + 1):
+        send_step()
         if inflight > depth:
-            last = c.execute_recv()
+            c.execute_recv()
             inflight -= 1
     while inflight:
-        last = c.execute_recv()
+        c.execute_recv()
         inflight -= 1
-    # Materialise the final result inside the timed window so pipelined
-    # transports can't fake throughput.
-    _ = last[-1].fetch()
+    _ = c.get(cur)  # sync the warmup chain
+
+    t0 = time.monotonic()
+    for _ in range(steps):
+        send_step()
+        if inflight > depth:
+            c.execute_recv()
+            inflight -= 1
+    while inflight:
+        c.execute_recv()
+        inflight -= 1
+    # Materialise the final chained result inside the timed window so
+    # pipelined transports can't fake throughput.
+    _ = c.get(cur)
     elapsed = time.monotonic() - t0
-    for o in last:
-        o.delete()
     c.close()
     return steps, elapsed
 
 
-def _tenant_entry(sock, tenant, steps, cfg_name, batch, seq, q):
+def _tenant_entry(sock, tenant, steps, warmup, cfg_name, batch, seq,
+                  core_limit, q):
     try:
-        q.put((tenant, run_tenant(sock, tenant, steps, cfg_name, batch,
-                                  seq)))
+        q.put((tenant, run_tenant(sock, tenant, steps, warmup, cfg_name,
+                                  batch, seq, core_limit)))
     except Exception as e:  # noqa: BLE001 - reported via queue
         q.put((tenant, ("error", f"{type(e).__name__}: {e}")))
 
 
-def start_broker(sock, region, hbm_limit, quick):
+def start_broker(sock, region, hbm_limit, core_limit, quick):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     if quick:
@@ -133,35 +233,40 @@ def start_broker(sock, region, hbm_limit, quick):
     env.setdefault("VTPU_LOG_LEVEL", "1")
     return subprocess.Popen(
         [sys.executable, "-m", "vtpu.runtime.server", "--socket", sock,
-         "--hbm-limit", str(hbm_limit), "--core-limit", "0",
+         "--hbm-limit", str(hbm_limit), "--core-limit", str(core_limit),
          "--region", region],
         env=env)
 
 
-def wait_socket(path, timeout=180):
+def wait_socket(path, proc, timeout=600):
+    """Chip hand-over between phases can be slow on relayed transports
+    (the previous broker's session must fully tear down before the next
+    jax client can claim the chip)."""
     t0 = time.monotonic()
     while not os.path.exists(path):
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"broker for {path} exited rc={proc.returncode}")
         if time.monotonic() - t0 > timeout:
             raise TimeoutError(f"broker socket {path} never appeared")
         time.sleep(0.2)
 
 
-def measure(sock, n_tenants, steps, cfg_name, batch, seq):
+def measure(sock, n_tenants, steps, warmup, cfg_name, batch, seq,
+            core_limit):
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [
         ctx.Process(target=_tenant_entry,
-                    args=(sock, f"bench-t{i}-of{n_tenants}", steps,
-                          cfg_name, batch, seq, q))
+                    args=(sock, f"bench-t{i}-of{n_tenants}", steps, warmup,
+                          cfg_name, batch, seq, core_limit, q))
         for i in range(n_tenants)
     ]
-    t0 = time.monotonic()
     for p in procs:
         p.start()
     results = [q.get(timeout=3600) for _ in procs]
     for p in procs:
         p.join(timeout=60)
-    wall = time.monotonic() - t0
     total_steps = 0
     max_elapsed = 0.0
     for tenant, res in results:
@@ -169,9 +274,9 @@ def measure(sock, n_tenants, steps, cfg_name, batch, seq):
             raise RuntimeError(f"{tenant}: {res[1]}")
         total_steps += res[0]
         max_elapsed = max(max_elapsed, res[1])
-    # Throughput over the measured window (excludes per-tenant param
-    # upload + compile, which `wall` would include).
-    return total_steps / max_elapsed if max_elapsed else 0.0, wall
+    # Aggregate over the measured window (excludes per-tenant param
+    # upload + compile).
+    return total_steps / max_elapsed if max_elapsed else 0.0
 
 
 def main():
@@ -185,44 +290,92 @@ def main():
     quick = args.quick or os.environ.get("JAX_PLATFORMS") == "cpu"
     cfg_name = "tiny" if quick else "bench"
     batch, seq = (2, 64) if quick else (4, 512)
-    steps = args.steps or (8 if quick else 30)
-    # Per-tenant HBM quota: fits one ~1.9 GB replica + activations on the
+    steps = args.steps or (8 if quick else 60)
+    warmup = 2 if quick else 10
+    direct_reps = 2 if quick else 3
+    # Per-tenant HBM quota: fits one ~0.9 GB replica + activations on the
     # full config; enforcement is real (a second replica would OOM).
+    # Core quota: an even 1/N share of device time per tenant.
     hbm_limit = "64Mi" if quick else "2048Mi"
+    core_limit = max(100 // args.tenants, 1)
+
+    from vtpu.models import transformer as tr
+    cfg = getattr(tr.TransformerConfig, cfg_name)()
+    tflop_per_step = model_flops_per_step(cfg, batch, seq) / 1e12
 
     tmp = tempfile.mkdtemp(prefix="vtpu_bench_")
 
-    def phase(name, limit):
+    # Phase 0: direct whole-chip baseline (own subprocess so the broker
+    # phases start with a free chip).
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=run_direct,
+                    args=(steps, warmup, cfg_name, batch, seq,
+                          direct_reps, quick, q))
+    p.start()
+    _, direct_rates = q.get(timeout=3600)
+    p.join(timeout=60)
+    direct_tput = statistics.fmean(direct_rates)
+    spread = ((max(direct_rates) - min(direct_rates)) / direct_tput
+              if direct_tput else 0.0)
+
+    def phase(name, hbm, core):
+        print(f"[bench] phase {name} starting", file=sys.stderr)
         sock = os.path.join(tmp, f"{name}.sock")
         broker = start_broker(sock, os.path.join(tmp, f"{name}.shr"),
-                              limit, quick)
+                              hbm, core, quick)
         try:
-            wait_socket(sock)
-            tput, _ = measure(sock, args.tenants, steps, cfg_name, batch,
-                              seq)
+            wait_socket(sock, broker)
+            out = measure(sock, args.tenants, steps, warmup, cfg_name,
+                          batch, seq, core)
+            print(f"[bench] phase {name}: {out:.3f} steps/s",
+                  file=sys.stderr)
+            return out
         finally:
             broker.terminate()
             try:
-                broker.wait(timeout=10)
+                broker.wait(timeout=20)
             except subprocess.TimeoutExpired:
                 broker.kill()
-        return tput
+                broker.wait(timeout=10)
+            time.sleep(2.0)  # let the chip session tear down fully
 
-    free_tput = phase("free", "0")          # unrestricted sharing
-    quota_tput = phase("quota", hbm_limit)  # HBM-quota-enforced sharing
-    ratio = quota_tput / free_tput if free_tput > 0 else 0.0
+    free_tput = phase("free", "0", 0)              # unrestricted sharing
+    quota_tput = phase("quota", hbm_limit, core_limit)  # enforced sharing
+
+    if quick:
+        peak = 0.0  # CPU smoke: no meaningful MFU
+    else:
+        q2 = ctx.Queue()
+        p2 = ctx.Process(target=_peak_entry, args=(q2,))
+        p2.start()
+        peak = q2.get(timeout=600)
+        p2.join(timeout=30)
+
+    def mfu(tput):
+        return (tput * tflop_per_step * 1e12 / peak) if peak else 0.0
+
+    ratio = quota_tput / direct_tput if direct_tput > 0 else 0.0
     print(json.dumps({
-        "metric": ("quota_enforcement_throughput_ratio_"
-                   f"{args.tenants}tenant"),
+        "metric": f"vtpu_{args.tenants}tenant_vs_direct_throughput",
         "value": round(ratio, 4),
         "unit": "ratio",
         "vs_baseline": round(ratio / 0.90, 4),
-        "unrestricted_steps_per_s": round(free_tput, 3),
+        "direct_steps_per_s": round(direct_tput, 3),
+        "direct_run_spread": round(spread, 4),
+        "unrestricted_share_steps_per_s": round(free_tput, 3),
         "quota_enforced_steps_per_s": round(quota_tput, 3),
+        "tflop_per_step": round(tflop_per_step, 6),
+        "gflop_per_step": round(tflop_per_step * 1000, 3),
+        "direct_mfu": round(mfu(direct_tput), 4),
+        "quota_mfu": round(mfu(quota_tput), 4),
+        "enforcement_vs_free_ratio": round(
+            quota_tput / free_tput if free_tput else 0.0, 4),
         "config": cfg_name,
         "tenants": args.tenants,
         "steps_per_tenant": steps,
         "per_tenant_hbm_quota": hbm_limit,
+        "per_tenant_core_quota_pct": core_limit,
     }))
     return 0
 

@@ -168,6 +168,14 @@ static std::unordered_map<PJRT_LoadedExecutable*, size_t>& exe_nout() {
   static auto* m = new std::unordered_map<PJRT_LoadedExecutable*, size_t>();
   return *m;
 }
+/* Per-executable addressable devices (fixed after load): caching avoids
+ * an AddressableDevices RPC on every execute. */
+static std::unordered_map<PJRT_LoadedExecutable*,
+                          std::vector<PJRT_Device*>>& exe_devs() {
+  static auto* m = new std::unordered_map<PJRT_LoadedExecutable*,
+                                          std::vector<PJRT_Device*>>();
+  return *m;
+}
 
 static uint64_t now_us() {
   struct timespec ts;
@@ -574,12 +582,16 @@ static PJRT_Memory* find_host_memory(PJRT_Client* client) {
 }
 
 static int is_host_memory(PJRT_Memory* mem);
+static int ordinal_of_memory(PJRT_Memory* mem);
 
 static PJRT_Error* w_BufferFromHostBuffer(
     PJRT_Client_BufferFromHostBuffer_Args* args) {
   if (!g_region) return g_real->PJRT_Client_BufferFromHostBuffer(args);
 
-  int dev = args->device ? ordinal_of(args->device) : 0;
+  /* Placement may come as a device OR a memory space (JAX memory-kinds);
+   * charge whichever device actually backs the buffer. */
+  int dev = args->device ? ordinal_of(args->device)
+            : args->memory ? ordinal_of_memory(args->memory) : 0;
   uint64_t est = estimate_bytes(args->type, args->dims, args->num_dims);
 
   /* Caller-directed host placement (JAX memory_kind offloading) uses no
@@ -846,6 +858,12 @@ struct ExecMeter {
   uint64_t t0_us;
   uint64_t est_us;
   bool gated = false;                 /* tokens were charged up front */
+  /* No completion event existed: we are settling at dispatch, so the
+   * elapsed wall time is dispatch latency, NOT device time.  The
+   * up-front estimate must stand (no credit-back) and must not train
+   * the EMA — else a gated caller that never passes events would pay
+   * near-zero and collapse its own future charges. */
+  bool estimate_only = false;
   std::vector<int> devs;              /* gated/charged ordinals */
   PJRT_LoadedExecutable* exe;
   std::vector<PJRT_Buffer*> staged;   /* spill copies, freed on done */
@@ -854,8 +872,12 @@ struct ExecMeter {
 
 static void on_exec_done(PJRT_Error* error, void* user_arg) {
   ExecMeter* m = (ExecMeter*)user_arg;
-  uint64_t actual = now_us() - m->t0_us;
-  if (g_region && m->gated) {
+  uint64_t actual = m->estimate_only ? m->est_us : now_us() - m->t0_us;
+  if (g_region) {
+    /* Duty-cycle source for monitors (vtpu-smi/tpu-info), gated or not. */
+    for (int dev : m->devs) vtpu_busy_add(g_region, dev, actual);
+  }
+  if (g_region && m->gated && !m->estimate_only) {
     /* Correct the up-front charge to measured time.  Ungated runs (sole
      * tenant under DEFAULT policy) charge nothing — they must not bank
      * debt against a co-tenant that arrives later.  The floor also
@@ -867,7 +889,7 @@ static void on_exec_done(PJRT_Error* error, void* user_arg) {
       vtpu_rate_adjust(g_region, dev,
                        (int64_t)charged - (int64_t)m->est_us);
   }
-  {
+  if (!m->estimate_only) {
     std::lock_guard<std::mutex> lk(g_mu);
     double& ema = exe_cost()[m->exe];
     ema = ema <= 0 ? (double)actual : ema * 0.7 + (double)actual * 0.3;
@@ -923,6 +945,36 @@ static size_t num_outputs_of(PJRT_LoadedExecutable* lexe) {
   return n;
 }
 
+/* The executable's addressable devices, cached per executable (fixed
+ * after load; dropped in w_LoadedExecutable_Destroy). */
+static const std::vector<PJRT_Device*>& devices_of_executable(
+    PJRT_LoadedExecutable* lexe) {
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = exe_devs().find(lexe);
+    if (it != exe_devs().end()) return it->second;
+  }
+  std::vector<PJRT_Device*> devs;
+  if (g_real->PJRT_LoadedExecutable_AddressableDevices) {
+    PJRT_LoadedExecutable_AddressableDevices_Args la;
+    memset(&la, 0, sizeof(la));
+    la.struct_size =
+        PJRT_LoadedExecutable_AddressableDevices_Args_STRUCT_SIZE;
+    la.executable = lexe;
+    if (PJRT_Error* err =
+            g_real->PJRT_LoadedExecutable_AddressableDevices(&la)) {
+      destroy_real_error(err);
+    } else {
+      devs.assign(la.addressable_devices,
+                  la.addressable_devices + la.num_addressable_devices);
+    }
+  }
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& slot = exe_devs()[lexe];
+  slot = std::move(devs);
+  return slot;
+}
+
 /* Ordinals the execution touches: execute_device when given, else the
  * executable's addressable devices (ADVICE r1 #5: a portable execution
  * must not charge everything to ordinal 0). */
@@ -933,21 +985,10 @@ static std::vector<int> exec_ordinals(
     devs.push_back(ordinal_of(args->execute_device));
     return devs;
   }
-  if (g_real->PJRT_LoadedExecutable_AddressableDevices) {
-    PJRT_LoadedExecutable_AddressableDevices_Args la;
-    memset(&la, 0, sizeof(la));
-    la.struct_size =
-        PJRT_LoadedExecutable_AddressableDevices_Args_STRUCT_SIZE;
-    la.executable = args->executable;
-    if (PJRT_Error* err =
-            g_real->PJRT_LoadedExecutable_AddressableDevices(&la)) {
-      destroy_real_error(err);
-    } else {
-      for (size_t i = 0; i < la.num_addressable_devices &&
-                         i < args->num_devices; i++)
-        devs.push_back(ordinal_of(la.addressable_devices[i]));
-    }
-  }
+  const std::vector<PJRT_Device*>& cached =
+      devices_of_executable(args->executable);
+  for (size_t i = 0; i < cached.size() && i < args->num_devices; i++)
+    devs.push_back(ordinal_of(cached[i]));
   if (devs.empty()) devs.push_back(0);
   return devs;
 }
@@ -989,19 +1030,9 @@ static PJRT_Buffer* stage_to_device(PJRT_Buffer* host_buf,
 static PJRT_Device* exec_target_device(
     PJRT_LoadedExecutable_Execute_Args* args) {
   if (args->execute_device) return args->execute_device;
-  if (!g_real->PJRT_LoadedExecutable_AddressableDevices) return nullptr;
-  PJRT_LoadedExecutable_AddressableDevices_Args la;
-  memset(&la, 0, sizeof(la));
-  la.struct_size =
-      PJRT_LoadedExecutable_AddressableDevices_Args_STRUCT_SIZE;
-  la.executable = args->executable;
-  if (PJRT_Error* err =
-          g_real->PJRT_LoadedExecutable_AddressableDevices(&la)) {
-    destroy_real_error(err);
-    return nullptr;
-  }
-  return la.num_addressable_devices > 0 ? la.addressable_devices[0]
-                                        : nullptr;
+  const std::vector<PJRT_Device*>& cached =
+      devices_of_executable(args->executable);
+  return cached.empty() ? nullptr : cached[0];
 }
 
 /* Cheap cached contention probe for the DEFAULT policy (sole tenant runs
@@ -1189,11 +1220,14 @@ static PJRT_Error* w_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
     oa.user_arg = m;
     if (PJRT_Error* oerr = g_real->PJRT_Event_OnReady(&oa)) {
       destroy_real_error(oerr);
-      on_exec_done(nullptr, m);  /* settle immediately */
+      m->estimate_only = true;  /* no real completion signal */
+      on_exec_done(nullptr, m);
     }
   } else {
-    /* No event to hook: settle immediately (staged copies freed; the
-     * charge stands as the estimate). */
+    /* No event to hook: settle immediately — staged copies freed, the
+     * up-front charge stands as the estimate (estimate_only suppresses
+     * the credit-back and EMA training on dispatch latency). */
+    m->estimate_only = true;
     on_exec_done(nullptr, m);
   }
   return nullptr;
@@ -1207,6 +1241,7 @@ static PJRT_Error* w_LoadedExecutable_Destroy(
     std::lock_guard<std::mutex> lk(g_mu);
     exe_cost().erase(args->executable);
     exe_nout().erase(args->executable);
+    exe_devs().erase(args->executable);
   }
   return g_real->PJRT_LoadedExecutable_Destroy(args);
 }

@@ -27,7 +27,7 @@
 #include <unistd.h>
 
 #define VTPU_MAGIC 0x76545055u /* "vTPU" */
-#define VTPU_VERSION 1u
+#define VTPU_VERSION 2u /* v2: DeviceState.busy_us */
 
 /* Burst cap for the token bucket: how much device time may be "saved up".
  * 250ms keeps bursts short enough that a co-tenant is never starved for
@@ -57,6 +57,8 @@ typedef struct {
   /* token bucket (device-time microseconds) */
   int64_t tokens_us;
   uint64_t last_refill_ns;
+  /* cumulative completed device time (us) — duty-cycle source */
+  uint64_t busy_us;
 } DeviceState;
 
 typedef struct {
@@ -424,6 +426,7 @@ int vtpu_device_get_stats(vtpu_region* r, int dev, vtpu_device_stats* out) {
   out->used_bytes = ds->used_bytes;
   out->peak_bytes = ds->peak_bytes;
   out->core_limit_pct = ds->core_limit_pct;
+  out->busy_us = ds->busy_us;
   int n = 0;
   for (int s = 0; s < VTPU_MAX_PROCS; s++)
     if (g->proc[s].active && g->proc[s].used_bytes[dev] > 0) n++;
@@ -523,6 +526,16 @@ void vtpu_rate_block(vtpu_region* r, int dev, uint64_t cost_us,
     ts.tv_nsec = (long)(wait_ns % 1000000000ull);
     nanosleep(&ts, NULL);
   }
+}
+
+void vtpu_busy_add(vtpu_region* r, int dev, uint64_t us) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) return;
+  if (lock_region(g) != 0) return;
+  g->dev[dev].busy_us += us;
+  ProcSlot* me = my_slot_locked(r, g);
+  if (me) me->last_seen_ns = now_ns();
+  unlock_region(g);
 }
 
 void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct) {

@@ -43,6 +43,11 @@ typedef struct {
   uint64_t peak_bytes;
   int32_t core_limit_pct; /* 0 = no compute cap */
   int32_t n_procs;        /* live processes touching this device */
+  /* Cumulative device busy time (us), fed by every execute completion
+   * (gated or not).  Monitors sample it twice to derive a duty cycle —
+   * the tpu-info/nvidia-smi "utilization" analogue (reference
+   * nvmlDeviceGetUtilizationRates via get_used_gpu_utilization). */
+  uint64_t busy_us;
 } vtpu_device_stats;
 
 typedef struct {
@@ -125,6 +130,11 @@ void vtpu_rate_block(vtpu_region* r, int dev, uint64_t cost_us,
 
 /* Set/read the core limit at runtime (monitor / tests). */
 void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct);
+
+/* Record `us` of completed device time on `dev` (all execute paths call
+ * this on completion, independent of rate gating) — the duty-cycle
+ * source for monitors. */
+void vtpu_busy_add(vtpu_region* r, int dev, uint64_t us);
 
 /* ---- introspection ----------------------------------------------------- */
 

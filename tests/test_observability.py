@@ -1,0 +1,97 @@
+"""Observability surface (VERDICT r1 #7): busy-time accounting feeds a
+duty cycle; the tpu-info-style view and the metrics HTTP endpoint show
+the QUOTA-adjusted picture, not the raw chip."""
+
+import json
+import threading
+import urllib.request
+
+from vtpu.shim.core import SharedRegion
+from vtpu.tools import metrics_server, tpu_info
+
+MB = 2**20
+
+
+def make_region(tmp_path, name="shr.cache"):
+    return SharedRegion(str(tmp_path / name),
+                        limits=[64 * MB, 32 * MB], core_pcts=[50, 0])
+
+
+def test_busy_add_accumulates(tmp_path):
+    r = make_region(tmp_path)
+    try:
+        r.register()
+        assert r.device_stats(0).busy_us == 0
+        r.busy_add(0, 1500)
+        r.busy_add(0, 500)
+        assert r.device_stats(0).busy_us == 2000
+        assert r.device_stats(1).busy_us == 0
+    finally:
+        r.close()
+
+
+def test_tpu_info_sample_shows_quota_and_duty(tmp_path):
+    r = make_region(tmp_path)
+    try:
+        r.register()
+        r.mem_acquire(0, 10 * MB)
+
+        # Feed busy time from another thread while the sampler's window
+        # is open, approximating a ~40% duty cycle.
+        def feeder():
+            import time
+            for _ in range(10):
+                r.busy_add(0, 8000)
+                time.sleep(0.02)
+
+        th = threading.Thread(target=feeder)
+        th.start()
+        devs = tpu_info.sample(r, interval=0.25)
+        th.join()
+    finally:
+        r.close()
+    d0 = next(d for d in devs if d["device"] == 0)
+    # The tenant sees its QUOTA (64 MiB), not a physical 16 GiB.
+    assert d0["hbm_limit_bytes"] == 64 * MB
+    assert d0["hbm_used_bytes"] == 10 * MB
+    assert d0["core_limit_pct"] == 50
+    assert 5.0 < d0["duty_cycle_pct"] <= 100.0
+    # Render doesn't crash and mentions the quota.
+    assert "GiB" in tpu_info.render(devs)
+
+
+def test_metrics_server_prometheus_and_json(tmp_path):
+    r = make_region(tmp_path)
+    try:
+        r.register()
+        r.mem_acquire(0, 5 * MB)
+        r.busy_add(0, 1234)
+    finally:
+        r.close()
+
+    srv = metrics_server.make_server(0, regions=[str(tmp_path /
+                                                     "shr.cache")])
+    port = srv.server_address[1]
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            text = resp.read().decode()
+        assert f"vtpu_hbm_used_bytes" in text
+        assert str(5 * MB) in text
+        assert f"vtpu_hbm_limit_bytes" in text and str(64 * MB) in text
+        assert "vtpu_busy_us_total" in text and "1234" in text
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/json") as resp:
+            data = json.loads(resp.read().decode())
+        assert data[0]["devices"][0]["hbm_used_bytes"] == 5 * MB
+        assert data[0]["procs"]  # merged process list is visible
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz") as resp:
+            assert resp.read() == b"ok\n"
+    finally:
+        srv.shutdown()
+        srv.server_close()
