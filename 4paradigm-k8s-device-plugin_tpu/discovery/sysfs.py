@@ -143,14 +143,3 @@ class SysfsChipBackend(ChipBackend):
             if not os.path.exists(path):
                 return f"device node {path} disappeared"
         return None
-
-
-def write_pci_inventory(path: str, chips: List[TpuChip]) -> None:
-    """Persist the PCI inventory for the in-container shim (the reference
-    writes $PCIBUSFILE at startup, main.go:164-185, and mounts it as
-    pciinfo.vgpu, server.go:516-517)."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        for chip in chips:
-            f.write(f"{chip.index} {chip.uuid} {chip.pci_bus_id or '-'}\n")
-    os.replace(tmp, path)

@@ -358,10 +358,25 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         # NVIDIA_VISIBLE_DEVICES, server.go:469-471, 565-581).
         if self.cfg.device_id_strategy == "index":
             by_uuid = {v.chip_uuid: v.chip.index for v in vdevs}
-            envs[envspec.ENV_VISIBLE_DEVICES] = ",".join(
-                str(by_uuid[u]) for u in chip_uuids)
+            visible = [str(by_uuid[u]) for u in chip_uuids]
         else:
-            envs[envspec.ENV_VISIBLE_DEVICES] = ",".join(chip_uuids)
+            visible = list(chip_uuids)
+        device_list_mounts = []
+        if self.cfg.device_list_strategy == "device-specs":
+            # Mounts-based device list (reference volume-mounts strategy,
+            # server.go:565-581): one /dev/null mount per visible chip
+            # under DEVICE_LIST_DIR.  Unlike an env var a pod spec cannot
+            # clobber it, so it survives hostile images.  Names carry an
+            # ordinal prefix so the consumer recovers ALLOCATION order —
+            # a bare lexicographic listing would misalign the ordinals
+            # with VTPU_DEVICE_MAP / VTPU_DEVICE_HBM_LIMIT_<i>.
+            for i, tok in enumerate(visible):
+                device_list_mounts.append(
+                    (os.path.join(envspec.DEVICE_LIST_DIR,
+                                  f"{i:02d}_{tok}"),
+                     "/dev/null", True))
+        else:
+            envs[envspec.ENV_VISIBLE_DEVICES] = ",".join(visible)
 
         # Ordinal -> physical map + per-ordinal HBM caps (reference
         # server.go:486-493).
@@ -443,6 +458,7 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         if self.cfg.monitor_mode:
             mounts.append((os.path.join(CONTAINER_LIB_DIR, "shared"),
                            os.path.join(host, "shared"), False))
+        mounts.extend(device_list_mounts)
         for cpath, hpath, ro in mounts:
             car.mounts.add(container_path=cpath, host_path=hpath,
                            read_only=ro)

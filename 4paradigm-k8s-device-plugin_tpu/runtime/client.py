@@ -156,13 +156,19 @@ class RuntimeClient:
         self.execute_send_ids(eid, [a.id for a in args], out_ids)
 
     def execute_send_ids(self, eid: str, arg_ids: Sequence[str],
-                         out_ids: Sequence[str]) -> None:
+                         out_ids: Sequence[str], repeats: int = 1,
+                         carry: Sequence[Sequence[int]] = ((0, 0),)) -> None:
         """Id-based send: lets a chained pipeline name a prior in-flight
         step's output id as an argument (the broker resolves ids at
-        dispatch time)."""
-        P.send_msg(self.sock, {"kind": P.EXECUTE, "exe": eid,
-                               "args": list(arg_ids),
-                               "outs": list(out_ids)})
+        dispatch time).  ``repeats`` > 1 runs the program as a broker-side
+        K-step chain (one device program, no per-step RPC) with ``carry``
+        mapping each step's output indices back into argument indices."""
+        msg = {"kind": P.EXECUTE, "exe": eid, "args": list(arg_ids),
+               "outs": list(out_ids)}
+        if repeats > 1:
+            msg["repeats"] = int(repeats)
+            msg["carry"] = [list(p) for p in carry]
+        P.send_msg(self.sock, msg)
 
     def execute_recv(self) -> List[RemoteArray]:
         resp = P.recv_msg(self.sock)

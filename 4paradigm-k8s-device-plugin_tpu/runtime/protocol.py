@@ -29,7 +29,13 @@ PUT = "put"              # {id, shape, dtype, data} -> {ok, nbytes}
 GET = "get"              # {id} -> {ok, shape, dtype, data}
 DELETE = "delete"        # {id} -> {ok, freed}
 COMPILE = "compile"      # {id, exported} -> {ok}
-EXECUTE = "execute"      # {exe, args: [ids], outs: [ids]} -> {ok, outs:[...]}
+# EXECUTE optional fields: repeats (int, default 1) runs the program as a
+# server-side chain of K steps in ONE device program; carry
+# ([[out_idx, arg_idx], ...], default [[0, 0]]) maps each iteration's
+# outputs back into the next iteration's arguments.  The reply carries
+# the LAST step's outputs.  Replies are sent at dispatch (shapes are
+# static); completion-time failures surface on the next sync request.
+EXECUTE = "execute"      # {exe, args: [ids], outs: [ids], repeats?, carry?}
 STATS = "stats"          # {} -> {ok, tenants: {...}}
 SHUTDOWN = "shutdown"    # {} -> {ok}  (admin)
 

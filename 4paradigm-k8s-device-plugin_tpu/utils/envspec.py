@@ -57,6 +57,13 @@ ENV_LOG_LEVEL = "VTPU_LOG_LEVEL"
 # PCI/platform inventory file mounted by the daemon so the shim can present
 # stable virtual device identities (reference pciinfo.vgpu).
 ENV_PCIBUS_FILE = "VTPU_PCIINFO_FILE"
+# --device-list-strategy=device-specs: instead of the env var (which a pod
+# spec can spoof/clobber), the daemon mounts one file per visible chip into
+# this directory; the file NAME is `<ordinal>_<chip uuid/index>` so the
+# listing reconstructs allocation order (the reference's volume-mounts
+# strategy, server.go:565-581: /dev/null mounts under
+# /var/run/nvidia-container-devices/<id>).
+DEVICE_LIST_DIR = "/var/run/vtpu-devices"
 
 ALL_ENV_VARS = [
     ENV_HBM_LIMIT,
@@ -158,6 +165,20 @@ def parse_device_map(raw: str) -> List[DeviceMapEntry]:
     return entries
 
 
+def device_list_from_mounts() -> List[str]:
+    """Visible-device list under the device-specs strategy: mount names
+    are `<NN>_<id>` so allocation order survives the directory listing
+    (ordinal NN aligns with VTPU_DEVICE_MAP / per-ordinal HBM limits)."""
+    if not os.path.isdir(DEVICE_LIST_DIR):
+        return []
+    entries = []
+    for name in os.listdir(DEVICE_LIST_DIR):
+        prefix, _, ident = name.partition("_")
+        if ident and prefix.isdigit():
+            entries.append((int(prefix), ident))
+    return [ident for _, ident in sorted(entries)]
+
+
 def quota_from_env(env: Optional[Dict[str, str]] = None) -> QuotaSpec:
     """Parse the contract from an environment mapping (defaults to os.environ)."""
     if env is None:
@@ -189,7 +210,13 @@ def quota_from_env(env: Optional[Dict[str, str]] = None) -> QuotaSpec:
         policy = "DEFAULT"
     spec.utilization_policy = policy
     spec.active_oom_killer = _parse_bool(env.get(ENV_ACTIVE_OOM_KILLER))
-    if env.get(ENV_VISIBLE_DEVICES):
+    mounted = device_list_from_mounts()
+    if mounted:
+        # device-specs strategy: the kubelet-controlled mounts WIN over
+        # the env var — that is the strategy's whole point (a pod spec
+        # can set VTPU_VISIBLE_DEVICES, it cannot fabricate mounts).
+        spec.visible_devices = mounted
+    elif env.get(ENV_VISIBLE_DEVICES):
         spec.visible_devices = [
             t for t in env[ENV_VISIBLE_DEVICES].replace(",", " ").split() if t
         ]

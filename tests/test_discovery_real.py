@@ -8,7 +8,7 @@ import os
 import pytest
 
 from vtpu.discovery.pjrt import PjrtChipBackend, enumerate_via_pjrt
-from vtpu.discovery.sysfs import SysfsChipBackend, write_pci_inventory
+from vtpu.discovery.sysfs import SysfsChipBackend
 
 GENERATION_BY_DEVICE_ID = {
     "0x005e": ("v4", 2),
@@ -91,14 +91,22 @@ def test_sysfs_probe_detects_vanished_node(tmp_path):
 
 
 def test_sysfs_pci_inventory_roundtrip(tmp_path):
+    """The daemon's inventory writer (the lspci -> $PCIBUSFILE analogue,
+    plugin/main.py) renders sysfs-discovered chips in the 6-field format
+    the shim parses."""
+    from vtpu.plugin.config import Config
+    from vtpu.plugin.main import write_chip_inventory
+
     make_sysfs_tree(tmp_path, 2)
     backend = SysfsChipBackend(root=str(tmp_path))
-    inv = tmp_path / "tpuinfo.vtpu"
-    write_pci_inventory(str(inv), backend.chips())
+    inv = tmp_path / "vtpu" / "tpuinfo.vtpu"
+    cfg = Config(pcibus_file=str(inv))
+    write_chip_inventory(cfg, backend.chips())
     lines = inv.read_text().strip().splitlines()
     assert len(lines) == 2
-    idx, uuid, pci = lines[0].split()
+    idx, uuid, pci, hbm, gen, coord = lines[0].split()
     assert idx == "0" and uuid.startswith("TPU-") and pci.startswith("0000:")
+    assert int(hbm) > 0 and gen
 
 
 def test_pjrt_enumeration_subprocess_cpu():

@@ -131,6 +131,59 @@ def test_allocate_env_contract(env):
     ch.close()
 
 
+def test_allocate_device_specs_strategy(tmp_path):
+    """--device-list-strategy=device-specs: the visible-device list rides
+    as mount names under DEVICE_LIST_DIR instead of the env var
+    (reference volume-mounts strategy, server.go:565-581)."""
+    cfg = Config(
+        device_plugin_path=str(tmp_path) + "/",
+        device_split_count=2,
+        host_lib_dir=str(tmp_path / "vtpu"),
+        device_list_strategy="device-specs",
+    )
+    backend = FakeChipBackend(num_chips=2, generation="v5e")
+    specs = build_plugin_specs(cfg, backend)
+    plugin = VtpuDevicePlugin(specs[0], cfg, topology=backend.topology())
+    sim = KubeletSim(str(tmp_path)).start()
+    plugin.start(register=True)
+    try:
+        reg = sim.wait_registration()
+        stub, ch = sim.plugin_stub(reg.endpoint)
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=[plugin.vdevices[0].id])
+        resp = stub.Allocate(req)
+        car = resp.container_responses[0]
+        envs = dict(car.envs)
+        assert envspec.ENV_VISIBLE_DEVICES not in envs
+        listed = [m for m in car.mounts
+                  if m.container_path.startswith(envspec.DEVICE_LIST_DIR)]
+        assert len(listed) == 1
+        assert listed[0].host_path == "/dev/null"
+        name = os.path.basename(listed[0].container_path)
+        assert name == f"00_{plugin.vdevices[0].chip_uuid}"
+        ch.close()
+    finally:
+        plugin.stop()
+        sim.stop()
+
+
+def test_device_list_dir_fallback(tmp_path, monkeypatch):
+    """Consumer side: the mounted device list reconstructs ALLOCATION
+    order from the ordinal prefixes (not lexicographic id order), and it
+    WINS over a pod-spec-supplied env var."""
+    d = tmp_path / "vtpu-devices"
+    d.mkdir()
+    (d / "01_TPU-fake-0").touch()   # allocation order: fake-2, fake-0
+    (d / "00_TPU-fake-2").touch()
+    monkeypatch.setattr(envspec, "DEVICE_LIST_DIR", str(d))
+    spec = envspec.quota_from_env({})
+    assert spec.visible_devices == ["TPU-fake-2", "TPU-fake-0"]
+    # Hostile image sets the env var: mounts still win.
+    spec = envspec.quota_from_env(
+        {envspec.ENV_VISIBLE_DEVICES: "TPU-fake-0,TPU-fake-1,TPU-fake-2"})
+    assert spec.visible_devices == ["TPU-fake-2", "TPU-fake-0"]
+
+
 def test_allocate_unknown_id_errors(env):
     sim, plugin, cfg = env
     reg = sim.wait_registration()

@@ -217,6 +217,69 @@ def test_throttled_tenant_does_not_delay_unthrottled(tmp_path):
         srv.server_close()
 
 
+def test_chained_execute(broker):
+    """repeats/carry runs K steps as ONE broker-side device program
+    (server.py chain_fn): out 0 feeds arg 0 each iteration."""
+    c = RuntimeClient(broker, tenant="chain")
+    exe = c.compile(lambda a, b: a + b, [np.zeros(4, np.float32),
+                                         np.ones(4, np.float32)])
+    h0 = c.put(np.zeros(4, np.float32), "acc")
+    hb = c.put(np.ones(4, np.float32), "one")
+    c.execute_send_ids(exe.id, ["acc", "one"], ["acc"], repeats=7)
+    outs = c.execute_recv()
+    np.testing.assert_array_equal(outs[0].fetch(), [7, 7, 7, 7])
+    # executions counts chain STEPS, not RPCs.
+    assert c.stats()["chain"]["executions"] == 7
+    h0.delete()
+    hb.delete()
+    c.close()
+
+
+def test_chained_execute_pipelined(broker):
+    """Chains pipeline like single steps: step k+1's chain consumes step
+    k's in-flight output id."""
+    c = RuntimeClient(broker, tenant="chain2")
+    exe = c.compile(lambda a: a * 2.0, [np.ones(2, np.float32)])
+    c.put(np.ones(2, np.float32), "x0")
+    cur, nxt = "x0", "x1"
+    for _ in range(4):  # 4 chains x 3 doublings, all in flight
+        c.execute_send_ids(exe.id, [cur], [nxt], repeats=3)
+        cur, nxt = nxt, cur
+    for _ in range(4):
+        c.execute_recv()
+    np.testing.assert_array_equal(c.get(cur), [4096.0, 4096.0])
+    c.close()
+
+
+def test_bad_carry_rejected(broker):
+    c = RuntimeClient(broker, tenant="badcarry")
+    exe = c.compile(lambda a: a + 1.0, [np.ones(2, np.float32)])
+    c.put(np.ones(2, np.float32), "x")
+    c.execute_send_ids(exe.id, ["x"], ["y"], repeats=3, carry=((0, 5),))
+    with pytest.raises(Exception) as ei:
+        c.execute_recv()
+    assert "BAD_CARRY" in str(ei.value)
+    c.close()
+
+
+def test_async_error_surfaces_on_next_sync(broker):
+    """Replies are sent at dispatch; a missing argument id still fails
+    the execute reply itself (dispatch-time error), and a poisoned
+    dependency chain surfaces on the next synchronous request."""
+    c = RuntimeClient(broker, tenant="poison")
+    exe = c.compile(lambda a: a + 1.0, [np.ones(2, np.float32)])
+    c.execute_send_ids(exe.id, ["missing"], ["y"])
+    with pytest.raises(Exception) as ei:
+        c.execute_recv()
+    assert "NOT_FOUND" in str(ei.value)
+    # The session survives and serves the tenant normally afterwards.
+    c.put(np.ones(2, np.float32), "x")
+    c.execute_send_ids(exe.id, ["x"], ["y"])
+    c.execute_recv()
+    np.testing.assert_array_equal(c.get("y"), [2, 2])
+    c.close()
+
+
 def test_priority_zero_borrows(tmp_path):
     sock = str(tmp_path / "rt3.sock")
     srv = make_server(sock, hbm_limit=0, core_limit=10,
