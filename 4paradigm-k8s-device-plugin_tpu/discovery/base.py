@@ -15,6 +15,13 @@ from .types import TpuChip, TpuTopology
 class ChipBackend(abc.ABC):
     """Enumerates physical chips and watches their health."""
 
+    # Health-loop tuning, overridable per backend (and by tests):
+    # consecutive probe failures before a chip flips unhealthy (debounce
+    # for noisy probes — the pjrt backend raises it), and the poll
+    # period (the reference's 5s event-wait timeout, nvidia.go:180).
+    health_fail_threshold = 1
+    health_interval = 5.0
+
     @abc.abstractmethod
     def chips(self) -> List[TpuChip]:
         """Enumerate physical TPU chips on this node."""
@@ -28,17 +35,34 @@ class ChipBackend(abc.ABC):
         stop: threading.Event,
         chips: List[TpuChip],
         on_unhealthy: Callable[[TpuChip, str], None],
+        on_healthy: Optional[Callable[[TpuChip], None]] = None,
     ) -> None:
-        """Blocking health loop; invokes ``on_unhealthy(chip, reason)`` and
-        returns when ``stop`` is set.  Mirrors the reference's XID event
-        loop (reference nvidia.go:166-237).  Default: poll ``probe()``
-        every 5 seconds (the reference's event-wait timeout).
-        """
-        while not stop.wait(5.0):
+        """Blocking health loop; invokes ``on_unhealthy(chip, reason)``
+        after ``health_fail_threshold`` consecutive probe failures and —
+        unlike the reference, whose unhealthy is one-way (server.go:262
+        FIXME) — ``on_healthy(chip)`` when a downed chip probes clean
+        again.  Returns when ``stop`` is set.  Mirrors the reference's
+        XID event loop (nvidia.go:166-237) with polling."""
+        import os
+        interval = float(os.environ.get("VTPU_HEALTH_INTERVAL",
+                                        self.health_interval))
+        fails = {c.uuid: 0 for c in chips}
+        down = set()
+        while not stop.wait(interval):
             for chip in chips:
                 reason = self.probe(chip)
                 if reason is not None:
-                    on_unhealthy(chip, reason)
+                    fails[chip.uuid] = fails.get(chip.uuid, 0) + 1
+                    if fails[chip.uuid] >= self.health_fail_threshold \
+                            and chip.uuid not in down:
+                        down.add(chip.uuid)
+                        on_unhealthy(chip, reason)
+                else:
+                    fails[chip.uuid] = 0
+                    if chip.uuid in down:
+                        down.discard(chip.uuid)
+                        if on_healthy is not None:
+                            on_healthy(chip)
 
     def probe(self, chip: TpuChip) -> Optional[str]:
         """Return an unhealth reason for ``chip``, or None if healthy."""

@@ -69,8 +69,11 @@ def _kind_to_generation(kind: str) -> str:
     return "v5e"
 
 
-def enumerate_via_pjrt(timeout: float = 120.0) -> Optional[List[dict]]:
-    """Enumerate devices in a throwaway subprocess; None on failure."""
+def enumerate_via_pjrt_full(timeout: float = 120.0):
+    """Enumerate devices in a throwaway subprocess.  Returns
+    (devices-or-None, stderr) — the stderr matters to the health probe:
+    a libtpu single-process-lock failure means the chip is ALIVE and
+    someone (broker/tenant) holds it."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c", _ENUM_SNIPPET],
@@ -78,20 +81,81 @@ def enumerate_via_pjrt(timeout: float = 120.0) -> Optional[List[dict]]:
             env={**os.environ, "JAX_PLATFORMS": os.environ.get(
                 "JAX_PLATFORMS", "")},
         )
-    except (subprocess.TimeoutExpired, OSError):
-        return None
+    except subprocess.TimeoutExpired as e:
+        return None, (e.stderr or b"").decode("utf-8", "replace") \
+            if isinstance(e.stderr, bytes) else (e.stderr or "timeout")
+    except OSError as e:
+        return None, str(e)
     if proc.returncode != 0:
-        return None
+        return None, proc.stderr
     try:
-        return json.loads(proc.stdout.strip().splitlines()[-1])
+        return json.loads(proc.stdout.strip().splitlines()[-1]), \
+            proc.stderr
     except (ValueError, IndexError):
-        return None
+        return None, proc.stderr
+
+
+def enumerate_via_pjrt(timeout: float = 120.0) -> Optional[List[dict]]:
+    """Enumerate devices in a throwaway subprocess; None on failure."""
+    return enumerate_via_pjrt_full(timeout)[0]
+
+
+# stderr fragments that mean "chip is claimed, not dead" (libtpu's
+# single-process lock / a live broker session).
+_BUSY_MARKERS = ("already in use", "in use by", "device or resource busy",
+                 "libtpu.so is already in use")
 
 
 class PjrtChipBackend(ChipBackend):
+    # Enumeration is a subprocess with real startup jitter: debounce 3
+    # consecutive failures before declaring a chip dead (VERDICT r2 #8 —
+    # the sysfs node-vanish probe stays immediate; this one is the
+    # wedged-but-present detector).
+    health_fail_threshold = 3
+    health_interval = 30.0
+    # Probe cache: one enumeration serves a whole per-chip probe round.
+    _PROBE_TTL = 25.0
+
     def __init__(self, raw: Optional[List[dict]] = None):
         self._raw = raw
         self._chips: Optional[List[TpuChip]] = None
+        self._probe_at = 0.0
+        self._probe_result: Optional[tuple] = None
+
+    def probe(self, chip: TpuChip) -> Optional[str]:
+        """Re-enumerate periodically; a chip is unhealthy when a FRESH
+        enumeration succeeds without its devices, or enumeration fails
+        for reasons other than the libtpu single-process lock (a lock
+        failure proves the chip is alive and claimed — a tenant/broker
+        holds it, which must never read as a fault)."""
+        import time as _time
+        now = _time.monotonic()
+        if self._probe_result is None or now - self._probe_at > \
+                self._PROBE_TTL:
+            self._probe_result = enumerate_via_pjrt_full(timeout=60.0)
+            self._probe_at = now
+        raw, stderr = self._probe_result
+        if raw is None:
+            low = (stderr or "").lower()
+            if any(m in low for m in _BUSY_MARKERS):
+                return None  # claimed == alive
+            return f"pjrt enumeration failed: {(stderr or '')[-160:]}"
+        ncores = max(len(chip.cores), 1)
+        # Match by coords when the enumeration provides them; the
+        # id-based fallback applies ONLY to coord-less devices —
+        # surviving devices get renumbered ids after a failure, and an
+        # id collision must not mask a dead chip.
+        seen = 0
+        for d in raw:
+            coords = tuple(d.get("coords") or ())
+            if coords:
+                if coords == chip.coord:
+                    seen += 1
+            elif d.get("id", -1) // ncores == chip.index:
+                seen += 1
+        if seen == 0:
+            return "chip absent from pjrt enumeration"
+        return None
 
     def chips(self) -> List[TpuChip]:
         if self._chips is not None:

@@ -124,6 +124,10 @@ class Daemon:
                 os.unlink(sock)
             except OSError:
                 pass
+        # Per-tenant quotas arrive in each tenant's HELLO (its own
+        # Allocate-time env contract), so the broker gets only DEFAULTS
+        # for tenants that send none: the first spec's vdevice shape
+        # (heterogeneous splits are honored per grant, not frozen here).
         v = shared[0].vdevices[0]
         cmd = [sys.executable, "-m", "vtpu.runtime.server",
                "--socket", self.cfg.runtime_socket,
@@ -265,9 +269,17 @@ class Daemon:
             for p in plugins:
                 p.set_chip_health(chip.uuid, Health.UNHEALTHY, reason)
 
+        def on_healthy(chip: TpuChip):
+            # Recovery-to-healthy: the reference never un-flips a device
+            # (server.go:262 FIXME); a probe-clean chip re-advertises.
+            log.info("chip %s recovered; re-advertising", chip.uuid)
+            for p in plugins:
+                p.set_chip_health(chip.uuid, Health.HEALTHY, "recovered")
+
         def run():
             try:
-                self.backend.check_health(stop, chips, on_unhealthy)
+                self.backend.check_health(stop, chips, on_unhealthy,
+                                          on_healthy)
             except Exception as e:  # noqa: BLE001
                 # A dead health loop must not take the daemon down; mark
                 # everything unhealthy instead (reference marks all devices

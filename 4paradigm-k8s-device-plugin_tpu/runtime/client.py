@@ -66,7 +66,11 @@ class RemoteExecutable:
 
 class RuntimeClient:
     def __init__(self, socket_path: str, tenant: Optional[str] = None,
-                 priority: Optional[int] = None):
+                 priority: Optional[int] = None,
+                 device: Optional[int] = None,
+                 hbm_limit: Optional[int] = None,
+                 core_limit: Optional[int] = None,
+                 oversubscribe: Optional[bool] = None):
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(socket_path)
         self._ids = itertools.count()
@@ -74,10 +78,43 @@ class RuntimeClient:
         self.tenant = tenant or os.environ.get(
             "VTPU_TENANT", f"pid{os.getpid()}")
         self.priority = spec.task_priority if priority is None else priority
-        resp = self._rpc({"kind": P.HELLO, "tenant": self.tenant,
-                          "priority": self.priority,
-                          "oversubscribe": spec.oversubscribe})
+        hello = {"kind": P.HELLO, "tenant": self.tenant,
+                 "priority": self.priority,
+                 "oversubscribe": spec.oversubscribe
+                 if oversubscribe is None else bool(oversubscribe),
+                 "device": self._grant_device() if device is None
+                 else device}
+        # The tenant's own Allocate-time grant rides in HELLO so the
+        # broker seeds THIS tenant's slot with it (heterogeneous splits;
+        # reference per-vdevice CUDA_DEVICE_MEMORY_LIMIT_<i>).  An
+        # explicit 0 ("unlimited") is sent too — only a grant that says
+        # NOTHING falls back to the broker's spawn defaults.
+        hbm = hbm_limit
+        if hbm is None and spec.hbm_limit_bytes:
+            hbm = spec.limit_for(0)
+        core = core_limit
+        if core is None and envspec.ENV_CORE_LIMIT in os.environ:
+            core = spec.core_limit_pct
+        if hbm is not None:
+            hello["hbm_limit"] = int(hbm)
+        if core is not None:
+            hello["core_limit"] = int(core)
+        resp = self._rpc(hello)
         self.tenant_index = resp["tenant_index"]
+        self.chip = resp.get("chip", 0)
+
+    @staticmethod
+    def _grant_device() -> int:
+        """Node chip index this tenant's grant maps to: the shim
+        bootstrap resolves VTPU_VISIBLE_DEVICES against the mounted chip
+        inventory into TPU_VISIBLE_CHIPS (pyshim.py); its first entry is
+        the grant's chip.  Falls back to 0 (single-chip nodes)."""
+        vis = os.environ.get("TPU_VISIBLE_CHIPS", "")
+        first = vis.replace(",", " ").split()
+        try:
+            return int(first[0]) if first else 0
+        except ValueError:
+            return 0
 
     @classmethod
     def from_env(cls, **kw) -> "RuntimeClient":

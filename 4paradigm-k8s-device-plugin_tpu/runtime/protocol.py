@@ -24,7 +24,14 @@ _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 30
 
 # message kinds (client -> server)
-HELLO = "hello"          # {tenant, priority} -> {ok, tenant_index}
+# HELLO optional fields: device (chip index on the node, default 0 — the
+# broker serves EVERY chip, each with its own scheduler + accounting
+# region); hbm_limit (bytes) / core_limit (pct): this tenant's own
+# Allocate-time grant, seeded into its slot (first HELLO wins; absent ->
+# broker spawn defaults).
+HELLO = "hello"          # {tenant, priority, device?, hbm_limit?,
+                         #  core_limit?, oversubscribe?}
+                         # -> {ok, tenant_index, chip}
 PUT = "put"              # {id, shape, dtype, data} -> {ok, nbytes}
 GET = "get"              # {id} -> {ok, shape, dtype, data}
 DELETE = "delete"        # {id} -> {ok, freed}

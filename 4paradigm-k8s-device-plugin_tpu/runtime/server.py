@@ -95,9 +95,10 @@ MAX_PENDING_REPLIES = 128
 
 class Tenant:
     def __init__(self, name: str, index: int, priority: int,
-                 oversubscribe: bool = False):
+                 oversubscribe: bool = False, chip=None):
         self.name = name
-        self.index = index          # region device index for accounting
+        self.index = index          # tenant slot in its chip's region
+        self.chip = chip            # ChipState serving this tenant
         self.priority = priority
         self.oversubscribe = oversubscribe
         # Guards arrays/nbytes/host_arrays: the dispatcher registers
@@ -177,8 +178,9 @@ class DeviceScheduler:
     themselves: a tenant is eligible whenever its device-time budget
     admits the next program)."""
 
-    def __init__(self, state: "RuntimeState"):
+    def __init__(self, state: "RuntimeState", chip: "ChipState"):
         self.state = state
+        self.chip = chip
         self.mu = threading.Condition()
         self.queues: Dict[str, collections.deque] = {}
         self.inflight: Dict[str, int] = {}
@@ -188,12 +190,12 @@ class DeviceScheduler:
         self._completion_q: "queue.Queue" = queue.Queue()
         self._pool_us = 0.0  # unbilled device time (metering loop only)
         self._stop = False
-        self._dispatcher = threading.Thread(target=self._dispatch_loop,
-                                            daemon=True,
-                                            name="vtpu-rt-dispatch")
-        self._completer = threading.Thread(target=self._completion_loop,
-                                           daemon=True,
-                                           name="vtpu-rt-complete")
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"vtpu-rt-dispatch-{chip.index}")
+        self._completer = threading.Thread(
+            target=self._completion_loop, daemon=True,
+            name=f"vtpu-rt-complete-{chip.index}")
         self._dispatcher.start()
         self._completer.start()
 
@@ -253,10 +255,10 @@ class DeviceScheduler:
             t = item.tenant
             est = max(t.cost_ema.get(item.key, 5000.0),
                       float(self.state.min_exec_cost_us)) * item.steps
-            metered = (self.state.region.device_stats(t.index)
+            metered = (self.chip.region.device_stats(t.index)
                        .core_limit_pct > 0)
             if metered:
-                wait_ns = self.state.region.rate_acquire(
+                wait_ns = self.chip.region.rate_acquire(
                     t.index, int(est), t.priority)
                 if wait_ns:
                     nr = now + wait_ns / 1e9
@@ -307,7 +309,7 @@ class DeviceScheduler:
                             # this execute (transient overshoot is the
                             # cost of oversubscription).
                             a = jax.device_put(t.host_arrays[aid],
-                                               self.state.device)
+                                               self.chip.device)
                         if a is None:
                             raise KeyError(f"NOT_FOUND: {aid}")
                         args.append(a)
@@ -326,7 +328,7 @@ class DeviceScheduler:
                 if total_out:
                     # Can't refuse outputs post-hoc; oversubscribe-admit
                     # so the next put/execute hits the cap.
-                    self.state.region.mem_acquire(t.index, total_out, True)
+                    self.chip.region.mem_acquire(t.index, total_out, True)
                 with t.mu:
                     for i, o in enumerate(out_list):
                         if i < len(item.out_ids):
@@ -343,8 +345,8 @@ class DeviceScheduler:
                 # Failed before reaching the device: credit the up-front
                 # charge back and retire the item immediately.
                 if item.metered:
-                    self.state.region.rate_adjust(t.index,
-                                                  -int(item.est_us))
+                    self.chip.region.rate_adjust(t.index,
+                                                 -int(item.est_us))
                 item.session.complete_execute(item, metas, e, 0.0)
                 self._retire(t.name)
                 continue
@@ -393,7 +395,7 @@ class DeviceScheduler:
             except Exception as e:  # noqa: BLE001 - poisoned chain
                 exc = e
             t_obs = time.monotonic()
-            lat_s = self.state.calibrate_latency_us() / 1e6
+            lat_s = self.chip.calibrate_latency_us() / 1e6
             avail_us = max(min(t_obs - prev_obs, t_obs - t0 - lat_s),
                            0.0) * 1e6
             prev_obs_before, prev_obs = prev_obs, t_obs
@@ -427,7 +429,7 @@ class DeviceScheduler:
             t = item.tenant
             if exc is not None:
                 t.async_error = exc
-            self.state.region.busy_add(t.index, int(busy_us))
+            self.chip.region.busy_add(t.index, int(busy_us))
             charged = max(busy_us, float(self.state.min_exec_cost_us)
                           * item.steps)
             if item.metered:
@@ -436,7 +438,7 @@ class DeviceScheduler:
                 # must not wedge the bucket for ages.  The EMA (also
                 # growth-clamped below) catches real cost within a few
                 # items, so sustained under-charging is impossible.
-                self.state.region.rate_adjust(
+                self.chip.region.rate_adjust(
                     t.index,
                     int(min(charged, item.est_us * 4.0) - item.est_us))
             per_step = busy_us / item.steps
@@ -465,29 +467,25 @@ class DeviceScheduler:
             self.mu.notify_all()
 
 
-class RuntimeState:
-    """Shared across tenant sessions; owns the jax client and the region."""
+class ChipState:
+    """Per-chip execution context: the chip's own accounting region
+    (tenant axis WITHIN the chip — tenants are not conflated with chips,
+    so every chip serves up to MAX_TENANTS tenants), its own dispatcher
+    and metering threads (the device queue is in-order per chip), and
+    its own transport-latency calibration."""
 
-    def __init__(self, region_path: str, hbm_limit: int, core_limit: int,
-                 min_exec_cost_us: int = 0):
-        import jax
-        self.jax = jax
-        self.device = jax.devices()[0]
-        limits = [hbm_limit] * MAX_TENANTS
-        pcts = [core_limit] * MAX_TENANTS
-        self.region = SharedRegion(region_path, limits=limits,
-                                   core_pcts=pcts)
+    def __init__(self, state: "RuntimeState", index: int, device,
+                 region_path: str):
+        self.index = index
+        self.device = device
+        self.region = SharedRegion(
+            region_path, limits=[state.default_hbm] * MAX_TENANTS,
+            core_pcts=[state.default_core] * MAX_TENANTS)
         self.region.register()
-        self.min_exec_cost_us = min_exec_cost_us
-        self.tenants: Dict[str, Tenant] = {}
-        self.blob_cache: "collections.OrderedDict[str, Any]" = \
-            collections.OrderedDict()
-        self.chain_cache: "collections.OrderedDict[tuple, Any]" = \
-            collections.OrderedDict()
-        self.mu = threading.Lock()
         self._latency_us: Optional[float] = None
+        self._jax = state.jax
         self.calibrate_latency_us()  # while the device is idle
-        self.scheduler = DeviceScheduler(self)
+        self.scheduler = DeviceScheduler(state, self)
 
     def calibrate_latency_us(self) -> float:
         """Observed completion latency of a ~zero-cost execute: the
@@ -499,7 +497,7 @@ class RuntimeState:
         if self._latency_us is not None:
             return self._latency_us
         import numpy as np
-        jax = self.jax
+        jax = self._jax
         try:
             x = jax.device_put(np.zeros(8, np.float32), self.device)
             fn = jax.jit(lambda v: v + 1.0)
@@ -513,21 +511,90 @@ class RuntimeState:
         except Exception as e:  # noqa: BLE001 - calibration best-effort
             log.warn("latency calibration failed (%s); assuming 0", e)
             self._latency_us = 0.0
-        log.info("execute-path latency calibrated: %.0f us",
-                 self._latency_us)
+        log.info("chip %d execute-path latency calibrated: %.0f us",
+                 self.index, self._latency_us)
         return self._latency_us
 
+
+class RuntimeState:
+    """Shared across tenant sessions; owns the jax client and one
+    ChipState per served chip (every chip on the node is reachable for
+    time-shared tenants — VERDICT r2 #3)."""
+
+    def __init__(self, region_path: str, hbm_limit: int, core_limit: int,
+                 min_exec_cost_us: int = 0):
+        import jax
+        self.jax = jax
+        self.devices = list(jax.devices())
+        self.region_path = region_path
+        # Spawn-time limits are only DEFAULTS: each tenant's HELLO
+        # carries its own Allocate-time grant (reference per-vdevice
+        # CUDA_DEVICE_MEMORY_LIMIT_<i>, server.go:487-489).
+        self.default_hbm = hbm_limit
+        self.default_core = core_limit
+        self.min_exec_cost_us = min_exec_cost_us
+        self.tenants: Dict[str, Tenant] = {}
+        self.blob_cache: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self.chain_cache: "collections.OrderedDict[tuple, Any]" = \
+            collections.OrderedDict()
+        self.mu = threading.Lock()
+        self.chips: Dict[int, ChipState] = {}
+        # Chip creation is slow (region mmap + latency calibration with
+        # real device round trips): serialized on its own lock so it
+        # never stalls HELLO/compile/release of tenants on other chips.
+        self.chips_mu = threading.Lock()
+        self.chip(0)  # chip 0 eagerly: fail fast if the device is gone
+
+    def chip_region_path(self, index: int) -> str:
+        # Chip 0 keeps the bare path (vtpu-smi/back-compat); others get
+        # a .chip<k> suffix next to it.
+        return self.region_path if index == 0 \
+            else f"{self.region_path}.chip{index}"
+
+    def chip(self, index: int) -> ChipState:
+        """ChipState for a device index, created on first use (a chip
+        with no tenants costs no threads)."""
+        if not 0 <= index < len(self.devices):
+            raise ValueError(
+                f"INVALID_DEVICE: chip {index} not on this node "
+                f"({len(self.devices)} devices)")
+        c = self.chips.get(index)
+        if c is not None:
+            return c
+        with self.chips_mu:
+            c = self.chips.get(index)
+            if c is None:
+                c = ChipState(self, index, self.devices[index],
+                              self.chip_region_path(index))
+                self.chips[index] = c
+            return c
+
     def tenant(self, name: str, priority: int,
-               oversubscribe: bool = False) -> Tenant:
+               oversubscribe: bool = False, device: int = 0,
+               hbm_limit: Optional[int] = None,
+               core_limit: Optional[int] = None) -> Tenant:
+        chip = self.chip(device)
         with self.mu:
             t = self.tenants.get(name)
             if t is None:
-                used = {x.index for x in self.tenants.values()}
+                used = {x.index for x in self.tenants.values()
+                        if x.chip is chip}
                 index = next((i for i in range(MAX_TENANTS)
                               if i not in used), None)
                 if index is None:
-                    raise RuntimeError("tenant slots exhausted")
-                t = Tenant(name, index, priority, oversubscribe)
+                    raise RuntimeError(
+                        f"tenant slots exhausted on chip {chip.index}")
+                t = Tenant(name, index, priority, oversubscribe,
+                           chip=chip)
+                # Seed THIS tenant's grant into its slot (first HELLO
+                # wins for the tenant's lifetime; reconnects reuse it).
+                chip.region.set_mem_limit(
+                    index, hbm_limit if hbm_limit is not None
+                    else self.default_hbm)
+                chip.region.set_core_limit(
+                    index, core_limit if core_limit is not None
+                    else self.default_core)
                 self.tenants[name] = t
             t.connections += 1
             return t
@@ -540,7 +607,7 @@ class RuntimeState:
             if t.connections > 0:
                 return False
             self.tenants.pop(t.name, None)
-            self.scheduler.forget_tenant(t.name)
+            t.chip.scheduler.forget_tenant(t.name)
             return True
 
     def cached_blob(self, blob: bytes) -> "Program":
@@ -658,10 +725,17 @@ class TenantSession(socketserver.BaseRequestHandler):
             kind = msg.get("kind")
             try:
                 if kind == P.HELLO:
+                    hbm = msg.get("hbm_limit")
+                    core = msg.get("core_limit")
                     tenant = self.state.tenant(
                         str(msg["tenant"]), int(msg.get("priority", 1)),
-                        bool(msg.get("oversubscribe", False)))
-                    self._send({"ok": True, "tenant_index": tenant.index})
+                        bool(msg.get("oversubscribe", False)),
+                        device=int(msg.get("device", 0)),
+                        hbm_limit=int(hbm) if hbm is not None else None,
+                        core_limit=int(core) if core is not None
+                        else None)
+                    self._send({"ok": True, "tenant_index": tenant.index,
+                                "chip": tenant.chip.index})
                     continue
                 if tenant is None:
                     self._send_err("NO_HELLO", "hello required")
@@ -691,10 +765,10 @@ class TenantSession(socketserver.BaseRequestHandler):
                     # quota check so an exact-fit re-PUT succeeds.
                     self._drop_array(tenant, aid)
                     spilled = False
-                    if not self.state.region.mem_acquire(tenant.index,
-                                                         nbytes, False):
+                    if not tenant.chip.region.mem_acquire(tenant.index,
+                                                          nbytes, False):
                         if not tenant.oversubscribe:
-                            free, total = self.state.region.mem_info(
+                            free, total = tenant.chip.region.mem_info(
                                 tenant.index)
                             raise MemoryError(
                                 f"RESOURCE_EXHAUSTED: tenant {tenant.name}"
@@ -712,11 +786,12 @@ class TenantSession(socketserver.BaseRequestHandler):
                             tenant.nbytes[aid] = 0
                     else:
                         try:
-                            dev_arr = jax.device_put(arr, self.state.device)
+                            dev_arr = jax.device_put(arr,
+                                                     tenant.chip.device)
                             dev_arr.block_until_ready()
                         except Exception:
-                            self.state.region.mem_release(tenant.index,
-                                                          nbytes)
+                            tenant.chip.region.mem_release(tenant.index,
+                                                           nbytes)
                             raise
                         with tenant.mu:
                             tenant.arrays[aid] = dev_arr
@@ -750,7 +825,7 @@ class TenantSession(socketserver.BaseRequestHandler):
                 elif kind == P.STATS:
                     # Fresh counters: let the metering thread retire
                     # everything this tenant has dispatched.
-                    self.state.scheduler.quiesce(tenant.name)
+                    tenant.chip.scheduler.quiesce(tenant.name)
                     self._send({"ok": True, "tenants": self._stats()})
 
                 else:
@@ -775,7 +850,7 @@ class TenantSession(socketserver.BaseRequestHandler):
         if aid in t.arrays:
             nbytes = t.nbytes.pop(aid, 0)
             del t.arrays[aid]
-            self.state.region.mem_release(t.index, nbytes)
+            t.chip.region.mem_release(t.index, nbytes)
             return nbytes
         return 0
 
@@ -825,7 +900,7 @@ class TenantSession(socketserver.BaseRequestHandler):
             while self.pending >= MAX_PENDING_REPLIES:
                 self.pending_cond.wait(timeout=0.5)
             self.pending += 1
-        self.state.scheduler.submit(item)
+        t.chip.scheduler.submit(item)
 
     def complete_execute(self, item: WorkItem, metas, exc,
                          actual_us: float) -> None:
@@ -856,9 +931,10 @@ class TenantSession(socketserver.BaseRequestHandler):
     def _stats(self):
         out = {}
         for name, t in self.state.tenants.items():
-            st = self.state.region.device_stats(t.index)
+            st = t.chip.region.device_stats(t.index)
             out[name] = {
                 "index": t.index,
+                "chip": t.chip.index,
                 "used_bytes": int(st.used_bytes),
                 "limit_bytes": int(st.limit_bytes),
                 "peak_bytes": int(st.peak_bytes),
@@ -886,12 +962,14 @@ def make_server(socket_path: str, hbm_limit: int, core_limit: int,
     if os.path.exists(socket_path):
         os.unlink(socket_path)
     os.makedirs(os.path.dirname(socket_path) or ".", exist_ok=True)
-    # The region is broker-owned state: a stale file from a previous run
-    # would silently keep the OLD quotas (vtpu_region_open only seeds
-    # limits on first creation).
+    # The regions are broker-owned state: a stale file from a previous
+    # run would silently keep the OLD quotas (vtpu_region_open only
+    # seeds limits on first creation).  One region per chip.
     rpath = region_path or socket_path + ".shr"
-    if os.path.exists(rpath):
-        os.unlink(rpath)
+    import glob as _glob
+    for stale in [rpath] + _glob.glob(rpath + ".chip*"):
+        if os.path.exists(stale):
+            os.unlink(stale)
     state = RuntimeState(rpath, hbm_limit, core_limit, min_exec_cost_us)
     handler = type("BoundSession", (TenantSession,), {"state": state})
     srv = _Server(socket_path, handler)

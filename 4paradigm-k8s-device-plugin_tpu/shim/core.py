@@ -41,6 +41,8 @@ class ProcStats(ctypes.Structure):
         ("pid", ctypes.c_int),
         ("host_pid", ctypes.c_int),
         ("used_bytes", ctypes.c_uint64 * MAX_DEVICES_PER_NODE),
+        # per-device cumulative device time (us) — per-tenant duty cycle
+        ("busy_us", ctypes.c_uint64 * MAX_DEVICES_PER_NODE),
     ]
 
 
@@ -100,6 +102,8 @@ def load() -> ctypes.CDLL:
                                     ctypes.c_uint64, ctypes.c_int]
     lib.vtpu_set_core_limit.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.c_int32]
+    lib.vtpu_set_mem_limit.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_uint64]
     lib.vtpu_busy_add.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                   ctypes.c_uint64]
     lib.vtpu_region_ndevices.restype = ctypes.c_int
@@ -199,6 +203,10 @@ class SharedRegion:
 
     def set_core_limit(self, dev: int, pct: int) -> None:
         self.lib.vtpu_set_core_limit(self.handle, dev, pct)
+
+    def set_mem_limit(self, dev: int, limit_bytes: int) -> None:
+        """Re-seed one slot's HBM cap (broker per-grant quotas)."""
+        self.lib.vtpu_set_mem_limit(self.handle, dev, int(limit_bytes))
 
     def busy_add(self, dev: int, us: int) -> None:
         """Record completed device time (duty-cycle source)."""

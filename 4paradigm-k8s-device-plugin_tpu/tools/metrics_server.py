@@ -79,11 +79,43 @@ class MetricsState:
                         "n_procs": int(st.n_procs),
                         "busy_us_total": int(st.busy_us),
                     })
-                procs = [{
-                    "pid": int(p.pid), "host_pid": int(p.host_pid),
-                    "used_bytes": [int(b) for b in
-                                   p.used_bytes[:region.ndevices]],
-                } for p in region.proc_stats()]
+                procs = []
+                live_pkeys = set()
+                for p in region.proc_stats():
+                    pinfo = {
+                        "pid": int(p.pid), "host_pid": int(p.host_pid),
+                        "used_bytes": [int(b) for b in
+                                       p.used_bytes[:region.ndevices]],
+                        "busy_us": [int(b) for b in
+                                    p.busy_us[:region.ndevices]],
+                    }
+                    # Per-tenant duty cycle (reference per-process
+                    # utilization, nvmlDeviceGetProcessUtilization):
+                    # delta of the proc's busy_us between scrapes.
+                    # Keyed by HOST pid — in-namespace pids collide
+                    # across containers (every pod's workload is pid 1).
+                    duties = []
+                    for d in range(region.ndevices):
+                        pkey = (path, "proc", int(p.host_pid), d)
+                        live_pkeys.add(pkey)
+                        with self.mu:
+                            pprev = self._prev.get(pkey)
+                            self._prev[pkey] = (p.busy_us[d], now)
+                        pd = 0.0
+                        if pprev is not None and now > pprev[1]:
+                            pd = min((p.busy_us[d] - pprev[0])
+                                     / ((now - pprev[1]) * 1e6) * 100.0,
+                                     100.0)
+                        duties.append(round(max(pd, 0.0), 2))
+                    pinfo["duty_cycle_pct"] = duties
+                    procs.append(pinfo)
+                # Prune samples of exited processes: per-pid keys are
+                # unbounded under pod churn.
+                with self.mu:
+                    for k in [k for k in self._prev
+                              if len(k) == 4 and k[0] == path
+                              and k not in live_pkeys]:
+                        del self._prev[k]
                 out.append({"region": path, "devices": devices,
                             "procs": procs})
             finally:
@@ -104,6 +136,9 @@ def to_prometheus(infos: List[Dict]) -> str:
         "# TYPE vtpu_busy_us_total counter",
         "# HELP vtpu_procs Live processes accounted on the device.",
         "# TYPE vtpu_procs gauge",
+        "# HELP vtpu_proc_busy_us_total Cumulative device busy "
+        "microseconds per process (tenant attribution).",
+        "# TYPE vtpu_proc_busy_us_total counter",
     ]
     for info in infos:
         region = os.path.basename(os.path.dirname(info["region"])) or \
@@ -119,6 +154,15 @@ def to_prometheus(infos: List[Dict]) -> str:
             lines.append(f'vtpu_busy_us_total{labels} '
                          f'{d["busy_us_total"]}')
             lines.append(f'vtpu_procs{labels} {d["n_procs"]}')
+        for p in info.get("procs", []):
+            for d, busy in enumerate(p.get("busy_us", [])):
+                if not busy:
+                    continue
+                # host pid: unique across containers (namespace pids
+                # collide -> duplicate Prometheus series).
+                labels = (f'{{region="{region}",device="{d}",'
+                          f'pid="{p["host_pid"]}"}}')
+                lines.append(f'vtpu_proc_busy_us_total{labels} {busy}')
     return "\n".join(lines) + "\n"
 
 

@@ -66,6 +66,10 @@ def read_region(path: str, sweep_host: bool = False) -> Dict:
                 "host_pid": int(st.host_pid),
                 "used_bytes": [int(b) for b in
                                st.used_bytes[:r.ndevices]],
+                # per-device cumulative device time: which TENANT is
+                # consuming the chip (reference per-process utilization,
+                # nvmlDeviceGetProcessUtilization)
+                "busy_us": [int(b) for b in st.busy_us[:r.ndevices]],
             })
         return {"region": path, "devices": devices, "procs": procs}
     finally:
@@ -97,8 +101,9 @@ def render(infos: List[Dict]) -> str:
             lines.append(row + " " * max(0, 76 - len(row)) + "|")
         for p in info["procs"]:
             used = sum(p["used_bytes"])
+            busy = sum(p.get("busy_us", []))
             row = (f"|   pid {p['pid']:>7} (host {p['host_pid']:>7}) "
-                   f"uses {_mb(used):>10}")
+                   f"uses {_mb(used):>10}  busy {busy / 1e6:>8.1f}s")
             lines.append(row + " " * max(0, 75 - len(row)) + "|")
         lines.append("+" + "-" * 74 + "+")
     if not infos:

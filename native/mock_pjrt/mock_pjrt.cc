@@ -47,6 +47,12 @@ struct MockMemory {
 struct MockDevice {
   int id;
   MockMemory* hbm = nullptr;
+  /* Description payload (the device doubles as its own
+   * PJRT_DeviceDescription).  Mimics a 2-core-per-chip part (v4-like):
+   * coords = chip position, core_on_chip = which TensorCore. */
+  int64_t coords[3] = {0, 0, 0};
+  int64_t core_on_chip = 0;
+  std::vector<PJRT_NamedValue> attrs;
 };
 
 struct MockClient {
@@ -126,7 +132,27 @@ PJRT_Error* M_Client_Create(PJRT_Client_Create_Args* a) {
   int nd = n ? atoi(n) : 2;
   auto* c = new MockClient();
   for (int i = 0; i < nd; i++) {
-    auto* d = new MockDevice{i};
+    auto* d = new MockDevice();
+    d->id = i;
+    d->coords[0] = i / 2; /* 2 cores per chip */
+    d->core_on_chip = i % 2;
+    PJRT_NamedValue nv;
+    memset(&nv, 0, sizeof(nv));
+    nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    nv.name = "coords";
+    nv.name_size = 6;
+    nv.type = PJRT_NamedValue_kInt64List;
+    nv.int64_array_value = d->coords;
+    nv.value_size = 3;
+    d->attrs.push_back(nv);
+    memset(&nv, 0, sizeof(nv));
+    nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    nv.name = "core_on_chip";
+    nv.name_size = 12;
+    nv.type = PJRT_NamedValue_kInt64;
+    nv.int64_value = d->core_on_chip;
+    nv.value_size = 1;
+    d->attrs.push_back(nv);
     c->devices.push_back(d);
     c->device_ptrs.push_back(reinterpret_cast<PJRT_Device*>(d));
   }
@@ -366,6 +392,45 @@ PJRT_Error* M_Device_MemoryStats(PJRT_Device_MemoryStats_Args*) {
              "mock backend has no memory stats (like real libtpu)");
 }
 
+/* The MockDevice doubles as its own PJRT_DeviceDescription. */
+PJRT_Error* M_Device_GetDescription(PJRT_Device_GetDescription_Args* a) {
+  a->device_description =
+      reinterpret_cast<PJRT_DeviceDescription*>(a->device);
+  return nullptr;
+}
+
+PJRT_Error* M_Device_LocalHardwareId(PJRT_Device_LocalHardwareId_Args* a) {
+  a->local_hardware_id =
+      reinterpret_cast<MockDevice*>(a->device)->id;
+  return nullptr;
+}
+
+PJRT_Error* M_DeviceDescription_Id(PJRT_DeviceDescription_Id_Args* a) {
+  a->id = reinterpret_cast<MockDevice*>(a->device_description)->id;
+  return nullptr;
+}
+
+PJRT_Error* M_DeviceDescription_ProcessIndex(
+    PJRT_DeviceDescription_ProcessIndex_Args* a) {
+  a->process_index = 0;
+  return nullptr;
+}
+
+PJRT_Error* M_DeviceDescription_Attributes(
+    PJRT_DeviceDescription_Attributes_Args* a) {
+  auto* d = reinterpret_cast<MockDevice*>(a->device_description);
+  a->attributes = d->attrs.data();
+  a->num_attributes = d->attrs.size();
+  return nullptr;
+}
+
+PJRT_Error* M_DeviceDescription_Kind(PJRT_DeviceDescription_Kind_Args* a) {
+  static const char kKind[] = "MockTPU v0";
+  a->device_kind = kKind;
+  a->device_kind_size = sizeof(kKind) - 1;
+  return nullptr;
+}
+
 PJRT_Api make_api() {
   PJRT_Api api;
   memset(&api, 0, sizeof(api));
@@ -404,6 +469,12 @@ PJRT_Api make_api() {
   api.PJRT_Event_Destroy = M_Event_Destroy;
   api.PJRT_Event_OnReady = M_Event_OnReady;
   api.PJRT_Device_MemoryStats = M_Device_MemoryStats;
+  api.PJRT_Device_GetDescription = M_Device_GetDescription;
+  api.PJRT_Device_LocalHardwareId = M_Device_LocalHardwareId;
+  api.PJRT_DeviceDescription_Id = M_DeviceDescription_Id;
+  api.PJRT_DeviceDescription_ProcessIndex = M_DeviceDescription_ProcessIndex;
+  api.PJRT_DeviceDescription_Attributes = M_DeviceDescription_Attributes;
+  api.PJRT_DeviceDescription_Kind = M_DeviceDescription_Kind;
   return api;
 }
 

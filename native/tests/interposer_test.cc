@@ -360,7 +360,51 @@ static int sc_coresplit(const char* dir, const char* shr) {
   CHECK(error_code(e) == PJRT_Error_Code_RESOURCE_EXHAUSTED);
   CHECK(error_message(e).find("device 0") != std::string::npos);
   destroy_error(e);
-  printf("coresplit: 1 of 2 devices visible, renumbered to ordinal 0\n");
+
+  /* Identity virtualization (reference assigning_virtual_pcibusID,
+   * SURVEY §2.9e): the tenant was granted physical core 1 (id 1,
+   * core_on_chip 1 in the mock) but must see a self-consistent device
+   * 0 — description id 0, local hardware id 0, coords (0,0,0),
+   * core_on_chip 0. */
+  PJRT_Device_GetDescription_Args gd;
+  memset(&gd, 0, sizeof(gd));
+  gd.struct_size = PJRT_Device_GetDescription_Args_STRUCT_SIZE;
+  gd.device = env.devices[0];
+  CHECK(api->PJRT_Device_GetDescription(&gd) == nullptr);
+  PJRT_DeviceDescription_Id_Args di;
+  memset(&di, 0, sizeof(di));
+  di.struct_size = PJRT_DeviceDescription_Id_Args_STRUCT_SIZE;
+  di.device_description = gd.device_description;
+  CHECK(api->PJRT_DeviceDescription_Id(&di) == nullptr);
+  CHECK(di.id == 0);
+  PJRT_Device_LocalHardwareId_Args lh;
+  memset(&lh, 0, sizeof(lh));
+  lh.struct_size = PJRT_Device_LocalHardwareId_Args_STRUCT_SIZE;
+  lh.device = env.devices[0];
+  CHECK(api->PJRT_Device_LocalHardwareId(&lh) == nullptr);
+  CHECK(lh.local_hardware_id == 0);
+  PJRT_DeviceDescription_Attributes_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_DeviceDescription_Attributes_Args_STRUCT_SIZE;
+  da.device_description = gd.device_description;
+  CHECK(api->PJRT_DeviceDescription_Attributes(&da) == nullptr);
+  bool saw_coords = false, saw_core = false;
+  for (size_t i = 0; i < da.num_attributes; i++) {
+    const PJRT_NamedValue& nv = da.attributes[i];
+    std::string name(nv.name, nv.name_size);
+    if (name == "coords") {
+      saw_coords = true;
+      CHECK(nv.int64_array_value[0] == 0);
+      CHECK(nv.int64_array_value[1] == 0);
+      CHECK(nv.int64_array_value[2] == 0);
+    } else if (name == "core_on_chip") {
+      saw_core = true;
+      CHECK(nv.int64_value == 0);
+    }
+  }
+  CHECK(saw_coords && saw_core);
+  printf("coresplit: 1 of 2 devices visible, renumbered to ordinal 0, "
+         "virtual identity (id 0, coords 0,0,0)\n");
   return 0;
 }
 

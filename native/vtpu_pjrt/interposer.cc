@@ -339,6 +339,11 @@ static void parse_core_filter() {
   }
 }
 
+/* Defined with the identity-virtualization block below; rebuilds the
+ * description->ordinal map for a new visible list (g_mu held). */
+static void register_desc_ords_locked(
+    const std::vector<PJRT_Device*>& slot);
+
 /* The container-visible device list: the real addressable list, subset to
  * VTPU_CORE_INDICES positions when a core-split grant pins TensorCores
  * (reference initial_virtual_devices/map_cuda_visible_devices, §2.9e).
@@ -377,6 +382,7 @@ static const std::vector<PJRT_Device*>* visible_devices(PJRT_Client* client) {
   slot = std::move(vis);
   for (size_t i = 0; i < slot.size() && i < VTPU_MAX_DEVICES; i++)
     dev_ord()[slot[i]] = (int)i;
+  register_desc_ords_locked(slot);
   return &slot;
 }
 
@@ -1274,6 +1280,109 @@ static PJRT_Error* w_Device_MemoryStats(PJRT_Device_MemoryStats_Args* args) {
 }
 
 /* ------------------------------------------------------------------ */
+/* device identity virtualization (core-split grants)                 */
+/* ------------------------------------------------------------------ */
+/* A filtered tenant must see a SELF-CONSISTENT renumbered identity:
+ * description ids / local hardware ids renumbered from 0 and coords
+ * rewritten so each granted core presents as its own chip at position
+ * (ordinal, 0, 0) with core_on_chip 0 — a co-tenant can no longer read
+ * the physical position of the shared chip off its device attributes
+ * (the reference fakes PCI bus ids the same way:
+ * assigning_virtual_pcibusID, SURVEY §2.9e). */
+
+struct VirtDesc {
+  int ord = 0;
+  int64_t coords[3] = {0, 0, 0};
+  bool attrs_built = false;
+  std::vector<PJRT_NamedValue> attrs;
+};
+
+static std::unordered_map<PJRT_DeviceDescription*, VirtDesc>& desc_virt() {
+  static auto* m =
+      new std::unordered_map<PJRT_DeviceDescription*, VirtDesc>();
+  return *m;
+}
+
+static void register_desc_ords_locked(
+    const std::vector<PJRT_Device*>& slot) {
+  /* UPSERT, never clear: another client's already-returned attribute
+   * arrays must stay valid (a global clear would dangle them and let
+   * later Id() calls leak the physical identity).  Entries are bounded
+   * by the backend's device count. */
+  if (core_filter().empty() || !g_real->PJRT_Device_GetDescription)
+    return;
+  for (size_t i = 0; i < slot.size() && i < VTPU_MAX_DEVICES; i++) {
+    PJRT_Device_GetDescription_Args gd;
+    memset(&gd, 0, sizeof(gd));
+    gd.struct_size = PJRT_Device_GetDescription_Args_STRUCT_SIZE;
+    gd.device = slot[i];
+    PJRT_Error* err = g_real->PJRT_Device_GetDescription(&gd);
+    if (err) {
+      destroy_real_error(err);
+      continue;
+    }
+    if (gd.device_description) {
+      VirtDesc& vd = desc_virt()[gd.device_description];
+      if (vd.ord != (int)i) {
+        vd.ord = (int)i;
+        vd.attrs_built = false;  /* rebuild with the new ordinal */
+      }
+    }
+  }
+}
+
+static PJRT_Error* w_DeviceDescription_Id(
+    PJRT_DeviceDescription_Id_Args* args) {
+  PJRT_Error* err = g_real->PJRT_DeviceDescription_Id(args);
+  if (err || core_filter().empty()) return err;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = desc_virt().find(args->device_description);
+  if (it != desc_virt().end()) args->id = it->second.ord;
+  return nullptr;
+}
+
+static PJRT_Error* w_Device_LocalHardwareId(
+    PJRT_Device_LocalHardwareId_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Device_LocalHardwareId(args);
+  if (err || core_filter().empty()) return err;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = dev_ord().find(args->device);
+  if (it != dev_ord().end()) args->local_hardware_id = it->second;
+  return nullptr;
+}
+
+static PJRT_Error* w_DeviceDescription_Attributes(
+    PJRT_DeviceDescription_Attributes_Args* args) {
+  PJRT_Error* err = g_real->PJRT_DeviceDescription_Attributes(args);
+  if (err || core_filter().empty()) return err;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = desc_virt().find(args->device_description);
+  if (it == desc_virt().end()) return nullptr;
+  VirtDesc& vd = it->second;
+  if (!vd.attrs_built) {
+    vd.coords[0] = vd.ord;
+    vd.coords[1] = 0;
+    vd.coords[2] = 0;
+    vd.attrs.assign(args->attributes,
+                    args->attributes + args->num_attributes);
+    for (PJRT_NamedValue& nv : vd.attrs) {
+      std::string name(nv.name, nv.name_size);
+      if (name == "coords" && nv.type == PJRT_NamedValue_kInt64List) {
+        nv.int64_array_value = vd.coords;
+        nv.value_size = nv.value_size < 3 ? nv.value_size : 3;
+      } else if (name == "core_on_chip" &&
+                 nv.type == PJRT_NamedValue_kInt64) {
+        nv.int64_value = 0;
+      }
+    }
+    vd.attrs_built = true;
+  }
+  args->attributes = vd.attrs.data();
+  args->num_attributes = vd.attrs.size();
+  return nullptr;
+}
+
+/* ------------------------------------------------------------------ */
 /* bootstrap                                                          */
 /* ------------------------------------------------------------------ */
 
@@ -1362,6 +1471,14 @@ static void init_once() {
   if (g_real->PJRT_AsyncHostToDeviceTransferManager_Destroy)
     g_wrapped.PJRT_AsyncHostToDeviceTransferManager_Destroy =
         w_AsyncXfer_Destroy;
+  /* Device identity virtualization (core-split renumbering). */
+  if (g_real->PJRT_DeviceDescription_Id)
+    g_wrapped.PJRT_DeviceDescription_Id = w_DeviceDescription_Id;
+  if (g_real->PJRT_Device_LocalHardwareId)
+    g_wrapped.PJRT_Device_LocalHardwareId = w_Device_LocalHardwareId;
+  if (g_real->PJRT_DeviceDescription_Attributes)
+    g_wrapped.PJRT_DeviceDescription_Attributes =
+        w_DeviceDescription_Attributes;
 
   VTPU_LOG(3, "wrapping real PJRT api v%d.%d from %s",
            g_real->pjrt_api_version.major_version,

@@ -27,7 +27,7 @@
 #include <unistd.h>
 
 #define VTPU_MAGIC 0x76545055u /* "vTPU" */
-#define VTPU_VERSION 2u /* v2: DeviceState.busy_us */
+#define VTPU_VERSION 3u /* v3: per-proc busy_us (tenant attribution) */
 
 /* Burst cap for the token bucket: how much device time may be "saved up".
  * 250ms keeps bursts short enough that a co-tenant is never starved for
@@ -45,6 +45,11 @@ typedef struct {
    * the number may name an unrelated process. */
   uint64_t ns_id;
   uint64_t used_bytes[VTPU_MAX_DEVICES];
+  /* Cumulative device time (us) this process has run per device: the
+   * per-tenant half of the duty-cycle view (reference
+   * nvmlDeviceGetProcessUtilization merge, SURVEY §2.9d/f) — both
+   * enforcement paths feed it from vtpu_busy_add. */
+  uint64_t busy_us[VTPU_MAX_DEVICES];
   uint64_t last_seen_ns;
 } ProcSlot;
 
@@ -448,6 +453,7 @@ int vtpu_proc_get_stats(vtpu_region* r, int slot, vtpu_proc_stats* out) {
     out->pid = p->pid;
     out->host_pid = p->host_pid;
     memcpy(out->used_bytes, p->used_bytes, sizeof(out->used_bytes));
+    memcpy(out->busy_us, p->busy_us, sizeof(out->busy_us));
   }
   unlock_region(g);
   return active ? 0 : -1;
@@ -534,7 +540,10 @@ void vtpu_busy_add(vtpu_region* r, int dev, uint64_t us) {
   if (lock_region(g) != 0) return;
   g->dev[dev].busy_us += us;
   ProcSlot* me = my_slot_locked(r, g);
-  if (me) me->last_seen_ns = now_ns();
+  if (me) {
+    me->busy_us[dev] += us;
+    me->last_seen_ns = now_ns();
+  }
   unlock_region(g);
 }
 
@@ -547,7 +556,33 @@ void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct) {
   unlock_region(g);
 }
 
+void vtpu_set_mem_limit(vtpu_region* r, int dev, uint64_t limit_bytes) {
+  /* Runtime re-seed of one device/tenant slot's HBM cap: the broker
+   * applies each tenant's own Allocate-time grant at HELLO instead of a
+   * daemon-wide spawn default (reference per-vdevice
+   * CUDA_DEVICE_MEMORY_LIMIT_<i>, server.go:487-489). */
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) return;
+  if (lock_region(g) != 0) return;
+  g->dev[dev].limit_bytes = limit_bytes;
+  unlock_region(g);
+}
+
 int vtpu_region_ndevices(vtpu_region* r) { return r->shm->ndevices; }
+
+/* Foreign-tenant liveness window (docs/DESIGN.md "DEFAULT-policy
+ * contention window"): a foreign-namespace slot that has not
+ * heartbeated for this long stops counting as contention.  Default 30s;
+ * VTPU_FOREIGN_LIVE_WINDOW_US overrides (ops tuning + tests). */
+static uint64_t foreign_live_window_ns(void) {
+  static uint64_t v = 0;
+  if (v == 0) {
+    const char* s = getenv("VTPU_FOREIGN_LIVE_WINDOW_US");
+    uint64_t us = s && *s ? strtoull(s, NULL, 10) : 0;
+    v = us ? us * 1000ull : 30ull * 1000000000ull;
+  }
+  return v;
+}
 
 int vtpu_region_active_procs(vtpu_region* r) {
   Region* g = r->shm;
@@ -558,7 +593,6 @@ int vtpu_region_active_procs(vtpu_region* r) {
    * heartbeat: slots touch last_seen_ns on every acquire/gate, so a
    * crashed (or idle) co-tenant container stops counting as contention
    * within the window and the DEFAULT policy un-gates the survivor. */
-  static const uint64_t kForeignLiveWindowNs = 30ull * 1000000000ull;
   uint64_t now = now_ns();
   uint64_t mine = my_ns_id();
   ProcSlot* me = my_slot_locked(r, g);
@@ -568,7 +602,7 @@ int vtpu_region_active_procs(vtpu_region* r) {
     ProcSlot* p = &g->proc[s];
     if (!p->active) continue;
     if (p->ns_id == mine ||
-        now - p->last_seen_ns <= kForeignLiveWindowNs)
+        now - p->last_seen_ns <= foreign_live_window_ns())
       n++;
   }
   unlock_region(g);

@@ -54,6 +54,11 @@ typedef struct {
   pid_t pid;
   pid_t host_pid; /* pid in the host namespace when known, else == pid */
   uint64_t used_bytes[VTPU_MAX_DEVICES];
+  /* Cumulative device time (us) this process has run per device — the
+   * per-tenant utilization source (reference
+   * nvmlDeviceGetProcessUtilization, SURVEY §2.9d/f).  Monitors sample
+   * twice to derive each tenant's duty cycle. */
+  uint64_t busy_us[VTPU_MAX_DEVICES];
 } vtpu_proc_stats;
 
 /* ---- region lifecycle -------------------------------------------------- */
@@ -130,6 +135,9 @@ void vtpu_rate_block(vtpu_region* r, int dev, uint64_t cost_us,
 
 /* Set/read the core limit at runtime (monitor / tests). */
 void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct);
+
+/* Re-seed one slot's HBM cap at runtime (broker per-grant quotas). */
+void vtpu_set_mem_limit(vtpu_region* r, int dev, uint64_t limit_bytes);
 
 /* Record `us` of completed device time on `dev` (all execute paths call
  * this on completion, independent of rate gating) — the duty-cycle

@@ -22,6 +22,7 @@ def spawn_daemon(tmp_path, fault_dir, extra=()):
         "PYTHONPATH": REPO,
         "VTPU_FAKE_CHIPS": "2",
         "VTPU_FAKE_FAULT_DIR": str(fault_dir),
+        "VTPU_HEALTH_INTERVAL": "0.5",
         "VTPU_LOG_LEVEL": "4",
     })
     return subprocess.Popen(
@@ -73,7 +74,7 @@ def test_daemon_registers_and_survives_kubelet_restart(daemon):
         sim2.stop()
 
 
-def test_daemon_health_fault_injection(daemon):
+def test_daemon_health_fault_injection_and_recovery(daemon):
     sim, proc, tmp_path, fault_dir = daemon
     reg = sim.wait_registration(timeout=10)
     stub, ch = sim.plugin_stub(reg.endpoint)
@@ -81,12 +82,19 @@ def test_daemon_health_fault_injection(daemon):
     first = collect_stream(stream, 1)
     assert all(d.health == "Healthy" for d in first[0].devices)
 
-    # Inject a fault; the 5s-poll health loop should flip the chip.
+    # Inject a fault; the health loop should flip the chip.
     (fault_dir / "TPU-fake-v5e-00").write_text("injected for test")
     upd = collect_stream(stream, 1, timeout=10)
     assert upd, "expected health refresh"
     bad = [d for d in upd[-1].devices if d.health == "Unhealthy"]
     assert len(bad) == 2
+
+    # Clear the fault: the chip must flip BACK to healthy (the reference
+    # never recovers a device — server.go:262 FIXME; we do).
+    (fault_dir / "TPU-fake-v5e-00").unlink()
+    rec = collect_stream(stream, 1, timeout=10)
+    assert rec, "expected recovery refresh"
+    assert all(d.health == "Healthy" for d in rec[-1].devices)
     ch.close()
 
 

@@ -74,3 +74,63 @@ def test_fault_injection_health(tmp_path):
     stop.wait(7)
     t.join(timeout=8)
     assert events and events[0][0] == chips[0].uuid
+
+
+def test_health_threshold_and_recovery(tmp_path, monkeypatch):
+    """Debounce + recovery (VERDICT r2 #8): a chip flips unhealthy only
+    after health_fail_threshold consecutive probe failures, and flips
+    BACK when the probe clears (the reference's unhealthy is one-way)."""
+    monkeypatch.setenv("VTPU_HEALTH_INTERVAL", "0.02")
+    b = FakeChipBackend(num_chips=1, fault_dir=str(tmp_path))
+    b.health_fail_threshold = 3
+    chips = b.chips()
+    events = []
+    stop = threading.Event()
+
+    def on_unhealthy(chip, reason):
+        events.append(("down", chip.uuid))
+        # fault observed: clear it so the next polls probe clean
+        (tmp_path / chip.uuid).unlink()
+
+    def on_healthy(chip):
+        events.append(("up", chip.uuid))
+        stop.set()
+
+    (tmp_path / chips[0].uuid).write_text("wedged")
+    t = threading.Thread(target=lambda: b.check_health(
+        stop, chips, on_unhealthy, on_healthy))
+    t.start()
+    stop.wait(10)
+    stop.set()
+    t.join(timeout=5)
+    assert events == [("down", chips[0].uuid), ("up", chips[0].uuid)]
+
+
+def test_pjrt_probe_busy_means_alive(monkeypatch):
+    """A libtpu single-process-lock failure during the pjrt health probe
+    means the chip is CLAIMED (broker/tenant holds it), never a fault."""
+    from vtpu.discovery import pjrt as pj
+
+    b = pj.PjrtChipBackend(raw=[
+        {"id": 0, "kind": "TPU v5 lite", "coords": [0, 0, 0],
+         "core_on_chip": 0, "hbm_bytes": 16 * 2**30}])
+    chips = b.chips()
+    # Case 1: enumeration fails with the lock error -> healthy.
+    monkeypatch.setattr(
+        pj, "enumerate_via_pjrt_full",
+        lambda timeout=0: (None, "The TPU is already in use by pid 123"))
+    b._probe_result = None
+    assert b.probe(chips[0]) is None
+    # Case 2: enumeration fails for another reason -> fault reported.
+    monkeypatch.setattr(
+        pj, "enumerate_via_pjrt_full",
+        lambda timeout=0: (None, "driver wedged: DMA timeout"))
+    b._probe_result = None
+    b._probe_at = 0.0
+    assert "enumeration failed" in b.probe(chips[0])
+    # Case 3: enumeration succeeds without the chip -> absent fault.
+    monkeypatch.setattr(
+        pj, "enumerate_via_pjrt_full", lambda timeout=0: ([], ""))
+    b._probe_result = None
+    b._probe_at = 0.0
+    assert "absent" in b.probe(chips[0])

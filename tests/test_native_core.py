@@ -169,3 +169,98 @@ def test_rate_adjust_credits_back(region_path):
         t0 = time.monotonic()
         r.rate_block(0, 80_000)
         assert time.monotonic() - t0 < 0.05
+
+
+# ---------------------------------------------------------------------------
+# Foreign-tenant liveness window (docs/DESIGN.md "DEFAULT-policy
+# contention window"): a paused co-tenant in ANOTHER pid namespace stops
+# counting as contention after the window, and counts again the moment it
+# resumes heartbeating.
+# ---------------------------------------------------------------------------
+
+def _foreign_ns_proc(path, ready, resume, done):
+    """Runs a registered region member inside a NEW pid namespace, with
+    one heartbeat, a pause, and a resume heartbeat on request."""
+    try:
+        os.unshare(os.CLONE_NEWPID)
+    except (PermissionError, OSError, AttributeError):
+        with open(ready, "w") as f:
+            f.write("skip")
+        return
+    pid = os.fork()
+    if pid:
+        os.waitpid(pid, 0)
+        return
+    # grandchild: first process of the new pid namespace
+    from vtpu.shim.core import SharedRegion
+    r = SharedRegion(path)
+    r.register()
+    r.busy_add(0, 1)  # heartbeat
+    with open(ready, "w") as f:
+        f.write("ok")
+    while not os.path.exists(resume):
+        time.sleep(0.02)
+    r.busy_add(0, 1)  # resumed: heartbeat again
+    with open(done, "w") as f:
+        f.write("ok")
+    time.sleep(1.0)   # stay alive while the parent samples
+    os._exit(0)
+
+
+def _foreign_window_parent(path, ready, resume, done, q):
+    os.environ["VTPU_FOREIGN_LIVE_WINDOW_US"] = "300000"  # 0.3 s
+    from vtpu.shim.core import SharedRegion
+    import multiprocessing as mp
+    r = SharedRegion(path, limits=[0], core_pcts=[50])
+    r.register()
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_foreign_ns_proc,
+                    args=(path, ready, resume, done))
+    p.start()
+    t0 = time.monotonic()
+    while not os.path.exists(ready):
+        if time.monotonic() - t0 > 30:
+            q.put(("error", "foreign proc never became ready"))
+            return
+        time.sleep(0.02)
+    with open(ready) as f:
+        if f.read() == "skip":
+            q.put(("skip", "unshare(CLONE_NEWPID) not permitted"))
+            p.join(10)
+            return
+    both = r.active_procs()
+    time.sleep(0.8)  # > window with no foreign heartbeat
+    paused = r.active_procs()
+    with open(resume, "w") as f:
+        f.write("go")
+    t0 = time.monotonic()
+    while not os.path.exists(done):
+        if time.monotonic() - t0 > 30:
+            q.put(("error", "foreign proc never resumed"))
+            return
+        time.sleep(0.02)
+    resumed = r.active_procs()
+    p.join(10)
+    q.put(("ok", (both, paused, resumed)))
+
+
+def test_foreign_liveness_resume_regates(tmp_path):
+    """Expiry AND resume of the foreign-liveness window: contention
+    drops while the foreign tenant is silent past the window and
+    re-engages the moment it heartbeats again (the DEFAULT policy
+    re-gates)."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    args = [str(tmp_path / n) for n in
+            ("shr.cache", "ready", "resume", "done")]
+    p = ctx.Process(target=_foreign_window_parent, args=(*args, q))
+    p.start()
+    status, payload = q.get(timeout=120)
+    p.join(timeout=30)
+    if status == "skip":
+        pytest.skip(payload)
+    assert status == "ok", payload
+    both, paused, resumed = payload
+    assert both == 2, f"expected 2 active at start, got {both}"
+    assert paused == 1, f"paused foreign tenant still counted: {paused}"
+    assert resumed == 2, f"resumed tenant not re-counted: {resumed}"
