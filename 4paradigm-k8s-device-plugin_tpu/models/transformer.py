@@ -59,6 +59,18 @@ class TransformerConfig:
                                  max_seq=2048)
 
     @staticmethod
+    def llama3_8b() -> "TransformerConfig":
+        """Full Llama-3-8B geometry (32 layers, GQA 32/8, 128k vocab,
+        rope 500k): the multi-chip serving target (BASELINE config 5 —
+        tp-sharded over a v5e-8 slice; the full geometry's sharded
+        lowering is exercised abstractly by
+        tests/test_multichip_e2e.py::test_llama3_8b_sharded_lowering,
+        runtime sharding on tiny shapes by the dryrun)."""
+        return TransformerConfig(vocab=128256, dim=4096, n_layers=32,
+                                 n_heads=32, n_kv_heads=8, hidden=14336,
+                                 max_seq=8192, rope_theta=500000.0)
+
+    @staticmethod
     def bench() -> "TransformerConfig":
         """Llama-3-8B layer geometry, reduced vocab + depth so 4 tenant
         replicas (~1 GB bf16 each) co-reside on one 16 GB v5e chip with

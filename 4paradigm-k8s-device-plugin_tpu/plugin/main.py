@@ -137,6 +137,10 @@ class Daemon:
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # Tenant programs survive broker respawns via the persistent XLA
+        # compile cache on the hostPath lib dir.
+        env.setdefault("VTPU_COMPILE_CACHE_DIR",
+                       os.path.join(self.cfg.host_lib_dir, "xla-cache"))
         try:
             self._runtime_proc = subprocess.Popen(cmd, env=env)
         except OSError as e:

@@ -1000,6 +1000,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         except RuntimeError:
             pass
+    # Persistent XLA compile cache: tenant programs survive broker
+    # restarts (compiles cost seconds per program; the daemon respawns
+    # brokers on crash/SIGHUP).  Opt-in via env — node deployments point
+    # it at the hostPath lib dir.
+    cache_dir = os.environ.get("VTPU_COMPILE_CACHE_DIR")
+    if cache_dir:
+        import jax
+
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
+            # LRU-capped: an unbounded hostPath cache would grow with
+            # every tenant program ever seen until node disk pressure.
+            jax.config.update("jax_compilation_cache_max_size",
+                              4 * 2**30)
+        except (RuntimeError, OSError) as e:
+            log.warn("compile cache %s unavailable: %s", cache_dir, e)
     hbm = envspec.parse_quantity(ns.hbm_limit) if ns.hbm_limit != "0" else 0
     srv = make_server(ns.socket, hbm, ns.core_limit, ns.region,
                       ns.min_exec_cost_us)

@@ -30,17 +30,24 @@ from ..utils import envspec
 
 
 def find_regions(scan: Optional[str]) -> List[str]:
+    # *.chip<k> variants are the multi-chip broker's per-chip regions
+    # (runtime/server.py chip_region_path).
     if scan:
         pats = [os.path.join(scan, "*", "vtpushr.cache"),
-                os.path.join(scan, "*.cache")]
+                os.path.join(scan, "*", "vtpushr.cache.chip*"),
+                os.path.join(scan, "*.cache"),
+                os.path.join(scan, "*.cache.chip*"),
+                os.path.join(scan, "*.shr"),
+                os.path.join(scan, "*.shr.chip*")]
         out: List[str] = []
         for pat in pats:
             out.extend(sorted(glob.glob(pat)))
         return out
     env_path = os.environ.get(envspec.ENV_SHARED_CACHE)
     if env_path and os.path.exists(env_path):
-        return [env_path]
-    return sorted(glob.glob("/tmp/vtpu*.cache"))
+        return [env_path] + sorted(glob.glob(env_path + ".chip*"))
+    return sorted(glob.glob("/tmp/vtpu*.cache")
+                  + glob.glob("/tmp/vtpu*.cache.chip*"))
 
 
 def read_region(path: str, sweep_host: bool = False) -> Dict:

@@ -330,6 +330,11 @@ def start_broker(sock, region, hbm_limit, core_limit, quick):
     if quick:
         env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("VTPU_LOG_LEVEL", "1")
+    # One persistent compile cache across phases: the quota-phase broker
+    # reuses the free phase's XLA compilations (warmup time, not the
+    # measured windows).
+    env.setdefault("VTPU_COMPILE_CACHE_DIR",
+                   os.path.join(os.path.dirname(region), "xla-cache"))
     return subprocess.Popen(
         [sys.executable, "-m", "vtpu.runtime.server", "--socket", sock,
          "--hbm-limit", str(hbm_limit), "--core-limit", str(core_limit),

@@ -71,3 +71,20 @@ def test_resnet50_forward(batch):
     out = model.apply(variables, x, train=False)
     assert out.shape == (batch, 10)
     assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_llama3_8b_config_param_count():
+    """The full Llama-3-8B config reproduces the real model's parameter
+    count (~8.0B) — abstract shapes only, nothing materialises."""
+    import jax
+
+    from vtpu.models import transformer as tr
+
+    cfg = tr.TransformerConfig.llama3_8b()
+    shapes = jax.eval_shape(lambda: tr.init_params(
+        cfg, jax.random.PRNGKey(0)))
+    n = sum(int(np.prod(a.shape))
+            for a in jax.tree_util.tree_leaves(shapes))
+    assert 7.9e9 < n < 8.2e9, f"param count {n/1e9:.2f}B"
+    # GQA shapes: kv heads are 1/4 of q heads.
+    assert cfg.n_kv_heads * 4 == cfg.n_heads

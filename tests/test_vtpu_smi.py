@@ -54,6 +54,21 @@ def test_smi_table_and_scan(tmp_path):
     r.close()
 
 
+def test_smi_finds_per_chip_regions(tmp_path, monkeypatch):
+    """The multi-chip broker keeps one region per chip
+    (<region>.chip<k>); the monitor must see them all."""
+    from vtpu.shim.core import SharedRegion
+    from vtpu.tools.vtpu_smi import find_regions
+
+    for name in ("b.shr", "b.shr.chip1", "b.shr.chip2"):
+        r = SharedRegion(str(tmp_path / name), limits=[0], core_pcts=[0])
+        r.register()
+        r.close()
+    found = find_regions(str(tmp_path))
+    assert [os.path.basename(p) for p in found] == \
+        ["b.shr", "b.shr.chip1", "b.shr.chip2"]
+
+
 def test_smi_env_discovery(tmp_path):
     path = str(tmp_path / "b.cache")
     SharedRegion(path, limits=[MB]).close()
