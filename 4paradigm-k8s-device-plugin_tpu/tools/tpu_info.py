@@ -30,10 +30,15 @@ from ..utils import envspec
 
 def sample(region: SharedRegion, interval: float) -> List[Dict]:
     before = [region.device_stats(d) for d in range(region.ndevices)]
+    # Keyed by (pid, host_pid): the namespaced pid alone collides across
+    # containers (every pod's workload is its namespace's pid 1).
+    pbefore = {(p.pid, p.host_pid): list(p.busy_us)
+               for p in region.proc_stats()}
     t0 = time.monotonic()
     time.sleep(interval)
     elapsed_us = (time.monotonic() - t0) * 1e6
     out = []
+    procs_after = region.proc_stats()
     for d in range(region.ndevices):
         st = region.device_stats(d)
         busy_delta = st.busy_us - before[d].busy_us
@@ -42,6 +47,24 @@ def sample(region: SharedRegion, interval: float) -> List[Dict]:
         if st.limit_bytes == 0 and st.used_bytes == 0 and st.n_procs == 0 \
                 and busy_delta == 0:
             continue
+        # Per-process share of this device's window (the reference's
+        # nvmlDeviceGetProcessUtilization merge): which TENANT is
+        # consuming the granted share.
+        procs = []
+        for p in procs_after:
+            prev = pbefore.get((p.pid, p.host_pid))
+            # max(.., 0): a swept-and-recycled slot can report lower
+            # counters than the before-snapshot.
+            delta = max(p.busy_us[d] - (prev[d] if prev else 0), 0)
+            if delta <= 0 and not p.used_bytes[d]:
+                continue
+            procs.append({
+                "pid": int(p.pid), "host_pid": int(p.host_pid),
+                "hbm_used_bytes": int(p.used_bytes[d]),
+                "duty_cycle_pct": round(
+                    min(delta / elapsed_us * 100.0, 100.0), 1)
+                if elapsed_us > 0 else 0.0,
+            })
         out.append({
             "device": d,
             "hbm_used_bytes": int(st.used_bytes),
@@ -50,6 +73,7 @@ def sample(region: SharedRegion, interval: float) -> List[Dict]:
             "duty_cycle_pct": round(duty, 1),
             "core_limit_pct": int(st.core_limit_pct),
             "n_procs": int(st.n_procs),
+            "procs": procs,
         })
     return out
 
@@ -73,6 +97,11 @@ def render(devs: List[Dict]) -> str:
             f"{str(d['duty_cycle_pct']) + '%':<12} "
             f"{(str(d['core_limit_pct']) + '%') if d['core_limit_pct'] else '-':<10} "
             f"{d['n_procs']:<5}")
+        for p in d.get("procs", []):
+            lines.append(
+                f"       pid {p['pid']:<8} (host {p['host_pid']:<8}) "
+                f"{_gib(p['hbm_used_bytes']):<12} "
+                f"{str(p['duty_cycle_pct']) + '%':<8}")
     if len(lines) == 2:
         lines.append("(no active vTPU devices)")
     return "\n".join(lines)

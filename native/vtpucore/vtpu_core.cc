@@ -287,7 +287,13 @@ int vtpu_proc_register(vtpu_region* r, pid_t host_pid) {
   sweep_locked(g, 0);
   int slot = -1;
   for (int s = 0; s < VTPU_MAX_PROCS; s++) {
-    if (g->proc[s].active && g->proc[s].pid == me) {
+    /* Idempotency must compare the PID NAMESPACE too: every container's
+     * workload tends to be its namespace's pid 1, and matching on the
+     * bare pid would silently merge two tenants into one slot
+     * (mis-attributing usage and letting one tenant's exit release the
+     * other's accounting). */
+    if (g->proc[s].active && g->proc[s].pid == me &&
+        g->proc[s].ns_id == my_ns_id()) {
       slot = s; /* idempotent */
       break;
     }

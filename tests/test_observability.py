@@ -118,6 +118,10 @@ def test_tpu_info_sample_shows_quota_and_duty(tmp_path):
     assert d0["hbm_used_bytes"] == 10 * MB
     assert d0["core_limit_pct"] == 50
     assert 5.0 < d0["duty_cycle_pct"] <= 100.0
+    # Per-process rows (which tenant consumes the share): our own proc
+    # fed the busy time, so it must appear with a non-zero duty.
+    assert d0["procs"] and d0["procs"][0]["pid"] > 0
+    assert d0["procs"][0]["duty_cycle_pct"] > 0.0
     # Render doesn't crash and mentions the quota.
     assert "GiB" in tpu_info.render(devs)
 
