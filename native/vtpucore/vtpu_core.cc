@@ -322,7 +322,7 @@ void vtpu_proc_deregister(vtpu_region* r) {
   if (r->my_slot < 0) return;
   if (lock_region(g) != 0) return;
   ProcSlot* p = &g->proc[r->my_slot];
-  if (p->active && p->pid == getpid()) {
+  if (p->active && p->pid == getpid() && p->ns_id == my_ns_id()) {
     for (int d = 0; d < g->ndevices; d++) {
       uint64_t u = p->used_bytes[d];
       g->dev[d].used_bytes = u > g->dev[d].used_bytes
@@ -354,8 +354,13 @@ int vtpu_sweep_dead_host(vtpu_region* r) {
 }
 
 static ProcSlot* my_slot_locked(vtpu_region* r, Region* g) {
+  /* Ownership needs pid AND namespace: after a host-mode sweep reclaims
+   * a slot, another container's same-numbered pid can re-register into
+   * it — a bare pid compare would bill this process's usage into the
+   * foreign tenant's slot. */
   if (r->my_slot >= 0 && g->proc[r->my_slot].active &&
-      g->proc[r->my_slot].pid == getpid())
+      g->proc[r->my_slot].pid == getpid() &&
+      g->proc[r->my_slot].ns_id == my_ns_id())
     return &g->proc[r->my_slot];
   return NULL;
 }
