@@ -91,6 +91,13 @@ CHAIN_CACHE_CAP = 64
 # for EVERY tenant).  The session reader blocks past this, throttling
 # only that connection.
 MAX_PENDING_REPLIES = 128
+# Estimated device time queued per chip before dispatch pauses
+# (microseconds).  Replies go out at dispatch, so without this a
+# fast-sending tenant pool can pile tens of seconds of work onto the
+# device queue — measured on the relayed transport: ~8s of queued chains
+# collapsed throughput 13x (deep-queue pathologies), while a ~2s bound
+# keeps the device saturated (it only needs a few programs of runway).
+MAX_QUEUED_US = int(os.environ.get("VTPU_MAX_QUEUE_US", "4000000"))
 
 
 class Tenant:
@@ -189,6 +196,9 @@ class DeviceScheduler:
         self._rr_pos = 0
         self._completion_q: "queue.Queue" = queue.Queue()
         self._pool_us = 0.0  # unbilled device time (metering loop only)
+        # Estimated device time of dispatched-but-unretired items (the
+        # chip's queue depth in time units); guarded by self.mu.
+        self.queued_est_us = 0.0
         self._stop = False
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
@@ -238,6 +248,11 @@ class DeviceScheduler:
         """
         now = time.monotonic()
         soonest = None
+        if self.queued_est_us >= MAX_QUEUED_US:
+            # Enough runway queued on the device; check back shortly
+            # (retirements notify self.mu, so the wait usually ends
+            # early).
+            return None, now + 0.01
         n = len(self.rr)
         for i in range(n):
             idx = (self._rr_pos + i) % n
@@ -280,6 +295,7 @@ class DeviceScheduler:
             item.first_run = (item.steps, item.carry) not in \
                 item.exe.warmed
             self.inflight[name] = self.inflight.get(name, 0) + 1
+            self.queued_est_us += est
             self._rr_pos = (idx + 1) % n
             return item, soonest
         return None, soonest
@@ -348,16 +364,19 @@ class DeviceScheduler:
                     self.chip.region.rate_adjust(t.index,
                                                  -int(item.est_us))
                 item.session.complete_execute(item, metas, e, 0.0)
-                self._retire(t.name)
+                self._retire(item)
                 continue
             # Reply NOW — shapes are static; the device is still working.
             item.exe.warmed.add((item.steps, item.carry))
             item.session.complete_execute(item, metas, None, item.est_us)
             self._completion_q.put((item, t0, out_list))
 
-    def _retire(self, name: str) -> None:
+    def _retire(self, item: WorkItem) -> None:
         with self.mu:
+            name = item.tenant.name
             self.inflight[name] = max(self.inflight.get(name, 1) - 1, 0)
+            self.queued_est_us = max(self.queued_est_us - item.est_us,
+                                     0.0)
             self.mu.notify_all()
 
     # -- metering ----------------------------------------------------------
@@ -396,28 +415,31 @@ class DeviceScheduler:
                 exc = e
             t_obs = time.monotonic()
             lat_s = self.chip.calibrate_latency_us() / 1e6
-            avail_us = max(min(t_obs - prev_obs, t_obs - t0 - lat_s),
-                           0.0) * 1e6
+            obs_us = max(t_obs - prev_obs, 0.0) * 1e6
+            disp_us = max(t_obs - t0 - lat_s, 0.0) * 1e6
             prev_obs_before, prev_obs = prev_obs, t_obs
-            # Pooled attribution: when observation latency fluctuates
-            # (batched readiness events), items can be observed with a
-            # ~zero gap right after a long block — billing them zero
-            # would refund their charges and decay their EMAs toward
-            # nothing, letting a pipelining tenant evade its core quota.
-            # Instead the idle-stripped window feeds a pool and every
-            # item bills from it, capped per item at 4x its estimate.
-            # What ENTERS the pool is capped by what the window could
-            # plausibly contain — this item plus the currently
-            # backlogged ones, each at 4x estimate — so a first-run XLA
-            # compile (seconds) cannot flood the pool and surcharge the
-            # next dozen items.
             backlog = self._completion_q.qsize()
+            t = item.tenant
+            prev_ema = t.cost_ema.get(item.key, 5000.0)
+            per_step = None  # EMA sample (None = don't learn)
             if item.first_run:
                 # Warmup execution: window is program-load/compile noise.
-                avail_us = 0.0
                 busy_us = item.est_us
-            else:
-                avail_us = min(avail_us,
+            elif obs_us <= disp_us:
+                # CONTINUOUS LOAD: the ready-to-ready gap is exact
+                # device time (constant observation latency cancels).
+                # Pooled attribution: when observation latency
+                # fluctuates (batched readiness events), items can be
+                # observed with a ~zero gap right after a long block —
+                # billing them zero would refund their charges and decay
+                # their EMAs toward nothing, letting a pipelining tenant
+                # evade its core quota.  The window feeds a pool and
+                # every item bills from it, capped per item at 4x its
+                # estimate; what ENTERS is capped by what the window
+                # could plausibly contain (this item + the backlog) so
+                # an anomalous window cannot surcharge the next dozen
+                # items.
+                avail_us = min(obs_us,
                                item.est_us * 4.0 * (1 + backlog))
                 self._pool_us = min(self._pool_us + avail_us,
                                     2_000_000.0)
@@ -426,7 +448,32 @@ class DeviceScheduler:
                              * item.steps)
                 busy_us = min(self._pool_us, cap_us)
                 self._pool_us -= busy_us
-            t = item.tenant
+                per_step = busy_us / item.steps
+            else:
+                # SPARSE (queue restarted): any pooled window credit is
+                # stale — the device provably idled — and must not be
+                # billed to a later tenant's continuous item.
+                self._pool_us = 0.0
+                # Only the dispatch-to-ready
+                # measurement exists, and on relayed transports it
+                # overshoots by an uncalibratable 60-120ms.  Billing it
+                # raw makes the estimate creep up, which makes dispatch
+                # sparser, which inflates the next measurement — a
+                # positive feedback loop that halved long-run throughput
+                # (measured).  Bill the estimate instead (learned from
+                # loaded measurements), and learn UP from a sparse
+                # sample only on strong evidence (>3x est — a genuinely
+                # bigger program; steady-state sparse overshoot measures
+                # up to ~2.2x true cost on the relayed transport), never
+                # from that overshoot.
+                busy_us = min(disp_us,
+                              max(item.est_us,
+                                  float(self.state.min_exec_cost_us)
+                                  * item.steps))
+                if disp_us > 3.0 * item.est_us:
+                    per_step = disp_us / item.steps
+                else:
+                    per_step = min(disp_us / item.steps, prev_ema)
             if exc is not None:
                 t.async_error = exc
             self.chip.region.busy_add(t.index, int(busy_us))
@@ -441,25 +488,22 @@ class DeviceScheduler:
                 self.chip.region.rate_adjust(
                     t.index,
                     int(min(charged, item.est_us * 4.0) - item.est_us))
-            per_step = busy_us / item.steps
-            # Growth-clamped EMA — INCLUDING the first sample: a
-            # program's first run embeds its XLA compile (seconds
-            # against a tunnel transport), and seeding the estimate
-            # with it raw would throttle the tenant for the next ~15
-            # executes (measured: est=6.9s for a 115ms chain).  From
-            # the 5ms default the clamp still converges on any real
-            # cost exponentially (x4 per observation).
-            prev = t.cost_ema.get(item.key, 5000.0)
-            t.cost_ema[item.key] = (prev * 0.7
-                                    + min(per_step, prev * 4.0) * 0.3)
+            if per_step is not None:
+                # Growth-clamped EMA — INCLUDING the first learned
+                # sample: seeding raw would let one outlier (compile,
+                # transport stall) throttle the tenant for ~15 executes.
+                # From the 5ms default the clamp still converges on any
+                # real cost exponentially (x4 per observation).
+                t.cost_ema[item.key] = (prev_ema * 0.7
+                                        + min(per_step, prev_ema * 4.0)
+                                        * 0.3)
             t.executions += item.steps
             log.debug(
-                "meter %s: est=%.0fus busy=%.0fus avail=%.0fus "
-                "pool=%.0fus backlog=%d obs_gap=%.0fus disp_gap=%.0fus",
-                t.name, item.est_us, busy_us, avail_us, self._pool_us,
-                backlog, (t_obs - prev_obs_before) * 1e6,
-                (t_obs - t0) * 1e6)
-            self._retire(t.name)
+                "meter %s: est=%.0fus busy=%.0fus pool=%.0fus "
+                "backlog=%d obs_gap=%.0fus disp_gap=%.0fus",
+                t.name, item.est_us, busy_us, self._pool_us,
+                backlog, obs_us, disp_us)
+            self._retire(item)
 
     def stop(self):
         self._stop = True

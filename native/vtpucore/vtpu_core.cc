@@ -501,12 +501,22 @@ uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
   refill_locked(ds, now_ns());
   uint64_t wait_ns = 0;
   /* A cost larger than the burst cap could never be admitted by a
-   * tokens >= cost test (tokens are clamped at the cap); admit it once
-   * the bucket is full and let it run deeply negative — later acquires
-   * then wait while the debt is paid back, which keeps the long-run
-   * average at the cap. */
+   * tokens >= cost test (tokens are clamped at the cap), so `need` is
+   * clamped to the cap and then reduced to the admission fraction
+   * below; the FULL cost is always debited, so later acquires wait
+   * while the debt (up to cost - cap/4) is paid back, keeping the
+   * long-run average at the cap.
+   *
+   * FRACTIONAL admission: a quarter of the cost banked admits (the full
+   * cost is still debited, so the long-run rate is unchanged — the
+   * bucket just swings negative by up to 3/4 of one program).  Whole-
+   * cost admission made co-tenant buckets phase-lock on big chained
+   * programs: all waiting to bank ~150ms simultaneously while the chip
+   * idled, costing ~25% aggregate on sustained runs (measured). */
   int64_t need = (int64_t)cost_us < kBurstCapUs ? (int64_t)cost_us
                                                 : kBurstCapUs;
+  need /= 4;
+  if (need < 1) need = 1;
   if (priority <= 0 || ds->tokens_us >= need) {
     /* High-priority tasks may borrow (run the bucket negative); they still
      * consume, so background tenants pay it back later. */
