@@ -131,8 +131,8 @@ def test_sigkill_reclaim(region_path):
 def test_rate_limiter_throttles(region_path):
     with SharedRegion(region_path, limits=[0], core_pcts=[50]) as r:
         r.register()
-        # Drain the initial burst allowance.
-        r.rate_block(0, 250_000)
+        # Drain the initial burst allowance (400ms cap).
+        r.rate_block(0, 400_000)
         # 200ms of device time at a 50% cap needs >= ~400ms of wall time.
         t0 = time.monotonic()
         for _ in range(4):
@@ -151,7 +151,7 @@ def test_rate_limiter_unlimited_is_free(region_path):
 
 def test_high_priority_borrows(region_path):
     with SharedRegion(region_path, limits=[0], core_pcts=[10]) as r:
-        r.rate_block(0, 250_000)  # drain burst
+        r.rate_block(0, 400_000)  # drain burst
         t0 = time.monotonic()
         for _ in range(5):
             r.rate_block(0, 100_000, priority=0)
@@ -162,7 +162,7 @@ def test_high_priority_borrows(region_path):
 
 def test_rate_adjust_credits_back(region_path):
     with SharedRegion(region_path, limits=[0], core_pcts=[50]) as r:
-        r.rate_block(0, 250_000)  # drain burst
+        r.rate_block(0, 400_000)  # drain burst
         # Estimate 100ms, actual 10ms -> credit 90ms back.
         r.rate_block(0, 100_000)
         r.rate_adjust(0, -90_000)

@@ -127,7 +127,7 @@ def test_execute_throttling(tmp_path):
         c = RuntimeClient(sock, tenant="slow")
         exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
         h = c.put(np.ones(4, np.float32))
-        for _ in range(30):     # drain the 250ms burst at 10ms/charge
+        for _ in range(50):     # drain the 400ms burst at 10ms/charge
             exe(h)
         t0 = time.monotonic()
         for _ in range(10):     # 100ms charged at 25% -> >= ~0.4s
