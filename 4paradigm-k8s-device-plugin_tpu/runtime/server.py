@@ -95,7 +95,7 @@ MAX_PENDING_REPLIES = 128
 # (microseconds).  Replies go out at dispatch, so without this a
 # fast-sending tenant pool can pile tens of seconds of work onto the
 # device queue — measured on the relayed transport: ~8s of queued chains
-# collapsed throughput 13x (deep-queue pathologies), while a ~2s bound
+# collapsed throughput 13x (deep-queue pathologies), while a ~4s bound
 # keeps the device saturated (it only needs a few programs of runway).
 MAX_QUEUED_US = int(os.environ.get("VTPU_MAX_QUEUE_US", "4000000"))
 

@@ -262,9 +262,9 @@ static int sc_throttle(const char* dir, const char* shr) {
   setenv("MOCK_OUT_BYTES", "4096", 1);
   Env env = setup(dir, shr);
 
-  /* Warmup drains the 250ms burst allowance (net drain is cost*(1-pct)
-   * = 5ms/exec, so ~50 rounds) and trains the latency EMA. */
-  for (int i = 0; i < 55; i++) run_once(env);
+  /* Warmup drains the 400ms burst allowance (net drain is cost*(1-pct)
+   * = 5ms/exec, so ~80 rounds) and trains the latency EMA. */
+  for (int i = 0; i < 100; i++) run_once(env);
   double t0 = mono_s();
   for (int i = 0; i < 15; i++) run_once(env);
   double elapsed = mono_s() - t0;
