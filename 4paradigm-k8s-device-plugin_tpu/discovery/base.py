@@ -44,8 +44,13 @@ class ChipBackend(abc.ABC):
         again.  Returns when ``stop`` is set.  Mirrors the reference's
         XID event loop (nvidia.go:166-237) with polling."""
         import os
-        interval = float(os.environ.get("VTPU_HEALTH_INTERVAL",
-                                        self.health_interval))
+        try:
+            interval = float(os.environ.get("VTPU_HEALTH_INTERVAL",
+                                            self.health_interval))
+        except ValueError:
+            # A malformed tuning knob must not escape into the daemon's
+            # catch-all (which marks the whole node unhealthy).
+            interval = self.health_interval
         fails = {c.uuid: 0 for c in chips}
         down = set()
         while not stop.wait(interval):

@@ -130,8 +130,16 @@ class PjrtChipBackend(ChipBackend):
         holds it, which must never read as a fault)."""
         import time as _time
         now = _time.monotonic()
-        if self._probe_result is None or now - self._probe_at > \
-                self._PROBE_TTL:
+        # The cache must expire faster than the poll interval, or one
+        # failed enumeration would be re-counted as several
+        # "consecutive" failures and defeat the debounce threshold.
+        try:
+            interval = float(os.environ.get("VTPU_HEALTH_INTERVAL",
+                                            self.health_interval))
+        except ValueError:
+            interval = self.health_interval
+        ttl = min(self._PROBE_TTL, interval * 0.8)
+        if self._probe_result is None or now - self._probe_at > ttl:
             self._probe_result = enumerate_via_pjrt_full(timeout=60.0)
             self._probe_at = now
         raw, stderr = self._probe_result

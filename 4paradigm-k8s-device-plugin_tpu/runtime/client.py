@@ -76,7 +76,7 @@ class RuntimeClient:
         self._ids = itertools.count()
         spec = envspec.quota_from_env()
         self.tenant = tenant or os.environ.get(
-            "VTPU_TENANT", f"pid{os.getpid()}")
+            "VTPU_TENANT", self._default_tenant())
         self.priority = spec.task_priority if priority is None else priority
         hello = {"kind": P.HELLO, "tenant": self.tenant,
                  "priority": self.priority,
@@ -102,6 +102,20 @@ class RuntimeClient:
         resp = self._rpc(hello)
         self.tenant_index = resp["tenant_index"]
         self.chip = resp.get("chip", 0)
+
+    @staticmethod
+    def _default_tenant() -> str:
+        """Unique-per-container fallback identity: every pod's workload
+        tends to be its namespace's pid 1, so a bare pid would merge two
+        pods into ONE broker tenant (shared quota slot, shared array
+        namespace — an isolation breach).  hostname (the pod name in
+        k8s) + pid-namespace inode + pid disambiguates."""
+        import socket as _socket
+        try:
+            ns = os.stat("/proc/self/ns/pid").st_ino
+        except OSError:
+            ns = 0
+        return f"{_socket.gethostname()}-{ns}-pid{os.getpid()}"
 
     @staticmethod
     def _grant_device() -> int:

@@ -374,7 +374,8 @@ class DeviceScheduler:
     def _retire(self, item: WorkItem) -> None:
         with self.mu:
             name = item.tenant.name
-            self.inflight[name] = max(self.inflight.get(name, 1) - 1, 0)
+            if name in self.inflight:  # forgotten tenants stay forgotten
+                self.inflight[name] = max(self.inflight[name] - 1, 0)
             self.queued_est_us = max(self.queued_est_us - item.est_us,
                                      0.0)
             self.mu.notify_all()
@@ -569,7 +570,13 @@ class RuntimeState:
                  min_exec_cost_us: int = 0):
         import jax
         self.jax = jax
-        self.devices = list(jax.devices())
+        # The broker's "device" axis is CHIPS: PJRT devices are
+        # TensorCores, and multi-core generations (v4/v5p) expose two
+        # per chip.  Group by chip coords so HELLO's device index (the
+        # grant's chip, from TPU_VISIBLE_CHIPS) lands on the right
+        # silicon; each ChipState drives its chip's first core (the
+        # core-split path handles per-core pinning via the interposer).
+        self.devices = self._chip_leaders(jax.devices())
         self.region_path = region_path
         # Spawn-time limits are only DEFAULTS: each tenant's HELLO
         # carries its own Allocate-time grant (reference per-vdevice
@@ -589,6 +596,16 @@ class RuntimeState:
         # never stalls HELLO/compile/release of tenants on other chips.
         self.chips_mu = threading.Lock()
         self.chip(0)  # chip 0 eagerly: fail fast if the device is gone
+
+    @staticmethod
+    def _chip_leaders(devs):
+        groups = {}
+        for d in devs:
+            coords = tuple(getattr(d, "coords", ()) or ())
+            key = coords if coords else ("id", d.id)
+            groups.setdefault(key, []).append(d)
+        return [sorted(g, key=lambda d: d.id)[0]
+                for _, g in sorted(groups.items(), key=lambda kv: str(kv[0]))]
 
     def chip_region_path(self, index: int) -> str:
         # Chip 0 keeps the bare path (vtpu-smi/back-compat); others get
@@ -631,6 +648,9 @@ class RuntimeState:
                         f"tenant slots exhausted on chip {chip.index}")
                 t = Tenant(name, index, priority, oversubscribe,
                            chip=chip)
+                # A recycled slot must not pass the previous grant's
+                # bucket debt/burst or duty counters to this tenant.
+                chip.region.reset_slot(index)
                 # Seed THIS tenant's grant into its slot (first HELLO
                 # wins for the tenant's lifetime; reconnects reuse it).
                 chip.region.set_mem_limit(
@@ -650,6 +670,13 @@ class RuntimeState:
             t.connections -= 1
             if t.connections > 0:
                 return False
+        # Let the metering thread retire everything this tenant has
+        # dispatched BEFORE the slot index is freed: late retirements
+        # would bill busy/bucket corrections into whoever claims the
+        # slot next.  (All items are dispatched by now — the session
+        # drained its replies — so inflight-only quiesce suffices.)
+        t.chip.scheduler.quiesce(t.name)
+        with self.mu:
             self.tenants.pop(t.name, None)
             t.chip.scheduler.forget_tenant(t.name)
             return True
@@ -974,7 +1001,9 @@ class TenantSession(socketserver.BaseRequestHandler):
 
     def _stats(self):
         out = {}
-        for name, t in self.state.tenants.items():
+        with self.state.mu:
+            tenants = list(self.state.tenants.items())
+        for name, t in tenants:
             st = t.chip.region.device_stats(t.index)
             out[name] = {
                 "index": t.index,

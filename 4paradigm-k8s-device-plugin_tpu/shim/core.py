@@ -104,6 +104,7 @@ def load() -> ctypes.CDLL:
                                         ctypes.c_int32]
     lib.vtpu_set_mem_limit.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                        ctypes.c_uint64]
+    lib.vtpu_reset_slot.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.vtpu_busy_add.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                   ctypes.c_uint64]
     lib.vtpu_region_ndevices.restype = ctypes.c_int
@@ -207,6 +208,10 @@ class SharedRegion:
     def set_mem_limit(self, dev: int, limit_bytes: int) -> None:
         """Re-seed one slot's HBM cap (broker per-grant quotas)."""
         self.lib.vtpu_set_mem_limit(self.handle, dev, int(limit_bytes))
+
+    def reset_slot(self, dev: int) -> None:
+        """Reset a recycled tenant slot's bucket/busy counters."""
+        self.lib.vtpu_reset_slot(self.handle, dev)
 
     def busy_add(self, dev: int, us: int) -> None:
         """Record completed device time (duty-cycle source)."""

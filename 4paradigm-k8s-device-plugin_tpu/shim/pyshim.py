@@ -242,7 +242,15 @@ def install_py_enforcement() -> bool:
         return enf.clamp_dev(int(getattr(d, "id", 0) or 0))
 
     def _target_dev(device) -> int:
-        """Ordinal of a device_put target (Device, Sharding, or None)."""
+        """Ordinal of a device_put target (Device, Sharding, or None —
+        None resolves through jax's default-device config so admission
+        is checked against the quota of the device the bytes will
+        actually land on (`with jax.default_device(...)` workloads)."""
+        if device is None:
+            try:
+                device = jax.config.jax_default_device
+            except AttributeError:
+                device = None
         if device is None:
             return 0
         if hasattr(device, "id"):

@@ -47,7 +47,13 @@ class MetricsState:
 
     def collect(self) -> List[Dict]:
         out = []
-        for path in self.paths():
+        live_paths = set(self.paths())
+        # Regions themselves vanish under pod churn (per-pod monitor-mode
+        # caches): drop their samples or _prev grows without bound.
+        with self.mu:
+            for k in [k for k in self._prev if k[0] not in live_paths]:
+                del self._prev[k]
+        for path in live_paths:
             try:
                 region = SharedRegion(path)
             except OSError:

@@ -1290,11 +1290,18 @@ static PJRT_Error* w_Device_MemoryStats(PJRT_Device_MemoryStats_Args* args) {
  * (the reference fakes PCI bus ids the same way:
  * assigning_virtual_pcibusID, SURVEY §2.9e). */
 
+/* One immutable attribute build.  Rebuilds (ordinal changed on a
+ * re-filter) allocate a NEW block and deliberately leak the old one:
+ * PJRT callers may hold the returned pointers indefinitely, and the
+ * leak is bounded by the number of re-filters (~1 per process). */
+struct VirtAttrs {
+  int64_t coords[3];
+  std::vector<PJRT_NamedValue> attrs;
+};
+
 struct VirtDesc {
   int ord = 0;
-  int64_t coords[3] = {0, 0, 0};
-  bool attrs_built = false;
-  std::vector<PJRT_NamedValue> attrs;
+  VirtAttrs* built = nullptr;  /* owned; old blocks intentionally leaked */
 };
 
 static std::unordered_map<PJRT_DeviceDescription*, VirtDesc>& desc_virt() {
@@ -1325,7 +1332,7 @@ static void register_desc_ords_locked(
       VirtDesc& vd = desc_virt()[gd.device_description];
       if (vd.ord != (int)i) {
         vd.ord = (int)i;
-        vd.attrs_built = false;  /* rebuild with the new ordinal */
+        vd.built = nullptr;  /* rebuild; old block intentionally leaked */
       }
     }
   }
@@ -1359,26 +1366,27 @@ static PJRT_Error* w_DeviceDescription_Attributes(
   auto it = desc_virt().find(args->device_description);
   if (it == desc_virt().end()) return nullptr;
   VirtDesc& vd = it->second;
-  if (!vd.attrs_built) {
-    vd.coords[0] = vd.ord;
-    vd.coords[1] = 0;
-    vd.coords[2] = 0;
-    vd.attrs.assign(args->attributes,
+  if (vd.built == nullptr) {
+    VirtAttrs* b = new VirtAttrs();
+    b->coords[0] = vd.ord;
+    b->coords[1] = 0;
+    b->coords[2] = 0;
+    b->attrs.assign(args->attributes,
                     args->attributes + args->num_attributes);
-    for (PJRT_NamedValue& nv : vd.attrs) {
+    for (PJRT_NamedValue& nv : b->attrs) {
       std::string name(nv.name, nv.name_size);
       if (name == "coords" && nv.type == PJRT_NamedValue_kInt64List) {
-        nv.int64_array_value = vd.coords;
+        nv.int64_array_value = b->coords;
         nv.value_size = nv.value_size < 3 ? nv.value_size : 3;
       } else if (name == "core_on_chip" &&
                  nv.type == PJRT_NamedValue_kInt64) {
         nv.int64_value = 0;
       }
     }
-    vd.attrs_built = true;
+    vd.built = b;
   }
-  args->attributes = vd.attrs.data();
-  args->num_attributes = vd.attrs.size();
+  args->attributes = vd.built->attrs.data();
+  args->num_attributes = vd.built->attrs.size();
   return nullptr;
 }
 

@@ -580,6 +580,20 @@ void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct) {
   unlock_region(g);
 }
 
+void vtpu_reset_slot(vtpu_region* r, int dev) {
+  /* Recycled tenant slot (broker): the departing tenant's bucket debt /
+   * banked burst and cumulative busy time must not transfer to the next
+   * grant assigned the same index. */
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) return;
+  if (lock_region(g) != 0) return;
+  g->dev[dev].tokens_us = kBurstCapUs;
+  g->dev[dev].last_refill_ns = now_ns();
+  g->dev[dev].busy_us = 0;
+  g->dev[dev].peak_bytes = g->dev[dev].used_bytes;
+  unlock_region(g);
+}
+
 void vtpu_set_mem_limit(vtpu_region* r, int dev, uint64_t limit_bytes) {
   /* Runtime re-seed of one device/tenant slot's HBM cap: the broker
    * applies each tenant's own Allocate-time grant at HELLO instead of a

@@ -139,6 +139,10 @@ void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct);
 /* Re-seed one slot's HBM cap at runtime (broker per-grant quotas). */
 void vtpu_set_mem_limit(vtpu_region* r, int dev, uint64_t limit_bytes);
 
+/* Reset a recycled tenant slot's bucket + busy counters (broker): the
+ * previous grant's debt/burst/duty must not transfer to the next. */
+void vtpu_reset_slot(vtpu_region* r, int dev);
+
 /* Record `us` of completed device time on `dev` (all execute paths call
  * this on completion, independent of rate gating) — the duty-cycle
  * source for monitors. */
