@@ -604,8 +604,11 @@ class RuntimeState:
             coords = tuple(getattr(d, "coords", ()) or ())
             key = coords if coords else ("id", d.id)
             groups.setdefault(key, []).append(d)
+        # Keys are homogeneous (all coord tuples, or all ("id", n)), so
+        # native tuple comparison orders chips numerically — a string
+        # sort would put chip 10 before chip 2.
         return [sorted(g, key=lambda d: d.id)[0]
-                for _, g in sorted(groups.items(), key=lambda kv: str(kv[0]))]
+                for _, g in sorted(groups.items())]
 
     def chip_region_path(self, index: int) -> str:
         # Chip 0 keeps the bare path (vtpu-smi/back-compat); others get
