@@ -72,7 +72,17 @@ def recv_msg(sock: socket.socket) -> Dict[str, Any]:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n > MAX_FRAME:
         raise ProtocolError(f"frame too large: {n}")
-    return msgpack.unpackb(_recv_exact(sock, n), raw=False)
+    payload = _recv_exact(sock, n)
+    try:
+        msg = msgpack.unpackb(payload, raw=False)
+    except Exception as e:  # noqa: BLE001 - anything undecodable
+        # Surface as ProtocolError so receivers' connection-teardown
+        # paths run (an escaped msgpack exception would skip tenant
+        # cleanup in the broker — slot/HBM leak).
+        raise ProtocolError(f"undecodable frame: {e}") from e
+    if not isinstance(msg, dict):
+        raise ProtocolError(f"frame is not a map: {type(msg).__name__}")
+    return msg
 
 
 def reply_err(sock: socket.socket, code: str, msg: str) -> None:

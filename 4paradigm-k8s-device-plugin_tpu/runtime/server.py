@@ -786,8 +786,20 @@ class TenantSession(socketserver.BaseRequestHandler):
             while self.pending > 0:
                 self.pending_cond.wait(timeout=0.5)
 
-    def handle(self):  # noqa: C901 - protocol dispatch
-        sock = self.request
+    def handle(self):
+        tenant_box: List[Optional[Tenant]] = [None]
+        try:
+            self._serve(self.request, tenant_box)
+        finally:
+            # Teardown must run no matter HOW the session died (a
+            # decode bug escaping the loop once leaked the tenant's
+            # slot and HBM accounting forever).
+            self._drain()
+            t = tenant_box[0]
+            if t is not None and self.state.release_tenant(t):
+                self._cleanup(t)
+
+    def _serve(self, sock, tenant_box):  # noqa: C901 - protocol dispatch
         tenant: Optional[Tenant] = None
         import numpy as np
         jax = self.state.jax
@@ -808,6 +820,7 @@ class TenantSession(socketserver.BaseRequestHandler):
                         hbm_limit=int(hbm) if hbm is not None else None,
                         core_limit=int(core) if core is not None
                         else None)
+                    tenant_box[0] = tenant
                     self._send({"ok": True, "tenant_index": tenant.index,
                                 "chip": tenant.chip.index})
                     continue
@@ -910,9 +923,6 @@ class TenantSession(socketserver.BaseRequestHandler):
                 log.warn("tenant %s request failed: %s",
                          tenant.name if tenant else "?", e)
                 self._send_err("INTERNAL", f"{type(e).__name__}: {e}")
-        self._drain()
-        if tenant is not None and self.state.release_tenant(tenant):
-            self._cleanup(tenant)
 
     def drop_array(self, t: Tenant, aid: str) -> int:
         """Caller must hold t.mu."""

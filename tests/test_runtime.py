@@ -359,6 +359,77 @@ def test_throttled_chip_does_not_slow_other_chip(tmp_path):
         srv.server_close()
 
 
+def test_malformed_frames_do_not_kill_broker(broker):
+    """Garbage on one connection (bad msgpack, oversized frame header,
+    truncated frame, unknown kind, wrong field types) must only affect
+    that connection — other tenants keep working."""
+    import socket as sk
+    import struct
+
+    from vtpu.runtime import protocol as P
+
+    good = RuntimeClient(broker, tenant="good")
+    h = good.put(np.ones(4, np.float32))
+
+    # 1. not-msgpack payload
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.connect(broker)
+    s.sendall(struct.pack("<I", 5) + b"\xff\xfe\xfd\xfc\xfb")
+    s.close()
+    # 2. frame length over MAX_FRAME
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.connect(broker)
+    s.sendall(struct.pack("<I", (1 << 30) + 1))
+    s.close()
+    # 3. truncated frame (claims 100 bytes, sends 3, disconnects)
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.connect(broker)
+    s.sendall(struct.pack("<I", 100) + b"abc")
+    s.close()
+    # 4. valid msgpack, bogus kinds/types — session must reply errors,
+    #    not die.
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.connect(broker)
+    P.send_msg(s, {"kind": "nope"})
+    resp = P.recv_msg(s)
+    assert resp["ok"] is False and resp["code"] == "NO_HELLO"
+    P.send_msg(s, {"kind": "hello", "tenant": "fuzz", "priority": "x"})
+    resp = P.recv_msg(s)
+    assert resp["ok"] is False  # bad priority type -> INTERNAL, not crash
+    P.send_msg(s, {"kind": "hello", "tenant": "fuzz"})
+    assert P.recv_msg(s)["ok"] is True
+    P.send_msg(s, {"kind": "put", "id": "x", "shape": [99999999],
+                   "dtype": "float32", "data": b"12"})
+    resp = P.recv_msg(s)
+    assert resp["ok"] is False  # shape/data mismatch -> error reply
+    s.close()
+
+    # 5. garbage AFTER a successful HELLO + PUT: the session dies but
+    #    teardown must still run — the tenant's slot and accounting are
+    #    released, not leaked (an escaped decode exception used to skip
+    #    cleanup entirely).
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.connect(broker)
+    P.send_msg(s, {"kind": "hello", "tenant": "fuzz-post"})
+    assert P.recv_msg(s)["ok"] is True
+    P.send_msg(s, {"kind": "put", "id": "y", "shape": [4],
+                   "dtype": "float32",
+                   "data": np.ones(4, np.float32).tobytes()})
+    assert P.recv_msg(s)["ok"] is True
+    s.sendall(struct.pack("<I", 5) + b"\xff\xfe\xfd\xfc\xfb")
+    s.close()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if "fuzz-post" not in good.stats():
+            break
+        time.sleep(0.1)
+    assert "fuzz-post" not in good.stats(), "leaked tenant slot"
+
+    # The good tenant is entirely unaffected.
+    np.testing.assert_array_equal(good.get(h.id), [1, 1, 1, 1])
+    good.close()
+
+
 def test_brokered_resnet_inference(broker):
     """A conv model (flax ResNetV2) through the broker: the chip broker
     serves any exportable jax program, not just the flagship
