@@ -184,6 +184,44 @@ def test_device_list_dir_fallback(tmp_path, monkeypatch):
     assert spec.visible_devices == ["TPU-fake-2", "TPU-fake-0"]
 
 
+def test_allocate_core_split_env_contract(tmp_path):
+    """Full gRPC wiring for --split-strategy=core on a v4 node: the
+    Allocate response pins the granted TensorCore via VTPU_CORE_INDICES
+    (the interposer's device-filter input) and carries the per-core HBM
+    cap; the broker socket is NOT advertised (hard partition, not
+    time-share)."""
+    cfg = Config(
+        device_plugin_path=str(tmp_path) + "/",
+        split_strategy="core",
+        host_lib_dir=str(tmp_path / "vtpu"),
+    )
+    backend = FakeChipBackend(num_chips=2, generation="v4")
+    specs = build_plugin_specs(cfg, backend)
+    plugin = VtpuDevicePlugin(specs[0], cfg, topology=backend.topology())
+    sim = KubeletSim(str(tmp_path)).start()
+    plugin.start(register=True)
+    try:
+        reg = sim.wait_registration()
+        assert reg.resource_name == "4paradigm.com/vtpu-core"
+        stub, ch = sim.plugin_stub(reg.endpoint)
+        req = pb.AllocateRequest()
+        # Grant core 1 of chip 0 specifically.
+        want = next(v for v in plugin.vdevices
+                    if v.core_index == 1)
+        req.container_requests.add(devicesIDs=[want.id])
+        resp = stub.Allocate(req)
+        envs = dict(resp.container_responses[0].envs)
+        assert envs["VTPU_CORE_INDICES"] == "1"
+        assert f"{envspec.ENV_HBM_LIMIT}_0" in envs
+        # Hard partition: no compute cap, no broker socket.
+        assert envspec.ENV_CORE_LIMIT not in envs
+        assert envspec.ENV_RUNTIME_SOCKET not in envs
+        ch.close()
+    finally:
+        plugin.stop()
+        sim.stop()
+
+
 def test_allocate_unknown_id_errors(env):
     sim, plugin, cfg = env
     reg = sim.wait_registration()

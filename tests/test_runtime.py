@@ -359,6 +359,49 @@ def test_throttled_chip_does_not_slow_other_chip(tmp_path):
         srv.server_close()
 
 
+def test_broker_populates_compile_cache(tmp_path):
+    """VTPU_COMPILE_CACHE_DIR: broker main() enables jax's persistent
+    compilation cache so tenant programs survive broker respawns."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache = tmp_path / "xc"
+    sock = str(tmp_path / "rt.sock")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["VTPU_COMPILE_CACHE_DIR"] = str(cache)
+    broker_proc = subprocess.Popen(
+        [sys.executable, "-m", "vtpu.runtime.server", "--socket", sock,
+         "--region", str(tmp_path / "rt.shr")], env=env)
+    try:
+        deadline = time.monotonic() + 60
+        while not os.path.exists(sock):
+            assert broker_proc.poll() is None, "broker died"
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        c = RuntimeClient(sock, tenant="cachetest")
+
+        # A compile big enough to clear the 0.5s min-compile-time bar
+        # on any host: a DEPENDENT chain of distinct ops (CSE cannot
+        # collapse it, unlike N identical `a @ a` terms).
+        def big(a):
+            for i in range(60):
+                a = a @ a + float(i)
+            return a.sum()
+
+        exe = c.compile(big, [np.ones((128, 128), np.float32)])
+        h = c.put(np.ones((128, 128), np.float32))
+        c.execute(exe.id, [h])
+        c.close()
+        assert cache.exists() and any(cache.iterdir()), \
+            "compile cache dir empty"
+    finally:
+        broker_proc.terminate()
+        broker_proc.wait(timeout=15)
+
+
 def test_malformed_frames_do_not_kill_broker(broker):
     """Garbage on one connection (bad msgpack, oversized frame header,
     truncated frame, unknown kind, wrong field types) must only affect
