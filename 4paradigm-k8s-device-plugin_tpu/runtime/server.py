@@ -602,11 +602,14 @@ class RuntimeState:
         groups = {}
         for d in devs:
             coords = tuple(getattr(d, "coords", ()) or ())
-            key = coords if coords else ("id", d.id)
+            # Coord-bearing chips sort first (as a group), id-only chips
+            # after; the leading discriminator keeps tuple comparison
+            # well-defined even on a backend where only SOME devices
+            # expose coords (int-vs-str compare would TypeError).
+            key = (0, *coords) if coords else (1, d.id)
             groups.setdefault(key, []).append(d)
-        # Keys are homogeneous (all coord tuples, or all ("id", n)), so
-        # native tuple comparison orders chips numerically — a string
-        # sort would put chip 10 before chip 2.
+        # Tuple comparison orders chips numerically — a string sort
+        # would put chip 10 before chip 2.
         return [sorted(g, key=lambda d: d.id)[0]
                 for _, g in sorted(groups.items())]
 
@@ -652,7 +655,8 @@ class RuntimeState:
                 t = Tenant(name, index, priority, oversubscribe,
                            chip=chip)
                 # A recycled slot must not pass the previous grant's
-                # bucket debt/burst or duty counters to this tenant.
+                # bucket debt/burst to this tenant (busy_us is
+                # intentionally inherited — it's a monotonic counter).
                 chip.region.reset_slot(index)
                 # Seed THIS tenant's grant into its slot (first HELLO
                 # wins for the tenant's lifetime; reconnects reuse it).
@@ -680,6 +684,14 @@ class RuntimeState:
         # drained its replies — so inflight-only quiesce suffices.)
         t.chip.scheduler.quiesce(t.name)
         with self.mu:
+            # The quiesce ran unlocked (it can take seconds): a client
+            # reconnecting under the same tenant name in that window
+            # attached to this Tenant object.  Tearing down anyway would
+            # drop the live session's arrays and recycle its slot index
+            # mid-use — abort instead; the reconnected session owns the
+            # state now.
+            if t.connections > 0 or self.tenants.get(t.name) is not t:
+                return False
             self.tenants.pop(t.name, None)
             t.chip.scheduler.forget_tenant(t.name)
             return True
@@ -811,6 +823,19 @@ class TenantSession(socketserver.BaseRequestHandler):
             kind = msg.get("kind")
             try:
                 if kind == P.HELLO:
+                    if tenant is not None:
+                        # Rebinding would orphan the first tenant's
+                        # connection count (teardown only releases the
+                        # last-bound tenant) — a retrying client could
+                        # leak slots until MAX_TENANTS is exhausted.
+                        # Drain first: the error reply must not overtake
+                        # in-flight execute replies (FIFO contract).
+                        self._drain()
+                        self._send_err(
+                            "ALREADY_BOUND",
+                            f"connection already bound to tenant "
+                            f"{tenant.name!r}; open a new connection")
+                        continue
                     hbm = msg.get("hbm_limit")
                     core = msg.get("core_limit")
                     tenant = self.state.tenant(

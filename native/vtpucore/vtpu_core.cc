@@ -582,14 +582,17 @@ void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct) {
 
 void vtpu_reset_slot(vtpu_region* r, int dev) {
   /* Recycled tenant slot (broker): the departing tenant's bucket debt /
-   * banked burst and cumulative busy time must not transfer to the next
-   * grant assigned the same index. */
+   * banked burst must not transfer to the next grant assigned the same
+   * index.  busy_us stays: it is exported as the Prometheus counter
+   * vtpu_busy_us_total, and a counter must never go backwards (rate()/
+   * increase() break, and the device total would fall below the summed
+   * per-proc busy counters).  Scrapers take deltas, so an inherited
+   * base offset is harmless. */
   Region* g = r->shm;
   if (dev < 0 || dev >= g->ndevices) return;
   if (lock_region(g) != 0) return;
   g->dev[dev].tokens_us = kBurstCapUs;
   g->dev[dev].last_refill_ns = now_ns();
-  g->dev[dev].busy_us = 0;
   g->dev[dev].peak_bytes = g->dev[dev].used_bytes;
   unlock_region(g);
 }

@@ -30,6 +30,23 @@ def test_busy_add_accumulates(tmp_path):
         r.close()
 
 
+def test_reset_slot_keeps_busy_monotonic(tmp_path):
+    """vtpu_busy_us_total is a Prometheus COUNTER: recycling a broker
+    tenant slot resets bucket/peak state but must never rewind the
+    cumulative busy counter (rate()/increase() break on decreases, and
+    the device total would fall below the per-proc sums)."""
+    r = make_region(tmp_path)
+    try:
+        r.register()
+        r.busy_add(0, 2000)
+        r.reset_slot(0)
+        assert r.device_stats(0).busy_us == 2000
+        r.busy_add(0, 500)
+        assert r.device_stats(0).busy_us == 2500
+    finally:
+        r.close()
+
+
 def _busy_tenant_proc(path, us):
     from vtpu.shim.core import SharedRegion
     rr = SharedRegion(path)

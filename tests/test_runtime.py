@@ -558,6 +558,101 @@ def test_multichip_churn_stress(broker):
     watcher.close()
 
 
+def test_second_hello_rejected(broker):
+    """Rebinding a connection to another tenant would leak the first
+    tenant's connection count (teardown releases only the last-bound
+    tenant) — the broker refuses instead (ADVICE r3)."""
+    import socket as sk
+
+    from vtpu.runtime import protocol as P
+
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.connect(broker)
+    P.send_msg(s, {"kind": P.HELLO, "tenant": "rebind"})
+    assert P.recv_msg(s)["ok"] is True
+    P.send_msg(s, {"kind": P.HELLO, "tenant": "rebind-two"})
+    resp = P.recv_msg(s)
+    assert resp["ok"] is False and resp["code"] == "ALREADY_BOUND"
+    s.close()
+    # The original binding tears down normally — no leaked slots.
+    watcher = RuntimeClient(broker, tenant="w")
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        st = watcher.stats()
+        if "rebind" not in st and "rebind-two" not in st:
+            break
+        time.sleep(0.1)
+    st = watcher.stats()
+    assert "rebind" not in st and "rebind-two" not in st
+    watcher.close()
+
+
+def test_reconnect_during_quiesce_keeps_state(tmp_path):
+    """A client reconnecting under the same tenant name while the old
+    session's teardown is quiescing must keep the tenant's arrays and
+    slot: teardown re-checks under the lock and aborts (ADVICE r3
+    medium — the unlocked quiesce window can span seconds)."""
+    sock = str(tmp_path / "rq.sock")
+    srv = make_server(sock, hbm_limit=8 * MB, core_limit=0,
+                      region_path=str(tmp_path / "rq.shr"))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        state = srv.state
+        c1 = RuntimeClient(sock, tenant="phoenix")
+        c1.put(np.arange(4, dtype=np.float32), "x")
+        chip = state.chips[0]
+        orig_quiesce = chip.scheduler.quiesce
+        reconnected = []
+
+        def racy_quiesce(name):
+            orig_quiesce(name)
+            if name == "phoenix" and not reconnected:
+                # Simulate the client reconnecting inside the teardown
+                # window (HELLO binds to the SAME Tenant object).
+                reconnected.append(RuntimeClient(sock, tenant="phoenix"))
+
+        chip.scheduler.quiesce = racy_quiesce
+        try:
+            c1.close()
+            deadline = time.monotonic() + 10.0
+            while not reconnected and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert reconnected, "teardown never reached quiesce"
+            c2 = reconnected[0]
+            # The reconnected session still owns the arrays and slot.
+            np.testing.assert_array_equal(c2.get("x"), [0, 1, 2, 3])
+            assert c2.stats()["phoenix"]["used_bytes"] == 16
+            c2.close()
+        finally:
+            chip.scheduler.quiesce = orig_quiesce
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_chip_leaders_mixed_coord_backends():
+    """Sorting chip groups must not TypeError when only some devices
+    expose coords (ADVICE r3): coord groups order numerically first,
+    id-only groups after."""
+    from vtpu.runtime.server import RuntimeState
+
+    class D:
+        def __init__(self, id, coords=None, core_on_chip=0):
+            self.id = id
+            self.coords = coords
+            self.core_on_chip = core_on_chip
+
+    devs = [D(3), D(1, coords=(1, 0, 0)), D(0, coords=(0, 0, 0)), D(2)]
+    leaders = RuntimeState._chip_leaders(devs)
+    assert [d.id for d in leaders] == [0, 1, 2, 3]
+    # Pure-coord backends order by coord tuple, not string: (10,0,0)
+    # comes after (2,0,0).
+    devs = [D(0, coords=(10, 0, 0)), D(1, coords=(2, 0, 0))]
+    leaders = RuntimeState._chip_leaders(devs)
+    assert [d.coords for d in leaders] == [(2, 0, 0), (10, 0, 0)]
+
+
 def test_priority_zero_borrows(tmp_path):
     sock = str(tmp_path / "rt3.sock")
     srv = make_server(sock, hbm_limit=0, core_limit=10,
