@@ -181,8 +181,18 @@ class PjrtChipBackend(ChipBackend):
             key = tuple(d["coords"]) if d.get("coords") else d["id"] // ncores
             by_chip.setdefault(key, []).append(d)
         chips: List[TpuChip] = []
+        # Order chips numerically, never lexically (chip 10 must follow
+        # chip 2: the index here seeds the uuid->index inventory the
+        # TPU_VISIBLE_CHIPS translation consumes).  Coord-keyed groups
+        # sort as a block before id-derived ones so mixed enumerations
+        # stay well-defined (same normalization as the broker's
+        # _chip_leaders).
+        def _order(kv):
+            key = kv[0]
+            return (0, *key) if isinstance(key, tuple) else (1, key)
+
         for index, (key, devs) in enumerate(sorted(by_chip.items(),
-                                                   key=lambda kv: str(kv[0]))):
+                                                   key=_order)):
             hbm = sum(d.get("hbm_bytes", 0) for d in devs) or \
                 HBM_BYTES.get(generation, 16 * 2**30)
             coord = key if isinstance(key, tuple) else (index,)
