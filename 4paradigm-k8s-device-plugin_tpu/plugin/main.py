@@ -141,6 +141,12 @@ class Daemon:
         # compile cache on the hostPath lib dir.
         env.setdefault("VTPU_COMPILE_CACHE_DIR",
                        os.path.join(self.cfg.host_lib_dir, "xla-cache"))
+        # Same execute-cost floor the pods get: the broker's metering is
+        # just as blind on enqueue-complete transports (docs/FLAGS.md).
+        from ..utils import envspec
+        env.setdefault(envspec.ENV_MIN_EXEC_COST,
+                       envspec.min_exec_cost_default(
+                           shared[0].vdevices[0].chip.generation))
         try:
             self._runtime_proc = subprocess.Popen(cmd, env=env)
         except OSError as e:

@@ -392,6 +392,13 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         # CUDA_DEVICE_SM_LIMIT, server.go:492).
         if self.spec.time_shared and vdevs and vdevs[0].core_pct > 0:
             envs[envspec.ENV_CORE_LIMIT] = str(vdevs[0].core_pct)
+            # Execute-cost floor: without it an enqueue-complete
+            # transport trains the device-time EMA toward 0 and the
+            # quota silently stops enforcing.  Operator env wins;
+            # otherwise inject the generation default.
+            envs[envspec.ENV_MIN_EXEC_COST] = os.environ.get(
+                envspec.ENV_MIN_EXEC_COST,
+                envspec.min_exec_cost_default(vdevs[0].chip.generation))
 
         # Core pinning for hard-partition (core-split) grants: the shim
         # translates to libtpu core selection.

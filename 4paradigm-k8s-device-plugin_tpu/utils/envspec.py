@@ -52,6 +52,27 @@ ENV_VISIBLE_DEVICES = "VTPU_VISIBLE_DEVICES"
 # Unix socket of the node-level vTPU runtime multiplexer (single-chip
 # time-sharing path).
 ENV_RUNTIME_SOCKET = "VTPU_RUNTIME_SOCKET"
+# Floor charge per execute step, µs.  Transports whose completion events
+# are optimistic (enqueue-complete) train the device-time EMA toward 0
+# and silently disable throttling; the daemon injects a per-generation
+# floor at Allocate so a fresh pod is quota-enforced without operator
+# tuning (an explicit operator value always wins).
+ENV_MIN_EXEC_COST = "VTPU_MIN_EXEC_COST_US"
+# Conservative floors: roughly the dispatch cost of the smallest real
+# device program per generation — low enough not to over-bill genuine
+# sub-ms steps by much, high enough that a zero-latency transport still
+# converges a 25% tenant to ~25% duty.
+MIN_EXEC_COST_US_DEFAULTS = {
+    "v4": 200, "v5e": 200, "v5p": 150, "v6e": 150,
+}
+MIN_EXEC_COST_US_FALLBACK = 200
+
+
+def min_exec_cost_default(generation: str) -> str:
+    """Floor value (µs, as env string) for a chip generation — single
+    source for both the Allocate injection and the broker spawn env."""
+    return str(MIN_EXEC_COST_US_DEFAULTS.get(generation,
+                                             MIN_EXEC_COST_US_FALLBACK))
 # Interceptor log level: 0=errors .. 4=debug (reference LIBCUDA_LOG_LEVEL).
 ENV_LOG_LEVEL = "VTPU_LOG_LEVEL"
 # PCI/platform inventory file mounted by the daemon so the shim can present
@@ -74,6 +95,7 @@ ALL_ENV_VARS = [
     ENV_TASK_PRIORITY,
     ENV_UTILIZATION_POLICY,
     ENV_ACTIVE_OOM_KILLER,
+    ENV_MIN_EXEC_COST,
     ENV_VISIBLE_DEVICES,
     ENV_RUNTIME_SOCKET,
     ENV_LOG_LEVEL,

@@ -125,9 +125,28 @@ def test_allocate_env_contract(env):
     assert envs["TPU_LIBRARY_PATH"].endswith("libvtpu_pjrt.so")
     assert envs["PYTHONPATH"].endswith("/shim")
 
+    # Execute-cost floor: injected per generation (v5e -> 200µs) so
+    # enqueue-complete transports stay quota-enforced (VERDICT r3 #7).
+    assert envs[envspec.ENV_MIN_EXEC_COST] == "200"
+
     mounts = {m.container_path: m.host_path for m in car.mounts}
     assert "/usr/local/vtpu/libvtpu_pjrt.so" in mounts
     assert "/usr/local/vtpu/shim" in mounts
+    ch.close()
+
+
+def test_allocate_min_exec_cost_operator_override(env, monkeypatch):
+    """An operator-set VTPU_MIN_EXEC_COST_US on the daemon wins over the
+    generation default (0 disables the floor)."""
+    sim, plugin, cfg = env
+    monkeypatch.setenv(envspec.ENV_MIN_EXEC_COST, "777")
+    reg = sim.wait_registration()
+    stub, ch = sim.plugin_stub(reg.endpoint)
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=[plugin.vdevices[0].id])
+    resp = stub.Allocate(req)
+    envs = dict(resp.container_responses[0].envs)
+    assert envs[envspec.ENV_MIN_EXEC_COST] == "777"
     ch.close()
 
 
