@@ -75,17 +75,23 @@ class Daemon:
         self._health_thread: Optional[threading.Thread] = None
 
     def _make_pod_lister(self):
+        from ..k8s.client import CachedPodLister, K8sClient
+        from ..k8s.client import pod_lister as make_lister
         if self.pod_lister is not None:
+            # One shared TTL cache for every consumer (all plugin specs
+            # AND the legacy controller): an admission burst is ~1
+            # API-server LIST node-wide, not one per caller.
+            if not isinstance(self.pod_lister, CachedPodLister):
+                self.pod_lister = CachedPodLister(self.pod_lister)
             return self.pod_lister
         if not (self.cfg.monitor_mode or self.cfg.enable_legacy_preferred):
             return None
-        from ..k8s.client import K8sClient, pod_lister as make_lister
         client = K8sClient()
         if not client.available:
             log.warn("monitor/legacy mode requested but no in-cluster "
                      "credentials; pod matching disabled")
             return None
-        self.pod_lister = make_lister(client)
+        self.pod_lister = CachedPodLister(make_lister(client))
         return self.pod_lister
 
     # -- runtime broker ------------------------------------------------------

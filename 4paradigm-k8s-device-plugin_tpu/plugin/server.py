@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Sequence
 import grpc
 
 from ..discovery.types import Health, TpuTopology
+from ..k8s.client import CachedPodLister
 from ..proto import DEVICE_PLUGIN_VERSION, pb, rpc
 from ..utils import envspec
 from ..utils import logging as log
@@ -78,6 +79,11 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         self.cfg = cfg
         self.topology = topology
         self.controller = controller
+        # Monitor-mode pod lists are TTL-cached so an admission burst is
+        # ~1 API-server LIST, not one per Allocate.
+        if pod_lister is not None \
+                and not isinstance(pod_lister, CachedPodLister):
+            pod_lister = CachedPodLister(pod_lister)
         self.pod_lister = pod_lister
         self.vdevices: List[VDevice] = list(spec.vdevices)
         self.socket_path = os.path.join(cfg.device_plugin_path,
@@ -310,28 +316,40 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         two pods' dirs can swap.  Consequence is misattributed
         *monitoring* only — quota enforcement itself keys off the region
         file the container actually receives."""
+        def scan(pods):
+            cand, live_ = [], set()
+            for pod in pods:
+                meta = pod.get("metadata", {})
+                uid = meta.get("uid", "nouid")
+                for ctr in pod.get("spec", {}).get("containers", []):
+                    live_.add((uid, ctr.get("name", "ctr")))
+                if pod.get("status", {}).get("phase") != "Pending":
+                    continue
+                for ctr in pod.get("spec", {}).get("containers", []):
+                    limits = ctr.get("resources", {}).get("limits", {})
+                    want = limits.get(self.spec.resource_name)
+                    cname = ctr.get("name", "ctr")
+                    if want is None or int(want) != n_vdevices:
+                        continue
+                    cand.append((meta.get("namespace", "default"),
+                                 meta.get("name", "pod"), cname, uid))
+            return cand, live_
+
         try:
-            pods = self.pod_lister(self.cfg.node_name)
+            candidates, live = scan(self.pod_lister(self.cfg.node_name))
+            with self._matched_mu:
+                has_unclaimed = any((c[3], c[2]) not in self._matched_pods
+                                    for c in candidates)
+            if not has_unclaimed:
+                # The pod being admitted may have been created inside the
+                # cache TTL (and every cached candidate may already be
+                # claimed by an earlier Allocate): one forced refresh
+                # before falling back to claim reuse.
+                candidates, live = scan(
+                    self.pod_lister(self.cfg.node_name, fresh=True))
         except Exception as e:  # noqa: BLE001 - monitor mode is best-effort
             log.warn("monitor mode pod list failed: %s", e)
             return None
-        candidates = []
-        live = set()
-        for pod in pods:
-            meta = pod.get("metadata", {})
-            uid = meta.get("uid", "nouid")
-            for ctr in pod.get("spec", {}).get("containers", []):
-                live.add((uid, ctr.get("name", "ctr")))
-            if pod.get("status", {}).get("phase") != "Pending":
-                continue
-            for ctr in pod.get("spec", {}).get("containers", []):
-                limits = ctr.get("resources", {}).get("limits", {})
-                want = limits.get(self.spec.resource_name)
-                cname = ctr.get("name", "ctr")
-                if want is None or int(want) != n_vdevices:
-                    continue
-                candidates.append((meta.get("namespace", "default"),
-                                   meta.get("name", "pod"), cname, uid))
         with self._matched_mu:
             # Prune claims of pods no longer on the node (bounds the map).
             for key in [k for k in self._matched_pods if k not in live]:
@@ -439,6 +457,11 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         # images needing extra paths use VTPU_EXTRA_PYTHONPATH, which the
         # shim's sitecustomize appends to sys.path (docs/FLAGS.md).
         envs["PYTHONPATH"] = os.path.join(CONTAINER_LIB_DIR, "shim")
+        # Operators debugging a pod whose image-ENV PYTHONPATH vanished
+        # land here: the replacement is invisible in-container.
+        log.info("allocate: injecting PYTHONPATH=%s (replaces any "
+                 "image-ENV PYTHONPATH; see docs/FLAGS.md "
+                 "VTPU_EXTRA_PYTHONPATH)", envs["PYTHONPATH"])
 
         for k, v in envs.items():
             car.envs[k] = v

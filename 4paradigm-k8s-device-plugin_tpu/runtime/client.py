@@ -18,6 +18,7 @@ from __future__ import annotations
 import itertools
 import os
 import socket
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -33,6 +34,21 @@ class VtpuQuotaError(MemoryError):
 
 class RuntimeError_(RuntimeError):
     pass
+
+
+class VtpuStateLost(RuntimeError_):
+    """The broker restarted under this client (fresh HELLO epoch): every
+    RemoteArray / RemoteExecutable handle is gone.  The client has
+    already rebound to the new broker instance when this is raised —
+    recover by re-``put``-ting arrays and re-``compile``-ing programs on
+    the SAME client object.  Pipelined callers must also restart their
+    send/recv pairing (in-flight executes died with the old broker)."""
+
+    def __init__(self, msg: str, epoch_old: Optional[str] = None,
+                 epoch_new: Optional[str] = None):
+        super().__init__(msg)
+        self.epoch_old = epoch_old
+        self.epoch_new = epoch_new
 
 
 class RemoteArray:
@@ -70,9 +86,11 @@ class RuntimeClient:
                  device: Optional[int] = None,
                  hbm_limit: Optional[int] = None,
                  core_limit: Optional[int] = None,
-                 oversubscribe: Optional[bool] = None):
-        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self.sock.connect(socket_path)
+                 oversubscribe: Optional[bool] = None,
+                 reconnect_timeout: float = 15.0):
+        self._socket_path = socket_path
+        self._reconnect_timeout = reconnect_timeout
+        self._closed = False
         self._ids = itertools.count()
         spec = envspec.quota_from_env()
         self.tenant = tenant or os.environ.get(
@@ -99,9 +117,84 @@ class RuntimeClient:
             hello["hbm_limit"] = int(hbm)
         if core is not None:
             hello["core_limit"] = int(core)
-        resp = self._rpc(hello)
+        self._hello = hello
+        self.epoch = self._connect()[0]
+
+    def _connect(self):
+        """Dial + HELLO; returns (epoch, created) where ``created``
+        means the broker bound this connection to a FRESH tenant slot.
+        Used for both the first connection and crash-recovery rebinds."""
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(self._socket_path)
+        P.send_msg(self.sock, self._hello)
+        resp = P.recv_msg(self.sock)
+        if not resp.get("ok"):
+            # Leave no half-open never-HELLO'd socket behind (every rpc
+            # on it would fail NO_HELLO).
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            raise RuntimeError_(
+                f"{resp.get('code', '')}: {resp.get('error', '')}")
         self.tenant_index = resp["tenant_index"]
         self.chip = resp.get("chip", 0)
+        return resp.get("epoch"), bool(resp.get("created", True))
+
+    def _on_disconnect(self) -> None:
+        """The connection died mid-request.  Rebind to the socket (the
+        daemon respawns a crashed broker with backoff) and classify:
+
+        - fresh epoch -> the broker restarted, device state is gone ->
+          typed ``VtpuStateLost`` (the contract VERDICT r3 #5 asks for,
+          instead of NOT_FOUND soup from dangling handle ids);
+        - same epoch but the rebind landed on a FRESH tenant slot -> the
+          broker never died, but its teardown beat the rebind and
+          dropped the tenant's arrays -> ``VtpuStateLost`` too;
+        - same epoch, existing tenant (another connection held it, or
+          the rebind won the teardown quiesce race) -> handles survive;
+          only in-flight requests are lost, surfaced as CONNECTION_LOST
+          so the caller never silently retries a non-idempotent
+          execute."""
+        if self._closed:
+            raise RuntimeError_("client is closed")
+        old = self.epoch
+        deadline = time.monotonic() + self._reconnect_timeout
+        last: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            try:
+                new_epoch, created = self._connect()
+            except (ConnectionError, FileNotFoundError, OSError,
+                    P.ProtocolError) as e:
+                last = e
+                time.sleep(0.25)
+                continue
+            except RuntimeError_ as e:
+                # HELLO itself rejected (e.g. slots exhausted while the
+                # dead session's teardown drains): retryable.
+                last = e
+                time.sleep(0.25)
+                continue
+            if new_epoch != old or created:
+                self.epoch = new_epoch
+                why = ("broker restarted" if new_epoch != old else
+                       "broker alive but tenant state was torn down "
+                       "before the rebind")
+                raise VtpuStateLost(
+                    f"{why} (epoch {old} -> {new_epoch}); arrays and "
+                    f"executables are lost — re-put/re-compile on this "
+                    f"client", epoch_old=old, epoch_new=new_epoch)
+            raise RuntimeError_(
+                "CONNECTION_LOST: broker connection dropped and was "
+                "rebound (same epoch, state intact); in-flight requests "
+                "were lost")
+        raise RuntimeError_(
+            f"broker unreachable for {self._reconnect_timeout:.0f}s "
+            f"on {self._socket_path}: {last}")
 
     @staticmethod
     def _default_tenant() -> str:
@@ -138,8 +231,12 @@ class RuntimeClient:
 
     # -- plumbing --
     def _rpc(self, msg: Dict[str, Any]) -> Dict[str, Any]:
-        P.send_msg(self.sock, msg)
-        resp = P.recv_msg(self.sock)
+        try:
+            P.send_msg(self.sock, msg)
+            resp = P.recv_msg(self.sock)
+        except (ConnectionError, P.ProtocolError, OSError):
+            self._on_disconnect()
+            raise AssertionError("unreachable")  # _on_disconnect raises
         if not resp.get("ok"):
             code = resp.get("code", "")
             if code == "RESOURCE_EXHAUSTED":
@@ -148,6 +245,7 @@ class RuntimeClient:
         return resp
 
     def close(self) -> None:
+        self._closed = True
         try:
             self.sock.close()
         except OSError:
@@ -219,10 +317,17 @@ class RuntimeClient:
         if repeats > 1:
             msg["repeats"] = int(repeats)
             msg["carry"] = [list(p) for p in carry]
-        P.send_msg(self.sock, msg)
+        try:
+            P.send_msg(self.sock, msg)
+        except (ConnectionError, P.ProtocolError, OSError):
+            self._on_disconnect()
 
     def execute_recv(self) -> List[RemoteArray]:
-        resp = P.recv_msg(self.sock)
+        try:
+            resp = P.recv_msg(self.sock)
+        except (ConnectionError, P.ProtocolError, OSError):
+            self._on_disconnect()
+            raise AssertionError("unreachable")
         if not resp.get("ok"):
             code = resp.get("code", "")
             if code == "RESOURCE_EXHAUSTED":

@@ -66,7 +66,7 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..shim.core import SharedRegion
 from ..utils.dtypes import np_dtype as _np_dtype
@@ -577,6 +577,11 @@ class RuntimeState:
         # silicon; each ChipState drives its chip's first core (the
         # core-split path handles per-core pinning via the interposer).
         self.devices = self._chip_leaders(jax.devices())
+        # Broker-instance epoch, echoed in every HELLO reply: a client
+        # reconnecting after a broker crash sees a fresh epoch and knows
+        # every handle it holds is gone (typed VtpuStateLost on the
+        # client side instead of NOT_FOUND soup — VERDICT r3 #5).
+        self.epoch = f"{os.getpid():x}-{time.time_ns():x}"
         self.region_path = region_path
         # Spawn-time limits are only DEFAULTS: each tenant's HELLO
         # carries its own Allocate-time grant (reference per-vdevice
@@ -640,11 +645,17 @@ class RuntimeState:
     def tenant(self, name: str, priority: int,
                oversubscribe: bool = False, device: int = 0,
                hbm_limit: Optional[int] = None,
-               core_limit: Optional[int] = None) -> Tenant:
+               core_limit: Optional[int] = None) -> "Tuple[Tenant, bool]":
+        """Bind a connection to a tenant; returns (tenant, created).
+        ``created`` tells HELLO whether this bound to a FRESH slot — a
+        reconnecting client uses it to learn its arrays did not survive
+        (teardown won the race) even though the broker never died."""
         chip = self.chip(device)
+        created = False
         with self.mu:
             t = self.tenants.get(name)
             if t is None:
+                created = True
                 used = {x.index for x in self.tenants.values()
                         if x.chip is chip}
                 index = next((i for i in range(MAX_TENANTS)
@@ -668,7 +679,7 @@ class RuntimeState:
                     else self.default_core)
                 self.tenants[name] = t
             t.connections += 1
-            return t
+            return t, created
 
     def release_tenant(self, t: Tenant) -> bool:
         """Drop one connection; True when the tenant's state should be
@@ -838,7 +849,7 @@ class TenantSession(socketserver.BaseRequestHandler):
                         continue
                     hbm = msg.get("hbm_limit")
                     core = msg.get("core_limit")
-                    tenant = self.state.tenant(
+                    tenant, created = self.state.tenant(
                         str(msg["tenant"]), int(msg.get("priority", 1)),
                         bool(msg.get("oversubscribe", False)),
                         device=int(msg.get("device", 0)),
@@ -847,7 +858,9 @@ class TenantSession(socketserver.BaseRequestHandler):
                         else None)
                     tenant_box[0] = tenant
                     self._send({"ok": True, "tenant_index": tenant.index,
-                                "chip": tenant.chip.index})
+                                "chip": tenant.chip.index,
+                                "epoch": self.state.epoch,
+                                "created": created})
                     continue
                 if tenant is None:
                     self._send_err("NO_HELLO", "hello required")
