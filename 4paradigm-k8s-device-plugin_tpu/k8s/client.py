@@ -89,18 +89,48 @@ class CachedPodLister:
         self.ttl = ttl
         self.calls = 0  # upstream LIST count (observability + tests)
         self._mu = threading.Lock()
+        self._cond = threading.Condition(self._mu)
+        # node -> fetch-start time of an upstream LIST in flight:
+        # concurrent misses (N Allocates racing a cold/expired entry)
+        # must coalesce into ONE upstream call, not recreate the burst
+        # the cache exists to prevent.
+        self._inflight: Dict[Optional[str], float] = {}
+        # Cache entries are stamped with the fetch START time: a fresh=
+        # True caller can then piggyback on a result only when the
+        # fetch began after its own request (a list started earlier may
+        # predate the pod it is looking for).
         self._cache: Dict[Optional[str], tuple] = {}
 
     def __call__(self, node_name: Optional[str],
                  fresh: bool = False) -> List[Dict]:
         import time
+        t_req = time.monotonic()
         with self._mu:
-            ent = self._cache.get(node_name)
-            if not fresh and ent is not None \
-                    and time.monotonic() - ent[0] < self.ttl:
-                return ent[1]
-        pods = self.lister(node_name)
+            while True:
+                ent = self._cache.get(node_name)
+                if ent is not None and (
+                        fresh and ent[0] >= t_req
+                        or not fresh
+                        and time.monotonic() - ent[0] < self.ttl):
+                    return ent[1]
+                if node_name not in self._inflight:
+                    self._inflight[node_name] = time.monotonic()
+                    break
+                # Single-flight: wait for the running fetch, then
+                # re-evaluate (it satisfies plain callers always, fresh
+                # callers only when it started after their request).
+                self._cond.wait(timeout=1.0)
+        start = self._inflight[node_name]
+        try:
+            pods = self.lister(node_name)
+        except BaseException:
+            with self._mu:
+                self._inflight.pop(node_name, None)
+                self._cond.notify_all()
+            raise
         with self._mu:
+            self._inflight.pop(node_name, None)
             self.calls += 1
-            self._cache[node_name] = (time.monotonic(), pods)
+            self._cache[node_name] = (start, pods)
+            self._cond.notify_all()
         return pods

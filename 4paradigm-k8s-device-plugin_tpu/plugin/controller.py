@@ -131,7 +131,17 @@ class VDeviceController:
         if self.pod_lister is None:
             return None
         try:
-            pods = self.pod_lister(self.node_name)
+            # Absence from this list FREES checkpoint-held vdevices, so
+            # staleness is destructive: a TTL-cached list predating a
+            # just-Allocated pod would release its grants to the next
+            # Allocate (double allocation).  Always list fresh here —
+            # reconciles are per-Allocate in legacy mode, exactly the
+            # pre-cache QPS.
+            from ..k8s.client import CachedPodLister
+            if isinstance(self.pod_lister, CachedPodLister):
+                pods = self.pod_lister(self.node_name, fresh=True)
+            else:
+                pods = self.pod_lister(self.node_name)
         except Exception as e:  # noqa: BLE001 - API server hiccups
             log.warn("pod list failed; trusting checkpoint: %s", e)
             return None

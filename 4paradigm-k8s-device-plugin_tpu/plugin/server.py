@@ -477,6 +477,19 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
             (os.path.join(CONTAINER_LIB_DIR, "shim"),
              os.path.join(host, "shim"), True),
         ]
+        # Forced native injection (reference server.go:511-515): mount
+        # the dlopen-redirecting preload lib plus its one-line list file
+        # over /etc/ld.so.preload, so even a workload that unsets
+        # TPU_LIBRARY_PATH or dlopens libtpu by absolute path is
+        # enforced.  Gated on the staged files existing — a bind mount
+        # with a missing source fails container creation outright.
+        preload_lib = os.path.join(host, "libvtpu_preload.so")
+        preload_list = os.path.join(host, "ld.so.preload")
+        if os.path.exists(preload_lib) and os.path.exists(preload_list):
+            mounts.append(
+                (os.path.join(CONTAINER_LIB_DIR, "libvtpu_preload.so"),
+                 preload_lib, True))
+            mounts.append(("/etc/ld.so.preload", preload_list, True))
         if self.cfg.pcibus_file:
             mounts.append((os.path.join(CONTAINER_LIB_DIR, "tpuinfo.vtpu"),
                            self.cfg.pcibus_file, True))

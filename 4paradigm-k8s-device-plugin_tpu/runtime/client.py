@@ -139,7 +139,12 @@ class RuntimeClient:
                 f"{resp.get('code', '')}: {resp.get('error', '')}")
         self.tenant_index = resp["tenant_index"]
         self.chip = resp.get("chip", 0)
-        return resp.get("epoch"), bool(resp.get("created", True))
+        # ``created`` defaults FALSE: True asserts state loss, and a
+        # pre-contract broker (daemonset upgrade: new shim, old broker
+        # kept alive across the plugin restart) sends neither key — a
+        # rebind to it must degrade to CONNECTION_LOST, not claim the
+        # tenant's intact arrays are gone.
+        return resp.get("epoch"), bool(resp.get("created", False))
 
     def _on_disconnect(self) -> None:
         """The connection died mid-request.  Rebind to the socket (the

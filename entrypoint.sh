@@ -13,4 +13,14 @@ VTPU_HOST_LIB_DIR="${VTPU_HOST_LIB_DIR:-/usr/local/vtpu}"
 mkdir -p "$VTPU_HOST_LIB_DIR" "$VTPU_HOST_LIB_DIR/shared"
 cp -r "$VTPU_STAGE_SRC"/* "$VTPU_HOST_LIB_DIR/" 2>/dev/null || true
 
+# One-line preload list: Allocate() mounts it over /etc/ld.so.preload so
+# every ELF process in the container loads the dlopen-redirecting
+# libvtpu_preload.so — forced injection even for non-Python workloads
+# (reference server.go:511-515 + vgpu/ld.so.preload:1).  The path is the
+# CONTAINER-side location of the lib mounted alongside it.
+if [ -f "$VTPU_HOST_LIB_DIR/libvtpu_preload.so" ]; then
+    printf '/usr/local/vtpu/libvtpu_preload.so\n' \
+        > "$VTPU_HOST_LIB_DIR/ld.so.preload"
+fi
+
 exec python3 -m vtpu.plugin.main "$@"

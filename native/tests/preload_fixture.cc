@@ -1,0 +1,136 @@
+/* Hostile-workload fixture for the forced-injection path: a plain C++
+ * binary (no Python, no TPU_LIBRARY_PATH, no PYTHONPATH) that dlopens a
+ * "libtpu.so" by absolute path — exactly the workload class the env-var
+ * channel cannot reach (VERDICT r3 missing #1).  Run by interposer_test's
+ * `preload` scenario with LD_PRELOAD=libvtpu_preload.so standing in for
+ * the /etc/ld.so.preload mount the daemon performs at Allocate
+ * (reference server.go:511-515).
+ *
+ * Modes (argv[1]):
+ *   enforced  - the dlopen must be redirected to the interposer and the
+ *               HBM quota must bite with no env cooperation
+ *   direct    - VTPU_PRELOAD_DISABLE=1: the dlopen must NOT be redirected
+ *   unrelated - a non-TPU library must pass through untouched
+ * argv[2] = the libtpu path to dlopen.
+ */
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "preload_fixture CHECK failed at %s:%d: %s\n",   \
+              __FILE__, __LINE__, #cond);                              \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+static int redirected(void* h) {
+  /* Only the vTPU interposer exports the ident symbol. */
+  return dlsym(h, "vtpu_interposer_ident") != NULL;
+}
+
+/* The granted quota (K8s-quantity syntax, same grammar as the shim's
+ * envspec parser) so the probe sizes scale with the REAL Allocate env
+ * instead of assuming a 1Mi test quota. */
+static long long quota_bytes(void) {
+  const char* s = getenv("VTPU_DEVICE_HBM_LIMIT_0");
+  if (!s || !*s) return 1024 * 1024;
+  char* end = NULL;
+  long long n = strtoll(s, &end, 10);
+  if (n <= 0) return 1024 * 1024;
+  if (strcmp(end, "m") == 0) return n * 1000000ll;
+  if (strcmp(end, "Ki") == 0) return n << 10;
+  if (strcmp(end, "Mi") == 0) return n << 20;
+  if (strcmp(end, "Gi") == 0) return n << 30;
+  return n;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: preload_fixture <mode> <libtpu-path>\n");
+    return 2;
+  }
+  const char* mode = argv[1];
+  const char* libtpu = argv[2];
+
+  void* h = dlopen(libtpu, RTLD_NOW);
+  if (!h) {
+    fprintf(stderr, "dlopen(%s): %s\n", libtpu, dlerror());
+    return 1;
+  }
+
+  if (strcmp(mode, "direct") == 0) {
+    CHECK(!redirected(h));
+    printf("preload_fixture direct: no redirect under "
+           "VTPU_PRELOAD_DISABLE\n");
+    return 0;
+  }
+  if (strcmp(mode, "unrelated") == 0) {
+    CHECK(!redirected(h));
+    printf("preload_fixture unrelated: non-TPU dlopen untouched\n");
+    return 0;
+  }
+  CHECK(strcmp(mode, "enforced") == 0);
+  CHECK(redirected(h));
+  /* The hook must have told the interposer which real backend the
+   * workload asked for. */
+  const char* real = getenv("VTPU_REAL_LIBTPU");
+  CHECK(real != NULL && strcmp(real, libtpu) == 0);
+
+  auto get = (const PJRT_Api* (*)())dlsym(h, "GetPjrtApi");
+  CHECK(get != NULL);
+  const PJRT_Api* api = get();
+  CHECK(api != NULL);
+
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == NULL);
+
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == NULL);
+  CHECK(da.num_addressable_devices >= 1);
+  PJRT_Device* dev = da.addressable_devices[0];
+
+  /* Within quota succeeds; past quota is RESOURCE_EXHAUSTED — quota
+   * enforcement engaged with zero env cooperation from the workload.
+   * Sizes derive from the granted quota (the mock backend books sizes
+   * without backing them, so over-quota probes are cheap). */
+  long long q = quota_bytes();
+  static float byte_src[1] = {0};
+  PJRT_Client_BufferFromHostBuffer_Args ba;
+  memset(&ba, 0, sizeof(ba));
+  ba.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  ba.client = ca.client;
+  ba.data = byte_src;
+  ba.type = PJRT_Buffer_Type_F32;
+  int64_t small[1] = {q / 8 / 4};  /* quota/8, in f32 elements */
+  ba.dims = small;
+  ba.num_dims = 1;
+  ba.host_buffer_semantics = PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+  ba.device = dev;
+  CHECK(api->PJRT_Client_BufferFromHostBuffer(&ba) == NULL);
+
+  int64_t big[1] = {q * 2 / 4};    /* 2x quota */
+  ba.dims = big;
+  ba.buffer = NULL;
+  PJRT_Error* e = api->PJRT_Client_BufferFromHostBuffer(&ba);
+  CHECK(e != NULL);
+  PJRT_Error_GetCode_Args gc;
+  memset(&gc, 0, sizeof(gc));
+  gc.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  gc.error = e;
+  api->PJRT_Error_GetCode(&gc);
+  CHECK(gc.code == PJRT_Error_Code_RESOURCE_EXHAUSTED);
+
+  printf("preload_fixture enforced: dlopen redirected, quota bites\n");
+  return 0;
+}

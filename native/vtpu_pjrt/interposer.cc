@@ -1405,6 +1405,14 @@ static const char* const kRealPaths[] = {
 static void init_once() {
   const char* path = getenv("VTPU_REAL_LIBTPU");
   void* h = nullptr;
+  /* Under the forced-injection preload (libvtpu_preload.so mounted over
+   * /etc/ld.so.preload), dlopen of anything named like libtpu is
+   * redirected back to THIS library — raise its re-entrancy guard while
+   * loading the real backend, whose basename is typically "libtpu.so"
+   * too. */
+  auto bypass =
+      (void (*)(int))dlsym(RTLD_DEFAULT, "vtpu_preload_bypass");
+  if (bypass) bypass(1);
   if (path && *path) {
     h = dlopen(path, RTLD_NOW | RTLD_LOCAL);
     if (!h) VTPU_LOG(0, "dlopen(%s): %s", path, dlerror());
@@ -1420,6 +1428,7 @@ static void init_once() {
       }
     }
   }
+  if (bypass) bypass(-1);
   if (!h) {
     VTPU_LOG(0, "real libtpu not found (set VTPU_REAL_LIBTPU)");
     return;
@@ -1492,6 +1501,10 @@ static void init_once() {
            g_real->pjrt_api_version.major_version,
            g_real->pjrt_api_version.minor_version, path);
 }
+
+/* Presence marker: lets the preload fixture (and operators with
+ * dlsym/nm) confirm a handle is the interposer and not a raw backend. */
+extern "C" const char* vtpu_interposer_ident() { return "vtpu_pjrt"; }
 
 extern "C" const PJRT_Api* GetPjrtApi() {
   static std::once_flag once;

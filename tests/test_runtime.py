@@ -478,7 +478,15 @@ def test_connection_drop_sole_tenant_is_state_lost(broker):
     ep = c.epoch
     c.put(np.ones(4, np.float32), "x")
     c.sock.shutdown(sk.SHUT_RDWR)   # transport drop, client not closed
-    time.sleep(0.8)                 # teardown (incl. quiesce) completes
+    # Wait for the broker to actually tear the tenant down (quiesce can
+    # take a while on a loaded machine — a fixed sleep races it and the
+    # rebind would attach to the still-live tenant as CONNECTION_LOST).
+    probe = RuntimeClient(broker, tenant="probe-droppy")
+    deadline = time.monotonic() + 30
+    while "droppy" in probe.stats():
+        assert time.monotonic() < deadline, "teardown never completed"
+        time.sleep(0.05)
+    probe.close()
     with pytest.raises(VtpuStateLost) as ei:
         c.get("x")
     assert ei.value.epoch_new == ep  # broker never restarted
