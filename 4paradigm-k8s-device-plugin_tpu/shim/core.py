@@ -102,6 +102,7 @@ def load() -> ctypes.CDLL:
                                     ctypes.c_uint64, ctypes.c_int]
     lib.vtpu_set_core_limit.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.c_int32]
+    lib.vtpu_region_set_wc.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.vtpu_set_mem_limit.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                        ctypes.c_uint64]
     lib.vtpu_reset_slot.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -204,6 +205,12 @@ class SharedRegion:
 
     def set_core_limit(self, dev: int, pct: int) -> None:
         self.lib.vtpu_set_core_limit(self.handle, dev, pct)
+
+    def set_work_conserving(self, on: bool) -> None:
+        """Idle-share redistribution across device entries — broker
+        regions only (entries = tenant slots of ONE chip); see
+        vtpu_core.h."""
+        self.lib.vtpu_region_set_wc(self.handle, 1 if on else 0)
 
     def set_mem_limit(self, dev: int, limit_bytes: int) -> None:
         """Re-seed one slot's HBM cap (broker per-grant quotas)."""

@@ -119,7 +119,39 @@ extern "C" void* dlopen(const char* filename, int mode) {
     return real_dlopen(interposer, mode);
   }
 passthrough:
-  return real_dlopen(filename, mode);
+  void* h = real_dlopen(filename, mode);
+  if (h == NULL && filename && filename[0] != '\0' &&
+      strchr(filename, '/') == NULL) {
+    /* Interposing dlopen makes glibc resolve bare names against THIS
+     * object's (empty) RPATH instead of the calling object's
+     * DT_RUNPATH — an $ORIGIN-relative plugin load in a non-TPU
+     * workload would fail under the forced preload.  Approximate the
+     * caller's $ORIGIN: retry next to the calling object's own file
+     * (docs/FLAGS.md documents the residual limitation for
+     * multi-entry RUNPATHs). */
+    Dl_info info;
+    if (dladdr(__builtin_return_address(0), &info) && info.dli_fname) {
+      const char* slash = strrchr(info.dli_fname, '/');
+      if (slash) {
+        size_t dir_len = (size_t)(slash + 1 - info.dli_fname);
+        size_t name_len = strlen(filename);
+        char buf[4096];
+        if (dir_len + name_len < sizeof(buf)) {
+          memcpy(buf, info.dli_fname, dir_len);
+          memcpy(buf + dir_len, filename, name_len + 1);
+          void* h2 = real_dlopen(buf, mode);
+          if (h2) {
+            plog("bare-name %s resolved via caller dir (%s)", filename,
+                 buf);
+            return h2;
+          }
+          /* Restore a sane dlerror for the original name. */
+          real_dlopen(filename, mode);
+        }
+      }
+    }
+  }
+  return h;
 }
 
 /* DT_NEEDED escape path: an app *linked* against libtpu never calls
@@ -131,19 +163,27 @@ typedef struct PJRT_Api PJRT_Api;
 
 extern "C" const PJRT_Api* GetPjrtApi(void) {
   static const PJRT_Api* (*fwd)(void) = NULL;
-  if (!fwd) {
-    const char* off = getenv("VTPU_PRELOAD_DISABLE");
-    const char* interposer = getenv("VTPU_INTERPOSER_PATH");
-    if (!interposer || !*interposer) interposer = DEFAULT_INTERPOSER;
-    if ((!off || off[0] != '1') && access(interposer, R_OK) == 0) {
-      t_bypass++;
-      void* h = real_dlopen(interposer, RTLD_NOW | RTLD_LOCAL);
-      t_bypass--;
-      if (h) fwd = (const PJRT_Api* (*)(void))dlsym(h, "GetPjrtApi");
+  if (fwd) return fwd();
+  const char* off = getenv("VTPU_PRELOAD_DISABLE");
+  const char* interposer = getenv("VTPU_INTERPOSER_PATH");
+  if (!interposer || !*interposer) interposer = DEFAULT_INTERPOSER;
+  if ((!off || off[0] != '1') && access(interposer, R_OK) == 0) {
+    t_bypass++;
+    void* h = real_dlopen(interposer, RTLD_NOW | RTLD_LOCAL);
+    t_bypass--;
+    if (h) {
+      auto f = (const PJRT_Api* (*)(void))dlsym(h, "GetPjrtApi");
+      /* Probe before caching: the interposer returns NULL when it
+       * cannot locate a real backend (VTPU_REAL_LIBTPU unset, nothing
+       * at its default paths) — fail OPEN to the next GetPjrtApi in
+       * search order (the DT_NEEDED-mapped real libtpu) instead of
+       * handing the workload a NULL API table. */
+      if (f && f() != NULL) {
+        fwd = f;
+        return fwd();
+      }
     }
-    if (!fwd)
-      fwd = (const PJRT_Api* (*)(void))dlsym(RTLD_NEXT, "GetPjrtApi");
-    if (!fwd) return NULL;
   }
-  return fwd();
+  fwd = (const PJRT_Api* (*)(void))dlsym(RTLD_NEXT, "GetPjrtApi");
+  return fwd ? fwd() : NULL;
 }

@@ -27,7 +27,7 @@
 #include <unistd.h>
 
 #define VTPU_MAGIC 0x76545055u /* "vTPU" */
-#define VTPU_VERSION 3u /* v3: per-proc busy_us (tenant attribution) */
+#define VTPU_VERSION 4u /* v4: work-conserving refill (demand stamps) */
 
 /* Burst cap for the token bucket: how much device time may be "saved up".
  * 400ms keeps bursts short enough that a co-tenant is never starved for
@@ -67,6 +67,21 @@ typedef struct {
   uint64_t last_refill_ns;
   /* cumulative completed device time (us) — duty-cycle source */
   uint64_t busy_us;
+  /* last rate_acquire stamp: a slot is "demanding" while this is
+   * within the demand window (work-conserving refill scaling). */
+  uint64_t last_demand_ns;
+  /* FIFO record of whether each outstanding admitted acquire was
+   * DEBITED (bit) or ungated (sole demander, no debit): the matching
+   * rate_adjust must mirror the acquire-time decision, not re-evaluate
+   * demand at completion time — contention arriving mid-flight would
+   * otherwise bill corrections against never-debited executes.
+   * Acquires and adjusts are 1:1 and per-slot FIFO in both enforcement
+   * paths (broker: dispatch order; interposer: completion events in
+   * execute order).  Capacity 64 > MAX_INFLIGHT; overflow degrades to
+   * the old behavior (apply the correction). */
+  uint64_t debit_flags;
+  uint32_t debit_outstanding;
+  uint32_t pad2_;
 } DeviceState;
 
 typedef struct {
@@ -74,6 +89,11 @@ typedef struct {
   uint32_t version;
   uint32_t initialized;
   int32_t ndevices;
+  /* Work-conserving refill across device entries — only meaningful
+   * when the entries are tenant slots of ONE chip (broker layout); see
+   * vtpu_region_set_wc in the header. */
+  uint32_t wc_mode;
+  uint32_t pad0_;
   pthread_mutex_t mu;
   DeviceState dev[VTPU_MAX_DEVICES];
   ProcSlot proc[VTPU_MAX_PROCS];
@@ -475,15 +495,53 @@ int vtpu_proc_get_stats(vtpu_region* r, int slot, vtpu_proc_stats* out) {
 
 /* ---- rate limiting ------------------------------------------------------ */
 
-static void refill_locked(DeviceState* ds, uint64_t t) {
+static void refill_locked(DeviceState* ds, int32_t pct, uint64_t t) {
   if (ds->last_refill_ns == 0) ds->last_refill_ns = t;
   uint64_t elapsed_ns = t - ds->last_refill_ns;
   ds->last_refill_ns = t;
   /* pct% of wall time accrues as device-time budget. */
-  int64_t gained_us =
-      (int64_t)(elapsed_ns / 1000ull) * ds->core_limit_pct / 100;
+  int64_t gained_us = (int64_t)(elapsed_ns / 1000ull) * pct / 100;
   ds->tokens_us += gained_us;
   if (ds->tokens_us > kBurstCapUs) ds->tokens_us = kBurstCapUs;
+}
+
+/* Demand window for work-conserving refill: a slot that rate-acquired
+ * within it counts as contending for the chip.  Throttled slots retry
+ * at least every 50ms (the sleep cap), so they never fall out; a slot
+ * doing >window of pure host work temporarily yields its share and
+ * re-claims it on its next acquire (the co-tenants' surplus stops at
+ * the next refill, and the burst cap bounds the transient).  Default
+ * 500ms; VTPU_WC_WINDOW_US overrides (ops tuning + tests). */
+static uint64_t wc_window_ns(void) {
+  static uint64_t v = 0;
+  if (v == 0) {
+    const char* s = getenv("VTPU_WC_WINDOW_US");
+    uint64_t us = s && *s ? strtoull(s, NULL, 10) : 0;
+    v = us ? us * 1000ull : 500ull * 1000000ull;
+  }
+  return v;
+}
+
+/* Effective refill pct of `ds` under work-conserving mode: its share
+ * of 100% proportional to its quota among currently-demanding slots
+ * (the reference utilization_watcher recomputes shares from observed
+ * utilization the same way, SURVEY §2.9d).  sum>=100 -> plain pct. */
+static int32_t effective_pct_locked(Region* g, DeviceState* ds,
+                                    uint64_t t) {
+  int32_t pct = ds->core_limit_pct;
+  if (!g->wc_mode || pct <= 0) return pct;
+  uint64_t win = wc_window_ns();
+  int64_t demand = 0;
+  for (int d = 0; d < g->ndevices && d < VTPU_MAX_DEVICES; d++) {
+    DeviceState* o = &g->dev[d];
+    if (o->core_limit_pct > 0 && o->last_demand_ns != 0 &&
+        t - o->last_demand_ns <= win)
+      demand += o->core_limit_pct;
+  }
+  if (demand < pct) demand = pct; /* self always counts */
+  if (demand >= 100) return pct;
+  int32_t eff = (int32_t)((int64_t)pct * 100 / demand);
+  return eff > 100 ? 100 : eff;
 }
 
 uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
@@ -491,17 +549,40 @@ uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
   Region* g = r->shm;
   if (dev < 0 || dev >= g->ndevices) return 0;
   if (lock_region(g) != 0) return 0;
+  uint64_t t = now_ns();
   /* Heartbeat: foreign-namespace liveness (active_procs) is judged by
    * recency of this stamp. */
   ProcSlot* me = my_slot_locked(r, g);
-  if (me) me->last_seen_ns = now_ns();
+  if (me) me->last_seen_ns = t;
   DeviceState* ds = &g->dev[dev];
   int32_t pct = ds->core_limit_pct;
+  if (pct > 0) ds->last_demand_ns = t; /* counts as contending */
   if (pct <= 0 || pct >= 100) {
+    /* pct>=100 callers still send adjusts (metered but unlimited):
+     * record the un-debited admission so the FIFO pairing holds. */
+    if (pct >= 100 && ds->debit_outstanding < 64) {
+      ds->debit_flags &= ~(1ull << ds->debit_outstanding);
+      ds->debit_outstanding++;
+    }
     unlock_region(g);
     return 0;
   }
-  refill_locked(ds, now_ns());
+  pct = effective_pct_locked(g, ds, t);
+  if (pct >= 100) {
+    /* Sole demander under work-conserving: ungated (the generalized
+     * DEFAULT-policy sole-tenant case).  Keep the bucket topped up so
+     * resumed contention starts from the burst allowance, not a stale
+     * balance, and skip the debit (the matching rate_adjust sees the
+     * recorded flag and skips its correction symmetrically). */
+    refill_locked(ds, 100, t);
+    if (ds->debit_outstanding < 64) {
+      ds->debit_flags &= ~(1ull << ds->debit_outstanding);
+      ds->debit_outstanding++; /* flag bit 0: not debited */
+    }
+    unlock_region(g);
+    return 0;
+  }
+  refill_locked(ds, pct, t);
   uint64_t wait_ns = 0;
   /* A cost larger than the burst cap could never be admitted by a
    * tokens >= cost test (tokens are clamped at the cap), so `need` is
@@ -524,6 +605,10 @@ uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
     /* High-priority tasks may borrow (run the bucket negative); they still
      * consume, so background tenants pay it back later. */
     ds->tokens_us -= (int64_t)cost_us;
+    if (ds->debit_outstanding < 64) {
+      ds->debit_flags |= 1ull << ds->debit_outstanding;
+      ds->debit_outstanding++; /* flag bit 1: debited */
+    }
   } else {
     int64_t deficit_us = need - ds->tokens_us;
     wait_ns = (uint64_t)deficit_us * 1000ull * 100ull / (uint64_t)pct;
@@ -539,7 +624,19 @@ void vtpu_rate_adjust(vtpu_region* r, int dev, int64_t delta_us) {
   if (dev < 0 || dev >= g->ndevices) return;
   if (lock_region(g) != 0) return;
   DeviceState* ds = &g->dev[dev];
-  if (ds->core_limit_pct > 0) {
+  /* Pop the acquire-time record: the correction applies only when the
+   * matching acquire was actually DEBITED.  Re-evaluating demand here
+   * instead would bill corrections against a sole demander's undebited
+   * executes the moment contention arrives mid-flight, starting it in
+   * unearned debt.  An unmatched adjust (legacy caller, ring overflow)
+   * degrades to the pre-work-conserving behavior: apply. */
+  int debited = 1;
+  if (ds->debit_outstanding > 0) {
+    debited = (int)(ds->debit_flags & 1ull);
+    ds->debit_flags >>= 1;
+    ds->debit_outstanding--;
+  }
+  if (ds->core_limit_pct > 0 && debited) {
     ds->tokens_us -= delta_us;
     if (ds->tokens_us > kBurstCapUs) ds->tokens_us = kBurstCapUs;
   }
@@ -593,7 +690,17 @@ void vtpu_reset_slot(vtpu_region* r, int dev) {
   if (lock_region(g) != 0) return;
   g->dev[dev].tokens_us = kBurstCapUs;
   g->dev[dev].last_refill_ns = now_ns();
+  g->dev[dev].last_demand_ns = 0; /* recycled slot: not contending */
+  g->dev[dev].debit_flags = 0;
+  g->dev[dev].debit_outstanding = 0;
   g->dev[dev].peak_bytes = g->dev[dev].used_bytes;
+  unlock_region(g);
+}
+
+void vtpu_region_set_wc(vtpu_region* r, int on) {
+  Region* g = r->shm;
+  if (lock_region(g) != 0) return;
+  g->wc_mode = on ? 1u : 0u;
   unlock_region(g);
 }
 

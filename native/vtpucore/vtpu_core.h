@@ -136,6 +136,19 @@ void vtpu_rate_block(vtpu_region* r, int dev, uint64_t cost_us,
 /* Set/read the core limit at runtime (monitor / tests). */
 void vtpu_set_core_limit(vtpu_region* r, int dev, int32_t pct);
 
+/* Work-conserving mode (region-wide): ONLY for regions whose device
+ * entries are tenant slots sharing ONE physical chip (the broker's
+ * layout).  When on, a slot's refill rate is scaled by the idle share
+ * of the chip — with demanders D (slots that rate-acquired within the
+ * demand window, VTPU_WC_WINDOW_US, default 500ms) summing to under
+ * 100%, each demander's effective pct becomes pct*100/sum(D), so 2
+ * active 25% tenants run at 50% each instead of idling the chip at 50%
+ * (the reference's utilization_watcher share adjustment, SURVEY §2.9d).
+ * Full contention (sum >= 100) degrades to the plain fixed pct.  MUST
+ * stay off (the default) when device entries are distinct chips: chip
+ * 0 idling must never inflate chip 1's budget. */
+void vtpu_region_set_wc(vtpu_region* r, int on);
+
 /* Re-seed one slot's HBM cap at runtime (broker per-grant quotas). */
 void vtpu_set_mem_limit(vtpu_region* r, int dev, uint64_t limit_bytes);
 

