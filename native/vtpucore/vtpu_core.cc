@@ -70,17 +70,19 @@ typedef struct {
   /* last rate_acquire stamp: a slot is "demanding" while this is
    * within the demand window (work-conserving refill scaling). */
   uint64_t last_demand_ns;
-  /* FIFO record of whether each outstanding admitted acquire was
-   * DEBITED (bit) or ungated (sole demander, no debit): the matching
-   * rate_adjust must mirror the acquire-time decision, not re-evaluate
-   * demand at completion time — contention arriving mid-flight would
-   * otherwise bill corrections against never-debited executes.
-   * Acquires and adjusts are 1:1 and per-slot FIFO in both enforcement
-   * paths (broker: dispatch order; interposer: completion events in
-   * execute order).  Capacity 64 > MAX_INFLIGHT; overflow degrades to
-   * the old behavior (apply the correction). */
-  uint64_t debit_flags;
-  uint32_t debit_outstanding;
+  /* Count of admitted-but-NOT-debited acquires (ungated sole demander
+   * under work-conserving, or pct>=100) whose completion adjust has
+   * not arrived yet.  An adjust consumes one such credit and is
+   * SKIPPED — the acquire-time decision is what must be mirrored, not
+   * a re-evaluation of demand at completion time (contention arriving
+   * mid-flight would otherwise bill corrections against never-debited
+   * executes).  A counter rather than an ordered record: adjusts can
+   * arrive out of dispatch order (broker pre-device failures) and some
+   * gated acquires never send one (interposer dispatch errors pair
+   * with an explicit 0-delta adjust) — an ordering-based scheme would
+   * desync permanently, while a counter mis-skips at most around
+   * gated/ungated transitions and self-heals as it drains. */
+  uint32_t undebited_outstanding;
   uint32_t pad2_;
 } DeviceState;
 
@@ -559,11 +561,9 @@ uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
   if (pct > 0) ds->last_demand_ns = t; /* counts as contending */
   if (pct <= 0 || pct >= 100) {
     /* pct>=100 callers still send adjusts (metered but unlimited):
-     * record the un-debited admission so the FIFO pairing holds. */
-    if (pct >= 100 && ds->debit_outstanding < 64) {
-      ds->debit_flags &= ~(1ull << ds->debit_outstanding);
-      ds->debit_outstanding++;
-    }
+     * record the un-debited admission so pairing holds. */
+    if (pct >= 100 && ds->undebited_outstanding < 0x7fffffffu)
+      ds->undebited_outstanding++;
     unlock_region(g);
     return 0;
   }
@@ -575,10 +575,8 @@ uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
      * balance, and skip the debit (the matching rate_adjust sees the
      * recorded flag and skips its correction symmetrically). */
     refill_locked(ds, 100, t);
-    if (ds->debit_outstanding < 64) {
-      ds->debit_flags &= ~(1ull << ds->debit_outstanding);
-      ds->debit_outstanding++; /* flag bit 0: not debited */
-    }
+    if (ds->undebited_outstanding < 0x7fffffffu)
+      ds->undebited_outstanding++;
     unlock_region(g);
     return 0;
   }
@@ -605,10 +603,6 @@ uint64_t vtpu_rate_acquire(vtpu_region* r, int dev, uint64_t cost_us,
     /* High-priority tasks may borrow (run the bucket negative); they still
      * consume, so background tenants pay it back later. */
     ds->tokens_us -= (int64_t)cost_us;
-    if (ds->debit_outstanding < 64) {
-      ds->debit_flags |= 1ull << ds->debit_outstanding;
-      ds->debit_outstanding++; /* flag bit 1: debited */
-    }
   } else {
     int64_t deficit_us = need - ds->tokens_us;
     wait_ns = (uint64_t)deficit_us * 1000ull * 100ull / (uint64_t)pct;
@@ -624,19 +618,12 @@ void vtpu_rate_adjust(vtpu_region* r, int dev, int64_t delta_us) {
   if (dev < 0 || dev >= g->ndevices) return;
   if (lock_region(g) != 0) return;
   DeviceState* ds = &g->dev[dev];
-  /* Pop the acquire-time record: the correction applies only when the
-   * matching acquire was actually DEBITED.  Re-evaluating demand here
-   * instead would bill corrections against a sole demander's undebited
-   * executes the moment contention arrives mid-flight, starting it in
-   * unearned debt.  An unmatched adjust (legacy caller, ring overflow)
-   * degrades to the pre-work-conserving behavior: apply. */
-  int debited = 1;
-  if (ds->debit_outstanding > 0) {
-    debited = (int)(ds->debit_flags & 1ull);
-    ds->debit_flags >>= 1;
-    ds->debit_outstanding--;
-  }
-  if (ds->core_limit_pct > 0 && debited) {
+  /* Consume an un-debited admission credit when one is outstanding:
+   * that acquire charged nothing, so its correction must charge
+   * nothing (see undebited_outstanding).  Otherwise apply. */
+  if (ds->undebited_outstanding > 0) {
+    ds->undebited_outstanding--;
+  } else if (ds->core_limit_pct > 0) {
     ds->tokens_us -= delta_us;
     if (ds->tokens_us > kBurstCapUs) ds->tokens_us = kBurstCapUs;
   }
@@ -691,8 +678,7 @@ void vtpu_reset_slot(vtpu_region* r, int dev) {
   g->dev[dev].tokens_us = kBurstCapUs;
   g->dev[dev].last_refill_ns = now_ns();
   g->dev[dev].last_demand_ns = 0; /* recycled slot: not contending */
-  g->dev[dev].debit_flags = 0;
-  g->dev[dev].debit_outstanding = 0;
+  g->dev[dev].undebited_outstanding = 0;
   g->dev[dev].peak_bytes = g->dev[dev].used_bytes;
   unlock_region(g);
 }

