@@ -952,9 +952,14 @@ class TenantSession(socketserver.BaseRequestHandler):
                     if not admitted:
                         # Quota pressure: staged spill copies are pure
                         # cache — evict them before refusing/spilling a
-                        # real PUT.
+                        # real PUT.  Only the SHORTFALL: copies that
+                        # could stay resident would otherwise be
+                        # re-staged on their next execute.
+                        free, _ = tenant.chip.region.mem_info(
+                            tenant.index)
                         with tenant.mu:
-                            freed = tenant.evict_staged_for(nbytes)
+                            freed = tenant.evict_staged_for(
+                                max(nbytes - free, 1))
                         if freed:
                             admitted = tenant.chip.region.mem_acquire(
                                 tenant.index, nbytes, False)
@@ -1124,6 +1129,8 @@ class TenantSession(socketserver.BaseRequestHandler):
             tenants = list(self.state.tenants.items())
         for name, t in tenants:
             st = t.chip.region.device_stats(t.index)
+            with t.mu:  # staged_bytes is mutated under t.mu by dispatch
+                staged = sum(t.staged_bytes.values())
             out[name] = {
                 "index": t.index,
                 "chip": t.chip.index,
@@ -1133,7 +1140,7 @@ class TenantSession(socketserver.BaseRequestHandler):
                 "core_limit_pct": int(st.core_limit_pct),
                 "arrays": len(t.arrays),
                 "host_spill_bytes": int(t.host_bytes),
-                "staged_resident_bytes": sum(t.staged_bytes.values()),
+                "staged_resident_bytes": staged,
                 "executions": t.executions,
             }
         return out

@@ -435,7 +435,8 @@ def main():
               if direct_tput else 0.0)
 
     def phase(name, hbm, core, n_tenants=None, psteps=None,
-              hbm_grant=None, oversub=False, concrete=False):
+              hbm_grant=None, oversub=False, concrete=False,
+              cfg=None, pbatch=None, pseq=None):
         print(f"[bench] phase {name} starting", file=sys.stderr)
         sock = os.path.join(tmp, f"{name}.sock")
         broker = start_broker(sock, os.path.join(tmp, f"{name}.shr"),
@@ -443,8 +444,9 @@ def main():
         try:
             wait_socket(sock, broker)
             out = measure(sock, n_tenants or args.tenants,
-                          psteps or steps, warmup, cfg_name,
-                          batch, seq, core, hbm_limit=hbm_grant,
+                          psteps or steps, warmup, cfg or cfg_name,
+                          pbatch or batch, pseq or seq, core,
+                          hbm_limit=hbm_grant,
                           oversubscribe=oversub,
                           concrete_params=concrete)
             print(f"[bench] phase {name}: {out:.3f} steps/s",
@@ -476,6 +478,7 @@ def main():
     # overhead.  Skipped on CPU smoke (no axon plugin; spill covered by
     # tests/test_oversubscribe.py there).
     over_tput = 0.0
+    llama_tput = 0.0
     interp_rates = []
     if not quick and not args.skip_extras:
         # Extras must never cost the headline number: a failure here
@@ -503,6 +506,16 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"[bench] interposed phase failed: {e}",
                   file=sys.stderr)
+        try:
+            # BASELINE config 5's model family: Llama-3-8B shapes
+            # (truncated stack, full 128k vocab — ~3.8 GB bf16 params)
+            # under 2 brokered 50% tenants on the real chip.
+            llama_tput = phase(
+                "llama", "6144Mi", 50, n_tenants=2,
+                psteps=max(steps // 3, 10),
+                cfg="llama_8b_proportions", pbatch=2, pseq=512)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] llama phase failed: {e}", file=sys.stderr)
 
     if quick:
         peak = 0.0  # CPU smoke: no meaningful MFU
@@ -547,6 +560,13 @@ def main():
         "partial_2active_steps_per_s": round(partial_tput, 3),
         "partial_2active_vs_direct": round(
             partial_tput / direct_tput if direct_tput else 0.0, 4),
+        # BASELINE config 5 flavor: Llama-3-8B-proportioned model, 2
+        # brokered 50% tenants (aggregate steps/s + analytic MFU).
+        "llama_2tenant_steps_per_s": round(llama_tput, 3),
+        "llama_2tenant_mfu": round(
+            (llama_tput * model_flops_per_step(
+                tr.TransformerConfig.llama_8b_proportions(), 2, 512)
+             / peak) if peak else 0.0, 4),
         "tflop_per_step": round(tflop_per_step, 6),
         "gflop_per_step": round(tflop_per_step * 1000, 3),
         "direct_mfu": round(mfu(direct_tput), 4),

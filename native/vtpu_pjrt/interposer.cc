@@ -968,10 +968,16 @@ static uint64_t evict_staged(int dev, uint64_t need) {
 
 /* Strict quota acquire with staged-cache eviction as the fallback: the
  * residency cache must never cause an OOM a cache-less build would not
- * have had. */
+ * have had.  Evicts only the SHORTFALL, not the full request — cached
+ * copies that could stay resident would otherwise be re-staged on
+ * their next execute, re-paying the overhead the cache removes. */
 static int acquire_with_evict(int dev, uint64_t est, int oversubscribe) {
   if (vtpu_mem_acquire(g_region, dev, est, oversubscribe) == 0) return 0;
-  if (evict_staged(dev, est) == 0) return -1;
+  uint64_t freeb = 0, total = 0;
+  uint64_t shortfall = est;
+  if (vtpu_mem_info(g_region, dev, &freeb, &total) == 0 && freeb < est)
+    shortfall = est - freeb;
+  if (evict_staged(dev, shortfall) == 0) return -1;
   return vtpu_mem_acquire(g_region, dev, est, oversubscribe);
 }
 
@@ -1283,7 +1289,15 @@ static PJRT_Error* w_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
             if (host) {
               auto sc = staged_cache().find(patched_args[a]);
               if (sc != staged_cache().end()) {
-                if (sc->second.dev == tdev) {
+                if (sc->second.orphaned) {
+                  /* Dangling entry: its HOST key was destroyed while
+                   * the copy was pinned, and the allocator may have
+                   * reused the address for THIS buffer — matching it
+                   * would compute on the dead buffer's stale copy.
+                   * Miss, and block a new install until the pinned
+                   * teardown completes. */
+                  cache_busy = true;
+                } else if (sc->second.dev == tdev) {
                   sc->second.in_flight++;
                   sc->second.last_use_us = now_us();
                   cached = sc->second.dcopy;

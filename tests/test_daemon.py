@@ -149,3 +149,48 @@ def test_daemon_fail_on_init_error(tmp_path):
          "--fail-on-init-error", "true"],
         env=env, stderr=subprocess.PIPE, text=True)
     assert proc.wait(timeout=15) == 1
+
+
+def test_entrypoint_stages_preload_artifacts(tmp_path):
+    """entrypoint.sh stages the native artifacts to the hostPath and
+    writes the one-line ld.so.preload list Allocate later mounts over
+    /etc/ld.so.preload (forced injection, reference server.go:511-515).
+    The staging block is exercised as shipped; only the final daemon
+    exec is stripped."""
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    for name in ("libvtpu_pjrt.so", "libvtpucore.so",
+                 "libvtpu_preload.so"):
+        (stage / name).write_text("elf")
+    host = tmp_path / "host"
+    env = dict(os.environ, VTPU_STAGE_SRC=str(stage),
+               VTPU_HOST_LIB_DIR=str(host))
+    r = subprocess.run(
+        ["sh", "-c",
+         f"sed '/^exec /d' {REPO}/entrypoint.sh | sh -s"],
+        env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    for name in ("libvtpu_pjrt.so", "libvtpucore.so",
+                 "libvtpu_preload.so"):
+        assert (host / name).exists()
+    assert (host / "shared").is_dir()
+    assert (host / "ld.so.preload").read_text() == \
+        "/usr/local/vtpu/libvtpu_preload.so\n"
+
+
+def test_entrypoint_no_preload_lib_no_list(tmp_path):
+    """Without the preload lib staged (older image), no ld.so.preload
+    list is written — Allocate then skips the mount (gated on both
+    files existing)."""
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    (stage / "libvtpu_pjrt.so").write_text("elf")
+    host = tmp_path / "host"
+    env = dict(os.environ, VTPU_STAGE_SRC=str(stage),
+               VTPU_HOST_LIB_DIR=str(host))
+    r = subprocess.run(
+        ["sh", "-c",
+         f"sed '/^exec /d' {REPO}/entrypoint.sh | sh -s"],
+        env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert not (host / "ld.so.preload").exists()
