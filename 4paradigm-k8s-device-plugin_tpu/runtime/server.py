@@ -127,6 +127,10 @@ class Tenant:
         self.staged: "collections.OrderedDict[str, Any]" = \
             collections.OrderedDict()
         self.staged_bytes: Dict[str, int] = {}
+        # Sum of staged_bytes, maintained under self.mu but READ without
+        # it (atomic int read): STATS must not block on the dispatch
+        # loop, which holds self.mu across GB-scale device_put staging.
+        self.staged_total = 0
         self.nbytes: Dict[str, int] = {}
         self.executables: Dict[str, Any] = {}
         self.cost_ema: Dict[str, float] = {}
@@ -148,6 +152,7 @@ class Tenant:
         """Evict one staged spill copy (caller holds self.mu)."""
         if self.staged.pop(aid, None) is not None:
             nb = self.staged_bytes.pop(aid, 0)
+            self.staged_total -= nb
             if nb and self.chip is not None:
                 self.chip.region.mem_release(self.index, nb)
 
@@ -366,10 +371,28 @@ class DeviceScheduler:
                                 a = jax.device_put(host_np,
                                                    self.chip.device)
                                 nb = int(host_np.nbytes)
-                                if self.chip.region.mem_acquire(
-                                        t.index, nb, False):
+                                admit = self.chip.region.mem_acquire(
+                                    t.index, nb, False)
+                                if not admit:
+                                    # Bounded overshoot residency (the
+                                    # unified-memory analogue): cache
+                                    # past the quota while books stay
+                                    # under limit*(1+overshoot) —
+                                    # checked ATOMICALLY, so concurrent
+                                    # allocations cannot push past the
+                                    # advertised ceiling.
+                                    ov = self.state.spill_overshoot
+                                    st = self.chip.region.device_stats(
+                                        t.index)
+                                    cap = int(st.limit_bytes * (1 + ov))
+                                    if ov > 0 and st.limit_bytes:
+                                        admit = (self.chip.region
+                                                 .mem_acquire_capped(
+                                                     t.index, nb, cap))
+                                if admit:
                                     t.staged[aid] = a
                                     t.staged_bytes[aid] = nb
+                                    t.staged_total += nb
                         if a is None:
                             raise KeyError(f"NOT_FOUND: {aid}")
                         args.append(a)
@@ -627,6 +650,17 @@ class RuntimeState:
             work_conserving = os.environ.get(
                 "VTPU_WORK_CONSERVING", "1") != "0"
         self.work_conserving = work_conserving
+        # Spilled-operand residency past the quota, as a fraction of the
+        # quota (default 1.0: books may reach 2x limit).  The reference's
+        # unified-memory spill caches hot pages ON DEVICE regardless of
+        # the tenant's quota (README.md:104) — explicit-staging must be
+        # allowed the same, or an over-quota model re-crosses the
+        # host->device link every step.  The overshoot is oversubscribe-
+        # accounted (visible in stats), backed by the authoritative host
+        # copy, and evicted on any real allocation's quota pressure.
+        # 0 disables (staged copies then stay strictly within quota).
+        self.spill_overshoot = float(os.environ.get(
+            "VTPU_SPILL_RESIDENT_OVERSHOOT", "1.0"))
         # The broker's "device" axis is CHIPS: PJRT devices are
         # TensorCores, and multi-core generations (v4/v5p) expose two
         # per chip.  Group by chip coords so HELLO's device index (the
@@ -954,12 +988,16 @@ class TenantSession(socketserver.BaseRequestHandler):
                         # cache — evict them before refusing/spilling a
                         # real PUT.  Only the SHORTFALL: copies that
                         # could stay resident would otherwise be
-                        # re-staged on their next execute.
-                        free, _ = tenant.chip.region.mem_info(
+                        # re-staged on their next execute.  Books may
+                        # sit past the limit (overshoot residency), so
+                        # the shortfall is used+request-limit, not just
+                        # request-free.
+                        st = tenant.chip.region.device_stats(
                             tenant.index)
+                        short = max(int(st.used_bytes) + nbytes
+                                    - int(st.limit_bytes), 1)
                         with tenant.mu:
-                            freed = tenant.evict_staged_for(
-                                max(nbytes - free, 1))
+                            freed = tenant.evict_staged_for(short)
                         if freed:
                             admitted = tenant.chip.region.mem_acquire(
                                 tenant.index, nbytes, False)
@@ -1129,8 +1167,9 @@ class TenantSession(socketserver.BaseRequestHandler):
             tenants = list(self.state.tenants.items())
         for name, t in tenants:
             st = t.chip.region.device_stats(t.index)
-            with t.mu:  # staged_bytes is mutated under t.mu by dispatch
-                staged = sum(t.staged_bytes.values())
+            # Lock-free: taking t.mu here would block monitoring behind
+            # the dispatch loop's GB-scale staging transfers.
+            staged = t.staged_total
             out[name] = {
                 "index": t.index,
                 "chip": t.chip.index,

@@ -81,6 +81,9 @@ def load() -> ctypes.CDLL:
     lib.vtpu_mem_acquire.restype = ctypes.c_int
     lib.vtpu_mem_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                      ctypes.c_uint64, ctypes.c_int]
+    lib.vtpu_mem_acquire_capped.restype = ctypes.c_int
+    lib.vtpu_mem_acquire_capped.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64]
     lib.vtpu_mem_release.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                      ctypes.c_uint64]
     lib.vtpu_mem_info.restype = ctypes.c_int
@@ -163,6 +166,13 @@ class SharedRegion:
                     oversubscribe: bool = False) -> bool:
         return self.lib.vtpu_mem_acquire(self.handle, dev, nbytes,
                                          1 if oversubscribe else 0) == 0
+
+    def mem_acquire_capped(self, dev: int, nbytes: int,
+                           cap_bytes: int) -> bool:
+        """Admit past the limit up to cap_bytes total, atomically
+        (broker overshoot residency)."""
+        return self.lib.vtpu_mem_acquire_capped(
+            self.handle, dev, nbytes, int(cap_bytes)) == 0
 
     def mem_release(self, dev: int, nbytes: int) -> None:
         self.lib.vtpu_mem_release(self.handle, dev, nbytes)

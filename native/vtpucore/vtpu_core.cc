@@ -425,6 +425,31 @@ int vtpu_mem_acquire(vtpu_region* r, int dev, uint64_t bytes,
   return 0;
 }
 
+int vtpu_mem_acquire_capped(vtpu_region* r, int dev, uint64_t bytes,
+                            uint64_t cap_bytes) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) {
+    errno = EINVAL;
+    return -1;
+  }
+  if (lock_region(g) != 0) return -1;
+  DeviceState* ds = &g->dev[dev];
+  if (ds->used_bytes + bytes > cap_bytes) {
+    unlock_region(g);
+    errno = ENOMEM;
+    return -1;
+  }
+  ds->used_bytes += bytes;
+  if (ds->used_bytes > ds->peak_bytes) ds->peak_bytes = ds->used_bytes;
+  ProcSlot* p = my_slot_locked(r, g);
+  if (p) {
+    p->used_bytes[dev] += bytes;
+    p->last_seen_ns = now_ns();
+  }
+  unlock_region(g);
+  return 0;
+}
+
 void vtpu_mem_release(vtpu_region* r, int dev, uint64_t bytes) {
   Region* g = r->shm;
   if (dev < 0 || dev >= g->ndevices) return;

@@ -103,6 +103,13 @@ int vtpu_sweep_dead_host(vtpu_region* r);
 int vtpu_mem_acquire(vtpu_region* r, int dev, uint64_t bytes,
                      int oversubscribe);
 
+/* Admit past the limit but only up to `cap_bytes` total usage, checked
+ * atomically under the region lock (the broker's bounded overshoot
+ * residency: a read-check-acquire sequence would race concurrent
+ * allocations past the advertised ceiling).  Returns 0 when admitted. */
+int vtpu_mem_acquire_capped(vtpu_region* r, int dev, uint64_t bytes,
+                            uint64_t cap_bytes);
+
 /* Release `bytes` previously acquired on `dev` by this process. */
 void vtpu_mem_release(vtpu_region* r, int dev, uint64_t bytes);
 

@@ -111,6 +111,77 @@ def test_spill_residency_cache_and_eviction(broker):
     c.close()
 
 
+def test_overshoot_residency_caches_past_quota(broker):
+    """A spilled operand larger than the remaining quota still goes
+    resident under the bounded overshoot (default 1.0: books up to 2x
+    limit) — the unified-memory analogue: the reference caches hot
+    spilled pages on device regardless of the tenant's quota
+    (README.md:104).  A later real PUT's pressure evicts it."""
+    c = _client(broker, "overshoot", oversubscribe=True)
+    n = 6_000_000 // 4
+    c.put(np.full(n, 2.0, np.float32), "w")  # 6 MB > 4 MB quota: spills
+    exe = c.compile(lambda x: x + 1.0, [np.zeros(n, np.float32)])
+    from vtpu.runtime.client import RemoteArray
+    w = RemoteArray(c, "w", (n,), "float32")
+    exe(w)[0].delete()
+    st = c.stats()["overshoot"]
+    assert st["staged_resident_bytes"] == 6_000_000, st
+    assert st["used_bytes"] == 6_000_000  # books past the 4 MB limit
+    assert st["limit_bytes"] == 4_000_000
+    # Reuse, not re-staging.
+    exe(w)[0].delete()
+    st = c.stats()["overshoot"]
+    assert st["staged_resident_bytes"] == 6_000_000
+
+    # A real PUT under pressure evicts the overshooting copy and lands
+    # resident.
+    m = 3_000_000 // 4
+    c.put(np.full(m, 1.0, np.float32), "real")
+    st = c.stats()["overshoot"]
+    assert st["staged_resident_bytes"] == 0
+    assert st["used_bytes"] == 3_000_000
+    # The spilled operand still computes and reads back.
+    np.testing.assert_array_equal(exe(w)[0].fetch()[:2], [3.0, 3.0])
+    c.close()
+
+
+def test_overshoot_disabled_keeps_books_within_quota(tmp_path):
+    """VTPU_SPILL_RESIDENT_OVERSHOOT=0: staged copies stay strictly
+    within quota; an over-quota operand is re-staged transiently and
+    the books never exceed the limit."""
+    import threading as th
+
+    old = os.environ.get("VTPU_SPILL_RESIDENT_OVERSHOOT")
+    os.environ["VTPU_SPILL_RESIDENT_OVERSHOOT"] = "0"
+    try:
+        sock = str(tmp_path / "strict.sock")
+        srv = make_server(sock, hbm_limit=4 * MB, core_limit=0,
+                          region_path=str(tmp_path / "strict.shr"))
+        t = th.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            c = _client(sock, "strictres", oversubscribe=True)
+            n = 6_000_000 // 4
+            c.put(np.full(n, 2.0, np.float32), "w")
+            exe = c.compile(lambda x: x + 1.0,
+                            [np.zeros(n, np.float32)])
+            from vtpu.runtime.client import RemoteArray
+            w = RemoteArray(c, "w", (n,), "float32")
+            exe(w)[0].delete()
+            st = c.stats()["strictres"]
+            assert st["staged_resident_bytes"] == 0, st
+            assert st["used_bytes"] <= 4_000_000
+            c.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    finally:
+        if old is None:
+            os.environ.pop("VTPU_SPILL_RESIDENT_OVERSHOOT", None)
+        else:
+            os.environ["VTPU_SPILL_RESIDENT_OVERSHOOT"] = old
+
+
 def test_overcommitted_training_progresses(broker):
     """Tiny 'BERT-ish' training under oversubscription: weights exceed the
     device quota, loss still decreases (host-staged weights)."""
