@@ -1,0 +1,83 @@
+/* DT_NEEDED fixture: a binary LINKED against libtpu.so (the mock,
+ * staged as build/fake_libtpu/libtpu.so) that calls GetPjrtApi()
+ * through normal symbol resolution — the workload class the dlopen
+ * hook cannot reach (the loader maps the library before any hook
+ * runs).  Under LD_PRELOAD=libvtpu_preload.so (standing in for the
+ * /etc/ld.so.preload mount) the preload object's GetPjrtApi leads the
+ * global lookup order and forwards to the interposer.
+ *
+ * Modes (argv[1]):
+ *   enforced   - preload active: the quota must bite
+ *   unenforced - no preload: the raw mock admits anything (proves the
+ *                preload is what added enforcement)
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+extern "C" const PJRT_Api* GetPjrtApi(void); /* resolved at link time */
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "dtneeded_fixture CHECK failed at %s:%d: %s\n",  \
+              __FILE__, __LINE__, #cond);                              \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: dtneeded_fixture <enforced|unenforced>\n");
+    return 2;
+  }
+  int want_enforced = strcmp(argv[1], "enforced") == 0;
+
+  const PJRT_Api* api = GetPjrtApi();
+  CHECK(api != NULL);
+
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == NULL);
+
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == NULL);
+  CHECK(da.num_addressable_devices >= 1);
+
+  /* 2 MiB of floats against a 1 MiB quota: must fail enforced, pass
+   * raw. */
+  static float src[1] = {0};
+  PJRT_Client_BufferFromHostBuffer_Args ba;
+  memset(&ba, 0, sizeof(ba));
+  ba.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  ba.client = ca.client;
+  ba.data = src;
+  ba.type = PJRT_Buffer_Type_F32;
+  int64_t big[1] = {512 * 1024};
+  ba.dims = big;
+  ba.num_dims = 1;
+  ba.host_buffer_semantics = PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+  ba.device = da.addressable_devices[0];
+  PJRT_Error* e = api->PJRT_Client_BufferFromHostBuffer(&ba);
+  if (want_enforced) {
+    CHECK(e != NULL);
+    PJRT_Error_GetCode_Args gc;
+    memset(&gc, 0, sizeof(gc));
+    gc.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+    gc.error = e;
+    api->PJRT_Error_GetCode(&gc);
+    CHECK(gc.code == PJRT_Error_Code_RESOURCE_EXHAUSTED);
+    printf("dtneeded_fixture enforced: linked GetPjrtApi forwarded, "
+           "quota bites\n");
+  } else {
+    CHECK(e == NULL);
+    printf("dtneeded_fixture unenforced: raw linked backend admits\n");
+  }
+  return 0;
+}

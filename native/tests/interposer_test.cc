@@ -556,18 +556,24 @@ static int sc_copyalloc(const char* dir, const char* shr) {
   return 0;
 }
 
-static int run_fixture(const char* dir, const char* mode,
-                       const char* libtpu) {
-  std::string fixture = std::string(dir) + "/preload_fixture";
+/* fork+exec a fixture binary; any spawn failure is a non-zero result
+ * (a fork/waitpid error must never read as a passing fixture). */
+static int run_child(const std::string& path, const char* a1,
+                     const char* a2 = nullptr) {
   pid_t pid = fork();
+  if (pid < 0) return 125;
   if (pid == 0) {
-    execl(fixture.c_str(), fixture.c_str(), mode, libtpu,
-          (char*)nullptr);
+    execl(path.c_str(), path.c_str(), a1, a2, (char*)nullptr);
     _exit(127);
   }
   int st = 0;
-  waitpid(pid, &st, 0);
+  if (waitpid(pid, &st, 0) != pid) return 126;
   return WIFEXITED(st) ? WEXITSTATUS(st) : 128;
+}
+
+static int run_fixture(const char* dir, const char* mode,
+                       const char* libtpu) {
+  return run_child(std::string(dir) + "/preload_fixture", mode, libtpu);
 }
 
 static int sc_preload(const char* dir, const char* shr) {
@@ -615,6 +621,39 @@ static int sc_preload(const char* dir, const char* shr) {
   return 0;
 }
 
+static int sc_dtneeded(const char* dir, const char* shr) {
+  /* A binary LINKED against libtpu (DT_NEEDED) never calls dlopen; the
+   * preload covers it by exporting GetPjrtApi, which leads the global
+   * lookup order and forwards to the interposer.  Without the preload
+   * the same binary runs raw — proving the preload added the
+   * enforcement. */
+  char cwd[1024];
+  CHECK(getcwd(cwd, sizeof(cwd)) != nullptr);
+  std::string abs_dir =
+      dir[0] == '/' ? std::string(dir) : std::string(cwd) + "/" + dir;
+  std::string fixture = abs_dir + "/dtneeded_fixture";
+
+  setenv("VTPU_INTERPOSER_PATH",
+         (abs_dir + "/libvtpu_pjrt.so").c_str(), 1);
+  setenv("VTPU_DEVICE_MEMORY_SHARED_CACHE", shr, 1);
+  setenv("MOCK_PJRT_DEVICES", "1", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "1Mi", 1);
+  /* The linked backend is not at a default install path in the test
+   * tree; in production the interposer's kRealPaths scan finds it. */
+  setenv("VTPU_REAL_LIBTPU",
+         (abs_dir + "/fake_libtpu/libtpu.so").c_str(), 1);
+  unsetenv("TPU_LIBRARY_PATH");
+  unsetenv("PYTHONPATH");
+
+  setenv("LD_PRELOAD", (abs_dir + "/libvtpu_preload.so").c_str(), 1);
+  CHECK(run_child(fixture, "enforced") == 0);
+  unsetenv("LD_PRELOAD");
+  CHECK(run_child(fixture, "unenforced") == 0);
+  printf("dtneeded: linked-libtpu GetPjrtApi forwarded under preload, "
+         "raw without\n");
+  return 0;
+}
+
 /* ---- driver ------------------------------------------------------- */
 
 struct Scenario {
@@ -635,6 +674,7 @@ static const Scenario kScenarios[] = {
     {"donation", sc_donation, 0},
     {"copyalloc", sc_copyalloc, 0},
     {"preload", sc_preload, 0},
+    {"dtneeded", sc_dtneeded, 0},
 };
 
 int main(int argc, char** argv) {
