@@ -44,7 +44,18 @@ COMPILE = "compile"      # {id, exported} -> {ok}
 # static); completion-time failures surface on the next sync request.
 EXECUTE = "execute"      # {exe, args: [ids], outs: [ids], repeats?, carry?}
 STATS = "stats"          # {} -> {ok, tenants: {...}}
-SHUTDOWN = "shutdown"    # {} -> {ok}  (admin)
+
+# Admin verbs — served ONLY on the host-side admin socket
+# (<socket>.admin, never mounted into tenant containers: the tenant
+# socket rejecting these is what keeps one tenant from suspending or
+# killing its neighbours).  SUSPEND/RESUME are the whole-task
+# suspend/resume control the reference's interceptor wields internally
+# (suspend_all/resume_all, SURVEY §2.9d), surfaced as an ops verb: a
+# suspended tenant's queue stops dispatching (work stays queued), other
+# tenants are unaffected.
+SUSPEND = "suspend"      # {tenant} -> {ok}
+RESUME = "resume"        # {tenant} -> {ok}
+SHUTDOWN = "shutdown"    # {} -> {ok}  then the broker exits gracefully
 
 
 class ProtocolError(RuntimeError):

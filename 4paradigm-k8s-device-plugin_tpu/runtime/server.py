@@ -275,6 +275,29 @@ class DeviceScheduler:
             if name in self.rr:
                 self.rr.remove(name)
 
+    def purge_session(self, session) -> int:
+        """Drop still-QUEUED items submitted by a now-dead connection
+        (dispatched items complete normally).  Without this, a
+        suspended or heavily-throttled tenant's disconnect would wedge
+        in teardown: _drain waits for replies of items the scheduler
+        will not dispatch for a long time (or, suspended, ever).
+        Dependents of the dropped items' out-ids fail NOT_FOUND — the
+        connection that would have consumed the replies is gone."""
+        purged = []
+        with self.mu:
+            for q in self.queues.values():
+                kept = [it for it in q if it.session is not session]
+                if len(kept) != len(q):
+                    purged.extend(it for it in q
+                                  if it.session is session)
+                    q.clear()
+                    q.extend(kept)
+            if purged:
+                self.mu.notify_all()
+        for it in purged:
+            session.abandon(it)
+        return len(purged)
+
     # -- dispatch ----------------------------------------------------------
 
     def _pick_locked(self):
@@ -295,6 +318,8 @@ class DeviceScheduler:
             q = self.queues.get(name)
             if not q:
                 continue
+            if name in self.state.suspended:
+                continue  # admin-suspended: hold the queue
             if self.inflight.get(name, 0) >= MAX_INFLIGHT:
                 continue
             nr = self.not_ready_until.get(name, 0.0)
@@ -681,6 +706,11 @@ class RuntimeState:
         self.default_core = core_limit
         self.min_exec_cost_us = min_exec_cost_us
         self.tenants: Dict[str, Tenant] = {}
+        # Admin-suspended tenant names (reference suspend_all/resume_all
+        # analogue, SURVEY §2.9d): their queues stop dispatching.  Set
+        # only via the host-side admin socket; reads are racy-by-design
+        # (a dispatch racing a suspend runs at most one extra item).
+        self.suspended: set = set()
         self.blob_cache: "collections.OrderedDict[str, Any]" = \
             collections.OrderedDict()
         self.chain_cache: "collections.OrderedDict[tuple, Any]" = \
@@ -796,6 +826,10 @@ class RuntimeState:
                 return False
             self.tenants.pop(t.name, None)
             t.chip.scheduler.forget_tenant(t.name)
+            # Suspension dies with the tenant instance: a redeployed pod
+            # reusing the name must not start silently frozen (the only
+            # clue would be the admin-side STATS list).
+            self.suspended.discard(t.name)
             return True
 
     def cached_blob(self, blob: bytes) -> "Program":
@@ -900,6 +934,13 @@ class TenantSession(socketserver.BaseRequestHandler):
             while self.pending > 0:
                 self.pending_cond.wait(timeout=0.5)
 
+    def abandon(self, item: WorkItem) -> None:
+        """A queued (never-dispatched) item of this dead connection was
+        purged: release its reply slot so teardown's drain completes."""
+        with self.pending_cond:
+            self.pending -= 1
+            self.pending_cond.notify_all()
+
     def handle(self):
         tenant_box: List[Optional[Tenant]] = [None]
         try:
@@ -907,9 +948,14 @@ class TenantSession(socketserver.BaseRequestHandler):
         finally:
             # Teardown must run no matter HOW the session died (a
             # decode bug escaping the loop once leaked the tenant's
-            # slot and HBM accounting forever).
-            self._drain()
+            # slot and HBM accounting forever).  Purge this dead
+            # connection's still-queued items first: a suspended (or
+            # deeply throttled) tenant would otherwise wedge the drain
+            # on replies the scheduler will not produce.
             t = tenant_box[0]
+            if t is not None:
+                t.chip.scheduler.purge_session(self)
+            self._drain()
             if t is not None and self.state.release_tenant(t):
                 self._cleanup(t)
 
@@ -1162,27 +1208,7 @@ class TenantSession(socketserver.BaseRequestHandler):
                 self.pending_cond.notify_all()
 
     def _stats(self):
-        out = {}
-        with self.state.mu:
-            tenants = list(self.state.tenants.items())
-        for name, t in tenants:
-            st = t.chip.region.device_stats(t.index)
-            # Lock-free: taking t.mu here would block monitoring behind
-            # the dispatch loop's GB-scale staging transfers.
-            staged = t.staged_total
-            out[name] = {
-                "index": t.index,
-                "chip": t.chip.index,
-                "used_bytes": int(st.used_bytes),
-                "limit_bytes": int(st.limit_bytes),
-                "peak_bytes": int(st.peak_bytes),
-                "core_limit_pct": int(st.core_limit_pct),
-                "arrays": len(t.arrays),
-                "host_spill_bytes": int(t.host_bytes),
-                "staged_resident_bytes": staged,
-                "executions": t.executions,
-            }
-        return out
+        return collect_stats(self.state)
 
     def _cleanup(self, t: Tenant):
         for aid in list(t.arrays) + list(t.host_arrays):
@@ -1190,9 +1216,94 @@ class TenantSession(socketserver.BaseRequestHandler):
         t.executables.clear()
 
 
+def collect_stats(state: RuntimeState):
+    out = {}
+    with state.mu:
+        tenants = list(state.tenants.items())
+    for name, t in tenants:
+        st = t.chip.region.device_stats(t.index)
+        # Lock-free: taking t.mu here would block monitoring behind
+        # the dispatch loop's GB-scale staging transfers.
+        staged = t.staged_total
+        out[name] = {
+            "index": t.index,
+            "chip": t.chip.index,
+            "used_bytes": int(st.used_bytes),
+            "limit_bytes": int(st.limit_bytes),
+            "peak_bytes": int(st.peak_bytes),
+            "core_limit_pct": int(st.core_limit_pct),
+            "arrays": len(t.arrays),
+            "host_spill_bytes": int(t.host_bytes),
+            "staged_resident_bytes": staged,
+            "suspended": name in state.suspended,
+            "executions": t.executions,
+        }
+    return out
+
+
+class AdminSession(socketserver.BaseRequestHandler):
+    """Host-side admin surface (<socket>.admin — NOT mounted into
+    tenant containers, which is what keeps a hostile tenant from
+    suspending or killing its neighbours).  Verbs: SUSPEND / RESUME
+    (reference suspend_all/resume_all, SURVEY §2.9d), STATS,
+    SHUTDOWN."""
+
+    state: RuntimeState  # injected by make_server
+
+    def handle(self):
+        while True:
+            try:
+                msg = P.recv_msg(self.request)
+            except (ConnectionError, P.ProtocolError):
+                return
+            kind = msg.get("kind")
+            try:
+                if kind in (P.SUSPEND, P.RESUME):
+                    name = str(msg["tenant"])
+                    if kind == P.SUSPEND:
+                        self.state.suspended.add(name)
+                    else:
+                        self.state.suspended.discard(name)
+                    # Wake every chip's dispatcher: a resumed tenant
+                    # must not wait out a scheduler sleep.
+                    for chip in list(self.state.chips.values()):
+                        with chip.scheduler.mu:
+                            chip.scheduler.mu.notify_all()
+                    log.info("admin: %s tenant %r", kind, name)
+                    P.send_msg(self.request, {"ok": True})
+                elif kind == P.STATS:
+                    P.send_msg(self.request,
+                               {"ok": True,
+                                "tenants": collect_stats(self.state),
+                                "suspended":
+                                    sorted(self.state.suspended)})
+                elif kind == P.SHUTDOWN:
+                    P.send_msg(self.request, {"ok": True})
+                    cb = getattr(self.state, "shutdown_cb", None)
+                    if cb is not None:
+                        threading.Thread(target=cb, daemon=True).start()
+                    return
+                else:
+                    P.reply_err(self.request, "BAD_KIND", str(kind))
+            except Exception as e:  # noqa: BLE001 - admin must survive
+                P.reply_err(self.request, "INTERNAL",
+                            f"{type(e).__name__}: {e}")
+
+
 class _Server(socketserver.ThreadingUnixStreamServer):
     daemon_threads = True
     allow_reuse_address = True
+    admin_server: "Optional[_Server]" = None
+
+    def shutdown(self):
+        if self.admin_server is not None:
+            self.admin_server.shutdown()
+        super().shutdown()
+
+    def server_close(self):
+        if self.admin_server is not None:
+            self.admin_server.server_close()
+        super().server_close()
 
 
 def make_server(socket_path: str, hbm_limit: int, core_limit: int,
@@ -1215,6 +1326,19 @@ def make_server(socket_path: str, hbm_limit: int, core_limit: int,
     handler = type("BoundSession", (TenantSession,), {"state": state})
     srv = _Server(socket_path, handler)
     srv.state = state  # type: ignore[attr-defined]
+    # Host-side admin socket (never mounted into containers): suspend/
+    # resume/stats/shutdown.  Served on its own thread; lifecycle is
+    # chained through _Server.shutdown/server_close.
+    admin_path = socket_path + ".admin"
+    if os.path.exists(admin_path):
+        os.unlink(admin_path)
+    admin_handler = type("BoundAdmin", (AdminSession,), {"state": state})
+    admin = _Server(admin_path, admin_handler)
+    admin.state = state  # type: ignore[attr-defined]
+    srv.admin_server = admin
+    state.shutdown_cb = srv.shutdown
+    threading.Thread(target=admin.serve_forever, daemon=True,
+                     name="vtpu-rt-admin").start()
     return srv
 
 

@@ -118,6 +118,22 @@ def render(infos: List[Dict]) -> str:
     return "\n".join(lines)
 
 
+def _admin_request(broker_socket: str, msg: dict) -> dict:
+    """One request over the broker's host-side admin socket
+    (<socket>.admin — suspend/resume/stats; see runtime/protocol.py)."""
+    import socket as socketmod
+
+    from ..runtime import protocol as P
+    s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+    s.settimeout(10.0)
+    try:
+        s.connect(broker_socket + ".admin")
+        P.send_msg(s, msg)
+        return P.recv_msg(s)
+    finally:
+        s.close()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
     ap.add_argument("--scan", default=None,
@@ -127,7 +143,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--sweep-host", action="store_true",
                     help="reclaim slots of dead host pids (node mode only)")
+    ap.add_argument("--broker", default=None, metavar="SOCKET",
+                    help="broker MAIN socket; enables the admin verbs "
+                         "below (talks to SOCKET.admin, host-only)")
+    ap.add_argument("--suspend", default=None, metavar="TENANT",
+                    help="hold TENANT's queue (reference "
+                         "suspend_all analogue)")
+    ap.add_argument("--resume", default=None, metavar="TENANT")
+    ap.add_argument("--broker-stats", action="store_true",
+                    help="per-tenant broker stats (quota, spill, "
+                         "residency, suspension)")
     ns = ap.parse_args(argv)
+
+    if (ns.suspend or ns.resume or ns.broker_stats) and not ns.broker:
+        ap.error("--suspend/--resume/--broker-stats need --broker "
+                 "<main socket>")
+    if ns.broker:
+        from ..runtime import protocol as P
+        if ns.suspend:
+            resp = _admin_request(ns.broker, {"kind": P.SUSPEND,
+                                              "tenant": ns.suspend})
+        elif ns.resume:
+            resp = _admin_request(ns.broker, {"kind": P.RESUME,
+                                              "tenant": ns.resume})
+        elif ns.broker_stats:
+            resp = _admin_request(ns.broker, {"kind": P.STATS})
+        else:
+            ap.error("--broker needs --suspend/--resume/--broker-stats")
+        print(json.dumps(resp, indent=2))
+        return 0 if resp.get("ok") else 1
 
     paths = ns.region or find_regions(ns.scan)
     infos = []

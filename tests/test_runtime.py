@@ -578,6 +578,137 @@ def test_connection_drop_shared_tenant_keeps_state(broker):
     dropper.close()
 
 
+def _admin(sock, msg):
+    import socket as sk
+
+    from vtpu.runtime import protocol as P
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.settimeout(10.0)
+    try:
+        s.connect(sock + ".admin")
+        P.send_msg(s, msg)
+        return P.recv_msg(s)
+    finally:
+        s.close()
+
+
+def test_admin_suspend_resume(broker):
+    """SUSPEND holds a tenant's queue (its executes stop dispatching)
+    while co-tenants keep running; RESUME releases the held work — the
+    reference's whole-task suspend/resume (SURVEY §2.9d) as a
+    host-side admin verb."""
+    from vtpu.runtime import protocol as P
+
+    victim = RuntimeClient(broker, tenant="victim")
+    bystander = RuntimeClient(broker, tenant="bystander")
+    exe_v = victim.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+    exe_b = bystander.compile(lambda a: a * 2.0,
+                              [np.ones(4, np.float32)])
+    hv = victim.put(np.ones(4, np.float32))
+    hb = bystander.put(np.ones(4, np.float32))
+    exe_v(hv)
+    exe_b(hb)
+
+    assert _admin(broker, {"kind": P.SUSPEND,
+                           "tenant": "victim"})["ok"]
+    # Pipeline executes without reading replies: they must stay queued.
+    out_ids = ["vs0"]
+    for _ in range(3):
+        victim.execute_send_ids(exe_v.id, [hv.id], out_ids)
+    time.sleep(0.5)
+    st = _admin(broker, {"kind": P.STATS})
+    assert st["tenants"]["victim"]["suspended"] is True
+    execs_while_suspended = st["tenants"]["victim"]["executions"]
+    # Bystander unaffected.
+    np.testing.assert_array_equal(exe_b(hb)[0].fetch(), [2, 2, 2, 2])
+    time.sleep(0.3)
+    st2 = _admin(broker, {"kind": P.STATS})
+    assert st2["tenants"]["victim"]["executions"] == \
+        execs_while_suspended, "suspended tenant must not dispatch"
+
+    assert _admin(broker, {"kind": P.RESUME, "tenant": "victim"})["ok"]
+    for _ in range(3):
+        victim.execute_recv()
+    np.testing.assert_array_equal(victim.get("vs0"), [2, 2, 2, 2])
+    st3 = _admin(broker, {"kind": P.STATS})
+    assert st3["tenants"]["victim"]["suspended"] is False
+    # executions is bumped by the metering thread after completion;
+    # admin STATS deliberately does not quiesce, so poll.
+    deadline = time.monotonic() + 10
+    while _admin(broker, {"kind": P.STATS})["tenants"]["victim"][
+            "executions"] <= execs_while_suspended:
+        assert time.monotonic() < deadline, "resumed work never metered"
+        time.sleep(0.05)
+    victim.close()
+    bystander.close()
+
+
+def test_tenant_socket_rejects_admin_verbs(broker):
+    """The TENANT socket (the one mounted into containers) must refuse
+    SUSPEND — otherwise any tenant could freeze its neighbours."""
+    import socket as sk
+
+    from vtpu.runtime import protocol as P
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.settimeout(10.0)
+    s.connect(broker)
+    P.send_msg(s, {"kind": P.HELLO, "tenant": "sneaky", "priority": 1})
+    assert P.recv_msg(s)["ok"]
+    P.send_msg(s, {"kind": P.SUSPEND, "tenant": "other"})
+    resp = P.recv_msg(s)
+    assert not resp["ok"] and resp["code"] == "BAD_KIND"
+    s.close()
+
+
+def test_suspended_tenant_disconnect_does_not_wedge(broker):
+    """A suspended tenant's connection dies with queued executes: the
+    queued items are purged (the scheduler will never dispatch them)
+    and teardown completes — slot and accounting are released."""
+    from vtpu.runtime import protocol as P
+
+    c = RuntimeClient(broker, tenant="wedgy")
+    exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+    h = c.put(np.ones(4, np.float32))
+    exe(h)
+    assert _admin(broker, {"kind": P.SUSPEND, "tenant": "wedgy"})["ok"]
+    for _ in range(4):
+        c.execute_send_ids(exe.id, [h.id], ["w0"])
+    c.sock.close()  # die with queued work
+    deadline = time.monotonic() + 15
+    while True:
+        st = _admin(broker, {"kind": P.STATS})
+        if "wedgy" not in st["tenants"]:
+            break
+        assert time.monotonic() < deadline, \
+            f"teardown wedged: {st['tenants'].get('wedgy')}"
+        time.sleep(0.1)
+    # Suspension dies with the tenant instance: a re-created tenant
+    # under the same name starts un-frozen.
+    assert "wedgy" not in _admin(broker, {"kind": P.STATS})["suspended"]
+    c2 = RuntimeClient(broker, tenant="wedgy")
+    h2 = c2.put(np.ones(4, np.float32))
+    exe2 = c2.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+    np.testing.assert_array_equal(exe2(h2)[0].fetch(), [2, 2, 2, 2])
+    c2.close()
+
+
+def test_admin_shutdown(tmp_path):
+    """SHUTDOWN on the admin socket stops the broker gracefully."""
+    from vtpu.runtime import protocol as P
+
+    sock = str(tmp_path / "sd.sock")
+    srv = make_server(sock, hbm_limit=0, core_limit=0,
+                      region_path=str(tmp_path / "sd.shr"))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    c = RuntimeClient(sock, tenant="bye")
+    c.put(np.ones(4, np.float32))
+    assert _admin(sock, {"kind": P.SHUTDOWN})["ok"]
+    t.join(timeout=10)
+    assert not t.is_alive(), "serve_forever did not stop"
+    srv.server_close()
+
+
 def test_malformed_frames_do_not_kill_broker(broker):
     """Garbage on one connection (bad msgpack, oversized frame header,
     truncated frame, unknown kind, wrong field types) must only affect
