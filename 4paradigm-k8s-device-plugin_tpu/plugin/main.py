@@ -91,7 +91,19 @@ class Daemon:
             log.warn("monitor/legacy mode requested but no in-cluster "
                      "credentials; pod matching disabled")
             return None
-        self.pod_lister = CachedPodLister(make_lister(client))
+        # Watch-based informer (reference vdevice-controller.go:162-223
+        # keeps a client-go informer): steady-state reads come from the
+        # watch-maintained cache, so Allocates cost no API LIST at all.
+        # VTPU_POD_INFORMER=0 falls back to the TTL-cached poller.
+        informer = None
+        if os.environ.get("VTPU_POD_INFORMER", "1") != "0":
+            from ..k8s.client import PodInformer
+            informer = PodInformer(client, self.cfg.node_name).start()
+            if not informer.wait_synced(5.0):
+                log.warn("pod informer slow to sync; serving stale-"
+                         "tolerant reads from the poll path meanwhile")
+        self.pod_lister = CachedPodLister(make_lister(client),
+                                          informer=informer)
         return self.pod_lister
 
     # -- runtime broker ------------------------------------------------------

