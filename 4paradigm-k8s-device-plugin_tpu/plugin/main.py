@@ -95,8 +95,13 @@ class Daemon:
         # keeps a client-go informer): steady-state reads come from the
         # watch-maintained cache, so Allocates cost no API LIST at all.
         # VTPU_POD_INFORMER=0 falls back to the TTL-cached poller.
+        # Monitor mode only — the legacy controller reads fresh=True
+        # exclusively (destructive free-on-absence needs
+        # list-linearized state), so an informer there would be a
+        # permanent WATCH with zero consumers.
         informer = None
-        if os.environ.get("VTPU_POD_INFORMER", "1") != "0":
+        if self.cfg.monitor_mode and \
+                os.environ.get("VTPU_POD_INFORMER", "1") != "0":
             from ..k8s.client import PodInformer
             informer = PodInformer(client, self.cfg.node_name).start()
             if not informer.wait_synced(5.0):

@@ -35,15 +35,50 @@ from .vtpu_smi import find_regions
 
 
 class MetricsState:
-    def __init__(self, scan: Optional[str], regions: List[str]):
+    def __init__(self, scan: Optional[str], regions: List[str],
+                 brokers: Optional[List[str]] = None):
         self.scan = scan
         self.explicit = regions
+        self.brokers = brokers or []
         # Duty cycle: previous (busy_us, t) sample per (region, device).
         self._prev: Dict[tuple, tuple] = {}
         self.mu = threading.Lock()
 
     def paths(self) -> List[str]:
         return self.explicit or find_regions(self.scan)
+
+    def collect_brokers(self) -> List[Dict]:
+        """Per-tenant broker stats over the host-side admin socket
+        (spill, residency, suspension — state the raw regions cannot
+        show).  Best-effort and bounded: brokers are scraped
+        concurrently with a short per-broker budget, and a dead,
+        wedged, or garbling broker is skipped — it must never cost the
+        scrape of healthy regions (Prometheus drops the WHOLE target
+        past its scrape_timeout)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..runtime import protocol as P
+        from .vtpu_smi import _admin_request
+
+        def scrape(sock):
+            try:
+                resp = _admin_request(sock, {"kind": P.STATS},
+                                      timeout=2.0)
+            except (OSError, P.ProtocolError) as e:
+                log.warn("broker %s unreachable: %s", sock, e)
+                return None
+            if not resp.get("ok"):
+                return None
+            return {"broker": sock,
+                    "tenants": resp.get("tenants", {}),
+                    "suspended": resp.get("suspended", [])}
+
+        if not self.brokers:
+            return []
+        with ThreadPoolExecutor(max_workers=min(len(self.brokers),
+                                                8)) as ex:
+            return [r for r in ex.map(scrape, self.brokers)
+                    if r is not None]
 
     def collect(self) -> List[Dict]:
         out = []
@@ -129,6 +164,54 @@ class MetricsState:
         return out
 
 
+def _esc(label: str) -> str:
+    """Prometheus exposition label escaping.  Tenant names are
+    TENANT-CONTROLLED (VTPU_TENANT / HELLO) — an unescaped quote or
+    newline would corrupt the whole scrape body, taking down node
+    observability from inside a container."""
+    return (str(label).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def broker_prometheus(brokers: List[Dict]) -> str:
+    lines = [
+        "# HELP vtpu_tenant_hbm_used_bytes Accounted HBM per broker "
+        "tenant (incl. overshoot residency).",
+        "# TYPE vtpu_tenant_hbm_used_bytes gauge",
+        "# HELP vtpu_tenant_hbm_limit_bytes HBM quota per broker tenant.",
+        "# TYPE vtpu_tenant_hbm_limit_bytes gauge",
+        "# HELP vtpu_tenant_host_spill_bytes Host-RAM spilled bytes per "
+        "tenant (oversubscription).",
+        "# TYPE vtpu_tenant_host_spill_bytes gauge",
+        "# HELP vtpu_tenant_staged_resident_bytes Device-resident spill "
+        "copies per tenant.",
+        "# TYPE vtpu_tenant_staged_resident_bytes gauge",
+        "# HELP vtpu_tenant_suspended 1 when the tenant is "
+        "admin-suspended.",
+        "# TYPE vtpu_tenant_suspended gauge",
+        "# HELP vtpu_tenant_executions_total Steps executed per tenant.",
+        "# TYPE vtpu_tenant_executions_total counter",
+    ]
+    for b in brokers:
+        broker = _esc(os.path.basename(b["broker"]))
+        for name, t in sorted(b["tenants"].items()):
+            labels = (f'{{broker="{broker}",tenant="{_esc(name)}",'
+                      f'chip="{t["chip"]}"}}')
+            lines.append(f'vtpu_tenant_hbm_used_bytes{labels} '
+                         f'{t["used_bytes"]}')
+            lines.append(f'vtpu_tenant_hbm_limit_bytes{labels} '
+                         f'{t["limit_bytes"]}')
+            lines.append(f'vtpu_tenant_host_spill_bytes{labels} '
+                         f'{t["host_spill_bytes"]}')
+            lines.append(f'vtpu_tenant_staged_resident_bytes{labels} '
+                         f'{t["staged_resident_bytes"]}')
+            lines.append(f'vtpu_tenant_suspended{labels} '
+                         f'{1 if t.get("suspended") else 0}')
+            lines.append(f'vtpu_tenant_executions_total{labels} '
+                         f'{t["executions"]}')
+    return "\n".join(lines) + "\n" if brokers else ""
+
+
 def to_prometheus(infos: List[Dict]) -> str:
     lines = [
         "# HELP vtpu_hbm_used_bytes Accounted HBM usage per vTPU device.",
@@ -187,11 +270,14 @@ def make_handler(state: MetricsState):
 
         def do_GET(self):  # noqa: N802 - stdlib API
             if self.path.startswith("/metrics"):
-                self._reply(200, to_prometheus(state.collect()),
-                            "text/plain; version=0.0.4")
+                body = to_prometheus(state.collect()) + \
+                    broker_prometheus(state.collect_brokers())
+                self._reply(200, body, "text/plain; version=0.0.4")
             elif self.path.startswith("/json"):
-                self._reply(200, json.dumps(state.collect(), indent=2),
-                            "application/json")
+                self._reply(200, json.dumps(
+                    {"regions": state.collect(),
+                     "brokers": state.collect_brokers()}, indent=2),
+                    "application/json")
             elif self.path.startswith("/healthz"):
                 self._reply(200, "ok\n", "text/plain")
             else:
@@ -202,8 +288,10 @@ def make_handler(state: MetricsState):
 
 def make_server(port: int, scan: Optional[str] = None,
                 regions: Optional[List[str]] = None,
-                host: str = "127.0.0.1") -> ThreadingHTTPServer:
-    state = MetricsState(scan, regions or [])
+                host: str = "127.0.0.1",
+                brokers: Optional[List[str]] = None
+                ) -> ThreadingHTTPServer:
+    state = MetricsState(scan, regions or [], brokers or [])
     srv = ThreadingHTTPServer((host, port), make_handler(state))
     srv.state = state  # type: ignore[attr-defined]
     return srv
@@ -217,8 +305,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--scan", default=None,
                     help="directory of per-pod shared regions (node mode)")
     ap.add_argument("--region", action="append", default=[])
+    ap.add_argument("--broker", action="append", default=[],
+                    help="broker MAIN socket (repeatable): adds "
+                         "per-tenant gauges (spill, residency, "
+                         "suspension) via the host-side admin socket")
     ns = ap.parse_args(argv)
-    srv = make_server(ns.port, ns.scan, ns.region, ns.host)
+    srv = make_server(ns.port, ns.scan, ns.region, ns.host, ns.broker)
     log.info("vtpu-metrics serving on %s:%d (/metrics /json /healthz)",
              ns.host, ns.port)
     try:

@@ -118,14 +118,15 @@ def render(infos: List[Dict]) -> str:
     return "\n".join(lines)
 
 
-def _admin_request(broker_socket: str, msg: dict) -> dict:
+def _admin_request(broker_socket: str, msg: dict,
+                   timeout: float = 10.0) -> dict:
     """One request over the broker's host-side admin socket
     (<socket>.admin — suspend/resume/stats; see runtime/protocol.py)."""
     import socket as socketmod
 
     from ..runtime import protocol as P
     s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
-    s.settimeout(10.0)
+    s.settimeout(timeout)
     try:
         s.connect(broker_socket + ".admin")
         P.send_msg(s, msg)

@@ -101,7 +101,7 @@ def test_metrics_server_per_proc_busy(tmp_path):
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/json") as resp:
             data = json.loads(resp.read().decode())
-        procs = data[0]["procs"]
+        procs = data["regions"][0]["procs"]
         assert any(p["busy_us"][0] == 4321 for p in procs)
         assert all("duty_cycle_pct" in p for p in procs)
     finally:
@@ -169,8 +169,10 @@ def test_metrics_server_prometheus_and_json(tmp_path):
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/json") as resp:
             data = json.loads(resp.read().decode())
-        assert data[0]["devices"][0]["hbm_used_bytes"] == 5 * MB
-        assert data[0]["procs"]  # merged process list is visible
+        regions = data["regions"]
+        assert regions[0]["devices"][0]["hbm_used_bytes"] == 5 * MB
+        assert regions[0]["procs"]  # merged process list is visible
+        assert data["brokers"] == []  # none configured
 
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz") as resp:
@@ -178,3 +180,45 @@ def test_metrics_server_prometheus_and_json(tmp_path):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_metrics_server_broker_tenant_gauges(tmp_path):
+    """--broker adds per-tenant gauges (spill, residency, suspension)
+    scraped over the broker's host-side admin socket — state the raw
+    regions cannot show."""
+    import numpy as np
+
+    from vtpu.runtime.client import RuntimeClient
+    from vtpu.runtime.server import make_server as make_broker
+
+    sock = str(tmp_path / "rt.sock")
+    broker = make_broker(sock, hbm_limit=8 * MB, core_limit=0,
+                         region_path=str(tmp_path / "rt.shr"))
+    bt = threading.Thread(target=broker.serve_forever, daemon=True)
+    bt.start()
+    srv = metrics_server.make_server(0, brokers=[sock])
+    port = srv.server_address[1]
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    try:
+        c = RuntimeClient(sock, tenant="scraped")
+        c.put(np.ones(MB // 4, np.float32))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            text = resp.read().decode()
+        assert 'vtpu_tenant_hbm_used_bytes' in text
+        assert 'tenant="scraped"' in text
+        assert 'vtpu_tenant_suspended' in text
+        assert 'vtpu_tenant_staged_resident_bytes' in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/json") as resp:
+            data = json.loads(resp.read().decode())
+        t = data["brokers"][0]["tenants"]["scraped"]
+        assert t["used_bytes"] == MB
+        assert t["suspended"] is False
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        broker.shutdown()
+        broker.server_close()
