@@ -36,6 +36,13 @@ class RuntimeError_(RuntimeError):
     pass
 
 
+class VtpuConnectionLost(RuntimeError_):
+    """The connection died and was rebound with tenant state intact —
+    only in-flight requests (and their replies) are lost.  Typed so
+    pipelined callers (the bridge) can tell 'my outstanding replies are
+    gone' apart from an application-level error reply."""
+
+
 class VtpuStateLost(RuntimeError_):
     """The broker restarted under this client (fresh HELLO epoch): every
     RemoteArray / RemoteExecutable handle is gone.  The client has
@@ -193,7 +200,7 @@ class RuntimeClient:
                     f"{why} (epoch {old} -> {new_epoch}); arrays and "
                     f"executables are lost — re-put/re-compile on this "
                     f"client", epoch_old=old, epoch_new=new_epoch)
-            raise RuntimeError_(
+            raise VtpuConnectionLost(
                 "CONNECTION_LOST: broker connection dropped and was "
                 "rebound (same epoch, state intact); in-flight requests "
                 "were lost")
@@ -258,7 +265,12 @@ class RuntimeClient:
 
     # -- data --
     def put(self, arr: np.ndarray, aid: Optional[str] = None) -> RemoteArray:
-        arr = np.ascontiguousarray(arr)
+        arr = np.asarray(arr)
+        if not arr.flags["C_CONTIGUOUS"]:
+            # NOT ascontiguousarray: that promotes 0-d scalars to (1,),
+            # which breaks rank-checked exported programs (bridge sends
+            # scalar args).  0-d arrays are always contiguous.
+            arr = np.ascontiguousarray(arr)
         aid = aid or f"a{next(self._ids)}"
         # dtype by NAME: extended types (bfloat16, fp8) have no portable
         # .str encoding; ml_dtypes registers the names on both ends.
@@ -274,16 +286,28 @@ class RuntimeClient:
     def delete(self, aid: str) -> None:
         self._rpc({"kind": P.DELETE, "id": aid})
 
+    def delete_many(self, aids: Sequence[str]) -> None:
+        """Batch delete: one round trip for any number of ids (the
+        bridge's deferred-free flush)."""
+        if aids:
+            self._rpc({"kind": P.DELETE, "ids": list(aids)})
+
     # -- compute --
     def compile(self, fn, example_args: Sequence[np.ndarray]) -> RemoteExecutable:
         """Trace+lower `fn` locally and register it remotely.  Lowered for
         both cpu and tpu so a CPU-only tenant (tracing needs no chip) can
         target a TPU-backed broker and vice versa."""
         import jax
-        exported = jax.export.export(jax.jit(fn),
+        # Under the transparent bridge jax.jit is patched (shim/bridge.py);
+        # the genuine jit rides on its _vtpu_real attribute.
+        jit = getattr(jax.jit, "_vtpu_real", jax.jit)
+        exported = jax.export.export(jit(fn),
                                      platforms=("cpu", "tpu"))(
             *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in example_args])
-        blob = exported.serialize()
+        return self.compile_blob(bytes(exported.serialize()))
+
+    def compile_blob(self, blob: bytes) -> RemoteExecutable:
+        """Register an already-serialized jax.export artifact."""
         eid = f"e{next(self._ids)}"
         self._rpc({"kind": P.COMPILE, "id": eid, "exported": bytes(blob)})
         return RemoteExecutable(self, eid)
@@ -311,17 +335,23 @@ class RuntimeClient:
 
     def execute_send_ids(self, eid: str, arg_ids: Sequence[str],
                          out_ids: Sequence[str], repeats: int = 1,
-                         carry: Sequence[Sequence[int]] = ((0, 0),)) -> None:
+                         carry: Sequence[Sequence[int]] = ((0, 0),),
+                         free: Sequence[str] = ()) -> None:
         """Id-based send: lets a chained pipeline name a prior in-flight
         step's output id as an argument (the broker resolves ids at
         dispatch time).  ``repeats`` > 1 runs the program as a broker-side
         K-step chain (one device program, no per-step RPC) with ``carry``
-        mapping each step's output indices back into argument indices."""
+        mapping each step's output indices back into argument indices.
+        ``free`` ids are dropped at this item's DISPATCH (after every
+        earlier item of this tenant queue has resolved its own args) —
+        zero-round-trip garbage collection for pipelined callers."""
         msg = {"kind": P.EXECUTE, "exe": eid, "args": list(arg_ids),
                "outs": list(out_ids)}
         if repeats > 1:
             msg["repeats"] = int(repeats)
             msg["carry"] = [list(p) for p in carry]
+        if free:
+            msg["free"] = list(free)
         try:
             P.send_msg(self.sock, msg)
         except (ConnectionError, P.ProtocolError, OSError):

@@ -42,7 +42,11 @@ COMPILE = "compile"      # {id, exported} -> {ok}
 # outputs back into the next iteration's arguments.  The reply carries
 # the LAST step's outputs.  Replies are sent at dispatch (shapes are
 # static); completion-time failures surface on the next sync request.
-EXECUTE = "execute"      # {exe, args: [ids], outs: [ids], repeats?, carry?}
+# EXECUTE optional field: free ([ids]) drops those arrays at THIS item's
+# dispatch (zero-round-trip GC for pipelined/bridged callers; safe
+# because a tenant queue dispatches FIFO).
+EXECUTE = "execute"      # {exe, args: [ids], outs: [ids], repeats?,
+                         #  carry?, free?}
 STATS = "stats"          # {} -> {ok, tenants: {...}}
 
 # Admin verbs — served ONLY on the host-side admin socket

@@ -197,10 +197,11 @@ class WorkItem:
     device program."""
 
     __slots__ = ("tenant", "session", "exe", "key", "arg_ids", "out_ids",
-                 "steps", "carry", "metered", "est_us", "first_run")
+                 "steps", "carry", "metered", "est_us", "first_run",
+                 "free_ids")
 
     def __init__(self, tenant, session, exe, key, arg_ids, out_ids,
-                 steps=1, carry=()):
+                 steps=1, carry=(), free_ids=()):
         self.tenant = tenant
         self.session = session
         self.exe = exe
@@ -212,6 +213,13 @@ class WorkItem:
         self.metered = False
         self.est_us = 0.0
         self.first_run = False
+        # Ids to drop right before this item resolves its args: the
+        # bridge's zero-round-trip GC.  Safe because a tenant's queue
+        # dispatches FIFO — every earlier item has already captured its
+        # argument arrays.  (If the item is purged undispatched, the
+        # frees are skipped; the owning connection is dying and its
+        # teardown reclaims everything anyway.)
+        self.free_ids = tuple(free_ids)
 
 
 class DeviceScheduler:
@@ -378,6 +386,8 @@ class DeviceScheduler:
             try:
                 args = []
                 with t.mu:
+                    for fid in item.free_ids:
+                        item.session.drop_array(t, fid)
                     for aid in item.arg_ids:
                         a = t.arrays.get(aid)
                         if a is None and aid in t.host_arrays:
@@ -1095,7 +1105,11 @@ class TenantSession(socketserver.BaseRequestHandler):
                         "dtype": host.dtype.name, "data": host.tobytes()})
 
                 elif kind == P.DELETE:
-                    freed = self._drop_array(tenant, str(msg["id"]))
+                    ids = msg.get("ids")
+                    if ids is None:
+                        ids = [msg["id"]]
+                    freed = sum(self._drop_array(tenant, str(a))
+                                for a in ids)
                     self._send({"ok": True, "freed": freed})
 
                 elif kind == P.COMPILE:
@@ -1172,7 +1186,8 @@ class TenantSession(socketserver.BaseRequestHandler):
         item = WorkItem(t, self, prog, str(msg["exe"]),
                         [str(a) for a in msg["args"]],
                         [str(x) for x in msg.get("outs", [])],
-                        steps=steps, carry=carry)
+                        steps=steps, carry=carry,
+                        free_ids=[str(f) for f in msg.get("free", ())])
         with self.pending_cond:
             # Backpressure a client that pipelines without reading
             # replies: blocks only THIS connection's reader.

@@ -50,6 +50,25 @@ def _main():
         # Staged copy keeps the package next to this file.
         return
     pyshim.bootstrap()
+    # Transparent broker bridge (shim/bridge.py): a time-shared grant
+    # carries VTPU_RUNTIME_SOCKET — route plain `import jax` workloads
+    # through the broker.  The local backend is pinned to CPU so this
+    # process can never take the libtpu chip lock away from the broker
+    # (the whole point of brokered co-tenancy).  VTPU_BRIDGE=0 opts out.
+    bridge_on = bool(os.environ.get("VTPU_RUNTIME_SOCKET")) and \
+        os.environ.get("VTPU_BRIDGE", "1") != "0"
+    if bridge_on:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            from vtpu.shim import bridge
+            bridge.install_import_hook()
+        except Exception as e:  # noqa: BLE001 - never break user startup
+            print(f"[vtpu shim] bridge hook failed: {e}", file=sys.stderr)
+            # Fail CLOSED on enforcement: with the hook dead this
+            # process will run on the (already pinned) CPU backend —
+            # let the pure-Python enforcement below pick the quotas up
+            # rather than running a time-shared grant unenforced.
+            bridge_on = False
     platforms = os.environ.get("JAX_PLATFORMS", "")
     try:
         from vtpu.utils.envspec import quota_from_env
@@ -57,8 +76,12 @@ def _main():
                          or quota_from_env().core_limit_pct)
     except Exception:  # noqa: BLE001 - malformed env must not kill startup
         has_quota = False
-    if os.environ.get("VTPU_FORCE_PY_ENFORCEMENT") == "1" or (
-            platforms == "cpu" and has_quota):
+    # Under the bridge the BROKER enforces quotas (HELLO carries the
+    # grant); local py-enforcement would double-charge host-side staging
+    # against the same region.
+    if not bridge_on and (
+            os.environ.get("VTPU_FORCE_PY_ENFORCEMENT") == "1" or (
+            platforms == "cpu" and has_quota)):
         # Defer until jax is importable *and* quota env exists; swallow
         # everything — user workloads must start regardless.
         try:
