@@ -91,6 +91,7 @@ class RuntimeClient:
     def __init__(self, socket_path: str, tenant: Optional[str] = None,
                  priority: Optional[int] = None,
                  device: Optional[int] = None,
+                 devices: Optional[Sequence[int]] = None,
                  hbm_limit: Optional[int] = None,
                  core_limit: Optional[int] = None,
                  oversubscribe: Optional[bool] = None,
@@ -103,12 +104,27 @@ class RuntimeClient:
         self.tenant = tenant or os.environ.get(
             "VTPU_TENANT", self._default_tenant())
         self.priority = spec.task_priority if priority is None else priority
+        # Chip binding: an explicit `devices` list makes this a
+        # MULTI-CHIP tenant (one slot per chip; sharded programs run
+        # across the set — reference multi-device tasks, server.go:
+        # 487-493).  Default: every chip of the grant
+        # (TPU_VISIBLE_CHIPS, resolved by the shim bootstrap).
+        if devices is None and device is None:
+            devices = self._grant_devices()
+        elif devices is None:
+            devices = [device]
+        devices = [int(d) for d in devices]
         hello = {"kind": P.HELLO, "tenant": self.tenant,
                  "priority": self.priority,
                  "oversubscribe": spec.oversubscribe
-                 if oversubscribe is None else bool(oversubscribe),
-                 "device": self._grant_device() if device is None
-                 else device}
+                 if oversubscribe is None else bool(oversubscribe)}
+        # "device" is ALWAYS sent (first granted chip): a pre-contract
+        # broker (daemonset upgrade: new shim, old broker kept alive)
+        # ignores "devices" and must still bind a granted chip, not
+        # default to chip 0.
+        hello["device"] = devices[0]
+        if len(devices) > 1:
+            hello["devices"] = devices
         # The tenant's own Allocate-time grant rides in HELLO so the
         # broker seeds THIS tenant's slot with it (heterogeneous splits;
         # reference per-vdevice CUDA_DEVICE_MEMORY_LIMIT_<i>).  An
@@ -122,6 +138,17 @@ class RuntimeClient:
             core = spec.core_limit_pct
         if hbm is not None:
             hello["hbm_limit"] = int(hbm)
+        if len(devices) > 1 and spec.hbm_limit_bytes:
+            # Per-ordinal grant limits (ordinal k of the grant = chip
+            # devices[k]): heterogeneous multi-chip splits.  Only sent
+            # when EVERY ordinal has an explicit limit — a 0 for an
+            # ordinal the env simply didn't mention would read as
+            # "explicitly unlimited" broker-side and bypass its default
+            # cap (a daemon-made grant always injects every ordinal,
+            # plugin/server.py).
+            per = [int(spec.limit_for(k)) for k in range(len(devices))]
+            if all(per):
+                hello["hbm_limits"] = per
         if core is not None:
             hello["core_limit"] = int(core)
         self._hello = hello
@@ -146,6 +173,7 @@ class RuntimeClient:
                 f"{resp.get('code', '')}: {resp.get('error', '')}")
         self.tenant_index = resp["tenant_index"]
         self.chip = resp.get("chip", 0)
+        self.chips = list(resp.get("chips", [self.chip]))
         # ``created`` defaults FALSE: True asserts state loss, and a
         # pre-contract broker (daemonset upgrade: new shim, old broker
         # kept alive across the plugin restart) sends neither key — a
@@ -223,17 +251,20 @@ class RuntimeClient:
         return f"{_socket.gethostname()}-{ns}-pid{os.getpid()}"
 
     @staticmethod
-    def _grant_device() -> int:
-        """Node chip index this tenant's grant maps to: the shim
+    def _grant_devices() -> List[int]:
+        """Node chip indices this tenant's grant maps to: the shim
         bootstrap resolves VTPU_VISIBLE_DEVICES against the mounted chip
-        inventory into TPU_VISIBLE_CHIPS (pyshim.py); its first entry is
-        the grant's chip.  Falls back to 0 (single-chip nodes)."""
+        inventory into TPU_VISIBLE_CHIPS (pyshim.py).  Falls back to
+        [0] (single-chip nodes)."""
         vis = os.environ.get("TPU_VISIBLE_CHIPS", "")
-        first = vis.replace(",", " ").split()
-        try:
-            return int(first[0]) if first else 0
-        except ValueError:
-            return 0
+        toks = vis.replace(",", " ").split()
+        out = []
+        for tok in toks:
+            try:
+                out.append(int(tok))
+            except ValueError:
+                pass
+        return out or [0]
 
     @classmethod
     def from_env(cls, **kw) -> "RuntimeClient":
@@ -274,13 +305,82 @@ class RuntimeClient:
         aid = aid or f"a{next(self._ids)}"
         # dtype by NAME: extended types (bfloat16, fp8) have no portable
         # .str encoding; ml_dtypes registers the names on both ends.
-        self._rpc({"kind": P.PUT, "id": aid, "shape": list(arr.shape),
-                   "dtype": arr.dtype.name, "data": arr.tobytes()})
+        if arr.nbytes > P.CHUNK_BYTES:
+            # Large tensors stream as PUT_PART frames (one frame can
+            # carry at most MAX_FRAME bytes); the final PUT names the
+            # staged buffer.
+            data = arr.tobytes()
+            for off in range(0, len(data), P.CHUNK_BYTES):
+                self._rpc({"kind": P.PUT_PART, "id": aid,
+                           "data": data[off:off + P.CHUNK_BYTES]})
+            self._rpc({"kind": P.PUT, "id": aid,
+                       "shape": list(arr.shape),
+                       "dtype": arr.dtype.name, "staged": True})
+        else:
+            self._rpc({"kind": P.PUT, "id": aid, "shape": list(arr.shape),
+                       "dtype": arr.dtype.name, "data": arr.tobytes()})
         return RemoteArray(self, aid, arr.shape, arr.dtype)
+
+    def put_send(self, arr: np.ndarray, aid: str) -> int:
+        """Pipelined PUT: send without consuming the ack(s).  Returns
+        the number of reply frames the caller must consume (FIFO on
+        this connection) — one per PUT_PART plus one for the PUT.
+        Lets a bridged train loop feed a fresh host batch every step
+        without draining its in-flight executes."""
+        arr = np.asarray(arr)
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        msgs = []
+        if arr.nbytes > P.CHUNK_BYTES:
+            data = arr.tobytes()
+            for off in range(0, len(data), P.CHUNK_BYTES):
+                msgs.append({"kind": P.PUT_PART, "id": aid,
+                             "data": data[off:off + P.CHUNK_BYTES]})
+            msgs.append({"kind": P.PUT, "id": aid,
+                         "shape": list(arr.shape),
+                         "dtype": arr.dtype.name, "staged": True})
+        else:
+            msgs.append({"kind": P.PUT, "id": aid,
+                         "shape": list(arr.shape),
+                         "dtype": arr.dtype.name, "data": arr.tobytes()})
+        try:
+            for m in msgs:
+                P.send_msg(self.sock, m)
+        except (ConnectionError, P.ProtocolError, OSError):
+            self._on_disconnect()
+        return len(msgs)
+
+    def recv_reply(self) -> Dict[str, Any]:
+        """Consume one pipelined reply frame (FIFO); raises the typed
+        error for non-ok replies, exactly like the synchronous path."""
+        try:
+            resp = P.recv_msg(self.sock)
+        except (ConnectionError, P.ProtocolError, OSError):
+            self._on_disconnect()
+            raise AssertionError("unreachable")
+        if not resp.get("ok"):
+            code = resp.get("code", "")
+            if code == "RESOURCE_EXHAUSTED":
+                raise VtpuQuotaError(resp.get("error", code))
+            raise RuntimeError_(f"{code}: {resp.get('error', '')}")
+        return resp
 
     def get(self, aid: str) -> np.ndarray:
         r = self._rpc({"kind": P.GET, "id": aid})
-        return np.frombuffer(r["data"], dtype=_np_dtype(r["dtype"])).reshape(
+        if "parts" in r:
+            # Chunked reply: the header frame is followed by N data
+            # frames on the same connection (FIFO).
+            chunks = []
+            try:
+                for _ in range(int(r["parts"])):
+                    chunks.append(P.recv_msg(self.sock)["data"])
+            except (ConnectionError, P.ProtocolError, OSError):
+                self._on_disconnect()
+                raise AssertionError("unreachable")
+            data = b"".join(chunks)
+        else:
+            data = r["data"]
+        return np.frombuffer(data, dtype=_np_dtype(r["dtype"])).reshape(
             r["shape"]).copy()
 
     def delete(self, aid: str) -> None:
@@ -358,16 +458,7 @@ class RuntimeClient:
             self._on_disconnect()
 
     def execute_recv(self) -> List[RemoteArray]:
-        try:
-            resp = P.recv_msg(self.sock)
-        except (ConnectionError, P.ProtocolError, OSError):
-            self._on_disconnect()
-            raise AssertionError("unreachable")
-        if not resp.get("ok"):
-            code = resp.get("code", "")
-            if code == "RESOURCE_EXHAUSTED":
-                raise VtpuQuotaError(resp.get("error", code))
-            raise RuntimeError_(f"{code}: {resp.get('error', '')}")
+        resp = self.recv_reply()
         return [RemoteArray(self, m["id"], m["shape"], m["dtype"])
                 for m in resp["outs"]]
 

@@ -14,6 +14,7 @@ the socket into containers.
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 from typing import Any, Dict
@@ -22,6 +23,10 @@ import msgpack
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 30
+# Tensor-payload segmentation threshold (bytes): well under MAX_FRAME so
+# msgpack overhead can never push a frame over the limit.  Env-tunable
+# for tests.
+CHUNK_BYTES = int(os.environ.get("VTPU_PUT_CHUNK_BYTES", str(256 << 20)))
 
 # message kinds (client -> server)
 # HELLO optional fields: device (chip index on the node, default 0 — the
@@ -32,8 +37,15 @@ MAX_FRAME = 1 << 30
 HELLO = "hello"          # {tenant, priority, device?, hbm_limit?,
                          #  core_limit?, oversubscribe?}
                          # -> {ok, tenant_index, chip}
-PUT = "put"              # {id, shape, dtype, data} -> {ok, nbytes}
-GET = "get"              # {id} -> {ok, shape, dtype, data}
+# Large tensors (> CHUNK_BYTES) do not fit one frame (MAX_FRAME):
+# the client streams PUT_PART frames {id, data} (each acked {ok}) and
+# finishes with PUT {id, shape, dtype, staged: true}; the server joins
+# the staged parts.  GET replies larger than CHUNK_BYTES come back as
+# {ok, shape, dtype, parts: N} followed by N frames {data} (FIFO on the
+# same connection).
+PUT_PART = "put_part"    # {id, data} -> {ok, staged_bytes}
+PUT = "put"              # {id, shape, dtype, data | staged} -> {ok, nbytes}
+GET = "get"              # {id} -> {ok, shape, dtype, data | parts: N}
 DELETE = "delete"        # {id} -> {ok, freed}
 COMPILE = "compile"      # {id, exported} -> {ok}
 # EXECUTE optional fields: repeats (int, default 1) runs the program as a

@@ -101,13 +101,27 @@ MAX_QUEUED_US = int(os.environ.get("VTPU_MAX_QUEUE_US", "4000000"))
 
 
 class Tenant:
+    """One tenant, bound to ONE OR MORE chips (HELLO ``devices`` list —
+    a pod granted K time-shared vtpus on different chips runs sharded
+    programs across all of them, the reference's multi-device tasks with
+    per-device enforcement, reference server.go:487-493).  ``chips`` /
+    ``slots`` are parallel lists; ``chip``/``index`` alias the PRIMARY
+    (first) chip, whose scheduler queues this tenant's work."""
+
     def __init__(self, name: str, index: int, priority: int,
-                 oversubscribe: bool = False, chip=None):
+                 oversubscribe: bool = False, chip=None,
+                 chips=None, slots=None):
         self.name = name
-        self.index = index          # tenant slot in its chip's region
-        self.chip = chip            # ChipState serving this tenant
+        self.chips = list(chips) if chips else [chip]
+        self.slots = list(slots) if slots else [index]
+        self.index = self.slots[0]  # tenant slot in its primary region
+        self.chip = self.chips[0]   # primary ChipState
         self.priority = priority
         self.oversubscribe = oversubscribe
+        # Per-array accounting: id -> [(chip_pos, bytes), ...].  A PUT
+        # lands whole on the primary; a sharded output is charged to
+        # each granted chip per its shard footprint.
+        self.charges: Dict[str, List[Tuple[int, int]]] = {}
         # Guards arrays/nbytes/host_arrays: the dispatcher registers
         # outputs while handler threads serve PUT/GET/DELETE.
         self.mu = threading.Lock()
@@ -148,6 +162,66 @@ class Tenant:
         # every async dispatch runtime has.
         self.async_error: Optional[BaseException] = None
 
+    # -- chip-set accounting ------------------------------------------------
+
+    def shard_charges(self, arr) -> List[Tuple[int, int]]:
+        """Per-granted-chip byte footprint of a (possibly sharded) device
+        array, from sharding METADATA only (never blocks on the value —
+        called at dispatch on future-backed outputs)."""
+        if len(self.chips) == 1:
+            return [(0, int(arr.nbytes))]
+        try:
+            sh = arr.sharding
+            shard_shape = sh.shard_shape(arr.shape)
+            per = 1
+            for s in shard_shape:
+                per *= int(s)
+            per *= int(arr.dtype.itemsize)
+            devs = sh.device_set
+        except Exception:  # noqa: BLE001 - unknown sharding: bill primary
+            return [(0, int(arr.nbytes))]
+        out = [(pos, per) for pos, c in enumerate(self.chips)
+               if c.device in devs]
+        return out or [(0, int(arr.nbytes))]
+
+    def charge_array(self, aid: str, charges: List[Tuple[int, int]],
+                     oversubscribe: bool) -> None:
+        """Record + apply an array's per-chip charges (caller holds
+        self.mu or is the single dispatcher)."""
+        for pos, nb in charges:
+            self.chips[pos].region.mem_acquire(self.slots[pos], nb,
+                                               oversubscribe)
+        self.charges[aid] = charges
+
+    def release_array(self, aid: str, default_nbytes: int = 0) -> None:
+        charges = self.charges.pop(aid, None)
+        if charges is None:
+            charges = [(0, default_nbytes)] if default_nbytes else []
+        for pos, nb in charges:
+            self.chips[pos].region.mem_release(self.slots[pos], nb)
+
+    def rate_acquire_all(self, est_us: int, priority: int) -> int:
+        """Debit every granted chip's bucket (the program occupies them
+        all); on any throttle, refund the partial debits and return the
+        wait."""
+        for k in range(len(self.chips)):
+            w = self.chips[k].region.rate_acquire(self.slots[k], est_us,
+                                                  priority)
+            if w:
+                for j in range(k):
+                    self.chips[j].region.rate_adjust(self.slots[j],
+                                                     -est_us)
+                return w
+        return 0
+
+    def rate_adjust_all(self, delta_us: int) -> None:
+        for chip, slot in zip(self.chips, self.slots):
+            chip.region.rate_adjust(slot, delta_us)
+
+    def busy_add_all(self, us: int) -> None:
+        for chip, slot in zip(self.chips, self.slots):
+            chip.region.busy_add(slot, us)
+
     def drop_staged(self, aid: str) -> None:
         """Evict one staged spill copy (caller holds self.mu)."""
         if self.staged.pop(aid, None) is not None:
@@ -172,14 +246,27 @@ class Tenant:
 class Program:
     """A compiled tenant program: the jitted callable plus the metadata
     needed without re-deserializing the export — input avals (AOT chain
-    compiles) and output count (carry validation)."""
+    compiles) and output count (carry validation).  Multi-device exports
+    additionally retain the Exported (to rebuild shardings over a
+    tenant's granted chip set) and cache one mesh-bound variant per chip
+    set (``variants``)."""
 
-    __slots__ = ("fn", "avals", "n_outs", "warmed")
+    __slots__ = ("fn", "avals", "n_outs", "warmed", "nr_devices",
+                 "exported", "variants", "in_shardings")
 
-    def __init__(self, fn, avals, n_outs):
+    def __init__(self, fn, avals, n_outs, nr_devices=1, exported=None,
+                 in_shardings=None):
         self.fn = fn
         self.avals = avals
         self.n_outs = n_outs
+        self.nr_devices = nr_devices
+        self.exported = exported
+        self.variants: Dict[tuple, "Program"] = {}
+        # Mesh-bound variants carry their per-arg shardings so the
+        # dispatcher can re-place args committed elsewhere (a PUT lands
+        # on the primary chip; jit rejects committed args whose sharding
+        # mismatches an explicit in_shardings).
+        self.in_shardings = in_shardings
         # (steps, carry) variants whose first device execution happened —
         # lives on the Program so blob-cache eviction or id() reuse can
         # never misclassify a fresh program as warmed.
@@ -304,6 +391,17 @@ class DeviceScheduler:
                 self.mu.notify_all()
         for it in purged:
             session.abandon(it)
+            # Apply the purged items' piggybacked frees: if the client
+            # REBINDS under the same tenant name (state-intact
+            # reconnect), teardown is aborted and nothing else would
+            # ever release these arrays — they'd sit charged against
+            # the quota for the tenant's lifetime.  Safe: every earlier
+            # item of this tenant either dispatched (args captured) or
+            # was purged right here.
+            if it.free_ids:
+                with it.tenant.mu:
+                    for fid in it.free_ids:
+                        session.drop_array(it.tenant, fid)
         return len(purged)
 
     # -- dispatch ----------------------------------------------------------
@@ -341,8 +439,7 @@ class DeviceScheduler:
             metered = (self.chip.region.device_stats(t.index)
                        .core_limit_pct > 0)
             if metered:
-                wait_ns = self.chip.region.rate_acquire(
-                    t.index, int(est), t.priority)
+                wait_ns = t.rate_acquire_all(int(est), t.priority)
                 if wait_ns:
                     nr = now + wait_ns / 1e9
                     self.not_ready_until[name] = nr
@@ -431,6 +528,17 @@ class DeviceScheduler:
                         if a is None:
                             raise KeyError(f"NOT_FOUND: {aid}")
                         args.append(a)
+                ish = item.exe.in_shardings
+                if ish:
+                    # Multi-chip program: args committed elsewhere (a
+                    # PUT lands whole on the primary chip) are re-placed
+                    # onto the program's sharding; args already on the
+                    # mesh (previous outputs) match and pass through.
+                    for k in range(len(args)):
+                        s = ish[k] if k < len(ish) else None
+                        if s is not None and \
+                                getattr(args[k], "sharding", None) != s:
+                            args[k] = jax.device_put(args[k], s)
                 fn = item.exe.fn
                 if item.steps > 1:
                     fn = self.state.chain_fn(item.exe.fn, item.steps,
@@ -440,13 +548,11 @@ class DeviceScheduler:
                             else [outs])
                 # Register outputs NOW (future-backed arrays): dependent
                 # pipelined steps resolve them at their own dispatch and
-                # XLA chains the programs on-device.  Shapes are static,
-                # so accounting needs no wait either.
-                total_out = sum(int(o.nbytes) for o in out_list)
-                if total_out:
-                    # Can't refuse outputs post-hoc; oversubscribe-admit
-                    # so the next put/execute hits the cap.
-                    self.chip.region.mem_acquire(t.index, total_out, True)
+                # XLA chains the programs on-device.  Shapes/shardings
+                # are static, so accounting needs no wait either — each
+                # granted chip is charged its shard footprint
+                # (oversubscribe-admit: can't refuse outputs post-hoc;
+                # the next put/execute hits the cap).
                 with t.mu:
                     for i, o in enumerate(out_list):
                         if i < len(item.out_ids):
@@ -457,14 +563,14 @@ class DeviceScheduler:
                         item.session.drop_array(t, oid)
                         t.arrays[oid] = o
                         t.nbytes[oid] = int(o.nbytes)
+                        t.charge_array(oid, t.shard_charges(o), True)
                         metas.append({"id": oid, "shape": list(o.shape),
                                       "dtype": str(o.dtype)})
             except Exception as e:  # noqa: BLE001 - reply with error
                 # Failed before reaching the device: credit the up-front
                 # charge back and retire the item immediately.
                 if item.metered:
-                    self.chip.region.rate_adjust(t.index,
-                                                 -int(item.est_us))
+                    t.rate_adjust_all(-int(item.est_us))
                 item.session.complete_execute(item, metas, e, 0.0)
                 self._retire(item)
                 continue
@@ -579,7 +685,7 @@ class DeviceScheduler:
                     per_step = min(disp_us / item.steps, prev_ema)
             if exc is not None:
                 t.async_error = exc
-            self.chip.region.busy_add(t.index, int(busy_us))
+            t.busy_add_all(int(busy_us))
             charged = max(busy_us, float(self.state.min_exec_cost_us)
                           * item.steps)
             if item.metered:
@@ -588,8 +694,7 @@ class DeviceScheduler:
                 # must not wedge the bucket for ages.  The EMA (also
                 # growth-clamped below) catches real cost within a few
                 # items, so sustained under-charging is impossible.
-                self.chip.region.rate_adjust(
-                    t.index,
+                t.rate_adjust_all(
                     int(min(charged, item.est_us * 4.0) - item.est_us))
             if per_step is not None:
                 # Growth-clamped EMA — INCLUDING the first learned
@@ -775,39 +880,60 @@ class RuntimeState:
 
     def tenant(self, name: str, priority: int,
                oversubscribe: bool = False, device: int = 0,
+               devices: Optional[List[int]] = None,
                hbm_limit: Optional[int] = None,
+               hbm_limits: Optional[List[int]] = None,
                core_limit: Optional[int] = None) -> "Tuple[Tenant, bool]":
         """Bind a connection to a tenant; returns (tenant, created).
         ``created`` tells HELLO whether this bound to a FRESH slot — a
         reconnecting client uses it to learn its arrays did not survive
-        (teardown won the race) even though the broker never died."""
-        chip = self.chip(device)
+        (teardown won the race) even though the broker never died.
+
+        ``devices`` (multi-chip grant) claims one slot in EACH chip's
+        region; ``hbm_limits`` seeds per-chip limits (else ``hbm_limit``
+        replicates — the grant is per-vdevice, reference per-vdevice
+        CUDA_DEVICE_MEMORY_LIMIT_<i>, server.go:487-489)."""
+        dev_list = list(devices) if devices else [device]
+        if len(set(dev_list)) != len(dev_list):
+            raise ValueError(f"INVALID_DEVICE: duplicate chips {dev_list}")
+        chips = [self.chip(d) for d in dev_list]
         created = False
         with self.mu:
             t = self.tenants.get(name)
             if t is None:
                 created = True
-                used = {x.index for x in self.tenants.values()
-                        if x.chip is chip}
-                index = next((i for i in range(MAX_TENANTS)
-                              if i not in used), None)
-                if index is None:
-                    raise RuntimeError(
-                        f"tenant slots exhausted on chip {chip.index}")
-                t = Tenant(name, index, priority, oversubscribe,
-                           chip=chip)
-                # A recycled slot must not pass the previous grant's
-                # bucket debt/burst to this tenant (busy_us is
-                # intentionally inherited — it's a monotonic counter).
-                chip.region.reset_slot(index)
-                # Seed THIS tenant's grant into its slot (first HELLO
-                # wins for the tenant's lifetime; reconnects reuse it).
-                chip.region.set_mem_limit(
-                    index, hbm_limit if hbm_limit is not None
-                    else self.default_hbm)
-                chip.region.set_core_limit(
-                    index, core_limit if core_limit is not None
-                    else self.default_core)
+                slots = []
+                for chip in chips:
+                    used = {x.slots[k] for x in self.tenants.values()
+                            for k, c in enumerate(x.chips) if c is chip}
+                    used.update(s for c, s in zip(chips[:len(slots)],
+                                                  slots) if c is chip)
+                    index = next((i for i in range(MAX_TENANTS)
+                                  if i not in used), None)
+                    if index is None:
+                        raise RuntimeError(
+                            f"tenant slots exhausted on chip "
+                            f"{chip.index}")
+                    slots.append(index)
+                t = Tenant(name, slots[0], priority, oversubscribe,
+                           chips=chips, slots=slots)
+                for k, (chip, index) in enumerate(zip(chips, slots)):
+                    # A recycled slot must not pass the previous grant's
+                    # bucket debt/burst to this tenant (busy_us is
+                    # intentionally inherited — a monotonic counter).
+                    chip.region.reset_slot(index)
+                    # Seed THIS tenant's grant into its slot (first
+                    # HELLO wins for the tenant's lifetime).
+                    h = None
+                    if hbm_limits is not None and k < len(hbm_limits):
+                        h = hbm_limits[k]
+                    elif hbm_limit is not None:
+                        h = hbm_limit
+                    chip.region.set_mem_limit(
+                        index, h if h is not None else self.default_hbm)
+                    chip.region.set_core_limit(
+                        index, core_limit if core_limit is not None
+                        else self.default_core)
                 self.tenants[name] = t
             t.connections += 1
             return t, created
@@ -860,22 +986,80 @@ class RuntimeState:
         fn = self.jax.jit(exported.call)
         avals = tuple(self.jax.ShapeDtypeStruct(a.shape, a.dtype)
                       for a in exported.in_avals)
+        nr = int(getattr(exported, "nr_devices", 1))
         # Compile NOW, in the calling session thread (the client is
         # waiting on its COMPILE rpc anyway): the dispatcher must never
         # head-of-line block other tenants on an XLA compile.  The jit
         # call cache reuses this lowering (verified: first __call__
-        # after .lower().compile() is ~free).
-        try:
-            fn.lower(*avals).compile()
-        except Exception as e:  # noqa: BLE001 - dispatch will retry
-            log.warn("eager compile failed (%s); deferring to dispatch", e)
-        prog = Program(fn, avals, len(exported.out_avals))
+        # after .lower().compile() is ~free).  Multi-device programs
+        # compile per chip set instead (tenant_program).
+        if nr == 1:
+            try:
+                fn.lower(*avals).compile()
+            except Exception as e:  # noqa: BLE001 - dispatch will retry
+                log.warn("eager compile failed (%s); deferring to dispatch",
+                         e)
+        prog = Program(fn, avals, len(exported.out_avals), nr_devices=nr,
+                       exported=exported if nr > 1 else None)
         with self.mu:
             self.blob_cache[h] = prog
             self.blob_cache.move_to_end(h)
             while len(self.blob_cache) > BLOB_CACHE_CAP:
                 self.blob_cache.popitem(last=False)
         return prog
+
+    def tenant_program(self, tenant: Tenant, prog: Program) -> Program:
+        """Mesh-bound variant of a multi-device program for this
+        tenant's granted chip set: rebuild the export's abstract mesh
+        concretely over the tenant's chips and pin the jit with
+        ``in_shardings`` (outputs follow the module's own sharding
+        annotations).  Cached per chip set on the blob-dedup'd Program,
+        so co-tenants with the same grant shape share one compilation."""
+        chips_key = tuple(c.index for c in tenant.chips)
+        variant = prog.variants.get(chips_key)
+        if variant is not None:
+            return variant
+        jax = self.jax
+        exported = prog.exported
+        if len(chips_key) != prog.nr_devices:
+            raise ValueError(
+                f"DEVICE_MISMATCH: program exported for "
+                f"{prog.nr_devices} devices but tenant {tenant.name} "
+                f"holds {len(chips_key)} chip(s) — HELLO a matching "
+                f"'devices' list")
+        # The export records an AbstractMesh (axis names + sizes); a
+        # concrete mesh over the granted chips with the SAME axes is
+        # what in_shardings_jax accepts.  jax-version-coupled private
+        # attr (jax 0.9 _in_named_shardings); GSPMD-only exports have
+        # no named shardings — fall back to positional device order.
+        devices = [c.device for c in tenant.chips]
+        mesh = None
+        try:
+            named = [s for s in exported._in_named_shardings  # noqa: SLF001
+                     if s is not None]
+            if named:
+                am = named[0].mesh
+                import numpy as _np
+                arr = _np.array(devices).reshape(
+                    *[am.shape[n] for n in am.axis_names])
+                mesh = self.jax.sharding.Mesh(arr, am.axis_names)
+        except Exception as e:  # noqa: BLE001 - fall through
+            log.warn("mesh reconstruction failed (%s); using device order",
+                     e)
+        ish = None
+        if mesh is not None:
+            ish = exported.in_shardings_jax(mesh)
+            fn = jax.jit(exported.call, in_shardings=ish)
+        else:
+            fn = jax.jit(exported.call)
+        try:
+            fn.lower(*prog.avals).compile()
+        except Exception as e:  # noqa: BLE001 - dispatch will retry
+            log.warn("multi-chip eager compile failed (%s); deferring", e)
+        variant = Program(fn, prog.avals, prog.n_outs,
+                          nr_devices=prog.nr_devices, in_shardings=ish)
+        prog.variants[chips_key] = variant
+        return variant
 
     def chain_fn(self, base, steps: int, carry, avals=None,
                  compile_now: bool = False):
@@ -928,6 +1112,11 @@ class TenantSession(socketserver.BaseRequestHandler):
         self.send_mu = threading.Lock()
         self.pending = 0
         self.pending_cond = threading.Condition()
+        # Chunked-PUT staging (large tensors span several PUT_PART
+        # frames; joined at the final PUT).  Per-connection, dies with
+        # the session.
+        self._staging: Dict[str, List[bytes]] = {}
+        self._staging_bytes = 0
 
     def _send(self, msg) -> None:
         with self.send_mu:
@@ -995,17 +1184,23 @@ class TenantSession(socketserver.BaseRequestHandler):
                             f"{tenant.name!r}; open a new connection")
                         continue
                     hbm = msg.get("hbm_limit")
+                    hbms = msg.get("hbm_limits")
                     core = msg.get("core_limit")
+                    devs = msg.get("devices")
                     tenant, created = self.state.tenant(
                         str(msg["tenant"]), int(msg.get("priority", 1)),
                         bool(msg.get("oversubscribe", False)),
                         device=int(msg.get("device", 0)),
+                        devices=[int(d) for d in devs] if devs else None,
                         hbm_limit=int(hbm) if hbm is not None else None,
+                        hbm_limits=[int(h) for h in hbms] if hbms
+                        else None,
                         core_limit=int(core) if core is not None
                         else None)
                     tenant_box[0] = tenant
                     self._send({"ok": True, "tenant_index": tenant.index,
                                 "chip": tenant.chip.index,
+                                "chips": [c.index for c in tenant.chips],
                                 "epoch": self.state.epoch,
                                 "created": created})
                     continue
@@ -1027,9 +1222,35 @@ class TenantSession(socketserver.BaseRequestHandler):
                     exc, tenant.async_error = tenant.async_error, None
                     raise exc
 
-                if kind == P.PUT:
+                if kind == P.PUT_PART:
+                    aid = str(msg["id"])
+                    part = msg["data"]
+                    # Host-RAM guard: a tenant streaming unbounded parts
+                    # must not OOM the broker.  Generous cap — spills and
+                    # oversubscribed uploads legitimately exceed the HBM
+                    # quota.
+                    st = tenant.chip.region.device_stats(tenant.index)
+                    cap = max(4 << 30, 2 * int(st.limit_bytes))
+                    if self._staging_bytes + len(part) > cap:
+                        parts = self._staging.pop(aid, [])
+                        self._staging_bytes -= sum(len(p) for p in parts)
+                        raise MemoryError(
+                            f"RESOURCE_EXHAUSTED: staged upload exceeds "
+                            f"{cap} bytes")
+                    self._staging.setdefault(aid, []).append(part)
+                    self._staging_bytes += len(part)
+                    self._send({"ok": True,
+                                "staged_bytes": self._staging_bytes})
+
+                elif kind == P.PUT:
+                    if msg.get("staged"):
+                        parts = self._staging.pop(str(msg["id"]), [])
+                        self._staging_bytes -= sum(len(p) for p in parts)
+                        buf = b"".join(parts)
+                    else:
+                        buf = msg["data"]
                     arr = np.frombuffer(
-                        msg["data"], dtype=_np_dtype(msg["dtype"])
+                        buf, dtype=_np_dtype(msg["dtype"])
                     ).reshape(msg["shape"])
                     nbytes = int(arr.nbytes)
                     aid = str(msg["id"])
@@ -1087,6 +1308,9 @@ class TenantSession(socketserver.BaseRequestHandler):
                         with tenant.mu:
                             tenant.arrays[aid] = dev_arr
                             tenant.nbytes[aid] = nbytes
+                            # PUT lands whole on the primary chip; the
+                            # admission above already debited it.
+                            tenant.charges[aid] = [(0, nbytes)]
                     self._send({"ok": True, "nbytes": nbytes,
                                 "spilled": spilled})
 
@@ -1100,9 +1324,21 @@ class TenantSession(socketserver.BaseRequestHandler):
                     if host is None:
                         self._send_err("NOT_FOUND", aid)
                         continue
-                    self._send({
-                        "ok": True, "shape": list(host.shape),
-                        "dtype": host.dtype.name, "data": host.tobytes()})
+                    data = host.tobytes()
+                    if len(data) > P.CHUNK_BYTES:
+                        # Multi-frame reply (FIFO-safe: executes were
+                        # drained above, and this thread is the only
+                        # producer of further replies until it returns).
+                        n = -(-len(data) // P.CHUNK_BYTES)
+                        self._send({"ok": True, "shape": list(host.shape),
+                                    "dtype": host.dtype.name, "parts": n})
+                        for off in range(0, len(data), P.CHUNK_BYTES):
+                            self._send(
+                                {"data": data[off:off + P.CHUNK_BYTES]})
+                    else:
+                        self._send({
+                            "ok": True, "shape": list(host.shape),
+                            "dtype": host.dtype.name, "data": data})
 
                 elif kind == P.DELETE:
                     ids = msg.get("ids")
@@ -1114,6 +1350,11 @@ class TenantSession(socketserver.BaseRequestHandler):
 
                 elif kind == P.COMPILE:
                     prog = self.state.cached_blob(bytes(msg["exported"]))
+                    if prog.nr_devices > 1:
+                        # Sharded program: bind it to THIS tenant's
+                        # granted chip set (per-chip slots were claimed
+                        # at HELLO).
+                        prog = self.state.tenant_program(tenant, prog)
                     tenant.executables[str(msg["id"])] = prog
                     self._send({"ok": True})
 
@@ -1143,7 +1384,7 @@ class TenantSession(socketserver.BaseRequestHandler):
         if aid in t.arrays:
             nbytes = t.nbytes.pop(aid, 0)
             del t.arrays[aid]
-            t.chip.region.mem_release(t.index, nbytes)
+            t.release_array(aid, default_nbytes=nbytes)
             return nbytes
         return 0
 
@@ -1237,15 +1478,18 @@ def collect_stats(state: RuntimeState):
         tenants = list(state.tenants.items())
     for name, t in tenants:
         st = t.chip.region.device_stats(t.index)
+        per_chip = [t.chips[k].region.device_stats(t.slots[k])
+                    for k in range(len(t.chips))]
         # Lock-free: taking t.mu here would block monitoring behind
         # the dispatch loop's GB-scale staging transfers.
         staged = t.staged_total
         out[name] = {
             "index": t.index,
             "chip": t.chip.index,
-            "used_bytes": int(st.used_bytes),
-            "limit_bytes": int(st.limit_bytes),
-            "peak_bytes": int(st.peak_bytes),
+            "chips": [c.index for c in t.chips],
+            "used_bytes": sum(int(s.used_bytes) for s in per_chip),
+            "limit_bytes": sum(int(s.limit_bytes) for s in per_chip),
+            "peak_bytes": sum(int(s.peak_bytes) for s in per_chip),
             "core_limit_pct": int(st.core_limit_pct),
             "arrays": len(t.arrays),
             "host_spill_bytes": int(t.host_bytes),

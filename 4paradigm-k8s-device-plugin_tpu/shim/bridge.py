@@ -244,9 +244,14 @@ class Bridge:
         self._mu = threading.RLock()
         self.client = RuntimeClient(socket_path)
         self._ids = itertools.count()
-        # Batches of BridgeArrays whose execute reply is unconsumed, in
-        # send order (strong refs until confirmed).
-        self._outstanding: "collections.deque[List[BridgeArray]]" = \
+        # Unconsumed replies, in send order: ("exe", [weakref, ...]) for
+        # pipelined executes and ("ack", None) for transient-put acks.
+        # WEAK refs on purpose: an output the user already dropped must
+        # be freeable (its free rides a later execute) — pinning it
+        # until its reply is consumed would make server-side memory grow
+        # with the pipeline depth instead of the live working set.  The
+        # refs exist only to poison still-held handles on failure.
+        self._outstanding: "collections.deque[tuple]" = \
             collections.deque()
         self._free: List[str] = []
         self._closed = False
@@ -262,25 +267,33 @@ class Bridge:
         return out
 
     # -- reply pipeline --
+    @staticmethod
+    def _poison_batch(batch, err: BaseException) -> None:
+        for ref in (batch or ()):
+            a = ref()
+            if a is not None:
+                a._err = err  # noqa: SLF001
+
     def _recv_one_locked(self) -> None:
         from ..runtime.client import VtpuConnectionLost, VtpuStateLost
-        batch = self._outstanding.popleft()
+        kind, batch = self._outstanding.popleft()
         try:
-            self.client.execute_recv()
+            if kind == "exe":
+                self.client.execute_recv()
+            else:  # transient-put ack
+                self.client.recv_reply()
         except (VtpuStateLost, VtpuConnectionLost) as e:
             # Connection-level loss: every reply still outstanding died
             # with the old socket — poison this batch AND the rest, or
             # the next drain would block forever on replies the fresh
             # connection will never carry.
-            for a in batch:
-                a._err = e  # noqa: SLF001
+            self._poison_batch(batch, e)
             self._poison_all(e)
             raise
         except Exception as e:  # noqa: BLE001 - poison just this batch
             # Application-level error reply (quota, NOT_FOUND, ...) on a
             # live connection: only this batch's outputs are invalid.
-            for a in batch:
-                a._err = e  # noqa: SLF001
+            self._poison_batch(batch, e)
             raise
 
     def _drain_locked(self) -> None:
@@ -292,8 +305,7 @@ class Bridge:
         dead.  Poison what we still hold (outstanding batches); fetches
         of already-confirmed handles will fail server-side NOT_FOUND."""
         while self._outstanding:
-            for a in self._outstanding.popleft():
-                a._err = err  # noqa: SLF001
+            self._poison_batch(self._outstanding.popleft()[1], err)
         self._free = []
 
     def _sync_prelude_locked(self) -> None:
@@ -329,23 +341,31 @@ class Bridge:
         synchronous (replies are FIFO); the execute itself is sent
         async — its reply is consumed lazily."""
         with self._mu:
+            while len(self._outstanding) >= _MAX_OUTSTANDING:
+                self._recv_one_locked()
             arg_ids = []
             for item in arg_items:
                 if item[0] == "id":
                     arg_ids.append(item[1])
                 else:
+                    # Transient upload rides the pipeline too (acks are
+                    # consumed lazily, FIFO): a fresh host batch per
+                    # step must not drain the in-flight executes.  The
+                    # fixed-id replacement stays safe server-side: the
+                    # session drains its own executes before processing
+                    # a PUT.
                     _, fid, arr = item
-                    self._sync_prelude_locked()
-                    self.client.put(arr, aid=fid)
+                    for _ in range(self.client.put_send(arr, fid)):
+                        self._outstanding.append(("ack", None))
                     arg_ids.append(fid)
-            while len(self._outstanding) >= _MAX_OUTSTANDING:
-                self._recv_one_locked()
+            import weakref
             out_ids = [f"bo{next(self._ids)}" for _ in out_avals]
             outs = [BridgeArray(self, oid, av.shape, av.dtype)
                     for oid, av in zip(out_ids, out_avals)]
             self.client.execute_send_ids(eid, arg_ids, out_ids,
                                          free=self._take_frees())
-            self._outstanding.append(outs)
+            self._outstanding.append(("exe",
+                                      [weakref.ref(a) for a in outs]))
             return outs
 
     def sync(self) -> None:

@@ -59,6 +59,24 @@ def _main():
         os.environ.get("VTPU_BRIDGE", "1") != "0"
     if bridge_on:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # Multi-chip grants: give the local CPU backend as many virtual
+        # devices as the grant has chips, so the workload's own
+        # mesh/pjit code traces unchanged — the broker maps the exported
+        # shardings onto the real granted chips (runtime/server.py
+        # tenant_program).
+        try:
+            n_chips = len([t for t in os.environ.get(
+                "TPU_VISIBLE_CHIPS",
+                os.environ.get("VTPU_VISIBLE_DEVICES", "")
+            ).replace(",", " ").split() if t])
+            flags = os.environ.get("XLA_FLAGS", "")
+            if n_chips > 1 and \
+                    "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    + str(n_chips)).strip()
+        except Exception:  # noqa: BLE001 - cosmetic; single device works
+            pass
         try:
             from vtpu.shim import bridge
             bridge.install_import_hook()

@@ -117,3 +117,100 @@ def test_interposer_oversubscribe_on_real_chip(tmp_path):
     assert r2.returncode != 0, "64MB on a 16MB quota must OOM"
     assert "RESOURCE_EXHAUSTED" in (r2.stderr + r2.stdout), \
         r2.stderr[-800:]
+
+
+def test_bridge_two_unmodified_processes_on_real_chip(tmp_path):
+    """The transparent-broker contract on hardware: a broker owns the
+    chip; two PLAIN jax scripts (no RuntimeClient, no vtpu imports) are
+    injected only with the shim PYTHONPATH + env contract and time-share
+    the chip through the bridge under per-tenant HBM quotas.  This is
+    the reference's "no changes to the application" bar
+    (reference server.go:511-522 + README) for brokered co-tenancy."""
+    import textwrap as tw
+
+    import numpy as np
+    sock = str(tmp_path / "rt.sock")
+    broker_code = tw.dedent(_PREAMBLE) % {
+        "repo": REPO, "interposer": INTERPOSER,
+    } + tw.dedent(f"""
+        from vtpu.runtime.server import make_server
+        srv = make_server({sock!r}, hbm_limit=256 * 2**20, core_limit=0,
+                          region_path={str(tmp_path / 'rt.shr')!r})
+        print("BROKER_READY", flush=True)
+        srv.serve_forever()
+    """)
+    benv = dict(os.environ)
+    benv.pop("PYTHONPATH", None)
+    benv["JAX_PLATFORMS"] = "axon"
+    benv["VTPU_REAL_LIBTPU"] = AXON_PLUGIN
+    broker = subprocess.Popen([sys.executable, "-c", broker_code],
+                              env=benv, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+    try:
+        import time as _t
+        t0 = _t.monotonic()
+        while not os.path.exists(sock):
+            if broker.poll() is not None:
+                out, err = broker.communicate()
+                raise AssertionError(f"broker died: {err[-1500:]}")
+            assert _t.monotonic() - t0 < 600, "broker socket timeout"
+            _t.sleep(0.25)
+
+        shim_dir = os.path.join(REPO, "4paradigm-k8s-device-plugin_tpu",
+                                "shim")
+        workload = tw.dedent("""
+            import jax, numpy as np
+            assert jax.devices()[0].platform == "cpu", jax.devices()
+            assert getattr(jax.jit, "_vtpu_bridge", False), "no bridge"
+
+            @jax.jit
+            def step(p, x):
+                return p * 1.001 + x.mean(), (p * p).sum()
+
+            p = jax.device_put(np.ones((128, 128), np.float32))
+            x = np.ones((64,), np.float32)
+            for _ in range(30):
+                p, loss = step(p, x)
+            print("final", float(loss))
+            try:
+                jax.device_put(np.ones((16384, 16384), np.float32))  # 1G
+                print("NO_OOM")
+            except MemoryError:
+                print("QUOTA_OOM")
+        """)
+
+        def spawn(tenant):
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            env.update({
+                "PYTHONPATH": shim_dir + os.pathsep + REPO,
+                "VTPU_RUNTIME_SOCKET": sock,
+                "VTPU_TENANT": tenant,
+                "VTPU_DEVICE_HBM_LIMIT_0": "256Mi",
+            })
+            return subprocess.Popen([sys.executable, "-c", workload],
+                                    env=env, stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True)
+
+        p1, p2 = spawn("pod-a"), spawn("pod-b")
+        out1, err1 = p1.communicate(timeout=600)
+        out2, err2 = p2.communicate(timeout=600)
+        assert p1.returncode == 0, err1[-1500:]
+        assert p2.returncode == 0, err2[-1500:]
+        for out in (out1, out2):
+            assert "QUOTA_OOM" in out and "NO_OOM" not in out, out
+        expect = np.ones((), np.float32)
+        p = np.ones((128, 128), np.float32)
+        for _ in range(29):
+            p = p * np.float32(1.001) + np.float32(1.0)
+        expect = float((p * p).sum())
+        for out in (out1, out2):
+            got = float(out.split()[1])
+            assert abs(got - expect) / expect < 1e-3, (got, expect)
+        print("REAL-CHIP BRIDGE OK")
+    finally:
+        broker.terminate()
+        try:
+            broker.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            broker.kill()

@@ -1073,3 +1073,24 @@ def test_priority_zero_borrows(tmp_path):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_chunked_put_get_roundtrip(broker, monkeypatch):
+    """Tensors larger than one frame stream as PUT_PART chunks and come
+    back as multi-frame GET replies — exercised with a tiny chunk size;
+    the real threshold (256 MiB) covers GiB-scale model weights that
+    would otherwise blow MAX_FRAME and kill the connection."""
+    from vtpu.runtime import protocol as P
+    monkeypatch.setattr(P, "CHUNK_BYTES", 4096)
+    c = RuntimeClient(broker, tenant="big")
+    x = np.random.rand(300, 300).astype(np.float32)   # 360 KB >> chunk
+    h = c.put(x)
+    np.testing.assert_array_equal(h.fetch(), x)
+    # Quota still enforced at the final (staged) PUT admission.
+    with pytest.raises(VtpuQuotaError):
+        c.put(np.ones(4 * MB, np.float32))            # 16 MB > 8 MB
+    # And the staged path composes with executes.
+    exe = c.compile(lambda a: a * 2.0, [x])
+    outs = exe(h)
+    np.testing.assert_allclose(outs[0].fetch(), x * 2.0, rtol=1e-6)
+    c.close()
