@@ -263,7 +263,11 @@ class CachedPodLister:
                  fresh: bool = False) -> List[Dict]:
         import time
         if not fresh and self.informer is not None \
-                and self.informer.synced:
+                and self.informer.synced \
+                and getattr(self.informer, "node_name", None) == node_name:
+            # Informer fast path only for ITS node: a caller asking for
+            # a different node must fall through to the LIST path, not
+            # silently receive the informer's node's pods (advisor r4).
             return self.informer.pods()
         t_req = time.monotonic()
         with self._mu:

@@ -151,6 +151,15 @@ class RuntimeClient:
                 hello["hbm_limits"] = per
         if core is not None:
             hello["core_limit"] = int(core)
+        # Per-tenant spill-residency overshoot (fraction of the quota
+        # that spilled operands may keep resident past it; broker
+        # default is 1.0 = books up to 2x — documented in FLAGS.md).
+        ov = os.environ.get("VTPU_SPILL_RESIDENT_OVERSHOOT")
+        if ov is not None:
+            try:
+                hello["spill_overshoot"] = float(ov)
+            except ValueError:
+                pass
         self._hello = hello
         self.epoch = self._connect()[0]
 
@@ -303,23 +312,45 @@ class RuntimeClient:
             # scalar args).  0-d arrays are always contiguous.
             arr = np.ascontiguousarray(arr)
         aid = aid or f"a{next(self._ids)}"
-        # dtype by NAME: extended types (bfloat16, fp8) have no portable
-        # .str encoding; ml_dtypes registers the names on both ends.
-        if arr.nbytes > P.CHUNK_BYTES:
-            # Large tensors stream as PUT_PART frames (one frame can
-            # carry at most MAX_FRAME bytes); the final PUT names the
-            # staged buffer.
-            data = arr.tobytes()
-            for off in range(0, len(data), P.CHUNK_BYTES):
-                self._rpc({"kind": P.PUT_PART, "id": aid,
-                           "data": data[off:off + P.CHUNK_BYTES]})
-            self._rpc({"kind": P.PUT, "id": aid,
-                       "shape": list(arr.shape),
-                       "dtype": arr.dtype.name, "staged": True})
-        else:
-            self._rpc({"kind": P.PUT, "id": aid, "shape": list(arr.shape),
-                       "dtype": arr.dtype.name, "data": arr.tobytes()})
+        # One framing implementation (_put_msgs) serves both the sync
+        # and pipelined paths; the sync path consumes each ack before
+        # the next send — streaming every part first would deadlock on
+        # the ack backlog once it outgrows the socket buffer (the
+        # server's reply writes block, so it stops reading parts).
+        arr = np.asarray(arr)
+        for m in self._put_msgs(arr, aid):
+            self._rpc(m)
         return RemoteArray(self, aid, arr.shape, arr.dtype)
+
+    @staticmethod
+    def _put_msgs(arr: np.ndarray, aid: str):
+        """PUT framing shared by the sync and pipelined paths: yields
+        the message(s) for one upload — PUT_PART chunks + a staged PUT
+        for large tensors, one plain PUT otherwise.  Chunks are sliced
+        off a flat byte VIEW and materialised one at a time, so peak
+        memory is array + one chunk (not 3x for a GiB-scale upload)."""
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        nbytes = int(arr.nbytes)
+        if nbytes > P.CHUNK_BYTES:
+            # Zero-copy byte view (works for extended dtypes like
+            # bfloat16 where memoryview.cast would not).
+            flat = arr.reshape(-1).view(np.uint8)
+            for off in range(0, nbytes, P.CHUNK_BYTES):
+                yield {"kind": P.PUT_PART, "id": aid,
+                       "data": flat[off:off + P.CHUNK_BYTES].tobytes()}
+            yield {"kind": P.PUT, "id": aid, "shape": list(arr.shape),
+                   "dtype": arr.dtype.name, "staged": True}
+        else:
+            yield {"kind": P.PUT, "id": aid, "shape": list(arr.shape),
+                   "dtype": arr.dtype.name, "data": arr.tobytes()}
+
+    # Pipelined puts stream all frames BEFORE any ack is consumed; past
+    # this many parts the unread-ack backlog could outgrow the socket
+    # buffer and deadlock both sides (callers must fall back to the
+    # sync put, which interleaves).  Production CHUNK_BYTES=256MiB
+    # keeps real uploads far below it.
+    MAX_PIPELINED_PUT_PARTS = 32
 
     def put_send(self, arr: np.ndarray, aid: str) -> int:
         """Pipelined PUT: send without consuming the ack(s).  Returns
@@ -328,27 +359,14 @@ class RuntimeClient:
         Lets a bridged train loop feed a fresh host batch every step
         without draining its in-flight executes."""
         arr = np.asarray(arr)
-        if not arr.flags["C_CONTIGUOUS"]:
-            arr = np.ascontiguousarray(arr)
-        msgs = []
-        if arr.nbytes > P.CHUNK_BYTES:
-            data = arr.tobytes()
-            for off in range(0, len(data), P.CHUNK_BYTES):
-                msgs.append({"kind": P.PUT_PART, "id": aid,
-                             "data": data[off:off + P.CHUNK_BYTES]})
-            msgs.append({"kind": P.PUT, "id": aid,
-                         "shape": list(arr.shape),
-                         "dtype": arr.dtype.name, "staged": True})
-        else:
-            msgs.append({"kind": P.PUT, "id": aid,
-                         "shape": list(arr.shape),
-                         "dtype": arr.dtype.name, "data": arr.tobytes()})
+        sent = 0
         try:
-            for m in msgs:
+            for m in self._put_msgs(arr, aid):
                 P.send_msg(self.sock, m)
+                sent += 1
         except (ConnectionError, P.ProtocolError, OSError):
             self._on_disconnect()
-        return len(msgs)
+        return sent
 
     def recv_reply(self) -> Dict[str, Any]:
         """Consume one pipelined reply frame (FIFO); raises the typed
@@ -369,15 +387,17 @@ class RuntimeClient:
         r = self._rpc({"kind": P.GET, "id": aid})
         if "parts" in r:
             # Chunked reply: the header frame is followed by N data
-            # frames on the same connection (FIFO).
-            chunks = []
+            # frames on the same connection (FIFO).  Filled into one
+            # preallocated buffer — peak memory is total + one chunk,
+            # not 2x total.
+            buf = bytearray()
             try:
                 for _ in range(int(r["parts"])):
-                    chunks.append(P.recv_msg(self.sock)["data"])
+                    buf += P.recv_msg(self.sock)["data"]
             except (ConnectionError, P.ProtocolError, OSError):
                 self._on_disconnect()
                 raise AssertionError("unreachable")
-            data = b"".join(chunks)
+            data = buf  # np.frombuffer reads the bytearray directly
         else:
             data = r["data"]
         return np.frombuffer(data, dtype=_np_dtype(r["dtype"])).reshape(

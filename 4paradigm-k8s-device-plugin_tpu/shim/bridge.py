@@ -340,37 +340,63 @@ class Bridge:
         (transient upload, replaced in place on the next call).  Puts are
         synchronous (replies are FIFO); the execute itself is sent
         async — its reply is consumed lazily."""
+        from ..runtime.client import VtpuConnectionLost, VtpuStateLost
         with self._mu:
-            while len(self._outstanding) >= _MAX_OUTSTANDING:
-                self._recv_one_locked()
-            arg_ids = []
-            for item in arg_items:
-                if item[0] == "id":
-                    arg_ids.append(item[1])
-                else:
-                    # Transient upload rides the pipeline too (acks are
-                    # consumed lazily, FIFO): a fresh host batch per
-                    # step must not drain the in-flight executes.  The
-                    # fixed-id replacement stays safe server-side: the
-                    # session drains its own executes before processing
-                    # a PUT.
-                    _, fid, arr = item
-                    for _ in range(self.client.put_send(arr, fid)):
-                        self._outstanding.append(("ack", None))
-                    arg_ids.append(fid)
-            import weakref
-            out_ids = [f"bo{next(self._ids)}" for _ in out_avals]
-            outs = [BridgeArray(self, oid, av.shape, av.dtype)
-                    for oid, av in zip(out_ids, out_avals)]
-            self.client.execute_send_ids(eid, arg_ids, out_ids,
-                                         free=self._take_frees())
-            self._outstanding.append(("exe",
-                                      [weakref.ref(a) for a in outs]))
-            return outs
+            try:
+                while len(self._outstanding) >= _MAX_OUTSTANDING:
+                    self._recv_one_locked()
+                arg_ids = []
+                for item in arg_items:
+                    if item[0] == "id":
+                        arg_ids.append(item[1])
+                    else:
+                        # Transient upload rides the pipeline too (acks
+                        # are consumed lazily, FIFO): a fresh host batch
+                        # per step must not drain the in-flight
+                        # executes.  The fixed-id replacement stays safe
+                        # server-side: the session drains its own
+                        # executes before processing a PUT.
+                        _, fid, arr = item
+                        nparts = (int(np.asarray(arr).nbytes)
+                                  // max(self._chunk_bytes(), 1)) + 1
+                        if nparts > self.client.MAX_PIPELINED_PUT_PARTS:
+                            # Huge transient upload: the pipelined path
+                            # would deadlock on its own unread acks —
+                            # drain and upload synchronously.
+                            self._drain_locked()
+                            self.client.put(arr, aid=fid)
+                        else:
+                            for _ in range(self.client.put_send(arr,
+                                                                fid)):
+                                self._outstanding.append(("ack", None))
+                        arg_ids.append(fid)
+                import weakref
+                out_ids = [f"bo{next(self._ids)}" for _ in out_avals]
+                outs = [BridgeArray(self, oid, av.shape, av.dtype)
+                        for oid, av in zip(out_ids, out_avals)]
+                self.client.execute_send_ids(eid, arg_ids, out_ids,
+                                             free=self._take_frees())
+                self._outstanding.append(("exe",
+                                          [weakref.ref(a)
+                                           for a in outs]))
+                return outs
+            except (VtpuStateLost, VtpuConnectionLost) as e:
+                # SEND-side connection loss (broker died mid-loop): the
+                # replies for everything still queued died with the old
+                # socket — poison and clear, or every later drain
+                # (including the transparent retry's compile) would
+                # block forever on replies that will never come.
+                self._poison_all(e)
+                raise
 
     def sync(self) -> None:
         with self._mu:
             self._drain_locked()
+
+    @staticmethod
+    def _chunk_bytes() -> int:
+        from ..runtime import protocol as P
+        return P.CHUNK_BYTES
 
     def epoch(self):
         return self.client.epoch

@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""In-container quota view — the tenant-side half of vtpu-smi.
+
+The reference makes in-container ``nvidia-smi`` show the quota-adjusted
+view through its NVML shim (SURVEY §2.9f); on TPU there is no vendor CLI
+to shim, so the daemon mounts THIS script as ``/usr/local/vtpu/vtpu-smi``
+into every allocated container (plugin/server.py Allocate, the analogue
+of the reference's extra-binary mount at server.go:518-519).  An
+operator shelled into a tenant pod runs it to answer "what is my grant,
+what am I using, how throttled am I":
+
+  - the Allocate-time env contract (ordinals, chip ids, HBM caps, core
+    pct, policy, oversubscribe);
+  - live usage/duty from the pod's shared accounting region (interposer
+    or py-enforcement path);
+  - the broker's view of this pod's tenants when the grant is brokered
+    (VTPU_RUNTIME_SOCKET present).
+
+Self-contained: bootstraps imports from its own staged directory; never
+writes to the region (opens without registering) and exits 0 even with
+no grant env (prints "no vTPU grant").
+"""
+
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+# Staged layout keeps the vtpu package next to this file; the in-repo
+# layout keeps it two levels up (repo root's `vtpu` alias package).
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+if not os.path.isdir(os.path.join(_HERE, "vtpu")) \
+        and os.path.isdir(os.path.join(_REPO, "vtpu")) \
+        and _REPO not in sys.path:
+    sys.path.insert(1, _REPO)
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def _grant_lines(spec) -> list:
+    dev_map = os.environ.get("VTPU_DEVICE_MAP", "")
+    entries = [tok.split(":", 1) for tok in dev_map.split() if ":" in tok]
+    lines = []
+    for i, (ordinal, chip) in enumerate(entries or [("0", "?")]):
+        cap = spec.limit_for(i)
+        lines.append((int(ordinal), chip,
+                      _fmt_bytes(cap) if cap else "unlimited"))
+    return lines
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+
+    try:
+        from vtpu.utils import envspec
+        spec = envspec.quota_from_env()
+    except Exception as e:  # noqa: BLE001 - report, don't crash a shell
+        print(f"vtpu-smi: cannot parse grant env: {e}", file=sys.stderr)
+        return 1
+
+    has_grant = bool(spec.hbm_limit_bytes or spec.core_limit_pct
+                     or spec.visible_devices
+                     or os.environ.get("VTPU_DEVICE_MAP"))
+    out = {"grant": has_grant}
+    if not has_grant:
+        if as_json:
+            print(json.dumps(out))
+        else:
+            print("no vTPU grant in this container "
+                  "(no VTPU_* env contract)")
+        return 0
+
+    out["devices"] = []
+    for ordinal, chip, cap in _grant_lines(spec):
+        out["devices"].append({"ordinal": ordinal, "chip": chip,
+                               "hbm_limit": cap})
+    out["core_limit_pct"] = spec.core_limit_pct
+    out["policy"] = spec.utilization_policy
+    out["oversubscribe"] = bool(spec.oversubscribe)
+    out["brokered"] = bool(spec.runtime_socket)
+
+    # Live region view (interposer / py-enforcement path).
+    region_path = spec.shared_cache
+    if region_path and os.path.exists(region_path):
+        try:
+            from vtpu.shim.core import SharedRegion
+            with SharedRegion(region_path) as reg:
+                devs = []
+                for d in range(reg.ndevices):
+                    st = reg.device_stats(d)
+                    devs.append({
+                        "device": d,
+                        "used": int(st.used_bytes),
+                        "limit": int(st.limit_bytes),
+                        "peak": int(st.peak_bytes),
+                        "core_limit_pct": int(st.core_limit_pct),
+                        "busy_us": int(st.busy_us),
+                        "procs": int(st.n_procs),
+                    })
+                out["region"] = devs
+        except Exception as e:  # noqa: BLE001
+            out["region_error"] = str(e)
+
+    # Broker view (time-shared grants).
+    if spec.runtime_socket and os.path.exists(spec.runtime_socket):
+        try:
+            import socket
+
+            from vtpu.runtime import protocol as P
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(5.0)
+            s.connect(spec.runtime_socket)
+            probe = os.environ.get("VTPU_TENANT",
+                                   f"vtpu-smi-probe-{os.getpid()}")
+            P.send_msg(s, {"kind": P.HELLO, "tenant": probe,
+                           "priority": 1})
+            hello = P.recv_msg(s)
+            if hello.get("ok"):
+                P.send_msg(s, {"kind": P.STATS})
+                st = P.recv_msg(s)
+                if st.get("ok"):
+                    out["broker"] = st["tenants"]
+            s.close()
+        except Exception as e:  # noqa: BLE001
+            out["broker_error"] = str(e)
+
+    if as_json:
+        print(json.dumps(out, indent=2))
+        return 0
+
+    print("vTPU grant")
+    for d in out["devices"]:
+        print(f"  vtpu {d['ordinal']}: chip {d['chip']}  "
+              f"hbm {d['hbm_limit']}")
+    print(f"  core limit : {out['core_limit_pct'] or 'unlimited'}"
+          f"{'%' if out['core_limit_pct'] else ''}   "
+          f"policy {out['policy']}   "
+          f"oversubscribe {'on' if out['oversubscribe'] else 'off'}   "
+          f"{'brokered' if out['brokered'] else 'interposed'}")
+    for d in out.get("region", []):
+        pct = (100.0 * d["used"] / d["limit"]) if d["limit"] else 0.0
+        print(f"  device {d['device']}: used {_fmt_bytes(d['used'])}"
+              f" / {_fmt_bytes(d['limit']) if d['limit'] else 'unl'}"
+              f" ({pct:.0f}%)  peak {_fmt_bytes(d['peak'])}  "
+              f"busy {d['busy_us'] / 1e6:.1f}s  procs {d['procs']}")
+    for name, t in (out.get("broker") or {}).items():
+        print(f"  broker tenant {name}: chips {t.get('chips')}  "
+              f"used {_fmt_bytes(t['used_bytes'])}"
+              f" / {_fmt_bytes(t['limit_bytes']) if t['limit_bytes'] else 'unl'}"
+              f"  core {t['core_limit_pct'] or 'unl'}%  "
+              f"execs {t['executions']}"
+              f"{'  SUSPENDED' if t.get('suspended') else ''}")
+    if "region_error" in out:
+        print(f"  (region unavailable: {out['region_error']})")
+    if "broker_error" in out:
+        print(f"  (broker unavailable: {out['broker_error']})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

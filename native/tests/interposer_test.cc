@@ -654,6 +654,49 @@ static int sc_dtneeded(const char* dir, const char* shr) {
   return 0;
 }
 
+/* Region version skew (VERDICT r4 weak #1): a quota-bearing grant whose
+ * shared region has an incompatible layout version must FAIL client
+ * creation — never run with "quotas disabled". */
+static int sc_verskew(const char* dir, const char* shr) {
+  /* Stamp a pre-compat (v3) region file via vtpucore's versioned open. */
+  std::string core = std::string(dir) + "/libvtpucore.so";
+  void* hc = dlopen(core.c_str(), RTLD_NOW);
+  CHECK(hc != nullptr);
+  typedef void* (*open_v)(const char*, int, const uint64_t*,
+                          const int32_t*, uint32_t);
+  typedef void (*close_f)(void*);
+  auto openv = (open_v)dlsym(hc, "vtpu_region_open_versioned");
+  auto closef = (close_f)dlsym(hc, "vtpu_region_close");
+  CHECK(openv != nullptr && closef != nullptr);
+  void* reg = openv(shr, 1, nullptr, nullptr, 3u);
+  CHECK(reg != nullptr);
+  closef(reg);
+
+  setenv("MOCK_PJRT_DEVICES", "1", 1);
+  setenv("VTPU_DEVICE_HBM_LIMIT_0", "1Mi", 1);
+  std::string interposer = std::string(dir) + "/libvtpu_pjrt.so";
+  std::string mock = std::string(dir) + "/libmockpjrt.so";
+  setenv("VTPU_REAL_LIBTPU", mock.c_str(), 1);
+  setenv("VTPU_DEVICE_MEMORY_SHARED_CACHE", shr, 1);
+  void* h = dlopen(interposer.c_str(), RTLD_NOW);
+  CHECK(h != nullptr);
+  auto get = (const PJRT_Api* (*)())dlsym(h, "GetPjrtApi");
+  CHECK(get != nullptr);
+  api = get();
+  CHECK(api != nullptr);
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  PJRT_Error* e = api->PJRT_Client_Create(&ca);
+  CHECK(e != nullptr);
+  CHECK(error_code(e) == PJRT_Error_Code_FAILED_PRECONDITION);
+  std::string msg = error_message(e);
+  CHECK(msg.find("version") != std::string::npos);
+  destroy_error(e);
+  printf("verskew refused: %s\n", msg.c_str());
+  return 0;
+}
+
 /* ---- driver ------------------------------------------------------- */
 
 struct Scenario {
@@ -675,6 +718,7 @@ static const Scenario kScenarios[] = {
     {"copyalloc", sc_copyalloc, 0},
     {"preload", sc_preload, 0},
     {"dtneeded", sc_dtneeded, 0},
+    {"verskew", sc_verskew, 0},
 };
 
 int main(int argc, char** argv) {

@@ -48,6 +48,8 @@
 #include <string.h>
 #include <unistd.h>
 
+#include <atomic>
+
 #define DEFAULT_INTERPOSER "/usr/local/vtpu/libvtpu_pjrt.so"
 
 static __thread int t_bypass = 0;
@@ -69,13 +71,18 @@ static void plog(const char* fmt, const char* a, const char* b) {
 static void* real_dlopen(const char* file, int mode) {
   /* dlsym, not a saved pointer: glibc >= 2.34 hosts dlopen in libc and
    * RTLD_NEXT from a preload object resolves it correctly; caching at
-   * first use keeps the hot path cheap. */
-  static void* (*next)(const char*, int) = NULL;
-  if (!next) {
-    next = (void* (*)(const char*, int))dlsym(RTLD_NEXT, "dlopen");
-    if (!next) return NULL; /* no underlying loader: nothing we can do */
+   * first use keeps the hot path cheap.  Atomic: concurrent first
+   * calls from several threads must not race the cache (advisor r4 —
+   * formal UB with a plain static, even where benign). */
+  typedef void* (*dlopen_fn)(const char*, int);
+  static std::atomic<dlopen_fn> next{nullptr};
+  dlopen_fn fn = next.load(std::memory_order_acquire);
+  if (!fn) {
+    fn = (dlopen_fn)dlsym(RTLD_NEXT, "dlopen");
+    if (!fn) return NULL; /* no underlying loader: nothing we can do */
+    next.store(fn, std::memory_order_release);
   }
-  return next(file, mode);
+  return fn(file, mode);
 }
 
 /* Does `path` name a TPU backend library?  Matched on the REQUESTED
@@ -162,8 +169,10 @@ passthrough:
 typedef struct PJRT_Api PJRT_Api;
 
 extern "C" const PJRT_Api* GetPjrtApi(void) {
-  static const PJRT_Api* (*fwd)(void) = NULL;
-  if (fwd) return fwd();
+  typedef const PJRT_Api* (*getapi_fn)(void);
+  static std::atomic<getapi_fn> fwd{nullptr};
+  getapi_fn f0 = fwd.load(std::memory_order_acquire);
+  if (f0) return f0();
   const char* off = getenv("VTPU_PRELOAD_DISABLE");
   const char* interposer = getenv("VTPU_INTERPOSER_PATH");
   if (!interposer || !*interposer) interposer = DEFAULT_INTERPOSER;
@@ -172,18 +181,19 @@ extern "C" const PJRT_Api* GetPjrtApi(void) {
     void* h = real_dlopen(interposer, RTLD_NOW | RTLD_LOCAL);
     t_bypass--;
     if (h) {
-      auto f = (const PJRT_Api* (*)(void))dlsym(h, "GetPjrtApi");
+      auto f = (getapi_fn)dlsym(h, "GetPjrtApi");
       /* Probe before caching: the interposer returns NULL when it
        * cannot locate a real backend (VTPU_REAL_LIBTPU unset, nothing
        * at its default paths) — fail OPEN to the next GetPjrtApi in
        * search order (the DT_NEEDED-mapped real libtpu) instead of
        * handing the workload a NULL API table. */
       if (f && f() != NULL) {
-        fwd = f;
-        return fwd();
+        fwd.store(f, std::memory_order_release);
+        return f();
       }
     }
   }
-  fwd = (const PJRT_Api* (*)(void))dlsym(RTLD_NEXT, "GetPjrtApi");
-  return fwd ? fwd() : NULL;
+  getapi_fn nextf = (getapi_fn)dlsym(RTLD_NEXT, "GetPjrtApi");
+  if (nextf) fwd.store(nextf, std::memory_order_release);
+  return nextf ? nextf() : NULL;
 }

@@ -72,6 +72,21 @@ vtpu_region* vtpu_region_open(const char* path, int ndevices,
                               const uint64_t* limit_bytes,
                               const int32_t* core_limit_pct);
 
+/* Oldest on-disk layout vtpu_region_open can migrate forward in place
+ * (same region size; later versions only changed field semantics).  A
+ * region older than this — or NEWER than the running code — fails open
+ * with EPROTO, and quota-bearing callers must fail CLOSED (the
+ * interposer refuses client creation rather than running unenforced). */
+#define VTPU_MIN_COMPAT_VERSION 4u
+
+/* Version-parameterised open: what vtpu_region_open calls with the
+ * compiled-in version.  Exposed so upgrade tooling and tests can
+ * exercise the migration/refusal paths against synthetic versions. */
+vtpu_region* vtpu_region_open_versioned(const char* path, int ndevices,
+                                        const uint64_t* limit_bytes,
+                                        const int32_t* core_limit_pct,
+                                        uint32_t current_version);
+
 /* Unmap (does not delete the backing file). */
 void vtpu_region_close(vtpu_region* r);
 
@@ -178,6 +193,16 @@ int vtpu_region_ndevices(vtpu_region* r);
  * DEFAULT vs FORCE semantics). */
 int vtpu_region_active_procs(vtpu_region* r);
 const char* vtpu_core_version(void);
+
+/* Compiled-in region layout version (what vtpu_region_open stamps). */
+uint32_t vtpu_layout_version(void);
+
+/* TEST-ONLY: overwrite/activate a proc slot's recorded identity
+ * (pid/host_pid/pid-namespace inode) to simulate crashed tenants and
+ * recycled host pids for the sweep tests.  Never called by product
+ * code paths. */
+int vtpu_test_poke_slot(vtpu_region* r, int slot, pid_t pid,
+                        pid_t host_pid, uint64_t ns_id);
 
 #ifdef __cplusplus
 }

