@@ -387,13 +387,19 @@ class RuntimeClient:
         r = self._rpc({"kind": P.GET, "id": aid})
         if "parts" in r:
             # Chunked reply: the header frame is followed by N data
-            # frames on the same connection (FIFO).  Filled into one
-            # preallocated buffer — peak memory is total + one chunk,
-            # not 2x total.
-            buf = bytearray()
+            # frames on the same connection (FIFO).  The header carries
+            # shape+dtype, so the buffer is PREALLOCATED and filled in
+            # place — peak memory is total + one chunk, not the ~2x a
+            # grow-by-append bytearray costs on GB-scale fetches.
+            dt = _np_dtype(r["dtype"])
+            total = int(np.prod(r["shape"], dtype=np.int64)) * dt.itemsize
+            buf = bytearray(total)
+            off = 0
             try:
                 for _ in range(int(r["parts"])):
-                    buf += P.recv_msg(self.sock)["data"]
+                    part = P.recv_msg(self.sock)["data"]
+                    buf[off:off + len(part)] = part
+                    off += len(part)
             except (ConnectionError, P.ProtocolError, OSError):
                 self._on_disconnect()
                 raise AssertionError("unreachable")

@@ -838,6 +838,25 @@ class RuntimeState:
             collections.OrderedDict()
         self.chain_cache: "collections.OrderedDict[tuple, Any]" = \
             collections.OrderedDict()
+        # Content-addressed PUT dedup: (chip, sha256, dtype, shape) ->
+        # weakref to the device array.  Co-tenants serving the SAME
+        # base weights (the common multi-tenant pattern — and every
+        # bridged tenant of one image) share ONE immutable device
+        # buffer: the host->device transfer happens once per node
+        # instead of once per tenant (on relayed transports that is
+        # minutes of tunnel traffic per GB-scale model).  Quota books
+        # still charge every tenant the full size — the advertised cap
+        # stays honest; physical HBM use is <= the books.  Weak refs:
+        # the buffer lives exactly as long as some tenant holds it.
+        self.put_cache: Dict[tuple, Any] = {}
+        self.put_cache_mu = threading.Lock()
+        # Opt-out (VTPU_PUT_DEDUP=0): content dedup is a classic
+        # memory-dedup DISCLOSURE channel (a cache hit acks measurably
+        # faster, confirming a co-tenant holds those exact bytes).
+        # Fine under the cooperative threat model the node runs by
+        # default; operators isolating mutually-distrusting tenants on
+        # one chip should turn it off (docs/FLAGS.md).
+        self.put_dedup = os.environ.get("VTPU_PUT_DEDUP", "1") != "0"
         self.mu = threading.Lock()
         self.chips: Dict[int, ChipState] = {}
         # Chip creation is slow (region mmap + latency calibration with
@@ -861,6 +880,34 @@ class RuntimeState:
         # would put chip 10 before chip 2.
         return [sorted(g, key=lambda d: d.id)[0]
                 for _, g in sorted(groups.items())]
+
+    # Hash-dedup only pays above this size (sha256 runs ~1 GB/s; tiny
+    # puts would pay overhead for no transfer win).
+    PUT_DEDUP_MIN_BYTES = 1 << 20
+
+    def put_cache_get(self, key):
+        with self.put_cache_mu:
+            ref = self.put_cache.get(key)
+            if ref is None:
+                return None
+            arr = ref()
+            if arr is None:
+                del self.put_cache[key]
+            return arr
+
+    def put_cache_add(self, key, arr) -> None:
+        import weakref
+        try:
+            ref = weakref.ref(arr)
+        except TypeError:
+            return
+        with self.put_cache_mu:
+            self.put_cache[key] = ref
+            # Opportunistic scrub of dead entries (bounds the dict).
+            if len(self.put_cache) > 512:
+                for k in [k for k, r in self.put_cache.items()
+                          if r() is None]:
+                    del self.put_cache[k]
 
     def chip_region_path(self, index: int) -> str:
         # Chip 0 keeps the bare path (vtpu-smi/back-compat); others get
@@ -1311,14 +1358,28 @@ class TenantSession(socketserver.BaseRequestHandler):
                             tenant.host_bytes += nbytes
                             tenant.nbytes[aid] = 0
                     else:
-                        try:
-                            dev_arr = jax.device_put(arr,
-                                                     tenant.chip.device)
-                            dev_arr.block_until_ready()
-                        except Exception:
-                            tenant.chip.region.mem_release(tenant.index,
-                                                           nbytes)
-                            raise
+                        dedup_key = None
+                        dev_arr = None
+                        if self.state.put_dedup and \
+                                nbytes >= RuntimeState.PUT_DEDUP_MIN_BYTES:
+                            import hashlib
+                            dedup_key = (tenant.chip.index,
+                                         hashlib.sha256(buf).hexdigest(),
+                                         arr.dtype.name,
+                                         tuple(arr.shape))
+                            dev_arr = self.state.put_cache_get(dedup_key)
+                        if dev_arr is None:
+                            try:
+                                dev_arr = jax.device_put(
+                                    arr, tenant.chip.device)
+                                dev_arr.block_until_ready()
+                            except Exception:
+                                tenant.chip.region.mem_release(
+                                    tenant.index, nbytes)
+                                raise
+                            if dedup_key is not None:
+                                self.state.put_cache_add(dedup_key,
+                                                         dev_arr)
                         with tenant.mu:
                             tenant.arrays[aid] = dev_arr
                             tenant.nbytes[aid] = nbytes

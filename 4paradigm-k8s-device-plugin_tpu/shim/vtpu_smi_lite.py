@@ -26,15 +26,21 @@ import os
 import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-if _HERE not in sys.path:
-    sys.path.insert(0, _HERE)
-# Staged layout keeps the vtpu package next to this file; the in-repo
-# layout keeps it two levels up (repo root's `vtpu` alias package).
-_REPO = os.path.dirname(os.path.dirname(_HERE))
-if not os.path.isdir(os.path.join(_HERE, "vtpu")) \
-        and os.path.isdir(os.path.join(_REPO, "vtpu")) \
-        and _REPO not in sys.path:
-    sys.path.insert(1, _REPO)
+# Candidate package roots, most specific first: the staged shim dir
+# next to this file (in-repo layout), the mounted shim dir below the
+# mount point (in-container: this file is /usr/local/vtpu/vtpu-smi and
+# the package lives at /usr/local/vtpu/shim/vtpu), and the repo root
+# two levels up (in-repo alias package).  The CLI must work from a
+# clean `kubectl exec` shell with NO PYTHONPATH.
+for _cand in (_HERE, os.path.join(_HERE, "shim"),
+              os.path.dirname(os.path.dirname(_HERE))):
+    if os.path.isdir(os.path.join(_cand, "vtpu")) \
+            and _cand not in sys.path:
+        sys.path.insert(0, _cand)
+        break
+else:
+    if _HERE not in sys.path:
+        sys.path.insert(0, _HERE)
 
 
 def _fmt_bytes(n: int) -> str:
@@ -119,8 +125,11 @@ def main(argv=None) -> int:
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             s.settimeout(5.0)
             s.connect(spec.runtime_socket)
-            probe = os.environ.get("VTPU_TENANT",
-                                   f"vtpu-smi-probe-{os.getpid()}")
+            # ALWAYS a throwaway name: HELLO is state-mutating (first
+            # HELLO wins the tenant's grant seeding) — probing under
+            # VTPU_TENANT could claim the pod's real tenant slot with
+            # default limits before the workload connects.
+            probe = f"vtpu-smi-probe-{os.getpid()}"
             P.send_msg(s, {"kind": P.HELLO, "tenant": probe,
                            "priority": 1})
             hello = P.recv_msg(s)

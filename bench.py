@@ -574,8 +574,16 @@ assert getattr(jax.jit, "_vtpu_bridge", False), "bridge not installed"
 from vtpu.models import transformer as tr
 
 cfg = getattr(tr.TransformerConfig, {cfg_name!r})()
-params = tr.init_params(cfg, jax.random.PRNGKey(0))
-params = jax.device_put(params)          # -> broker-resident handles
+
+# jit-init: params materialise broker-side as ONE exported program —
+# the idiomatic JAX pattern, and it keeps ~1 GB of weights off the
+# socket/tunnel per tenant (eager init + device_put also works; the
+# content-dedup'd PUT path then uploads one copy per node).
+@jax.jit
+def init():
+    return tr.init_params(cfg, jax.random.PRNGKey(0))
+
+params = init()
 tokens = jax.device_put(np.zeros(({batch}, {seq}), np.int32))
 
 @jax.jit
@@ -626,7 +634,15 @@ def measure_bridge(sock, n_tenants, steps, warmup, cfg_name, batch, seq,
     total = 0
     max_elapsed = 0.0
     for p in procs:
-        out, err = p.communicate(timeout=3600)
+        try:
+            # Bounded: a wedged tenant must fail the PHASE (reported as
+            # zeros), never hang the whole bench run.
+            out, err = p.communicate(timeout=1200)
+        except subprocess.TimeoutExpired:
+            for p2 in procs:
+                if p2.poll() is None:
+                    p2.kill()
+            raise RuntimeError("bridge tenant timed out")
         if p.returncode != 0:
             raise RuntimeError(f"bridge tenant failed: {err[-800:]}")
         line = [ln for ln in out.splitlines()

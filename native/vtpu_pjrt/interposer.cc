@@ -490,6 +490,10 @@ static void init_region_for_client(PJRT_Client* client) {
   }
   const char* host_pid = getenv("VTPU_HOST_PID");
   vtpu_proc_register(g_region, host_pid ? atoi(host_pid) : 0);
+  /* A successful open clears any earlier refusal (the operator removed
+   * the stale region / redeployed): a retried client create must
+   * succeed, not stay refused forever. */
+  g_region_failclosed = false;
   VTPU_LOG(3, "attached region %s (%d devices, limit[0]=%" PRIu64
            ", core=%d%%)", path.c_str(), n, limits[0], (int)pct);
 }

@@ -331,14 +331,33 @@ vtpu_region* vtpu_region_open_versioned(const char* path, int ndevices,
      *    code) -> EPROTO; the caller must refuse to run unenforced. */
     if (g->version >= VTPU_MIN_COMPAT_VERSION &&
         g->version < current_version) {
-      for (int d = 0; d < g->ndevices && d < VTPU_MAX_DEVICES; d++) {
-        g->dev[d].tokens_us = kBurstCapUs;
-        g->dev[d].last_refill_ns = now_ns();
-        g->dev[d].last_demand_ns = 0;
-        g->dev[d].undebited_outstanding = 0;
+      /* Under the region's own robust mutex (its layout is part of the
+       * compat guarantee): live old-version tenants do rate ops under
+       * it, and an unlocked reset would race their read-modify-writes.
+       * Un-debited credits are cleared only when NO process is
+       * registered — a live tenant's in-flight ungated execute must
+       * not have its completion adjust land against an empty credit
+       * (same guard sweep_locked uses). */
+      if (lock_region(g) == 0) {
+        int any_active = 0;
+        for (int s = 0; s < VTPU_MAX_PROCS; s++)
+          if (g->proc[s].active) { any_active = 1; break; }
+        for (int d = 0; d < g->ndevices && d < VTPU_MAX_DEVICES; d++) {
+          g->dev[d].tokens_us = kBurstCapUs;
+          g->dev[d].last_refill_ns = now_ns();
+          g->dev[d].last_demand_ns = 0;
+          if (!any_active) g->dev[d].undebited_outstanding = 0;
+        }
+        g->version = current_version;
+        __sync_synchronize();
+        unlock_region(g);
+      } else {
+        flock(fd, LOCK_UN);
+        munmap(g, sizeof(Region));
+        close(fd);
+        errno = EPROTO;
+        return NULL;
       }
-      g->version = current_version;
-      __sync_synchronize();
     } else {
       flock(fd, LOCK_UN);
       munmap(g, sizeof(Region));

@@ -153,18 +153,28 @@ def test_broker_restart_transparent_retry(tmp_path, monkeypatch):
         x = np.ones((4,), np.float32)
         old = f(x)
         np.testing.assert_allclose(np.asarray(old), 2.0)
+        unfetched = f(x)          # no local cache: dies with the broker
+        bridge_mod.get_bridge().sync()
         srv.shutdown()
         srv.server_close()
         srv = make_server(sock, hbm_limit=64 * MB, core_limit=0,
                           region_path=str(tmp_path / "r.shr"))
         threading.Thread(target=srv.serve_forever, daemon=True).start()
+        # In-process "restart" must also sever the live connection (a
+        # real broker death closes it; socketserver daemon handler
+        # threads survive shutdown()).
+        import socket as socketmod
+        bridge_mod.get_bridge().client.sock.shutdown(socketmod.SHUT_RDWR)
         # All-transient-args call: the bridge re-registers the stored
         # export blob on the fresh broker and retries, invisibly.
         out = f(x)
         np.testing.assert_allclose(np.asarray(out), 2.0)
-        # A handle from the old epoch is dead server-side.
+        # An already-FETCHED old handle serves its cached value; an
+        # unfetched one is dead server-side (NOT_FOUND on the fresh
+        # broker).
+        np.testing.assert_allclose(np.asarray(old), 2.0)
         with pytest.raises(Exception):
-            _ = np.asarray(old) + bridge_mod.get_bridge().get("nope")
+            np.asarray(unfetched)
     finally:
         bridge_mod.reset_for_tests()
         srv.shutdown()
@@ -260,3 +270,48 @@ def test_unmodified_process_quota_oom(broker):
     out, err = p.communicate(timeout=120)
     assert p.returncode == 0, err[-2000:]
     assert "QUOTA_OOM" in out and "NO_OOM" not in out, out
+
+
+def test_broker_restart_with_full_pipeline_does_not_hang(tmp_path,
+                                                         monkeypatch):
+    """Send-side connection loss with a non-empty reply pipeline: the
+    outstanding entries must be poisoned and cleared (pre-fix, the next
+    drain blocked forever on replies the fresh connection would never
+    carry), and the all-transient-args call retries transparently."""
+    import concurrent.futures
+
+    sock = str(tmp_path / "p.sock")
+    srv = make_server(sock, hbm_limit=64 * MB, core_limit=0,
+                      region_path=str(tmp_path / "p.shr"))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    monkeypatch.setenv("VTPU_RUNTIME_SOCKET", sock)
+    try:
+        f = BridgedFunction(lambda x: x + 1.0, (), {})
+        x = np.ones((8,), np.float32)
+        stale = [f(x) for _ in range(6)]     # pipeline stays unconsumed
+        srv.shutdown()
+        srv.server_close()
+        srv = make_server(sock, hbm_limit=64 * MB, core_limit=0,
+                          region_path=str(tmp_path / "p.shr"))
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        # Sever the live connection too — an in-process shutdown leaves
+        # established daemon handler threads serving it.
+        import socket as socketmod
+        bridge_mod.get_bridge().client.sock.shutdown(socketmod.SHUT_RDWR)
+
+        def call():
+            return np.asarray(f(x))
+
+        with concurrent.futures.ThreadPoolExecutor(1) as ex:
+            fut = ex.submit(call)
+            out = fut.result(timeout=120)    # pre-fix: hangs forever
+        np.testing.assert_allclose(out, 2.0)
+        # The pre-restart pipelined outputs are poisoned, not hanging.
+        with pytest.raises(Exception):
+            with concurrent.futures.ThreadPoolExecutor(1) as ex:
+                ex.submit(lambda: np.asarray(stale[0])).result(
+                    timeout=60)
+    finally:
+        bridge_mod.reset_for_tests()
+        srv.shutdown()
+        srv.server_close()

@@ -1136,3 +1136,46 @@ def test_admin_socket_hardened(broker):
         s2.close()
     finally:
         server_mod.AdminSession._allowed_uids = orig
+
+
+def test_content_dedup_shares_device_buffer(broker):
+    """Co-tenants PUTting identical large tensors (shared base weights —
+    every bridged tenant of one image does this) share ONE immutable
+    device buffer: the host->device transfer happens once per node.
+    Quota books still charge each tenant the full size."""
+    import vtpu.runtime.server as server_mod
+
+    a = RuntimeClient(broker, tenant="w-a")
+    b = RuntimeClient(broker, tenant="w-b")
+    big = np.random.rand(600_000).astype(np.float32)   # 2.4 MB > 1 MiB
+    ha = a.put(big, "w")
+    hb = b.put(big, "w")
+    srv = None
+    # Reach the in-process server state through the fixture's server
+    # object: the broker fixture yields only the socket, so find the
+    # state via the module-level registry of tenants on the region —
+    # simplest is a fresh STATS comparison + object identity via gc.
+    st_a = a.stats()["w-a"]
+    st_b = b.stats()["w-b"]
+    assert st_a["used_bytes"] == big.nbytes      # books: full charge
+    assert st_b["used_bytes"] == big.nbytes
+    # Identity check via gc: exactly ONE live device array of this
+    # shape/content should exist server-side.
+    import gc
+    import jax
+
+    arrs = [o for o in gc.get_objects()
+            if isinstance(o, jax.Array)
+            and getattr(o, "shape", None) == (600_000,)]
+    assert len({id(x) for x in arrs}) == 1, \
+        f"expected one shared buffer, found {len(arrs)}"
+    # Both tenants read back their own copy correctly.
+    np.testing.assert_array_equal(ha.fetch(), big)
+    np.testing.assert_array_equal(hb.fetch(), big)
+    # And a MUTATED upload under the same id must not hit the cache.
+    big2 = big.copy()
+    big2[0] += 1.0
+    hb2 = b.put(big2, "w2")
+    np.testing.assert_array_equal(hb2.fetch(), big2)
+    a.close()
+    b.close()
