@@ -618,108 +618,157 @@ class DeviceScheduler:
         prev_obs = 0.0
         while not self._stop:
             try:
-                item, t0, outs = self._completion_q.get(timeout=0.5)
+                first = self._completion_q.get(timeout=0.5)
             except queue.Empty:
                 # Idle: whatever is left in the pool is stale (compile
                 # residue, measurement slack) — never bill it to future
                 # work.
                 self._pool_us = 0.0
                 continue
+            # Batch-drain: everything dispatched since the last
+            # observation retires on ONE readiness wait (the last
+            # item's).  On relayed transports EVERY block_until_ready
+            # is a ~60-100ms round trip even for long-finished arrays,
+            # so per-item blocking caps retirement — and therefore
+            # MAX_INFLIGHT-bound dispatch — at ~1/RTT items/s
+            # (measured: un-chained tenants at 13 steps/s vs 87
+            # chained).  The device executes in dispatch order, so the
+            # last item's readiness implies the whole batch ran.
+            #
+            # The drain is CAPPED by estimated device time (~3 round
+            # trips): blocking on the newest of an unbounded batch
+            # delays retirement of the oldest by the whole batch
+            # window, and with MAX_INFLIGHT-gated admission the device
+            # runs dry near batch end (measured: 4-tenant chained
+            # aggregate 87 -> 78 steps/s with an unbounded drain).
+            # Under the cap, long chain items (>> RTT) still retire
+            # one-at-a-time with exact windows, while swarms of
+            # per-step items amortise one RTT across ~3 RTTs of work —
+            # enough for retirement to outpace the device.
+            lat_us_now = self.chip.calibrate_latency_us()
+            drain_cap_us = max(3.0 * lat_us_now, 50_000.0)
+            batch = [first]
+            batch_est = first[0].est_us
+            while batch_est < drain_cap_us:
+                try:
+                    nxt = self._completion_q.get_nowait()
+                except queue.Empty:
+                    break
+                batch.append(nxt)
+                batch_est += nxt[0].est_us
             exc = None
             try:
-                jax.block_until_ready(outs)
+                jax.block_until_ready(batch[-1][2])
             except Exception as e:  # noqa: BLE001 - poisoned chain
                 exc = e
+            if exc is not None:
+                # Rare failure path: re-observe every batch member
+                # individually (per-item RTTs are fine here) so the
+                # poison lands ONLY on the tenants whose chains
+                # actually failed.  When the tail item succeeds, a
+                # mid-batch member's device-side failure is not seen
+                # here at all — it surfaces through the dependency
+                # chain (the tenant's next execute carries it, or GET
+                # of the output raises): the async-error contract.
+                for it_f, _, outs_f in batch:
+                    try:
+                        jax.block_until_ready(outs_f)
+                    except Exception as e_f:  # noqa: BLE001
+                        it_f.tenant.async_error = e_f
             t_obs = time.monotonic()
             lat_s = self.chip.calibrate_latency_us() / 1e6
             obs_us = max(t_obs - prev_obs, 0.0) * 1e6
-            disp_us = max(t_obs - t0 - lat_s, 0.0) * 1e6
-            prev_obs_before, prev_obs = prev_obs, t_obs
-            backlog = self._completion_q.qsize()
-            t = item.tenant
-            prev_ema = t.cost_ema.get(item.key, 5000.0)
-            per_step = None  # EMA sample (None = don't learn)
-            if item.first_run:
-                # Warmup execution: window is program-load/compile noise.
-                busy_us = item.est_us
-            elif obs_us <= disp_us:
+            last_t0 = batch[-1][1]
+            disp_us = max(t_obs - last_t0 - lat_s, 0.0) * 1e6
+            prev_obs = t_obs
+            continuous = obs_us <= disp_us
+            if continuous:
                 # CONTINUOUS LOAD: the ready-to-ready gap is exact
-                # device time (constant observation latency cancels).
-                # Pooled attribution: when observation latency
-                # fluctuates (batched readiness events), items can be
-                # observed with a ~zero gap right after a long block —
-                # billing them zero would refund their charges and decay
-                # their EMAs toward nothing, letting a pipelining tenant
-                # evade its core quota.  The window feeds a pool and
-                # every item bills from it, capped per item at 4x its
+                # device time for the whole batch (constant observation
+                # latency cancels).  The window feeds a pool and every
+                # item bills from it, capped per item at 4x its
                 # estimate; what ENTERS is capped by what the window
-                # could plausibly contain (this item + the backlog) so
-                # an anomalous window cannot surcharge the next dozen
-                # items.
-                avail_us = min(obs_us,
-                               item.est_us * 4.0 * (1 + backlog))
-                self._pool_us = min(self._pool_us + avail_us,
+                # could plausibly contain so an anomalous window cannot
+                # surcharge the next dozen items.
+                self._pool_us = min(self._pool_us
+                                    + min(obs_us, batch_est * 4.0),
                                     2_000_000.0)
-                cap_us = max(item.est_us * 4.0,
-                             float(self.state.min_exec_cost_us)
-                             * item.steps)
-                busy_us = min(self._pool_us, cap_us)
-                self._pool_us -= busy_us
-                per_step = busy_us / item.steps
             else:
                 # SPARSE (queue restarted): any pooled window credit is
                 # stale — the device provably idled — and must not be
-                # billed to a later tenant's continuous item.
+                # billed to a later item.  Dispatch-to-ready is the
+                # only measurement and overshoots by an uncalibratable
+                # 60-120ms on relayed transports; billing it raw makes
+                # estimates creep up and dispatch sparser — a feedback
+                # loop that halved long-run throughput (measured).
                 self._pool_us = 0.0
-                # Only the dispatch-to-ready
-                # measurement exists, and on relayed transports it
-                # overshoots by an uncalibratable 60-120ms.  Billing it
-                # raw makes the estimate creep up, which makes dispatch
-                # sparser, which inflates the next measurement — a
-                # positive feedback loop that halved long-run throughput
-                # (measured).  Bill the estimate instead (learned from
-                # loaded measurements), and learn UP from a sparse
-                # sample only on strong evidence (>3x est — a genuinely
-                # bigger program; steady-state sparse overshoot measures
-                # up to ~2.2x true cost on the relayed transport), never
-                # from that overshoot.
-                busy_us = min(disp_us,
-                              max(item.est_us,
-                                  float(self.state.min_exec_cost_us)
-                                  * item.steps))
-                if disp_us > 3.0 * item.est_us:
-                    per_step = disp_us / item.steps
+            for item, t0, outs in batch:
+                t = item.tenant
+                prev_ema = t.cost_ema.get(item.key, 5000.0)
+                per_step = None  # EMA sample (None = don't learn)
+                if item.first_run:
+                    # Warmup: the window is program-load/compile noise.
+                    busy_us = item.est_us
+                elif continuous:
+                    cap_us = max(item.est_us * 4.0,
+                                 float(self.state.min_exec_cost_us)
+                                 * item.steps)
+                    busy_us = min(self._pool_us, cap_us)
+                    self._pool_us -= busy_us
+                    per_step = busy_us / item.steps
+                elif item is batch[-1][0]:
+                    # SPARSE, tail item: disp_us (ITS dispatch-to-ready)
+                    # is the only measurement.
+                    busy_us = min(disp_us,
+                                  max(item.est_us,
+                                      float(self.state.min_exec_cost_us)
+                                      * item.steps))
+                    if disp_us > 3.0 * item.est_us:
+                        per_step = disp_us / item.steps
+                    else:
+                        per_step = min(disp_us / item.steps, prev_ema)
                 else:
-                    per_step = min(disp_us / item.steps, prev_ema)
-            if exc is not None:
-                t.async_error = exc
-            t.busy_add_all(int(busy_us))
-            charged = max(busy_us, float(self.state.min_exec_cost_us)
-                          * item.steps)
-            if item.metered:
-                # Correction capped at 4x the estimate: an anomalous
-                # measurement (first-run XLA compile, stray host stall)
-                # must not wedge the bucket for ages.  The EMA (also
-                # growth-clamped below) catches real cost within a few
-                # items, so sustained under-charging is impossible.
-                t.rate_adjust_all(
-                    int(min(charged, item.est_us * 4.0) - item.est_us))
-            if per_step is not None:
-                # Growth-clamped EMA — INCLUDING the first learned
-                # sample: seeding raw would let one outlier (compile,
-                # transport stall) throttle the tenant for ~15 executes.
-                # From the 5ms default the clamp still converges on any
-                # real cost exponentially (x4 per observation).
-                t.cost_ema[item.key] = (prev_ema * 0.7
-                                        + min(per_step, prev_ema * 4.0)
-                                        * 0.3)
-            t.executions += item.steps
-            log.debug(
-                "meter %s: est=%.0fus busy=%.0fus pool=%.0fus "
-                "backlog=%d obs_gap=%.0fus disp_gap=%.0fus",
-                t.name, item.est_us, busy_us, self._pool_us,
-                backlog, obs_us, disp_us)
-            self._retire(item)
+                    # SPARSE, non-tail item: disp_us is measured from
+                    # the TAIL's dispatch and spans the whole batch —
+                    # attributing it per item would bill (and teach,
+                    # via the >3x learn-up) every small item the whole
+                    # batch's window, ratcheting EMAs batch-wide.  No
+                    # per-item measurement exists: bill the estimate,
+                    # learn nothing.
+                    busy_us = max(item.est_us,
+                                  float(self.state.min_exec_cost_us)
+                                  * item.steps)
+                t.busy_add_all(int(busy_us))
+                charged = max(busy_us,
+                              float(self.state.min_exec_cost_us)
+                              * item.steps)
+                if item.metered:
+                    # Correction capped at 4x the estimate: an
+                    # anomalous measurement (first-run XLA compile,
+                    # stray host stall) must not wedge the bucket for
+                    # ages.  The EMA (growth-clamped below) catches
+                    # real cost within a few items, so sustained
+                    # under-charging is impossible.
+                    t.rate_adjust_all(
+                        int(min(charged, item.est_us * 4.0)
+                            - item.est_us))
+                if per_step is not None:
+                    # Growth-clamped EMA — INCLUDING the first learned
+                    # sample: seeding raw would let one outlier
+                    # (compile, transport stall) throttle the tenant
+                    # for ~15 executes.  From the 5ms default the clamp
+                    # still converges on any real cost exponentially
+                    # (x4 per observation).
+                    t.cost_ema[item.key] = (
+                        prev_ema * 0.7
+                        + min(per_step, prev_ema * 4.0) * 0.3)
+                t.executions += item.steps
+                log.debug(
+                    "meter %s: est=%.0fus busy=%.0fus pool=%.0fus "
+                    "batch=%d obs_gap=%.0fus disp_gap=%.0fus",
+                    t.name, item.est_us, busy_us, self._pool_us,
+                    len(batch), obs_us, disp_us)
+                self._retire(item)
 
     def stop(self):
         self._stop = True

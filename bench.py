@@ -392,19 +392,27 @@ def run_interposed_direct(steps, warmup, cfg_name, batch, seq, reps,
     env["VTPU_CORE_INDICES"] = "0"
     env["VTPU_DEVICE_MEMORY_SHARED_CACHE"] = os.path.join(
         tmp, "interp.cache")
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__),
-         "--_interposed-child",
-         f"{steps},{warmup},{cfg_name},{batch},{seq},{reps}"],
-        env=env, capture_output=True, text=True, timeout=1200)
-    if proc.returncode != 0:
-        print(f"[bench] interposed phase failed: {proc.stderr[-400:]}",
-              file=sys.stderr)
-        return []
-    try:
-        return json.loads(proc.stdout.strip().splitlines()[-1])["rates"]
-    except (ValueError, IndexError, KeyError):
-        return []
+    # One retry with a longer settle: the previous phase's chip session
+    # can take >2s to tear down after GB-scale spill cleanup, and a
+    # register() against a still-claimed chip fails with an opaque
+    # backend error (seen as a bare "wrapper" stderr).
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--_interposed-child",
+             f"{steps},{warmup},{cfg_name},{batch},{seq},{reps}"],
+            env=env, capture_output=True, text=True, timeout=1200)
+        if proc.returncode == 0:
+            try:
+                return json.loads(
+                    proc.stdout.strip().splitlines()[-1])["rates"]
+            except (ValueError, IndexError, KeyError):
+                return []
+        print(f"[bench] interposed attempt {attempt} failed: "
+              f"{proc.stderr[-400:]}", file=sys.stderr)
+        if attempt == 0:
+            time.sleep(20.0)
+    return []
 
 
 def run_tenant(sock, tenant, steps, warmup, cfg_name, batch, seq,
@@ -541,6 +549,19 @@ def _tenant_entry(sock, tenant, steps, warmup, cfg_name, batch, seq,
         q.put((tenant, ("error", f"{type(e).__name__}: {e}")))
 
 
+def _reap_wedged(procs):
+    """SIGKILL children that outlive their join window.  A chip-holding
+    child that wedges in teardown (seen live: GB-scale spill cleanup on
+    the relayed transport) otherwise keeps the libtpu per-process lock,
+    and the NEXT phase's broker starts against an unclaimable chip."""
+    for p in procs:
+        if p.is_alive():
+            print(f"[bench] child {p.pid} wedged in teardown; killing",
+                  file=sys.stderr)
+            p.kill()
+            p.join(timeout=30)
+
+
 def _collect_tenants(specs):
     """Spawn one process per (name, target, args) spec; each target
     must q.put((name, (count, elapsed_s))) or (name, ("error", msg))
@@ -555,6 +576,7 @@ def _collect_tenants(specs):
     results = [q.get(timeout=3600) for _ in procs]
     for p in procs:
         p.join(timeout=60)
+    _reap_wedged(procs)
     total = 0
     max_elapsed = 0.0
     for name, res in results:
@@ -685,6 +707,57 @@ def wait_socket(path, proc, timeout=600):
         time.sleep(0.2)
 
 
+def stop_broker(broker):
+    broker.terminate()
+    try:
+        broker.wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        broker.kill()
+        broker.wait(timeout=10)
+    time.sleep(2.0)  # let the chip session tear down fully
+
+
+_CANARY_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+try:
+    jax.config.update("jax_platforms", "cpu")
+except RuntimeError:
+    pass
+import jax.numpy as jnp
+from vtpu.runtime.client import RuntimeClient
+
+
+def probe():
+    return jnp.full((4, 4), 7.0, jnp.float32)
+
+
+c = RuntimeClient({sock!r}, tenant="bench-canary")
+out = c.compile(probe, [])()
+val = c.get(out[0].id)
+assert float(val[0][0]) == 7.0, val
+c.close()
+"""
+
+
+def canary_probe(sock, timeout=240):
+    """One tiny end-to-end execute against a fresh broker, bounded.
+    Catches the wedged-chip failure mode seen live: a previous phase's
+    process wedges in teardown still holding the libtpu chip lock, the
+    next broker starts anyway (calibration fails open), and every
+    dispatch then blocks forever.  A bounded probe turns that into a
+    phase-level broker restart instead of a hung bench run."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _CANARY_SCRIPT.format(repo=REPO, sock=sock)],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(f"canary execute: {proc.stderr[-300:]}")
+
+
 def measure(sock, n_tenants, steps, warmup, cfg_name, batch, seq,
             core_limit, hbm_limit=None, oversubscribe=False,
             concrete_params=False):
@@ -743,6 +816,7 @@ def main():
     p.start()
     _, direct_out = q.get(timeout=3600)
     p.join(timeout=60)
+    _reap_wedged([p])
     direct_rates = direct_out["plain"]
     direct_tput = statistics.fmean(direct_rates)
     direct_chained_tput = statistics.fmean(direct_out["chained"])
@@ -754,10 +828,28 @@ def main():
               cfg=None, pbatch=None, pseq=None, measure_fn=None):
         print(f"[bench] phase {name} starting", file=sys.stderr)
         sock = os.path.join(tmp, f"{name}.sock")
-        broker = start_broker(sock, os.path.join(tmp, f"{name}.shr"),
-                              hbm, core, quick)
+        region = os.path.join(tmp, f"{name}.shr")
+        broker = start_broker(sock, region, hbm, core, quick)
         try:
             wait_socket(sock, broker)
+            if not quick:
+                for attempt in range(2):
+                    try:
+                        canary_probe(sock)
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        print(f"[bench] phase {name} canary failed "
+                              f"(attempt {attempt}): {e}",
+                              file=sys.stderr)
+                        if attempt:
+                            raise
+                        stop_broker(broker)
+                        if os.path.exists(sock):
+                            os.unlink(sock)
+                        time.sleep(15.0)  # wedged chip holder settles
+                        broker = start_broker(sock, region, hbm, core,
+                                              quick)
+                        wait_socket(sock, broker)
             if measure_fn is not None:
                 out = measure_fn(sock)
             else:
@@ -771,13 +863,7 @@ def main():
                   file=sys.stderr)
             return out
         finally:
-            broker.terminate()
-            try:
-                broker.wait(timeout=20)
-            except subprocess.TimeoutExpired:
-                broker.kill()
-                broker.wait(timeout=10)
-            time.sleep(2.0)  # let the chip session tear down fully
+            stop_broker(broker)
 
     free_tput = phase("free", "0", 0)              # unrestricted sharing
     quota_tput = phase("quota", hbm_limit, core_limit)  # enforced sharing
@@ -866,6 +952,7 @@ def main():
             pd.start()
             _, rn_rates = qd.get(timeout=3600)
             pd.join(timeout=60)
+            _reap_wedged([pd])
             if isinstance(rn_rates, tuple) and rn_rates \
                     and rn_rates[0] == "error":
                 raise RuntimeError(f"resnet direct: {rn_rates[1]}")
