@@ -174,6 +174,58 @@ def test_single_tenant_pipelining_saturates(broker):
     c.close()
 
 
+def test_unchained_bursts_batch_retire_and_meter(tmp_path):
+    """Batch-drain completion metering: bursts of INDEPENDENT per-step
+    executes (no chains, no carries — the transparent-bridge traffic
+    shape) must all retire through the capped batch drain, with idle
+    gaps between bursts forcing the sparse classification where only
+    the batch tail has a usable dispatch-to-ready measurement.  The
+    regression signals: a retirement wedge (recv hangs), lost replies,
+    mis-counted executions, or EMA/bucket ratcheting that turns later
+    bursts pathologically slower than the first (non-tail items must
+    bill their estimate, never the whole batch window)."""
+    sock = str(tmp_path / "bd.sock")
+    srv = make_server(sock, hbm_limit=0, core_limit=50,
+                      region_path=str(tmp_path / "bd.shr"),
+                      min_exec_cost_us=1_000)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = RuntimeClient(sock, tenant="burst")
+        c.sock.settimeout(30.0)  # a retirement wedge must FAIL, not hang
+        x = np.full((32, 32), 2.0, np.float32)
+        exe = c.compile(lambda a: a * 3.0, [x])
+        c.put(x, "x0")
+        exe_n, burst, times = 0, 24, []
+        for round_i in range(3):
+            t0 = time.monotonic()
+            for i in range(burst):
+                c.execute_send_ids(exe.id, ["x0"], [f"y{i}"])
+            for _ in range(burst):
+                c.execute_recv()
+            exe_n += burst
+            times.append(time.monotonic() - t0)
+            np.testing.assert_allclose(c.get(f"y{burst - 1}"), x * 3.0)
+            time.sleep(0.6)  # idle: next burst starts a sparse batch
+        # Retirement is asynchronous to the dispatch-time replies: poll
+        # until the completion loop drains, then require EXACT counts
+        # (a double-retire would over-count; nothing else executes).
+        deadline = time.monotonic() + 15.0
+        while (c.stats()["burst"]["executions"] != exe_n
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert c.stats()["burst"]["executions"] == exe_n
+        # 24 executes charged >= 1ms each at a 50% share bound the burst
+        # at ~48ms + slack; catastrophic over-billing (every batch item
+        # billed the whole window) would throttle later bursts into the
+        # multi-second range.
+        assert times[-1] < 5.0, times
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
 def test_work_conserving_two_of_four_tenants(tmp_path):
     """4 tenants hold 25% grants but only 2 execute: work-conserving
     refill hands the idle half to the active pair (eff 50% each), so
