@@ -678,10 +678,19 @@ class DeviceScheduler:
             t_obs = time.monotonic()
             lat_s = self.chip.calibrate_latency_us() / 1e6
             obs_us = max(t_obs - prev_obs, 0.0) * 1e6
-            last_t0 = batch[-1][1]
-            disp_us = max(t_obs - last_t0 - lat_s, 0.0) * 1e6
+            # Continuity is judged against the batch HEAD's dispatch:
+            # head_t0 + L <= prev_obs means the head was already queued
+            # when the previous observation fired, so the queue never
+            # drained and the whole obs window is device time.  Judging
+            # against the tail (dispatched mid-window under pipelining)
+            # would misclassify loaded multi-item batches as sparse,
+            # discarding measured device time — the quota-evasion hole
+            # the pool exists to close.  disp_us (the TAIL's own
+            # dispatch-to-ready) is kept separately for sparse billing.
+            cont_us = max(t_obs - batch[0][1] - lat_s, 0.0) * 1e6
+            disp_us = max(t_obs - batch[-1][1] - lat_s, 0.0) * 1e6
             prev_obs = t_obs
-            continuous = obs_us <= disp_us
+            continuous = obs_us <= cont_us
             if continuous:
                 # CONTINUOUS LOAD: the ready-to-ready gap is exact
                 # device time for the whole batch (constant observation
@@ -716,9 +725,11 @@ class DeviceScheduler:
                     busy_us = min(self._pool_us, cap_us)
                     self._pool_us -= busy_us
                     per_step = busy_us / item.steps
-                elif item is batch[-1][0]:
-                    # SPARSE, tail item: disp_us (ITS dispatch-to-ready)
-                    # is the only measurement.
+                elif len(batch) == 1:
+                    # SPARSE singleton: disp_us is this item's own
+                    # dispatch-to-ready — the one calibrated sparse
+                    # measurement (overshoot ~60-120ms, 3x learn-up
+                    # evidence threshold sized for it).
                     busy_us = min(disp_us,
                                   max(item.est_us,
                                       float(self.state.min_exec_cost_us)
@@ -728,13 +739,15 @@ class DeviceScheduler:
                     else:
                         per_step = min(disp_us / item.steps, prev_ema)
                 else:
-                    # SPARSE, non-tail item: disp_us is measured from
-                    # the TAIL's dispatch and spans the whole batch —
-                    # attributing it per item would bill (and teach,
-                    # via the >3x learn-up) every small item the whole
-                    # batch's window, ratcheting EMAs batch-wide.  No
-                    # per-item measurement exists: bill the estimate,
-                    # learn nothing.
+                    # SPARSE multi-item batch: even the tail's disp_us
+                    # embeds its co-batched predecessors' device time
+                    # (they were submitted ahead of it), so no item has
+                    # an uncontaminated measurement — attributing the
+                    # window per item would bill (and teach, via the
+                    # >3x learn-up) every small item the whole batch's
+                    # window, ratcheting EMAs burst over burst.  Bill
+                    # the estimate, learn nothing; continuous load does
+                    # the learning.
                     busy_us = max(item.est_us,
                                   float(self.state.min_exec_cost_us)
                                   * item.steps)

@@ -1,0 +1,59 @@
+"""Bench harness plumbing (CPU): the canary probe that guards every
+broker phase against the wedged-chip failure mode, and the reaper that
+SIGKILLs children which outlive their join window.  Both exist because
+a single wedged chip-holder otherwise turns a ~35-minute bench run
+into an indefinite hang (observed live on the relayed transport)."""
+
+import multiprocessing as mp
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import bench  # noqa: E402
+from vtpu.runtime.server import make_server  # noqa: E402
+
+
+def test_canary_probe_passes_on_live_broker(tmp_path):
+    sock = str(tmp_path / "cn.sock")
+    srv = make_server(sock, hbm_limit=0, core_limit=0,
+                      region_path=str(tmp_path / "cn.shr"))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        bench.canary_probe(sock, timeout=240)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_canary_probe_fails_fast_on_dead_socket(tmp_path):
+    # No listener: the probe must raise (not hang) well inside its
+    # timeout, so the phase restarts its broker instead of wedging.
+    sock = str(tmp_path / "nobody.sock")
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        bench.canary_probe(sock, timeout=240)
+    assert time.monotonic() - t0 < 120
+
+
+def _sleep_forever():
+    time.sleep(3600)
+
+
+def test_reap_wedged_kills_survivors():
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_sleep_forever)
+    p.start()
+    try:
+        p.join(timeout=0.5)
+        assert p.is_alive()
+        bench._reap_wedged([p])
+        assert not p.is_alive()
+    finally:
+        if p.is_alive():
+            p.kill()
