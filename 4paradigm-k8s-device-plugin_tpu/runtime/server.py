@@ -789,6 +789,36 @@ class DeviceScheduler:
             self.mu.notify_all()
 
 
+def claim_watchdog(stage: str):
+    """Arm a deadline around a chip-claim step; returns cancel().
+
+    The claim path (platform init in jax.devices(), the calibration
+    execute at first ChipState) BLOCKS indefinitely — no exception —
+    when another process holds the chip lease (libtpu's per-process
+    lock; seen live when a SIGKILLed chip holder's lease went stale on
+    a relayed transport).  A broker wedged there either never binds its
+    socket or, worse, serves HELLOs whose dispatch blocks forever.
+    Exiting lets the supervisor respawn with backoff (plugin/main.py)
+    and gives clients the typed broker-epoch crash contract instead of
+    an unbounded hang.  VTPU_CLAIM_WATCHDOG_S bounds the step (default
+    180s — first-compile on a cold relayed transport takes 20-40s;
+    0 disables)."""
+    deadline = float(os.environ.get("VTPU_CLAIM_WATCHDOG_S", "180"))
+    done = threading.Event()
+    if deadline <= 0:
+        return done.set
+    def _fire():
+        if not done.wait(deadline):
+            log.error(
+                "%s wedged for %.0fs (chip lease held by another "
+                "process?); exiting for supervisor respawn",
+                stage, deadline)
+            os._exit(3)
+    threading.Thread(target=_fire, daemon=True,
+                     name="vtpu-claim-watchdog").start()
+    return done.set
+
+
 class ChipState:
     """Per-chip execution context: the chip's own accounting region
     (tenant axis WITHIN the chip — tenants are not conflated with chips,
@@ -877,7 +907,11 @@ class RuntimeState:
         # grant's chip, from TPU_VISIBLE_CHIPS) lands on the right
         # silicon; each ChipState drives its chip's first core (the
         # core-split path handles per-core pinning via the interposer).
-        self.devices = self._chip_leaders(jax.devices())
+        cancel = claim_watchdog("platform init (jax.devices)")
+        try:
+            self.devices = self._chip_leaders(jax.devices())
+        finally:
+            cancel()
         # Broker-instance epoch, echoed in every HELLO reply: a client
         # reconnecting after a broker crash sees a fresh epoch and knows
         # every handle it holds is gone (typed VtpuStateLost on the
@@ -990,8 +1024,12 @@ class RuntimeState:
         with self.chips_mu:
             c = self.chips.get(index)
             if c is None:
-                c = ChipState(self, index, self.devices[index],
-                              self.chip_region_path(index))
+                cancel = claim_watchdog(f"chip {index} claim/calibration")
+                try:
+                    c = ChipState(self, index, self.devices[index],
+                                  self.chip_region_path(index))
+                finally:
+                    cancel()
                 self.chips[index] = c
             return c
 

@@ -226,6 +226,33 @@ def test_unchained_bursts_batch_retire_and_meter(tmp_path):
         srv.server_close()
 
 
+def test_claim_watchdog_exits_wedged_process():
+    """A wedged chip-claim step (blocked platform init / calibration —
+    no exception to catch) must exit rc 3 for supervisor respawn; a
+    cancelled watchdog must never fire."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import os, sys, time\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "os.environ['VTPU_CLAIM_WATCHDOG_S'] = '0.3'\n"
+        "from vtpu.runtime.server import claim_watchdog\n"
+        "cancel = claim_watchdog('test stage')\n"
+        "if sys.argv[1] == 'cancel':\n"
+        "    cancel()\n"
+        "time.sleep(1.2)\n"
+        "print('SURVIVED')\n")
+    wedged = subprocess.run([sys.executable, "-c", code, "wedge"],
+                            capture_output=True, text=True, timeout=60)
+    assert wedged.returncode == 3, (wedged.returncode, wedged.stderr)
+    assert "SURVIVED" not in wedged.stdout
+    ok = subprocess.run([sys.executable, "-c", code, "cancel"],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0 and "SURVIVED" in ok.stdout, ok.stderr
+
+
 def test_work_conserving_two_of_four_tenants(tmp_path):
     """4 tenants hold 25% grants but only 2 execute: work-conserving
     refill hands the idle half to the active pair (eff 50% each), so
