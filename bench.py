@@ -707,6 +707,63 @@ def wait_socket(path, proc, timeout=600):
         time.sleep(0.2)
 
 
+_CHIP_PROBE = """
+import os
+import jax
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Test env: the startup registration initialises the TPU platform
+    # regardless of the env var — only a config update actually selects
+    # the CPU backend (see tests/conftest.py).
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+import numpy as np
+x = jax.device_put(np.ones((128, 128), np.float32))
+assert float((x @ x).sum()) == 128.0 ** 3
+print("CHIP_CLAIMABLE")
+"""
+
+
+def wait_chip_claimable(max_wait_s=900):
+    """Gate the run on the chip actually being claimable.  A stale
+    lease (a SIGKILLed previous holder on the relayed transport) makes
+    EVERY claim block indefinitely with no error; without this gate the
+    first direct phase sits in q.get for its full hour-scale timeout.
+    Patient by design: leases can settle minutes after the holder dies,
+    and a fresh-process probe is cheap relative to the run it guards."""
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        p = subprocess.Popen([sys.executable, "-c", _CHIP_PROBE],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+        try:
+            out, errout = p.communicate(timeout=240)
+            if p.returncode == 0 and "CHIP_CLAIMABLE" in out:
+                return
+            err = errout[-200:]
+        except subprocess.TimeoutExpired:
+            # SIGTERM first, kill only after a grace window: a probe
+            # SIGKILLed mid-claim leaves ITS pool-side lease stale —
+            # manufacturing the very condition this gate detects.
+            p.terminate()
+            try:
+                p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate(timeout=10)
+            err = "probe timed out (chip lease held elsewhere?)"
+        waited = time.monotonic() - t0
+        print(f"[bench] chip probe {attempt} failed after "
+              f"{waited:.0f}s: {err}", file=sys.stderr)
+        if waited > max_wait_s:
+            raise RuntimeError(
+                f"chip not claimable after {max_wait_s}s: {err}")
+        time.sleep(20.0)
+
+
 def stop_broker(broker):
     broker.terminate()
     try:
@@ -805,6 +862,9 @@ def main():
     tflop_per_step = model_flops_per_step(cfg, batch, seq) / 1e12
 
     tmp = tempfile.mkdtemp(prefix="vtpu_bench_")
+
+    if not quick:
+        wait_chip_claimable()
 
     # Phase 0: direct whole-chip baseline (own subprocess so the broker
     # phases start with a free chip).

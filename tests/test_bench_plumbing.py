@@ -41,6 +41,13 @@ def test_canary_probe_fails_fast_on_dead_socket(tmp_path):
     assert time.monotonic() - t0 < 120
 
 
+def test_chip_gate_passes_when_claimable():
+    # conftest pins JAX_PLATFORMS=cpu, which the probe subprocess
+    # inherits: the CPU "chip" is always claimable, driving the gate's
+    # success path end to end (raises on failure).
+    bench.wait_chip_claimable(max_wait_s=300)
+
+
 def _sleep_forever():
     time.sleep(3600)
 
