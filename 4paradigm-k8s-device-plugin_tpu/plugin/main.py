@@ -164,6 +164,14 @@ class Daemon:
         # compile cache on the hostPath lib dir.
         env.setdefault("VTPU_COMPILE_CACHE_DIR",
                        os.path.join(self.cfg.host_lib_dir, "xla-cache"))
+        # Tenant STATE survives broker respawns via the crash-safe
+        # journal (docs/BROKER_RECOVERY.md): the respawned broker
+        # replays it and reconnecting tenants resume with quotas, HBM
+        # ledgers and cost EMAs intact.  VTPU_JOURNAL_DIR= (empty) on
+        # the daemon opts a node out.
+        env.setdefault("VTPU_JOURNAL_DIR",
+                       os.path.join(self.cfg.host_lib_dir,
+                                    "broker-journal"))
         # Same execute-cost floor the pods get: the broker's metering is
         # just as blind on enqueue-complete transports (docs/FLAGS.md).
         from ..utils import envspec

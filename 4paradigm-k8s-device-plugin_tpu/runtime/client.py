@@ -40,7 +40,16 @@ class VtpuConnectionLost(RuntimeError_):
     """The connection died and was rebound with tenant state intact —
     only in-flight requests (and their replies) are lost.  Typed so
     pipelined callers (the bridge) can tell 'my outstanding replies are
-    gone' apart from an application-level error reply."""
+    gone' apart from an application-level error reply.
+
+    ``resumed`` is True when the state survived a broker RESTART via
+    the journal (HELLO resume, docs/BROKER_RECOVERY.md) rather than the
+    broker staying alive: quotas, arrays, programs and cost EMAs are
+    intact on the new instance, so idempotent requests are safely
+    retryable (the client does this transparently) — but pipelined
+    executes in flight at the crash died unreplied."""
+
+    resumed = False
 
 
 class VtpuStateLost(RuntimeError_):
@@ -95,8 +104,15 @@ class RuntimeClient:
                  hbm_limit: Optional[int] = None,
                  core_limit: Optional[int] = None,
                  oversubscribe: Optional[bool] = None,
-                 reconnect_timeout: float = 15.0):
+                 reconnect_timeout: Optional[float] = None):
         self._socket_path = socket_path
+        # Reconnect budget: how long a disconnected client keeps
+        # redialing the socket (the daemon respawns crashed brokers
+        # with backoff) before giving up.  VTPU_RECONNECT_TIMEOUT_S
+        # tunes it per pod without code changes.
+        if reconnect_timeout is None:
+            reconnect_timeout = float(os.environ.get(
+                "VTPU_RECONNECT_TIMEOUT_S", "15"))
         self._reconnect_timeout = reconnect_timeout
         self._closed = False
         self._ids = itertools.count()
@@ -118,6 +134,15 @@ class RuntimeClient:
                  "priority": self.priority,
                  "oversubscribe": spec.oversubscribe
                  if oversubscribe is None else bool(oversubscribe)}
+        # Client identity for the broker's journal: recovery re-validates
+        # a recovered tenant against its owner's liveness (pid is only
+        # judged when the pid NAMESPACE matches the broker's — a
+        # containerized tenant's pid numbers mean nothing on the host).
+        hello["pid"] = os.getpid()
+        try:
+            hello["pidns"] = os.stat("/proc/self/ns/pid").st_ino
+        except OSError:
+            pass
         # "device" is ALWAYS sent (first granted chip): a pre-contract
         # broker (daemonset upgrade: new shim, old broker kept alive)
         # ignores "devices" and must still bind a granted chip, not
@@ -161,15 +186,24 @@ class RuntimeClient:
             except ValueError:
                 pass
         self._hello = hello
+        self.epoch: Optional[str] = None
         self.epoch = self._connect()[0]
 
     def _connect(self):
-        """Dial + HELLO; returns (epoch, created) where ``created``
-        means the broker bound this connection to a FRESH tenant slot.
-        Used for both the first connection and crash-recovery rebinds."""
+        """Dial + HELLO; returns (epoch, created, resumed) where
+        ``created`` means the broker bound this connection to a FRESH
+        tenant slot and ``resumed`` means a journal-recovered tenant
+        was re-adopted with its state intact.  Used for both the first
+        connection and crash-recovery rebinds."""
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(self._socket_path)
-        P.send_msg(self.sock, self._hello)
+        msg = dict(self._hello)
+        if self.epoch:
+            # Reconnect: offer our previous epoch — a journal-enabled
+            # successor broker answers resumed=true when it recovered
+            # this tenant (docs/BROKER_RECOVERY.md).
+            msg["resume_epoch"] = self.epoch
+        P.send_msg(self.sock, msg)
         resp = P.recv_msg(self.sock)
         if not resp.get("ok"):
             # Leave no half-open never-HELLO'd socket behind (every rpc
@@ -188,12 +222,19 @@ class RuntimeClient:
         # kept alive across the plugin restart) sends neither key — a
         # rebind to it must degrade to CONNECTION_LOST, not claim the
         # tenant's intact arrays are gone.
-        return resp.get("epoch"), bool(resp.get("created", False))
+        return (resp.get("epoch"), bool(resp.get("created", False)),
+                bool(resp.get("resumed", False)))
 
     def _on_disconnect(self) -> None:
         """The connection died mid-request.  Rebind to the socket (the
         daemon respawns a crashed broker with backoff) and classify:
 
+        - resumed -> a journal-enabled successor broker recovered this
+          tenant (quotas, arrays, programs, cost EMAs intact) -> typed
+          ``VtpuConnectionLost`` with ``resumed=True``; ``_rpc``
+          transparently retries idempotent requests on it, so a
+          synchronous caller never sees an error at all
+          (docs/BROKER_RECOVERY.md);
         - fresh epoch -> the broker restarted, device state is gone ->
           typed ``VtpuStateLost`` (the contract VERDICT r3 #5 asks for,
           instead of NOT_FOUND soup from dangling handle ids);
@@ -216,7 +257,7 @@ class RuntimeClient:
             except OSError:
                 pass
             try:
-                new_epoch, created = self._connect()
+                new_epoch, created, resumed = self._connect()
             except (ConnectionError, FileNotFoundError, OSError,
                     P.ProtocolError) as e:
                 last = e
@@ -224,10 +265,20 @@ class RuntimeClient:
                 continue
             except RuntimeError_ as e:
                 # HELLO itself rejected (e.g. slots exhausted while the
-                # dead session's teardown drains): retryable.
+                # dead session's teardown drains, or a DRAINING broker
+                # mid-handover): retryable.
                 last = e
                 time.sleep(0.25)
                 continue
+            if resumed:
+                self.epoch = new_epoch
+                err = VtpuConnectionLost(
+                    f"CONNECTION_LOST: broker restarted and this "
+                    f"tenant was recovered from its journal (epoch "
+                    f"{old} -> {new_epoch}); state is intact, only "
+                    f"in-flight requests were lost")
+                err.resumed = True
+                raise err
             if new_epoch != old or created:
                 self.epoch = new_epoch
                 why = ("broker restarted" if new_epoch != old else
@@ -281,14 +332,31 @@ class RuntimeClient:
         path = spec.runtime_socket or "/usr/local/vtpu/vtpu-runtime.sock"
         return cls(path, **kw)
 
+    # Kinds an interrupted synchronous request may transparently retry
+    # after a resumed reconnect: all single-frame idempotent verbs.
+    # EXECUTE is excluded (non-idempotent), as are staged PUT flows
+    # (the per-connection staging died with the old socket).
+    _RESUME_RETRY_KINDS = frozenset({P.GET, P.DELETE, P.STATS,
+                                     P.COMPILE, P.PUT})
+
     # -- plumbing --
-    def _rpc(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+    def _rpc(self, msg: Dict[str, Any],
+             _retry: bool = True) -> Dict[str, Any]:
         try:
             P.send_msg(self.sock, msg)
             resp = P.recv_msg(self.sock)
         except (ConnectionError, P.ProtocolError, OSError):
-            self._on_disconnect()
-            raise AssertionError("unreachable")  # _on_disconnect raises
+            try:
+                self._on_disconnect()
+                raise AssertionError("unreachable")  # it always raises
+            except VtpuConnectionLost as e:
+                # Journal resume: server-side state is intact, so an
+                # idempotent request simply re-runs against the new
+                # broker instance — the caller never sees the crash.
+                if e.resumed and _retry and not msg.get("staged") \
+                        and msg.get("kind") in self._RESUME_RETRY_KINDS:
+                    return self._rpc(msg, _retry=False)
+                raise
         if not resp.get("ok"):
             code = resp.get("code", "")
             if code == "RESOURCE_EXHAUSTED":
@@ -424,6 +492,10 @@ class RuntimeClient:
         both cpu and tpu so a CPU-only tenant (tracing needs no chip) can
         target a TPU-backed broker and vice versa."""
         import jax
+        # jax lazy-loads public submodules: without the explicit import,
+        # jax.export attribute access raises on jax >= 0.4.30.
+        import jax.export  # noqa: F401
+
         # Under the transparent bridge jax.jit is patched (shim/bridge.py);
         # the genuine jit rides on its _vtpu_real attribute.
         jit = getattr(jax.jit, "_vtpu_real", jax.jit)

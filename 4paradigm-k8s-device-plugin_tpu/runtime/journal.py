@@ -1,0 +1,378 @@
+"""Crash-safe broker state journal: write-ahead log + snapshot compaction.
+
+The runtime broker (runtime/server.py) is the node's enforcement point —
+per-tenant HBM ledgers, metering cost EMAs, chip calibration and tenant
+bindings all live in its process memory.  Without durability, any broker
+exit (watchdog ``os._exit(3)``, OOM-kill, upgrade) silently zeroes every
+tenant's quota state — exactly the "enforcement must survive component
+failure" property the reference gets from its mmap'd cross-process
+shared region.  This module gives the broker the same property the
+database way:
+
+  - every state-changing event (tenant bind/close, PUT/DELETE ledger
+    entries, program registration, learned cost-EMA samples, chip
+    calibration, epoch bumps) is appended to ``journal.log`` as one
+    CRC-framed JSON line and flushed to the OS before the reply is sent
+    — a SIGKILL'd broker loses at most the line being written;
+  - tensor payloads and program blobs land in a content-addressed
+    ``blobs/`` store (sha256-named, deduplicated), so a PUT array is
+    fully restorable after a crash — not just its accounting;
+  - every ``snapshot_every`` records the log is compacted: the log
+    rotates FIRST (appends during the build are preserved in the new
+    log), then the full-state snapshot is written tmp+fsync+rename and
+    the old log segment is deleted.  Replay of a record whose effect is
+    already in the snapshot is idempotent by construction.
+
+Corruption contract (``load_state``): a torn FINAL line of the newest
+log segment is the expected kill -9 artifact and is dropped silently; a
+bad line anywhere else, a CRC mismatch, or an unreadable snapshot raises
+``JournalCorrupt`` — the broker then quarantines the directory and boots
+a fresh epoch (fail closed: no guessed quota state), which clients see
+as today's typed ``VtpuStateLost``.
+
+Durability note: ``flush()`` survives process death (the page cache
+holds the bytes); it does NOT survive machine death.  Set
+``VTPU_JOURNAL_FSYNC=1`` to fsync every append when the journal dir is
+on persistent media and whole-node crashes must be covered too.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import logging as log
+
+LOG_NAME = "journal.log"
+SNAP_NAME = "snapshot.json"
+BLOBS_DIR = "blobs"
+# A blob younger than this is never GC'd even when unreferenced by the
+# snapshot: its PUT record may be racing the compaction.
+BLOB_GC_MIN_AGE_S = 60.0
+
+
+class JournalCorrupt(RuntimeError):
+    """The journal cannot be trusted — the caller must fail closed
+    (fresh epoch, no recovered state), never guess."""
+
+
+def _apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> None:
+    """Replay one record onto the snapshot-shaped state dict.  Must stay
+    idempotent: compaction rotates the log before the snapshot build, so
+    a record may be both replayed and already reflected."""
+    op = rec.get("op")
+    tenants = state.setdefault("tenants", {})
+    if op == "epoch":
+        state["epoch"] = rec.get("epoch")
+    elif op == "chip":
+        state.setdefault("chips", {})[str(rec.get("index"))] = \
+            rec.get("lat_us")
+    elif op == "bind":
+        t = tenants.setdefault(rec["name"], {"arrays": {}, "exes": {},
+                                             "ema": {}, "execs": 0})
+        for k in ("devices", "slots", "priority", "over", "hbm", "core",
+                  "spill", "pid", "pidns"):
+            if k in rec:
+                t[k] = rec[k]
+    elif op == "close":
+        tenants.pop(rec.get("name"), None)
+    elif op == "put":
+        t = tenants.get(rec.get("name"))
+        if t is not None:
+            t.setdefault("arrays", {})[rec["id"]] = {
+                k: rec[k] for k in ("sha", "shape", "dtype", "nbytes",
+                                    "charges", "spilled") if k in rec}
+    elif op == "del":
+        t = tenants.get(rec.get("name"))
+        if t is not None:
+            t.get("arrays", {}).pop(rec.get("id"), None)
+    elif op == "compile":
+        t = tenants.get(rec.get("name"))
+        if t is not None:
+            t.setdefault("exes", {})[rec["id"]] = rec.get("sha")
+    elif op == "ema":
+        t = tenants.get(rec.get("name"))
+        if t is not None:
+            t.setdefault("ema", {})[rec["key"]] = rec.get("ema")
+            if rec.get("execs") is not None:
+                t["execs"] = rec["execs"]
+    # Unknown ops are skipped (forward compatibility): an old broker
+    # replaying a newer journal must not lose the records it DOES know.
+
+
+class Journal:
+    """Append-only journal + blob store + snapshot, under one lock.
+
+    Lock ordering: callers hold broker-side locks (state.mu / tenant.mu)
+    and then call in here; nothing in this class calls back out, so the
+    journal mutex is always innermost.
+    """
+
+    def __init__(self, dirpath: str,
+                 snapshot_every: Optional[int] = None,
+                 fsync: Optional[bool] = None):
+        self.dir = dirpath
+        os.makedirs(os.path.join(dirpath, BLOBS_DIR), exist_ok=True)
+        if snapshot_every is None:
+            snapshot_every = int(os.environ.get(
+                "VTPU_JOURNAL_SNAPSHOT_EVERY", "4096"))
+        self.snapshot_every = max(int(snapshot_every), 1)
+        if fsync is None:
+            fsync = os.environ.get("VTPU_JOURNAL_FSYNC", "0") == "1"
+        self.fsync = bool(fsync)
+        self.mu = threading.Lock()
+        self.log_path = os.path.join(dirpath, LOG_NAME)
+        self.snap_path = os.path.join(dirpath, SNAP_NAME)
+        self._fh = open(self.log_path, "ab")
+        self._records_since = 0
+        self._appended_total = 0
+        self._last_snapshot_ts: Optional[float] = None
+        try:
+            st = os.stat(self.snap_path)
+            self._last_snapshot_ts = st.st_mtime
+        except OSError:
+            pass
+
+    # -- framing -----------------------------------------------------------
+
+    @staticmethod
+    def _frame(rec: Dict[str, Any]) -> bytes:
+        payload = json.dumps(rec, separators=(",", ":"),
+                             sort_keys=True).encode()
+        return b"%08x %s\n" % (zlib.crc32(payload), payload)
+
+    @staticmethod
+    def _parse_lines(data: bytes, tail_tolerant: bool
+                     ) -> List[Dict[str, Any]]:
+        """Decode CRC-framed lines.  ``tail_tolerant`` drops a torn or
+        CRC-bad FINAL line (the kill -9 artifact); damage anywhere else
+        is corruption."""
+        out: List[Dict[str, Any]] = []
+        lines = data.split(b"\n")
+        trailing_complete = data.endswith(b"\n")
+        if trailing_complete:
+            lines = lines[:-1]
+        for i, line in enumerate(lines):
+            last = i == len(lines) - 1
+            try:
+                crc_hex, payload = line.split(b" ", 1)
+                if int(crc_hex, 16) != zlib.crc32(payload):
+                    raise ValueError("crc mismatch")
+                rec = json.loads(payload)
+                if not isinstance(rec, dict):
+                    raise ValueError("record is not a map")
+            except (ValueError, json.JSONDecodeError) as e:
+                if tail_tolerant and last:
+                    log.warn("journal: dropping torn final record (%s)",
+                             e)
+                    return out
+                raise JournalCorrupt(
+                    f"bad journal record at line {i + 1}: {e}") from e
+            out.append(rec)
+        return out
+
+    # -- write path --------------------------------------------------------
+
+    def append(self, rec: Dict[str, Any]) -> None:
+        frame = self._frame(rec)
+        with self.mu:
+            self._fh.write(frame)
+            # flush() reaches the OS page cache: enough to survive the
+            # broker's own death (SIGKILL, os._exit).  fsync covers
+            # machine death, at a per-record syscall cost.
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+            self._records_since += 1
+            self._appended_total += 1
+
+    def snapshot_due(self) -> bool:
+        with self.mu:
+            return self._records_since >= self.snapshot_every
+
+    def put_blob(self, data: bytes, sha: Optional[str] = None) -> str:
+        """Store ``data`` content-addressed; returns its sha256 hex.
+        Idempotent — an existing blob is never rewritten."""
+        if sha is None:
+            sha = hashlib.sha256(data).hexdigest()
+        path = os.path.join(self.dir, BLOBS_DIR, sha)
+        if not os.path.exists(path):
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                if self.fsync:
+                    os.fsync(f.fileno())
+            os.replace(tmp, path)
+        return sha
+
+    def get_blob(self, sha: str) -> Optional[bytes]:
+        if not sha or "/" in sha:
+            return None
+        try:
+            with open(os.path.join(self.dir, BLOBS_DIR, sha), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    # -- compaction --------------------------------------------------------
+
+    def write_snapshot(self, build_fn: Callable[[], Dict[str, Any]]
+                       ) -> None:
+        """Rotate the log, build the snapshot via ``build_fn`` (appends
+        during the build go to the fresh log and replay idempotently),
+        then commit tmp+fsync+rename and drop the rotated segment."""
+        old = self.log_path + ".old"
+        with self.mu:
+            self._fh.close()
+            # A leftover .old from a crashed compaction still holds
+            # unsnapshotted records — fold it in, never overwrite it.
+            if os.path.exists(old):
+                with open(old, "ab") as dst, \
+                        open(self.log_path, "rb") as src:
+                    dst.write(src.read())
+                os.unlink(self.log_path)
+            else:
+                os.replace(self.log_path, old)
+            self._fh = open(self.log_path, "ab")
+            self._records_since = 0
+        snap = build_fn()
+        data = json.dumps(snap, separators=(",", ":"),
+                          sort_keys=True).encode()
+        with self.mu:
+            tmp = self.snap_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.snap_path)
+            try:
+                dirfd = os.open(self.dir, os.O_RDONLY)
+                try:
+                    os.fsync(dirfd)
+                finally:
+                    os.close(dirfd)
+            except OSError:
+                pass
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+            self._last_snapshot_ts = time.time()
+        self._gc_blobs(snap)
+
+    def _gc_blobs(self, snap: Dict[str, Any]) -> None:
+        referenced = set()
+        for t in snap.get("tenants", {}).values():
+            for am in t.get("arrays", {}).values():
+                referenced.add(am.get("sha"))
+            referenced.update(t.get("exes", {}).values())
+        bdir = os.path.join(self.dir, BLOBS_DIR)
+        cutoff = time.time() - BLOB_GC_MIN_AGE_S
+        try:
+            names = os.listdir(bdir)
+        except OSError:
+            return
+        for name in names:
+            if name in referenced:
+                continue
+            path = os.path.join(bdir, name)
+            try:
+                if os.stat(path).st_mtime < cutoff:
+                    os.unlink(path)
+            except OSError:
+                pass
+
+    # -- read path ---------------------------------------------------------
+
+    def load_state(self) -> Optional[Dict[str, Any]]:
+        """Snapshot + replay -> the recovered state dict, or None when
+        the journal is empty (first boot).  Raises JournalCorrupt on any
+        non-tail damage."""
+        snap: Optional[Dict[str, Any]] = None
+        if os.path.exists(self.snap_path):
+            try:
+                with open(self.snap_path, "rb") as f:
+                    snap = json.loads(f.read())
+                if not isinstance(snap, dict):
+                    raise ValueError("snapshot is not a map")
+            except (ValueError, json.JSONDecodeError, OSError) as e:
+                raise JournalCorrupt(f"unreadable snapshot: {e}") from e
+        state: Dict[str, Any] = snap if snap is not None else {}
+        state.setdefault("tenants", {})
+        state.setdefault("chips", {})
+        segments: List[Tuple[str, bool]] = []
+        old = self.log_path + ".old"
+        if os.path.exists(old):
+            # Crash mid-compaction: the rotated segment replays first,
+            # and only the NEWEST segment may have a torn tail.
+            segments.append((old, False))
+        segments.append((self.log_path, True))
+        # With a rotated segment present, a torn tail in it would mean
+        # the crash happened during its own appends — impossible, the
+        # rotation only happens after those lines were flushed; still,
+        # tolerate a torn tail ONLY on the last segment read.
+        any_records = snap is not None
+        for path, _ in segments:
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            if not data:
+                continue
+            recs = self._parse_lines(data,
+                                     tail_tolerant=(path == segments[-1][0]))
+            any_records = any_records or bool(recs)
+            for rec in recs:
+                _apply_record(state, rec)
+        return state if any_records else None
+
+    def quarantine(self) -> None:
+        """Move the corrupt journal aside (``<name>.corrupt.<ts>``) so
+        the fresh epoch starts from an empty, trustworthy directory."""
+        ts = int(time.time())
+        with self.mu:
+            self._fh.close()
+            for name in (LOG_NAME, LOG_NAME + ".old", SNAP_NAME):
+                path = os.path.join(self.dir, name)
+                if os.path.exists(path):
+                    try:
+                        os.replace(path, f"{path}.corrupt.{ts}")
+                    except OSError as e:
+                        log.warn("journal: cannot quarantine %s: %s",
+                                 name, e)
+            self._fh = open(self.log_path, "ab")
+            self._records_since = 0
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self.mu:
+            size = 0
+            for name in (LOG_NAME, LOG_NAME + ".old", SNAP_NAME):
+                try:
+                    size += os.stat(os.path.join(self.dir, name)).st_size
+                except OSError:
+                    pass
+            age = (time.time() - self._last_snapshot_ts
+                   if self._last_snapshot_ts else -1.0)
+            return {
+                "dir": self.dir,
+                "size_bytes": size,
+                "records_since_snapshot": self._records_since,
+                "records_appended": self._appended_total,
+                "last_snapshot_age_s": round(age, 1),
+                "fsync": self.fsync,
+            }
+
+    def close(self) -> None:
+        with self.mu:
+            try:
+                self._fh.close()
+            except OSError:
+                pass

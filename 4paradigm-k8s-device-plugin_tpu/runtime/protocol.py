@@ -33,10 +33,17 @@ CHUNK_BYTES = int(os.environ.get("VTPU_PUT_CHUNK_BYTES", str(256 << 20)))
 # broker serves EVERY chip, each with its own scheduler + accounting
 # region); hbm_limit (bytes) / core_limit (pct): this tenant's own
 # Allocate-time grant, seeded into its slot (first HELLO wins; absent ->
-# broker spawn defaults).
+# broker spawn defaults); pid/pidns (client pid + pid-namespace inode:
+# journal recovery re-validates recovered tenants against them);
+# resume_epoch (a reconnecting client's previous broker epoch: when the
+# new broker recovered this tenant from its journal, the reply carries
+# resumed=true and the tenant's quotas/ledger/EMAs/arrays are intact —
+# docs/BROKER_RECOVERY.md).
 HELLO = "hello"          # {tenant, priority, device?, hbm_limit?,
-                         #  core_limit?, oversubscribe?}
-                         # -> {ok, tenant_index, chip}
+                         #  core_limit?, oversubscribe?, pid?, pidns?,
+                         #  resume_epoch?}
+                         # -> {ok, tenant_index, chip, epoch, created,
+                         #     resumed}
 # Large tensors (> CHUNK_BYTES) do not fit one frame (MAX_FRAME):
 # the client streams PUT_PART frames {id, data} (each acked {ok}) and
 # finishes with PUT {id, shape, dtype, staged: true}; the server joins
@@ -59,7 +66,12 @@ COMPILE = "compile"      # {id, exported} -> {ok}
 # because a tenant queue dispatches FIFO).
 EXECUTE = "execute"      # {exe, args: [ids], outs: [ids], repeats?,
                          #  carry?, free?}
-STATS = "stats"          # {} -> {ok, tenants: {...}}
+# STATS is the one BIND-FREE verb: it may be sent before (or without)
+# HELLO — no tenant slot is claimed and no chip is lazily bound, so a
+# read-only probe (vtpu-smi) can never wedge a chip claim (ADVICE r5
+# #2).  On a bound connection it additionally quiesces the tenant's
+# dispatched work so counters are fresh.
+STATS = "stats"          # {} -> {ok, tenants: {...}, journal: {...}}
 
 # Admin verbs — served ONLY on the host-side admin socket
 # (<socket>.admin, never mounted into tenant containers: the tenant
@@ -72,6 +84,14 @@ STATS = "stats"          # {} -> {ok, tenants: {...}}
 SUSPEND = "suspend"      # {tenant} -> {ok}
 RESUME = "resume"        # {tenant} -> {ok}
 SHUTDOWN = "shutdown"    # {} -> {ok}  then the broker exits gracefully
+# DRAIN prepares a zero-downtime broker handover: new HELLOs are
+# refused with code DRAINING (clients retry against the successor),
+# dispatched work quiesces (bounded by timeout), and a final journal
+# snapshot is committed.  HANDOVER = DRAIN + graceful exit; the
+# supervisor's respawned broker recovers the snapshot and reconnecting
+# clients resume with state intact (docs/BROKER_RECOVERY.md).
+DRAIN = "drain"          # {timeout?} -> {ok, tenants, snapshotted}
+HANDOVER = "handover"    # {timeout?} -> {ok, tenants, snapshotted}
 
 
 class ProtocolError(RuntimeError):

@@ -49,6 +49,13 @@ Per-tenant HBM quotas and device-time budgets use the SAME native shared
 region as the interposer path (tenant index = region device index), so
 `vtpu-smi` shows both paths identically and kill-cleanup (sweep) applies.
 
+Durability (docs/BROKER_RECOVERY.md): with VTPU_JOURNAL_DIR set, every
+state-changing event write-ahead-journals (runtime/journal.py) and a
+crashed/upgraded broker's successor replays it — reconnecting tenants
+resume (HELLO resume_epoch) with quotas, HBM ledgers, arrays, programs
+and learned cost EMAs intact instead of the typed epoch-crash reset.
+The admin DRAIN/HANDOVER verbs turn that into zero-downtime upgrades.
+
 Priorities: tenants created with priority 0 borrow from the bucket
 instead of waiting (reference CUDA_TASK_PRIORITY semantics).
 
@@ -73,6 +80,7 @@ from ..utils.dtypes import np_dtype as _np_dtype
 from ..utils import envspec
 from ..utils import logging as log
 from . import protocol as P
+from .journal import Journal, JournalCorrupt
 
 MAX_TENANTS = 16
 # Dispatched-but-not-yet-metered items per tenant: bounds the device
@@ -98,6 +106,45 @@ MAX_PENDING_REPLIES = 128
 # collapsed throughput 13x (deep-queue pathologies), while a ~4s bound
 # keeps the device saturated (it only needs a few programs of runway).
 MAX_QUEUED_US = int(os.environ.get("VTPU_MAX_QUEUE_US", "4000000"))
+
+
+def sparse_batch_learn_scale(batch_est_us: float, disp_us: float,
+                             n_items: int) -> Optional[float]:
+    """ADVICE r5 #1: a SPARSE multi-item batch normally bills estimates
+    and learns nothing (no item has an uncontaminated measurement).
+    But when the tail's dispatch-to-ready window exceeds even the WHOLE
+    batch's estimate by 3x, the burst provably cost far more device
+    time than estimated — a burst-pipelining tenant would otherwise
+    keep its EMA pinned at the seed forever (sustained under-
+    enforcement).  Returns the estimate->sample scale factor to feed
+    each item its proportional share of the window as a learn-up
+    sample, or None when the estimates are plausible.  The per-sample
+    EMA growth clamp (4x/observation) bounds the damage of any single
+    anomalous window."""
+    if n_items <= 1 or batch_est_us <= 0.0 \
+            or disp_us <= 3.0 * batch_est_us:
+        return None
+    return disp_us / batch_est_us
+
+
+def _pid_alive(pid: int) -> bool:
+    """Provable-death check for journal recovery: only ESRCH counts as
+    dead (EPERM or any doubt keeps the slot — the native region's
+    'never reclaim live state on doubt' rule)."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+    return True
+
+
+def _my_pidns() -> int:
+    try:
+        return os.stat("/proc/self/ns/pid").st_ino
+    except OSError:
+        return 0
 
 
 class Tenant:
@@ -166,6 +213,21 @@ class Tenant:
         # synchronous request, then cleared — the async-error contract
         # every async dispatch runtime has.
         self.async_error: Optional[BaseException] = None
+        # -- crash-safe journal state (runtime/journal.py) --
+        # aid -> {sha, shape, dtype, nbytes, charges, spilled}: the
+        # journaled PUT arrays (restorable after a broker crash —
+        # execute outputs are deliberately NOT here, their device data
+        # dies with the broker).  eid -> blob sha for executables.
+        self.blob_meta: Dict[str, dict] = {}
+        self.exe_shas: Dict[str, str] = {}
+        # Grant echo for the journal's bind record (per-chip HBM caps,
+        # core pct) + the owning client's identity for recovery-time
+        # liveness re-validation.
+        self.grant: Optional[dict] = None
+        self.client_pid: Optional[int] = None
+        self.client_pidns: Optional[int] = None
+        # True between journal recovery and the owner's resume HELLO.
+        self.recovered = False
 
     # -- chip-set accounting ------------------------------------------------
 
@@ -257,10 +319,10 @@ class Program:
     set (``variants``)."""
 
     __slots__ = ("fn", "avals", "n_outs", "warmed", "nr_devices",
-                 "exported", "variants", "in_shardings")
+                 "exported", "variants", "in_shardings", "sha")
 
     def __init__(self, fn, avals, n_outs, nr_devices=1, exported=None,
-                 in_shardings=None):
+                 in_shardings=None, sha=None):
         self.fn = fn
         self.avals = avals
         self.n_outs = n_outs
@@ -276,6 +338,8 @@ class Program:
         # lives on the Program so blob-cache eviction or id() reuse can
         # never misclassify a fresh program as warmed.
         self.warmed = set()
+        # sha256 of the serialized export blob (journal blob store key).
+        self.sha = sha
 
 
 class WorkItem:
@@ -331,6 +395,7 @@ class DeviceScheduler:
         self._rr_pos = 0
         self._completion_q: "queue.Queue" = queue.Queue()
         self._pool_us = 0.0  # unbilled device time (metering loop only)
+        self._prev_obs = 0.0  # last readiness observation (metering)
         # Estimated device time of dispatched-but-unretired items (the
         # chip's queue depth in time units); guarded by self.mu.
         self.queued_est_us = 0.0
@@ -366,6 +431,21 @@ class DeviceScheduler:
                 if time.monotonic() >= deadline:
                     break
                 self.mu.wait(timeout=0.1)
+
+    def quiesce_all(self, timeout: float = 30.0) -> bool:
+        """Drain-for-handover: wait until every tenant's queued AND
+        dispatched work has retired (bounded — suspended tenants'
+        queues never drain; the handover snapshot simply records them
+        as-is).  Returns True when fully idle."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self.mu:
+            while any(self.inflight.values()) \
+                    or any(len(q) for n, q in self.queues.items()
+                           if n not in self.state.suspended):
+                if time.monotonic() >= deadline:
+                    return False
+                self.mu.wait(timeout=0.1)
+        return True
 
     def forget_tenant(self, name: str) -> None:
         with self.mu:
@@ -614,8 +694,6 @@ class DeviceScheduler:
         the failure mode that over-throttled co-tenants when wall-clock
         windows were attributed directly (35%+ aggregate loss measured on
         the tunnel transport)."""
-        jax = self.state.jax
-        prev_obs = 0.0
         while not self._stop:
             try:
                 first = self._completion_q.get(timeout=0.5)
@@ -656,132 +734,163 @@ class DeviceScheduler:
                     break
                 batch.append(nxt)
                 batch_est += nxt[0].est_us
-            exc = None
-            try:
-                jax.block_until_ready(batch[-1][2])
-            except Exception as e:  # noqa: BLE001 - poisoned chain
-                exc = e
-            if exc is not None:
-                # Rare failure path: re-observe every batch member
-                # individually (per-item RTTs are fine here) so the
-                # poison lands ONLY on the tenants whose chains
-                # actually failed.  When the tail item succeeds, a
-                # mid-batch member's device-side failure is not seen
-                # here at all — it surfaces through the dependency
-                # chain (the tenant's next execute carries it, or GET
-                # of the output raises): the async-error contract.
-                for it_f, _, outs_f in batch:
-                    try:
-                        jax.block_until_ready(outs_f)
-                    except Exception as e_f:  # noqa: BLE001
-                        it_f.tenant.async_error = e_f
-            t_obs = time.monotonic()
-            lat_s = self.chip.calibrate_latency_us() / 1e6
-            obs_us = max(t_obs - prev_obs, 0.0) * 1e6
-            # Continuity is judged against the batch HEAD's dispatch:
-            # head_t0 + L <= prev_obs means the head was already queued
-            # when the previous observation fired, so the queue never
-            # drained and the whole obs window is device time.  Judging
-            # against the tail (dispatched mid-window under pipelining)
-            # would misclassify loaded multi-item batches as sparse,
-            # discarding measured device time — the quota-evasion hole
-            # the pool exists to close.  disp_us (the TAIL's own
-            # dispatch-to-ready) is kept separately for sparse billing.
-            cont_us = max(t_obs - batch[0][1] - lat_s, 0.0) * 1e6
-            disp_us = max(t_obs - batch[-1][1] - lat_s, 0.0) * 1e6
-            prev_obs = t_obs
-            continuous = obs_us <= cont_us
-            if continuous:
-                # CONTINUOUS LOAD: the ready-to-ready gap is exact
-                # device time for the whole batch (constant observation
-                # latency cancels).  The window feeds a pool and every
-                # item bills from it, capped per item at 4x its
-                # estimate; what ENTERS is capped by what the window
-                # could plausibly contain so an anomalous window cannot
-                # surcharge the next dozen items.
-                self._pool_us = min(self._pool_us
-                                    + min(obs_us, batch_est * 4.0),
-                                    2_000_000.0)
-            else:
-                # SPARSE (queue restarted): any pooled window credit is
-                # stale — the device provably idled — and must not be
-                # billed to a later item.  Dispatch-to-ready is the
-                # only measurement and overshoots by an uncalibratable
-                # 60-120ms on relayed transports; billing it raw makes
-                # estimates creep up and dispatch sparser — a feedback
-                # loop that halved long-run throughput (measured).
-                self._pool_us = 0.0
-            for item, t0, outs in batch:
-                t = item.tenant
-                prev_ema = t.cost_ema.get(item.key, 5000.0)
-                per_step = None  # EMA sample (None = don't learn)
-                if item.first_run:
-                    # Warmup: the window is program-load/compile noise.
-                    busy_us = item.est_us
-                elif continuous:
-                    cap_us = max(item.est_us * 4.0,
-                                 float(self.state.min_exec_cost_us)
-                                 * item.steps)
-                    busy_us = min(self._pool_us, cap_us)
-                    self._pool_us -= busy_us
-                    per_step = busy_us / item.steps
-                elif len(batch) == 1:
-                    # SPARSE singleton: disp_us is this item's own
-                    # dispatch-to-ready — the one calibrated sparse
-                    # measurement (overshoot ~60-120ms, 3x learn-up
-                    # evidence threshold sized for it).
-                    busy_us = min(disp_us,
-                                  max(item.est_us,
-                                      float(self.state.min_exec_cost_us)
-                                      * item.steps))
-                    if disp_us > 3.0 * item.est_us:
-                        per_step = disp_us / item.steps
-                    else:
-                        per_step = min(disp_us / item.steps, prev_ema)
-                else:
-                    # SPARSE multi-item batch: even the tail's disp_us
-                    # embeds its co-batched predecessors' device time
-                    # (they were submitted ahead of it), so no item has
-                    # an uncontaminated measurement — attributing the
-                    # window per item would bill (and teach, via the
-                    # >3x learn-up) every small item the whole batch's
-                    # window, ratcheting EMAs burst over burst.  Bill
-                    # the estimate, learn nothing; continuous load does
-                    # the learning.
-                    busy_us = max(item.est_us,
+            self._meter_batch(batch)
+
+    def _meter_batch(self, batch) -> None:
+        """Observe, classify and retire one drained batch of (item, t0,
+        outs) tuples — the body of the metering loop, factored out so
+        the classification/learn-up arithmetic is drivable in tests
+        with fabricated dispatch times."""
+        jax = self.state.jax
+        batch_est = sum(it.est_us for it, _, _ in batch)
+        exc = None
+        try:
+            jax.block_until_ready(batch[-1][2])
+        except Exception as e:  # noqa: BLE001 - poisoned chain
+            exc = e
+        if exc is not None:
+            # Rare failure path: re-observe every batch member
+            # individually (per-item RTTs are fine here) so the
+            # poison lands ONLY on the tenants whose chains
+            # actually failed.  When the tail item succeeds, a
+            # mid-batch member's device-side failure is not seen
+            # here at all — it surfaces through the dependency
+            # chain (the tenant's next execute carries it, or GET
+            # of the output raises): the async-error contract.
+            for it_f, _, outs_f in batch:
+                try:
+                    jax.block_until_ready(outs_f)
+                except Exception as e_f:  # noqa: BLE001
+                    it_f.tenant.async_error = e_f
+        t_obs = time.monotonic()
+        lat_s = self.chip.calibrate_latency_us() / 1e6
+        obs_us = max(t_obs - self._prev_obs, 0.0) * 1e6
+        # Continuity is judged against the batch HEAD's dispatch:
+        # head_t0 + L <= _prev_obs means the head was already queued
+        # when the previous observation fired, so the queue never
+        # drained and the whole obs window is device time.  Judging
+        # against the tail (dispatched mid-window under pipelining)
+        # would misclassify loaded multi-item batches as sparse,
+        # discarding measured device time — the quota-evasion hole
+        # the pool exists to close.  disp_us (the TAIL's own
+        # dispatch-to-ready) is kept separately for sparse billing.
+        cont_us = max(t_obs - batch[0][1] - lat_s, 0.0) * 1e6
+        disp_us = max(t_obs - batch[-1][1] - lat_s, 0.0) * 1e6
+        self._prev_obs = t_obs
+        continuous = obs_us <= cont_us
+        if continuous:
+            # CONTINUOUS LOAD: the ready-to-ready gap is exact
+            # device time for the whole batch (constant observation
+            # latency cancels).  The window feeds a pool and every
+            # item bills from it, capped per item at 4x its
+            # estimate; what ENTERS is capped by what the window
+            # could plausibly contain so an anomalous window cannot
+            # surcharge the next dozen items.
+            self._pool_us = min(self._pool_us
+                                + min(obs_us, batch_est * 4.0),
+                                2_000_000.0)
+        else:
+            # SPARSE (queue restarted): any pooled window credit is
+            # stale — the device provably idled — and must not be
+            # billed to a later item.  Dispatch-to-ready is the
+            # only measurement and overshoots by an uncalibratable
+            # 60-120ms on relayed transports; billing it raw makes
+            # estimates creep up and dispatch sparser — a feedback
+            # loop that halved long-run throughput (measured).
+            self._pool_us = 0.0
+        # Sparse multi-item learn-up (ADVICE r5 #1): when the tail
+        # window dwarfs the whole batch's estimate, estimates are
+        # provably broken — feed each item its proportional share as a
+        # capped EMA sample (billing still uses the safe estimate).
+        learn_scale = None
+        if not continuous:
+            learn_scale = sparse_batch_learn_scale(batch_est, disp_us,
+                                                   len(batch))
+        for item, t0, outs in batch:
+            t = item.tenant
+            prev_ema = t.cost_ema.get(item.key, 5000.0)
+            per_step = None  # EMA sample (None = don't learn)
+            if item.first_run:
+                # Warmup: the window is program-load/compile noise.
+                busy_us = item.est_us
+            elif continuous:
+                cap_us = max(item.est_us * 4.0,
+                             float(self.state.min_exec_cost_us)
+                             * item.steps)
+                busy_us = min(self._pool_us, cap_us)
+                self._pool_us -= busy_us
+                per_step = busy_us / item.steps
+            elif len(batch) == 1:
+                # SPARSE singleton: disp_us is this item's own
+                # dispatch-to-ready — the one calibrated sparse
+                # measurement (overshoot ~60-120ms, 3x learn-up
+                # evidence threshold sized for it).
+                busy_us = min(disp_us,
+                              max(item.est_us,
                                   float(self.state.min_exec_cost_us)
-                                  * item.steps)
-                t.busy_add_all(int(busy_us))
-                charged = max(busy_us,
+                                  * item.steps))
+                if disp_us > 3.0 * item.est_us:
+                    per_step = disp_us / item.steps
+                else:
+                    per_step = min(disp_us / item.steps, prev_ema)
+            else:
+                # SPARSE multi-item batch: even the tail's disp_us
+                # embeds its co-batched predecessors' device time
+                # (they were submitted ahead of it), so no item has
+                # an uncontaminated measurement — attributing the
+                # window per item would bill (and teach, via the
+                # >3x learn-up) every small item the whole batch's
+                # window, ratcheting EMAs burst over burst.  Bill
+                # the estimate; learn only when the window exceeds
+                # even the WHOLE batch estimate 3x (learn_scale):
+                # each item then samples its proportional share, so
+                # a burst-pipelining tenant's EMA cannot stay pinned
+                # at the seed (ADVICE r5 #1) while the growth clamp
+                # below bounds any one anomalous window.
+                busy_us = max(item.est_us,
                               float(self.state.min_exec_cost_us)
                               * item.steps)
-                if item.metered:
-                    # Correction capped at 4x the estimate: an
-                    # anomalous measurement (first-run XLA compile,
-                    # stray host stall) must not wedge the bucket for
-                    # ages.  The EMA (growth-clamped below) catches
-                    # real cost within a few items, so sustained
-                    # under-charging is impossible.
-                    t.rate_adjust_all(
-                        int(min(charged, item.est_us * 4.0)
-                            - item.est_us))
-                if per_step is not None:
-                    # Growth-clamped EMA — INCLUDING the first learned
-                    # sample: seeding raw would let one outlier
-                    # (compile, transport stall) throttle the tenant
-                    # for ~15 executes.  From the 5ms default the clamp
-                    # still converges on any real cost exponentially
-                    # (x4 per observation).
-                    t.cost_ema[item.key] = (
-                        prev_ema * 0.7
-                        + min(per_step, prev_ema * 4.0) * 0.3)
-                t.executions += item.steps
-                log.debug(
-                    "meter %s: est=%.0fus busy=%.0fus pool=%.0fus "
-                    "batch=%d obs_gap=%.0fus disp_gap=%.0fus",
-                    t.name, item.est_us, busy_us, self._pool_us,
-                    len(batch), obs_us, disp_us)
-                self._retire(item)
+                if learn_scale is not None:
+                    per_step = item.est_us * learn_scale / item.steps
+            t.busy_add_all(int(busy_us))
+            charged = max(busy_us,
+                          float(self.state.min_exec_cost_us)
+                          * item.steps)
+            if item.metered:
+                # Correction capped at 4x the estimate: an
+                # anomalous measurement (first-run XLA compile,
+                # stray host stall) must not wedge the bucket for
+                # ages.  The EMA (growth-clamped below) catches
+                # real cost within a few items, so sustained
+                # under-charging is impossible.
+                t.rate_adjust_all(
+                    int(min(charged, item.est_us * 4.0)
+                        - item.est_us))
+            if per_step is not None:
+                # Growth-clamped EMA — INCLUDING the first learned
+                # sample: seeding raw would let one outlier
+                # (compile, transport stall) throttle the tenant
+                # for ~15 executes.  From the 5ms default the clamp
+                # still converges on any real cost exponentially
+                # (x4 per observation).
+                t.cost_ema[item.key] = (
+                    prev_ema * 0.7
+                    + min(per_step, prev_ema * 4.0) * 0.3)
+            t.executions += item.steps
+            if per_step is not None and self.state.journal is not None:
+                # Learned samples are journaled so a crashed broker's
+                # successor recovers the tenant's cost model within
+                # one sample of pre-crash (docs/BROKER_RECOVERY.md).
+                self.state.journal.append(
+                    {"op": "ema", "name": t.name, "key": item.key,
+                     "ema": t.cost_ema[item.key],
+                     "execs": t.executions})
+            log.debug(
+                "meter %s: est=%.0fus busy=%.0fus pool=%.0fus "
+                "batch=%d obs_gap=%.0fus disp_gap=%.0fus",
+                t.name, item.est_us, busy_us, self._pool_us,
+                len(batch), obs_us, disp_us)
+            self._retire(item)
 
     def stop(self):
         self._stop = True
@@ -844,7 +953,20 @@ class ChipState:
         self.region.register()
         self._latency_us: Optional[float] = None
         self._jax = state.jax
+        # Journal recovery re-adopts the previous broker instance's
+        # calibration (docs/BROKER_RECOVERY.md): a restarted broker must
+        # not spend device round trips re-measuring a constant, and the
+        # calibration execute is itself a chip claim the watchdog
+        # guards.
+        hint = state.chip_latency_hints.get(index)
+        if hint is not None:
+            self._latency_us = float(hint)
+            log.info("chip %d execute-path latency re-adopted from "
+                     "journal: %.0f us", index, self._latency_us)
         self.calibrate_latency_us()  # while the device is idle
+        if state.journal is not None and self._latency_us:
+            state.journal.append({"op": "chip", "index": index,
+                                  "lat_us": self._latency_us})
         self.scheduler = DeviceScheduler(state, self)
 
     def calibrate_latency_us(self) -> float:
@@ -883,9 +1005,58 @@ class RuntimeState:
 
     def __init__(self, region_path: str, hbm_limit: int, core_limit: int,
                  min_exec_cost_us: int = 0,
-                 work_conserving: Optional[bool] = None):
+                 work_conserving: Optional[bool] = None,
+                 journal: Optional[Journal] = None):
         import jax
+        # jax lazy-loads public submodules: without this explicit import
+        # the broker's first `jax.export.deserialize` dies with
+        # AttributeError on jax >= 0.4.30.
+        import jax.export  # noqa: F401
+
         self.jax = jax
+        # -- crash-safe journal (runtime/journal.py) --
+        self.journal = journal
+        self.prev_epoch: Optional[str] = None
+        # name -> (Tenant, reconnect deadline): recovered-but-unclaimed
+        # tenants parked for the resume grace window.
+        self.recovered: Dict[str, Tuple[Tenant, float]] = {}
+        self.resume_grace = float(os.environ.get(
+            "VTPU_RESUME_GRACE_S", "120"))
+        self.recovery = {
+            "recoveries_total": 0,
+            "tenants_recovered": 0,
+            "tenants_readopted": 0,
+            "tenants_dropped_dead": 0,
+            "tenants_dropped_expired": 0,
+            "tenants_dropped_replaced": 0,
+            "arrays_dropped": 0,
+            "corrupt_recoveries": 0,
+        }
+        self.chip_latency_hints: Dict[int, float] = {}
+        self.draining = False
+        self._keeper_stop = threading.Event()
+        self._journal_state = None
+        if journal is not None:
+            try:
+                self._journal_state = journal.load_state()
+            except JournalCorrupt as e:
+                # Fail CLOSED: no guessed quota state.  Fresh epoch;
+                # clients get today's typed VtpuStateLost.
+                log.error("journal corrupt (%s); quarantining and "
+                          "booting a fresh epoch", e)
+                journal.quarantine()
+                self.recovery["corrupt_recoveries"] += 1
+            if self._journal_state is not None:
+                self.prev_epoch = self._journal_state.get("epoch")
+                self.recovery["recoveries_total"] = int(
+                    self._journal_state.get("recoveries_total", 0))
+                for k, v in (self._journal_state.get("chips")
+                             or {}).items():
+                    try:
+                        if v:
+                            self.chip_latency_hints[int(k)] = float(v)
+                    except (TypeError, ValueError):
+                        pass
         if work_conserving is None:
             work_conserving = os.environ.get(
                 "VTPU_WORK_CONSERVING", "1") != "0"
@@ -946,13 +1117,18 @@ class RuntimeState:
         # the buffer lives exactly as long as some tenant holds it.
         self.put_cache: Dict[tuple, Any] = {}
         self.put_cache_mu = threading.Lock()
-        # Opt-out (VTPU_PUT_DEDUP=0): content dedup is a classic
+        # Scope (ADVICE r5 #3): cross-tenant content dedup is a classic
         # memory-dedup DISCLOSURE channel (a cache hit acks measurably
-        # faster, confirming a co-tenant holds those exact bytes).
-        # Fine under the cooperative threat model the node runs by
-        # default; operators isolating mutually-distrusting tenants on
-        # one chip should turn it off (docs/FLAGS.md).
-        self.put_dedup = os.environ.get("VTPU_PUT_DEDUP", "1") != "0"
+        # faster, confirming a co-tenant holds those exact bytes), so
+        # the DEFAULT key is scoped per tenant — a tenant still dedups
+        # its own repeated uploads (every bridged re-PUT of fixed-id
+        # weights), but can no longer probe its neighbours.
+        # VTPU_PUT_DEDUP=node restores node-wide sharing for
+        # cooperative clusters (one transfer per node for shared base
+        # weights); =0 disables dedup entirely (docs/FLAGS.md).
+        dedup_env = os.environ.get("VTPU_PUT_DEDUP", "1").strip().lower()
+        self.put_dedup = dedup_env not in ("0", "off", "")
+        self.put_dedup_node = dedup_env == "node"
         self.mu = threading.Lock()
         self.chips: Dict[int, ChipState] = {}
         # Chip creation is slow (region mmap + latency calibration with
@@ -960,6 +1136,13 @@ class RuntimeState:
         # never stalls HELLO/compile/release of tenants on other chips.
         self.chips_mu = threading.Lock()
         self.chip(0)  # chip 0 eagerly: fail fast if the device is gone
+        if self.journal is not None:
+            self._recover_from_journal()
+            # The epoch record goes out BEFORE the boot snapshot: a
+            # crash mid-compaction must still replay the new epoch, or
+            # resumed clients' lineage would skip a generation.
+            self.journal.append({"op": "epoch", "epoch": self.epoch})
+            self.journal.write_snapshot(self._snapshot_dict)
 
     @staticmethod
     def _chip_leaders(devs):
@@ -1033,6 +1216,249 @@ class RuntimeState:
                 self.chips[index] = c
             return c
 
+    # -- journal recovery / handover (docs/BROKER_RECOVERY.md) -------------
+
+    def _recover_from_journal(self) -> None:
+        """Rebuild tenants from the replayed journal state: re-validate
+        each against its recorded client identity (provably-dead pids
+        are dropped, everything else is kept — never reclaim live state
+        on doubt), re-seed the fresh accounting regions with the
+        journaled grants and HBM ledgers, and park the tenants for the
+        resume grace window.  Array DATA is restored lazily at the
+        owner's resume HELLO (blobs stay on disk until then)."""
+        st = self._journal_state
+        if not st or not st.get("tenants"):
+            return
+        self.recovery["recoveries_total"] += 1
+        my_ns = _my_pidns()
+        now = time.monotonic()
+        for name, rec in st["tenants"].items():
+            pid = rec.get("pid")
+            pidns = rec.get("pidns")
+            # The pid is only judgeable when the client registered from
+            # THIS pid namespace (same-host, non-containerized tenants
+            # and the test harness); a foreign namespace's pid numbers
+            # are meaningless here and the grace reaper covers them —
+            # the same rule the native region's sweep applies.
+            if pid and (not pidns or int(pidns) == my_ns) \
+                    and not _pid_alive(int(pid)):
+                self.recovery["tenants_dropped_dead"] += 1
+                log.info("journal: dropping tenant %r (client pid %s "
+                         "is dead)", name, pid)
+                continue
+            try:
+                devices = [int(d) for d in rec.get("devices") or [0]]
+                slots = [int(s) for s in rec.get("slots") or []]
+                chips = [self.chip(d) for d in devices]
+                if len(slots) != len(chips):
+                    raise ValueError(f"slots {slots} vs chips {devices}")
+                hbm = rec.get("hbm") or []
+                core = rec.get("core")
+                for k, (chip, slot) in enumerate(zip(chips, slots)):
+                    chip.region.reset_slot(slot)
+                    if k < len(hbm) and hbm[k] is not None:
+                        chip.region.set_mem_limit(slot, int(hbm[k]))
+                    else:
+                        chip.region.set_mem_limit(slot, self.default_hbm)
+                    chip.region.set_core_limit(
+                        slot, int(core) if core is not None
+                        else self.default_core)
+                t = Tenant(name, slots[0], int(rec.get("priority", 1)),
+                           bool(rec.get("over", False)),
+                           chips=chips, slots=slots)
+                t.spill_overshoot = rec.get("spill")
+                t.cost_ema = {str(k): float(v)
+                              for k, v in (rec.get("ema") or {}).items()}
+                t.executions = int(rec.get("execs", 0))
+                t.client_pid = int(pid) if pid else None
+                t.client_pidns = int(pidns) if pidns else None
+                t.grant = {"hbm": list(hbm), "core": core}
+                t.exe_shas = {str(k): str(v) for k, v
+                              in (rec.get("exes") or {}).items()}
+                t.recovered = True
+                # Re-apply the HBM ledger NOW (quotas hold from the
+                # first post-restart instant); forced admit — these
+                # bytes were already admitted by the previous instance.
+                for aid, am in (rec.get("arrays") or {}).items():
+                    charges = [(int(p), int(nb))
+                               for p, nb in am.get("charges") or []]
+                    for pos, nb in charges:
+                        chips[pos].region.mem_acquire(slots[pos], nb,
+                                                      True)
+                    t.charges[aid] = charges
+                    t.nbytes[aid] = (0 if am.get("spilled")
+                                     else int(am.get("nbytes", 0)))
+                    t.blob_meta[aid] = dict(am)
+            except Exception as e:  # noqa: BLE001 - skip, don't refuse boot
+                log.warn("journal: cannot recover tenant %r (%s); "
+                         "dropping it", name, e)
+                self.recovery["tenants_dropped_dead"] += 1
+                continue
+            self.recovered[name] = (t, now + self.resume_grace)
+            self.recovery["tenants_recovered"] += 1
+        log.info("journal: recovered %d tenant(s) from epoch %s "
+                 "(%d dropped as dead); resume grace %.0fs",
+                 len(self.recovered), self.prev_epoch,
+                 self.recovery["tenants_dropped_dead"],
+                 self.resume_grace)
+
+    def try_resume(self, name: str, resume_epoch: str
+                   ) -> Optional[Tenant]:
+        """Adopt a journal-recovered tenant for a reconnecting client
+        (HELLO resume_epoch matching the PREVIOUS broker epoch).
+        Restores journaled arrays and executables before returning, so
+        the client's next request sees intact state."""
+        if self.journal is None or resume_epoch is None:
+            return None
+        with self.mu:
+            if resume_epoch != self.prev_epoch:
+                return None
+            ent = self.recovered.pop(name, None)
+            if ent is None:
+                return None
+            t = ent[0]
+            t.connections += 1
+            self.tenants[name] = t
+        self._restore_tenant(t)
+        t.recovered = False
+        self.recovery["tenants_readopted"] += 1
+        log.info("journal: tenant %r resumed (%d arrays, %d programs, "
+                 "%d EMA keys)", name, len(t.arrays) + len(t.host_arrays),
+                 len(t.executables), len(t.cost_ema))
+        return t
+
+    def _restore_tenant(self, t: Tenant) -> None:
+        import numpy as np
+        jax = self.jax
+        for aid, am in list(t.blob_meta.items()):
+            blob = self.journal.get_blob(am.get("sha", ""))
+            expect = int(am.get("nbytes", 0))
+            if blob is None or (expect and len(blob) != expect):
+                # Unrestorable array (blob GC'd or truncated): release
+                # its ledger so books match reality.
+                with t.mu:
+                    charges = t.charges.pop(aid, [])
+                    t.nbytes.pop(aid, None)
+                    t.blob_meta.pop(aid, None)
+                for pos, nb in charges:
+                    t.chips[pos].region.mem_release(t.slots[pos], nb)
+                self.recovery["arrays_dropped"] += 1
+                continue
+            arr = np.frombuffer(blob, dtype=_np_dtype(am["dtype"])
+                                ).reshape(am["shape"])
+            if am.get("spilled"):
+                with t.mu:
+                    t.host_arrays[aid] = np.array(arr)
+                    t.host_bytes += int(arr.nbytes)
+            else:
+                dev = jax.device_put(arr, t.chip.device)
+                with t.mu:
+                    t.arrays[aid] = dev
+        for eid, sha in list(t.exe_shas.items()):
+            blob = self.journal.get_blob(sha)
+            if blob is None:
+                continue  # client re-registers on its next epoch check
+            try:
+                prog = self.cached_blob(bytes(blob))
+                if prog.nr_devices > 1:
+                    prog = self.tenant_program(t, prog)
+                t.executables[eid] = prog
+            except Exception as e:  # noqa: BLE001 - best effort
+                log.warn("journal: cannot restore program %s of %r: %s",
+                         eid, t.name, e)
+
+    def _release_recovered(self, t: Tenant, counter: str) -> None:
+        """Drop a parked recovered tenant: release its re-applied
+        ledger and journal the close (slots recycle)."""
+        for aid, charges in list(t.charges.items()):
+            for pos, nb in charges:
+                t.chips[pos].region.mem_release(t.slots[pos], nb)
+        t.charges.clear()
+        t.blob_meta.clear()
+        self.recovery[counter] += 1
+        if self.journal is not None:
+            self.journal.append({"op": "close", "name": t.name})
+
+    def journal_tick(self) -> None:
+        """Periodic journal upkeep (keeper thread): expire parked
+        recovered tenants past the grace window and compact the log
+        when due."""
+        now = time.monotonic()
+        expired = []
+        with self.mu:
+            for name, (t, deadline) in list(self.recovered.items()):
+                if now >= deadline:
+                    del self.recovered[name]
+                    expired.append(t)
+        for t in expired:
+            log.info("journal: recovered tenant %r never reconnected "
+                     "within %.0fs; dropping", t.name, self.resume_grace)
+            self._release_recovered(t, "tenants_dropped_expired")
+        if self.journal is not None and self.journal.snapshot_due():
+            self.journal.write_snapshot(self._snapshot_dict)
+
+    def _snapshot_dict(self) -> dict:
+        with self.mu:
+            items = list(self.tenants.items()) \
+                + [(n, e[0]) for n, e in self.recovered.items()]
+        tenants = {}
+        for name, t in items:
+            with t.mu:
+                arrays = {aid: dict(am)
+                          for aid, am in t.blob_meta.items()}
+            grant = t.grant or {}
+            tenants[name] = {
+                "devices": [c.index for c in t.chips],
+                "slots": list(t.slots),
+                "priority": t.priority,
+                "over": t.oversubscribe,
+                "hbm": grant.get("hbm"),
+                "core": grant.get("core"),
+                "spill": t.spill_overshoot,
+                "pid": t.client_pid,
+                "pidns": t.client_pidns,
+                "arrays": arrays,
+                "exes": dict(t.exe_shas),
+                "ema": {k: float(v) for k, v in t.cost_ema.items()},
+                "execs": t.executions,
+            }
+        with self.chips_mu:
+            chips = {str(i): c._latency_us  # noqa: SLF001 - own class
+                     for i, c in self.chips.items() if c._latency_us}
+        return {"version": 1, "epoch": self.epoch,
+                "recoveries_total": self.recovery["recoveries_total"],
+                "tenants": tenants, "chips": chips}
+
+    def journal_stats(self) -> dict:
+        out: Dict[str, Any] = {
+            "enabled": self.journal is not None,
+            "draining": self.draining,
+            "epoch": self.epoch,
+        }
+        out.update(self.recovery)
+        with self.mu:
+            out["tenants_awaiting_resume"] = len(self.recovered)
+        if self.journal is not None:
+            out.update(self.journal.stats())
+        return out
+
+    def drain(self, timeout: float = 30.0) -> int:
+        """Prepare a zero-downtime handover: refuse new HELLOs
+        (DRAINING — clients retry against the successor), quiesce
+        dispatched work, commit a final snapshot.  Returns the number
+        of tenants the snapshot carries."""
+        self.draining = True
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self.chips_mu:
+            chips = list(self.chips.values())
+        for chip in chips:
+            chip.scheduler.quiesce_all(
+                max(deadline - time.monotonic(), 0.0))
+        if self.journal is not None:
+            self.journal.write_snapshot(self._snapshot_dict)
+        with self.mu:
+            return len(self.tenants) + len(self.recovered)
+
     def tenant(self, name: str, priority: int,
                oversubscribe: bool = False, device: int = 0,
                devices: Optional[List[int]] = None,
@@ -1054,12 +1480,25 @@ class RuntimeState:
         chips = [self.chip(d) for d in dev_list]
         created = False
         with self.mu:
+            # A plain (non-resume) HELLO under a journal-recovered name
+            # supersedes the parked state: the client explicitly started
+            # fresh — release the old ledger before the slot search so
+            # a recycled slot starts with clean books.
+            ent = self.recovered.pop(name, None)
+            if ent is not None:
+                self._release_recovered(ent[0],
+                                        "tenants_dropped_replaced")
             t = self.tenants.get(name)
             if t is None:
                 created = True
                 slots = []
+                parked = [e[0] for e in self.recovered.values()]
                 for chip in chips:
-                    used = {x.slots[k] for x in self.tenants.values()
+                    # Parked recovered tenants hold their journaled
+                    # slots (with live ledger charges) until resume or
+                    # grace expiry — they must not be re-issued.
+                    used = {x.slots[k]
+                            for x in list(self.tenants.values()) + parked
                             for k, c in enumerate(x.chips) if c is chip}
                     used.update(s for c, s in zip(chips[:len(slots)],
                                                   slots) if c is chip)
@@ -1121,6 +1560,8 @@ class RuntimeState:
             # reusing the name must not start silently frozen (the only
             # clue would be the admin-side STATS list).
             self.suspended.discard(t.name)
+            if self.journal is not None:
+                self.journal.append({"op": "close", "name": t.name})
             return True
 
     def cached_blob(self, blob: bytes) -> "Program":
@@ -1155,7 +1596,7 @@ class RuntimeState:
                 log.warn("eager compile failed (%s); deferring to dispatch",
                          e)
         prog = Program(fn, avals, len(exported.out_avals), nr_devices=nr,
-                       exported=exported if nr > 1 else None)
+                       exported=exported if nr > 1 else None, sha=h)
         with self.mu:
             self.blob_cache[h] = prog
             self.blob_cache.move_to_end(h)
@@ -1198,6 +1639,14 @@ class RuntimeState:
                 arr = _np.array(devices).reshape(
                     *[am.shape[n] for n in am.axis_names])
                 mesh = self.jax.sharding.Mesh(arr, am.axis_names)
+        except AttributeError:
+            # jax 0.4.x exports keep only the HLO shardings, and
+            # in_shardings_jax maps those onto ANY mesh of the right
+            # SIZE (device-order semantics) — a flat mesh over the
+            # granted chips reconstructs the placement exactly.
+            import numpy as _np
+            mesh = self.jax.sharding.Mesh(_np.array(devices),
+                                          ("_vtpu_flat",))
         except Exception as e:  # noqa: BLE001 - fall through
             log.warn("mesh reconstruction failed (%s); using device order",
                      e)
@@ -1212,7 +1661,8 @@ class RuntimeState:
         except Exception as e:  # noqa: BLE001 - dispatch will retry
             log.warn("multi-chip eager compile failed (%s); deferring", e)
         variant = Program(fn, prog.avals, prog.n_outs,
-                          nr_devices=prog.nr_devices, in_shardings=ish)
+                          nr_devices=prog.nr_devices, in_shardings=ish,
+                          sha=prog.sha)
         prog.variants[chips_key] = variant
         return variant
 
@@ -1338,33 +1788,69 @@ class TenantSession(socketserver.BaseRequestHandler):
                             f"connection already bound to tenant "
                             f"{tenant.name!r}; open a new connection")
                         continue
+                    if self.state.draining:
+                        # Handover in progress: the successor broker
+                        # owns new bindings.  Typed refusal — clients
+                        # treat it as retryable and land on the
+                        # successor's socket.
+                        self._send_err(
+                            "DRAINING",
+                            "broker is draining for handover; retry")
+                        continue
                     hbm = msg.get("hbm_limit")
                     hbms = msg.get("hbm_limits")
                     core = msg.get("core_limit")
                     devs = msg.get("devices")
                     overshoot = msg.get("spill_overshoot")
-                    tenant, created = self.state.tenant(
-                        str(msg["tenant"]), int(msg.get("priority", 1)),
-                        bool(msg.get("oversubscribe", False)),
-                        device=int(msg.get("device", 0)),
-                        devices=[int(d) for d in devs] if devs else None,
-                        hbm_limit=int(hbm) if hbm is not None else None,
-                        hbm_limits=[int(h) for h in hbms] if hbms
-                        else None,
-                        core_limit=int(core) if core is not None
-                        else None)
+                    created = False
+                    resumed = False
+                    r_epoch = msg.get("resume_epoch")
+                    if r_epoch is not None:
+                        # Reconnect after a broker crash/handover: adopt
+                        # the journal-recovered tenant — quotas, HBM
+                        # ledger, arrays, programs and cost EMAs intact
+                        # (docs/BROKER_RECOVERY.md).
+                        tenant = self.state.try_resume(
+                            str(msg["tenant"]), str(r_epoch))
+                        resumed = tenant is not None
+                    if tenant is None:
+                        tenant, created = self.state.tenant(
+                            str(msg["tenant"]),
+                            int(msg.get("priority", 1)),
+                            bool(msg.get("oversubscribe", False)),
+                            device=int(msg.get("device", 0)),
+                            devices=[int(d) for d in devs] if devs
+                            else None,
+                            hbm_limit=int(hbm) if hbm is not None
+                            else None,
+                            hbm_limits=[int(h) for h in hbms] if hbms
+                            else None,
+                            core_limit=int(core) if core is not None
+                            else None)
                     if overshoot is not None and \
                             tenant.spill_overshoot is None:
                         # First HELLO wins, like the hbm/core grant.
                         tenant.spill_overshoot = max(float(overshoot),
                                                      0.0)
+                    self._journal_bind(tenant, msg)
                     tenant_box[0] = tenant
                     self._send({"ok": True, "tenant_index": tenant.index,
                                 "chip": tenant.chip.index,
                                 "chips": [c.index for c in tenant.chips],
                                 "epoch": self.state.epoch,
-                                "created": created})
+                                "created": created,
+                                "resumed": resumed})
                     continue
+                if kind == P.STATS and tenant is None:
+                    # BIND-FREE probe (ADVICE r5 #2): answers without a
+                    # tenant slot or chip binding, so a read-only CLI
+                    # (vtpu-smi) can never trigger a lazy chip claim —
+                    # the path that wedged claims and os._exit(3)'d the
+                    # broker when the probe HELLO'd chip 0.
+                    self._send({"ok": True, "tenants": self._stats(),
+                                "journal": self.state.journal_stats()})
+                    continue
+
                 if tenant is None:
                     self._send_err("NO_HELLO", "hello required")
                     continue
@@ -1452,6 +1938,7 @@ class TenantSession(socketserver.BaseRequestHandler):
                         # reference's unified-memory spill, reference
                         # README.md:104, done TPU-style: explicit staging).
                         spilled = True
+                    buf_sha = None
                     if spilled:
                         with tenant.mu:
                             tenant.host_arrays[aid] = np.array(arr)
@@ -1463,8 +1950,14 @@ class TenantSession(socketserver.BaseRequestHandler):
                         if self.state.put_dedup and \
                                 nbytes >= RuntimeState.PUT_DEDUP_MIN_BYTES:
                             import hashlib
-                            dedup_key = (tenant.chip.index,
-                                         hashlib.sha256(buf).hexdigest(),
+                            buf_sha = hashlib.sha256(buf).hexdigest()
+                            # Per-tenant scope by default (ADVICE r5
+                            # #3): node-wide keys let a tenant time-
+                            # probe a co-tenant's exact bytes.
+                            scope = ("node" if self.state.put_dedup_node
+                                     else tenant.name)
+                            dedup_key = (scope, tenant.chip.index,
+                                         buf_sha,
                                          arr.dtype.name,
                                          tuple(arr.shape))
                             dev_arr = self.state.put_cache_get(dedup_key)
@@ -1486,6 +1979,29 @@ class TenantSession(socketserver.BaseRequestHandler):
                             # PUT lands whole on the primary chip; the
                             # admission above already debited it.
                             tenant.charges[aid] = [(0, nbytes)]
+                    jr = self.state.journal
+                    if jr is not None:
+                        # Journal the payload + ledger entry BEFORE the
+                        # ack: once the client sees ok, the array
+                        # survives a broker crash (restored at resume).
+                        if buf_sha is None:
+                            import hashlib
+                            buf_sha = hashlib.sha256(buf).hexdigest()
+                        jr.put_blob(bytes(buf), sha=buf_sha)
+                        rec = {"op": "put", "name": tenant.name,
+                               "id": aid, "sha": buf_sha,
+                               "shape": list(arr.shape),
+                               "dtype": arr.dtype.name,
+                               "nbytes": nbytes,
+                               "charges": ([] if spilled
+                                           else [[0, nbytes]]),
+                               "spilled": spilled}
+                        with tenant.mu:
+                            tenant.blob_meta[aid] = {
+                                k: rec[k] for k in
+                                ("sha", "shape", "dtype", "nbytes",
+                                 "charges", "spilled")}
+                        jr.append(rec)
                     self._send({"ok": True, "nbytes": nbytes,
                                 "spilled": spilled})
 
@@ -1530,20 +2046,33 @@ class TenantSession(socketserver.BaseRequestHandler):
                     self._send({"ok": True, "freed": freed})
 
                 elif kind == P.COMPILE:
-                    prog = self.state.cached_blob(bytes(msg["exported"]))
+                    blob = bytes(msg["exported"])
+                    prog = self.state.cached_blob(blob)
                     if prog.nr_devices > 1:
                         # Sharded program: bind it to THIS tenant's
                         # granted chip set (per-chip slots were claimed
                         # at HELLO).
                         prog = self.state.tenant_program(tenant, prog)
-                    tenant.executables[str(msg["id"])] = prog
+                    eid = str(msg["id"])
+                    tenant.executables[eid] = prog
+                    jr = self.state.journal
+                    if jr is not None and prog.sha:
+                        # Program blobs journal too: a resumed tenant's
+                        # executables re-register from the blob store
+                        # under their original ids.
+                        jr.put_blob(blob, sha=prog.sha)
+                        tenant.exe_shas[eid] = prog.sha
+                        jr.append({"op": "compile",
+                                   "name": tenant.name,
+                                   "id": eid, "sha": prog.sha})
                     self._send({"ok": True})
 
                 elif kind == P.STATS:
                     # Fresh counters: let the metering thread retire
                     # everything this tenant has dispatched.
                     tenant.chip.scheduler.quiesce(tenant.name)
-                    self._send({"ok": True, "tenants": self._stats()})
+                    self._send({"ok": True, "tenants": self._stats(),
+                                "journal": self.state.journal_stats()})
 
                 else:
                     self._send_err("BAD_KIND", str(kind))
@@ -1561,13 +2090,49 @@ class TenantSession(socketserver.BaseRequestHandler):
             t.drop_staged(aid)  # resident staged copy goes with it
             t.nbytes.pop(aid, None)
             t.host_bytes -= int(arr.nbytes)
+            self._journal_drop(t, aid)
             return int(arr.nbytes)
         if aid in t.arrays:
             nbytes = t.nbytes.pop(aid, 0)
             del t.arrays[aid]
             t.release_array(aid, default_nbytes=nbytes)
+            self._journal_drop(t, aid)
             return nbytes
         return 0
+
+    def _journal_drop(self, t: Tenant, aid: str) -> None:
+        jr = self.state.journal
+        if jr is not None and t.blob_meta.pop(aid, None) is not None:
+            jr.append({"op": "del", "name": t.name, "id": aid})
+
+    def _journal_bind(self, t: Tenant, msg) -> None:
+        """Record a tenant binding (creation, reconnect or resume) so
+        recovery knows the grant shape and the owning client's identity
+        for liveness re-validation."""
+        jr = self.state.journal
+        if jr is None:
+            return
+        pid = msg.get("pid")
+        pidns = msg.get("pidns")
+        if pid:
+            t.client_pid = int(pid)
+        if pidns:
+            t.client_pidns = int(pidns)
+        if t.grant is None:
+            t.grant = {
+                "hbm": [int(c.region.device_stats(s).limit_bytes)
+                        for c, s in zip(t.chips, t.slots)],
+                "core": int(t.chip.region.device_stats(t.index)
+                            .core_limit_pct),
+            }
+        jr.append({"op": "bind", "name": t.name,
+                   "devices": [c.index for c in t.chips],
+                   "slots": list(t.slots),
+                   "priority": t.priority, "over": t.oversubscribe,
+                   "hbm": t.grant.get("hbm"),
+                   "core": t.grant.get("core"),
+                   "spill": t.spill_overshoot,
+                   "pid": t.client_pid, "pidns": t.client_pidns})
 
     def _drop_array(self, t: Tenant, aid: str) -> int:
         with t.mu:
@@ -1677,6 +2242,13 @@ def collect_stats(state: RuntimeState):
             "staged_resident_bytes": staged,
             "suspended": name in state.suspended,
             "executions": t.executions,
+            # Learned device-time cost model (us/step per program key):
+            # surfaced so operators — and the recovery tests — can see
+            # that a crashed broker's successor kept the cost model
+            # instead of re-seeding every tenant at the 5ms default.
+            "cost_ema_us": {k: round(float(v), 1)
+                            for k, v in t.cost_ema.items()},
+            "recovered": bool(t.recovered),
         }
     return out
 
@@ -1757,7 +2329,25 @@ class AdminSession(socketserver.BaseRequestHandler):
                     P.send_msg(self.request,
                                {"ok": True,
                                 "tenants": collect_stats(self.state),
-                                "suspended": suspended})
+                                "suspended": suspended,
+                                "journal": self.state.journal_stats()})
+                elif kind in (P.DRAIN, P.HANDOVER):
+                    # Zero-downtime upgrade: quiesce + final snapshot;
+                    # HANDOVER then exits so the supervisor's successor
+                    # recovers the journal and reconnecting clients
+                    # resume with state intact.
+                    n = self.state.drain(
+                        float(msg.get("timeout", 30.0)))
+                    P.send_msg(self.request,
+                               {"ok": True, "tenants": n,
+                                "snapshotted":
+                                    self.state.journal is not None})
+                    if kind == P.HANDOVER:
+                        cb = getattr(self.state, "shutdown_cb", None)
+                        if cb is not None:
+                            threading.Thread(target=cb,
+                                             daemon=True).start()
+                        return
                 elif kind == P.SHUTDOWN:
                     P.send_msg(self.request, {"ok": True})
                     cb = getattr(self.state, "shutdown_cb", None)
@@ -1777,6 +2367,9 @@ class _Server(socketserver.ThreadingUnixStreamServer):
     admin_server: "Optional[_Server]" = None
 
     def shutdown(self):
+        st = getattr(self, "state", None)
+        if st is not None:
+            st._keeper_stop.set()  # noqa: SLF001 - lifecycle owner
         if self.admin_server is not None:
             self.admin_server.shutdown()
         super().shutdown()
@@ -1787,10 +2380,21 @@ class _Server(socketserver.ThreadingUnixStreamServer):
         super().server_close()
 
 
+def _journal_keeper(state: RuntimeState) -> None:
+    """Background journal upkeep: snapshot compaction + resume-grace
+    expiry.  Dies with the server (keeper_stop) or the process."""
+    while not state._keeper_stop.wait(1.0):  # noqa: SLF001
+        try:
+            state.journal_tick()
+        except Exception as e:  # noqa: BLE001 - upkeep must survive
+            log.warn("journal keeper: %s", e)
+
+
 def make_server(socket_path: str, hbm_limit: int, core_limit: int,
                 region_path: Optional[str] = None,
                 min_exec_cost_us: int = 0,
-                work_conserving: Optional[bool] = None) -> _Server:
+                work_conserving: Optional[bool] = None,
+                journal_dir: Optional[str] = None) -> _Server:
     if os.path.exists(socket_path):
         os.unlink(socket_path)
     os.makedirs(os.path.dirname(socket_path) or ".", exist_ok=True)
@@ -1802,8 +2406,27 @@ def make_server(socket_path: str, hbm_limit: int, core_limit: int,
     for stale in [rpath] + _glob.glob(rpath + ".chip*"):
         if os.path.exists(stale):
             os.unlink(stale)
+    # Crash-safe state journal (docs/BROKER_RECOVERY.md): enabled by
+    # pointing VTPU_JOURNAL_DIR (or the explicit arg) at a broker-owned
+    # state dir.  Unset -> exactly the pre-journal behavior: a broker
+    # crash zeroes tenant state and clients get typed VtpuStateLost.
+    jdir = journal_dir if journal_dir is not None \
+        else (os.environ.get("VTPU_JOURNAL_DIR") or None)
+    jr = None
+    if jdir:
+        try:
+            jr = Journal(jdir)
+        except OSError as e:
+            # An unwritable journal dir (read-only hostPath, bad mount)
+            # must degrade to the journal-less contract, not keep the
+            # node's broker from booting at all.
+            log.error("journal dir %s unusable (%s); running WITHOUT "
+                      "crash recovery", jdir, e)
     state = RuntimeState(rpath, hbm_limit, core_limit, min_exec_cost_us,
-                         work_conserving)
+                         work_conserving, journal=jr)
+    if jr is not None:
+        threading.Thread(target=_journal_keeper, args=(state,),
+                         daemon=True, name="vtpu-rt-journal").start()
     handler = type("BoundSession", (TenantSession,), {"state": state})
     srv = _Server(socket_path, handler)
     srv.state = state  # type: ignore[attr-defined]
@@ -1844,6 +2467,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="redistribute idle tenants' core share to active"
                         " ones (default on; also VTPU_WORK_CONSERVING)")
     p.add_argument("--region", default=None)
+    p.add_argument("--journal-dir", default=os.environ.get(
+        "VTPU_JOURNAL_DIR") or None,
+        help="crash-safe state journal dir (tmpfs/hostPath); unset "
+             "disables recovery — see docs/BROKER_RECOVERY.md")
     ns = p.parse_args(argv)
     # Some images register a TPU plugin at interpreter startup and override
     # JAX_PLATFORMS; re-assert the env's explicit choice.
@@ -1877,7 +2504,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     srv = make_server(ns.socket, hbm, ns.core_limit, ns.region,
                       ns.min_exec_cost_us,
                       work_conserving=(None if ns.work_conserving is None
-                                       else bool(ns.work_conserving)))
+                                       else bool(ns.work_conserving)),
+                      journal_dir=ns.journal_dir)
     log.info("vtpu-runtime serving on %s (hbm=%d core=%d%%)",
              ns.socket, hbm, ns.core_limit)
     try:

@@ -38,10 +38,14 @@ execute message ("free" field) — zero extra round trips.
 
 Failure contract: if the broker restarts (``VtpuStateLost``), every
 handle is poisoned and the error surfaces on the next fetch/step — same
-epoch semantics as the cooperative client.  If a function cannot be
-exported (exotic primitives, non-array leaves), the call falls back to
-the local CPU backend — still quota-safe, since the process holds no
-chip.
+epoch semantics as the cooperative client.  When the broker's state
+journal recovered the tenant instead (``VtpuConnectionLost`` with
+``resumed=True``, docs/BROKER_RECOVERY.md), the bridge retries the
+interrupted send once: journaled arrays and programs survived the
+crash, so a loop whose inputs are PUTs keeps running.  If a function
+cannot be exported (exotic primitives, non-array leaves), the call
+falls back to the local CPU backend — still quota-safe, since the
+process holds no chip.
 """
 
 from __future__ import annotations
@@ -339,55 +343,76 @@ class Bridge:
         (reuse a live remote buffer) or ``("put", fixed_id, np_arr)``
         (transient upload, replaced in place on the next call).  Puts are
         synchronous (replies are FIFO); the execute itself is sent
-        async — its reply is consumed lazily."""
+        async — its reply is consumed lazily.
+
+        Bounded reconnect-and-resume: when the broker crashed but its
+        journal recovered this tenant (``VtpuConnectionLost`` with
+        ``resumed=True`` — the client already re-HELLO'd), the
+        outstanding replies are gone but every journaled array/program
+        survived, so the send is retried ONCE against the new instance
+        instead of failing the training loop."""
         from ..runtime.client import VtpuConnectionLost, VtpuStateLost
         with self._mu:
             try:
-                while len(self._outstanding) >= _MAX_OUTSTANDING:
-                    self._recv_one_locked()
-                arg_ids = []
-                for item in arg_items:
-                    if item[0] == "id":
-                        arg_ids.append(item[1])
+                return self._run_locked(eid, arg_items, out_avals)
+            except VtpuConnectionLost as e:
+                if not getattr(e, "resumed", False):
+                    raise
+                try:
+                    return self._run_locked(eid, arg_items, out_avals)
+                except (VtpuStateLost, VtpuConnectionLost) as e2:
+                    self._poison_all(e2)
+                    raise
+
+    def _run_locked(self, eid: str, arg_items: Sequence[Tuple[str, Any]],
+                    out_avals: Sequence[Any]) -> List[BridgeArray]:
+        from ..runtime.client import VtpuConnectionLost, VtpuStateLost
+        try:
+            while len(self._outstanding) >= _MAX_OUTSTANDING:
+                self._recv_one_locked()
+            arg_ids = []
+            for item in arg_items:
+                if item[0] == "id":
+                    arg_ids.append(item[1])
+                else:
+                    # Transient upload rides the pipeline too (acks
+                    # are consumed lazily, FIFO): a fresh host batch
+                    # per step must not drain the in-flight
+                    # executes.  The fixed-id replacement stays safe
+                    # server-side: the session drains its own
+                    # executes before processing a PUT.
+                    _, fid, arr = item
+                    nparts = (int(np.asarray(arr).nbytes)
+                              // max(self._chunk_bytes(), 1)) + 1
+                    if nparts > self.client.MAX_PIPELINED_PUT_PARTS:
+                        # Huge transient upload: the pipelined path
+                        # would deadlock on its own unread acks —
+                        # drain and upload synchronously.
+                        self._drain_locked()
+                        self.client.put(arr, aid=fid)
                     else:
-                        # Transient upload rides the pipeline too (acks
-                        # are consumed lazily, FIFO): a fresh host batch
-                        # per step must not drain the in-flight
-                        # executes.  The fixed-id replacement stays safe
-                        # server-side: the session drains its own
-                        # executes before processing a PUT.
-                        _, fid, arr = item
-                        nparts = (int(np.asarray(arr).nbytes)
-                                  // max(self._chunk_bytes(), 1)) + 1
-                        if nparts > self.client.MAX_PIPELINED_PUT_PARTS:
-                            # Huge transient upload: the pipelined path
-                            # would deadlock on its own unread acks —
-                            # drain and upload synchronously.
-                            self._drain_locked()
-                            self.client.put(arr, aid=fid)
-                        else:
-                            for _ in range(self.client.put_send(arr,
-                                                                fid)):
-                                self._outstanding.append(("ack", None))
-                        arg_ids.append(fid)
-                import weakref
-                out_ids = [f"bo{next(self._ids)}" for _ in out_avals]
-                outs = [BridgeArray(self, oid, av.shape, av.dtype)
-                        for oid, av in zip(out_ids, out_avals)]
-                self.client.execute_send_ids(eid, arg_ids, out_ids,
-                                             free=self._take_frees())
-                self._outstanding.append(("exe",
-                                          [weakref.ref(a)
-                                           for a in outs]))
-                return outs
-            except (VtpuStateLost, VtpuConnectionLost) as e:
-                # SEND-side connection loss (broker died mid-loop): the
-                # replies for everything still queued died with the old
-                # socket — poison and clear, or every later drain
-                # (including the transparent retry's compile) would
-                # block forever on replies that will never come.
-                self._poison_all(e)
-                raise
+                        for _ in range(self.client.put_send(arr,
+                                                            fid)):
+                            self._outstanding.append(("ack", None))
+                    arg_ids.append(fid)
+            import weakref
+            out_ids = [f"bo{next(self._ids)}" for _ in out_avals]
+            outs = [BridgeArray(self, oid, av.shape, av.dtype)
+                    for oid, av in zip(out_ids, out_avals)]
+            self.client.execute_send_ids(eid, arg_ids, out_ids,
+                                         free=self._take_frees())
+            self._outstanding.append(("exe",
+                                      [weakref.ref(a)
+                                       for a in outs]))
+            return outs
+        except (VtpuStateLost, VtpuConnectionLost) as e:
+            # SEND-side connection loss (broker died mid-loop): the
+            # replies for everything still queued died with the old
+            # socket — poison and clear, or every later drain
+            # (including the transparent retry's compile) would
+            # block forever on replies that will never come.
+            self._poison_all(e)
+            raise
 
     def sync(self) -> None:
         with self._mu:
@@ -677,6 +702,7 @@ class BridgedFunction:
             out = apply(dyn, kw_dyn)
             return tuple(jax.tree_util.tree_leaves(out))
 
+        import jax.export  # noqa: F401 - jax lazy-loads submodules
         real_jit = getattr(jax.jit, "_vtpu_real", jax.jit)
         exported = jax.export.export(
             real_jit(flat_fn), platforms=("cpu", "tpu"))(*avals)
@@ -720,8 +746,16 @@ def install(jax_module=None) -> bool:
     jax_module.jit = jit
 
     real_device_put = jax_module.device_put
+    # Reentrancy guard: jnp.asarray's canonicalization path calls
+    # jax.device_put INTERNALLY on some jax versions (0.4.x
+    # lax_numpy.array) — without the guard the patched device_put
+    # recurses through itself until the stack dies.  Inner calls run
+    # the REAL device_put on the pinned CPU backend (never the chip).
+    _dp_reentry = threading.local()
 
     def device_put(x, device=None, **kw):
+        if getattr(_dp_reentry, "active", False):
+            return real_device_put(x, device, **kw)
         bridge = None
         leaves, td = jax_module.tree_util.tree_flatten(x)
         if not any(isinstance(v, jax.core.Tracer) for v in leaves):
@@ -737,10 +771,13 @@ def install(jax_module=None) -> bool:
             if isinstance(leaf, BridgeArray):
                 out.append(leaf)
                 continue
+            _dp_reentry.active = True
             try:
                 arr = np.asarray(jnp.asarray(leaf))
             except (TypeError, ValueError):
                 return real_device_put(x, device, **kw)
+            finally:
+                _dp_reentry.active = False
             out.append(bridge.put_owned(arr))
         return jax_module.tree_util.tree_unflatten(td, out)
 

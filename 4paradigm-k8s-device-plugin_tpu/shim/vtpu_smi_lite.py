@@ -125,19 +125,33 @@ def main(argv=None) -> int:
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             s.settimeout(5.0)
             s.connect(spec.runtime_socket)
-            # ALWAYS a throwaway name: HELLO is state-mutating (first
-            # HELLO wins the tenant's grant seeding) — probing under
-            # VTPU_TENANT could claim the pod's real tenant slot with
-            # default limits before the workload connects.
-            probe = f"vtpu-smi-probe-{os.getpid()}"
-            P.send_msg(s, {"kind": P.HELLO, "tenant": probe,
-                           "priority": 1})
-            hello = P.recv_msg(s)
-            if hello.get("ok"):
-                P.send_msg(s, {"kind": P.STATS})
-                st = P.recv_msg(s)
-                if st.get("ok"):
-                    out["broker"] = st["tenants"]
+            # STATS is bind-free: no tenant slot, no chip binding, no
+            # lazy chip claim — a read-only probe must never be able to
+            # wedge a claim and take the broker down (ADVICE r5 #2).
+            P.send_msg(s, {"kind": P.STATS})
+            st = P.recv_msg(s)
+            if not st.get("ok") and st.get("code") == "NO_HELLO":
+                # Pre-STATS broker (daemonset upgrade skew): fall back
+                # to a throwaway HELLO — never under VTPU_TENANT (first
+                # HELLO wins the grant seeding), and ALWAYS bound to
+                # the grant's own first chip, never default chip 0
+                # (binding a foreign chip can lazily claim it).
+                chips = os.environ.get("TPU_VISIBLE_CHIPS", "")
+                toks = chips.replace(",", " ").split()
+                try:
+                    dev = int(toks[0]) if toks else 0
+                except ValueError:
+                    dev = 0
+                probe = f"vtpu-smi-probe-{os.getpid()}"
+                P.send_msg(s, {"kind": P.HELLO, "tenant": probe,
+                               "priority": 1, "device": dev})
+                if P.recv_msg(s).get("ok"):
+                    P.send_msg(s, {"kind": P.STATS})
+                    st = P.recv_msg(s)
+            if st.get("ok"):
+                out["broker"] = st["tenants"]
+                if st.get("journal"):
+                    out["broker_journal"] = st["journal"]
             s.close()
         except Exception as e:  # noqa: BLE001
             out["broker_error"] = str(e)
@@ -168,6 +182,15 @@ def main(argv=None) -> int:
               f"  core {t['core_limit_pct'] or 'unl'}%  "
               f"execs {t['executions']}"
               f"{'  SUSPENDED' if t.get('suspended') else ''}")
+    bj = out.get("broker_journal")
+    if bj and bj.get("enabled"):
+        dropped = (bj.get("tenants_dropped_dead", 0)
+                   + bj.get("tenants_dropped_expired", 0))
+        print(f"  broker journal: epoch {bj.get('epoch')}  "
+              f"recoveries {bj.get('recoveries_total', 0)}  "
+              f"readopted {bj.get('tenants_readopted', 0)}  "
+              f"dropped {dropped}"
+              f"{'  DRAINING' if bj.get('draining') else ''}")
     if "region_error" in out:
         print(f"  (region unavailable: {out['region_error']})")
     if "broker_error" in out:

@@ -71,7 +71,8 @@ class MetricsState:
                 return None
             return {"broker": sock,
                     "tenants": resp.get("tenants", {}),
-                    "suspended": resp.get("suspended", [])}
+                    "suspended": resp.get("suspended", []),
+                    "journal": resp.get("journal") or {}}
 
         if not self.brokers:
             return []
@@ -191,9 +192,56 @@ def broker_prometheus(brokers: List[Dict]) -> str:
         "# TYPE vtpu_tenant_suspended gauge",
         "# HELP vtpu_tenant_executions_total Steps executed per tenant.",
         "# TYPE vtpu_tenant_executions_total counter",
+        # Journal health (docs/BROKER_RECOVERY.md): a growing journal
+        # with an aging snapshot means compaction stalled; recoveries /
+        # readopted / dropped tell operators whether broker restarts
+        # are actually tenant-transparent.
+        "# HELP vtpu_broker_journal_enabled 1 when the broker journals "
+        "its state (crash-safe recovery).",
+        "# TYPE vtpu_broker_journal_enabled gauge",
+        "# HELP vtpu_broker_journal_size_bytes Journal log+snapshot "
+        "bytes on disk.",
+        "# TYPE vtpu_broker_journal_size_bytes gauge",
+        "# HELP vtpu_broker_journal_last_snapshot_age_seconds Seconds "
+        "since the last snapshot compaction (-1 = never).",
+        "# TYPE vtpu_broker_journal_last_snapshot_age_seconds gauge",
+        "# HELP vtpu_broker_recoveries_total Broker restarts that "
+        "replayed a journal.",
+        "# TYPE vtpu_broker_recoveries_total counter",
+        "# HELP vtpu_broker_tenants_readopted_total Recovered tenants "
+        "re-adopted by their reconnecting clients.",
+        "# TYPE vtpu_broker_tenants_readopted_total counter",
+        "# HELP vtpu_broker_tenants_recovery_dropped_total Recovered "
+        "tenants dropped (dead pid, grace expiry, replaced).",
+        "# TYPE vtpu_broker_tenants_recovery_dropped_total counter",
+        "# HELP vtpu_broker_draining 1 while the broker refuses new "
+        "tenants for a handover.",
+        "# TYPE vtpu_broker_draining gauge",
     ]
     for b in brokers:
         broker = _esc(os.path.basename(b["broker"]))
+        j = b.get("journal") or {}
+        if j:
+            bl = f'{{broker="{broker}"}}'
+            dropped = (j.get("tenants_dropped_dead", 0)
+                       + j.get("tenants_dropped_expired", 0)
+                       + j.get("tenants_dropped_replaced", 0))
+            lines.append(f'vtpu_broker_journal_enabled{bl} '
+                         f'{1 if j.get("enabled") else 0}')
+            lines.append(f'vtpu_broker_journal_size_bytes{bl} '
+                         f'{j.get("size_bytes", 0)}')
+            lines.append(
+                f'vtpu_broker_journal_last_snapshot_age_seconds{bl} '
+                f'{j.get("last_snapshot_age_s", -1)}')
+            lines.append(f'vtpu_broker_recoveries_total{bl} '
+                         f'{j.get("recoveries_total", 0)}')
+            lines.append(f'vtpu_broker_tenants_readopted_total{bl} '
+                         f'{j.get("tenants_readopted", 0)}')
+            lines.append(
+                f'vtpu_broker_tenants_recovery_dropped_total{bl} '
+                f'{dropped}')
+            lines.append(f'vtpu_broker_draining{bl} '
+                         f'{1 if j.get("draining") else 0}')
         for name, t in sorted(b["tenants"].items()):
             labels = (f'{{broker="{broker}",tenant="{_esc(name)}",'
                       f'chip="{t["chip"]}"}}')

@@ -153,12 +153,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--resume", default=None, metavar="TENANT")
     ap.add_argument("--broker-stats", action="store_true",
                     help="per-tenant broker stats (quota, spill, "
-                         "residency, suspension)")
+                         "residency, suspension, journal/recovery)")
+    ap.add_argument("--drain", action="store_true",
+                    help="refuse new tenants, quiesce dispatch and "
+                         "commit a final journal snapshot (handover "
+                         "prep; docs/BROKER_RECOVERY.md)")
+    ap.add_argument("--handover", action="store_true",
+                    help="--drain, then exit the broker gracefully so "
+                         "the supervisor's successor recovers the "
+                         "journal (zero-downtime upgrade)")
     ns = ap.parse_args(argv)
 
-    if (ns.suspend or ns.resume or ns.broker_stats) and not ns.broker:
-        ap.error("--suspend/--resume/--broker-stats need --broker "
-                 "<main socket>")
+    admin_verbs = (ns.suspend or ns.resume or ns.broker_stats
+                   or ns.drain or ns.handover)
+    if admin_verbs and not ns.broker:
+        ap.error("--suspend/--resume/--broker-stats/--drain/--handover "
+                 "need --broker <main socket>")
     if ns.broker:
         from ..runtime import protocol as P
         if ns.suspend:
@@ -169,8 +179,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                                               "tenant": ns.resume})
         elif ns.broker_stats:
             resp = _admin_request(ns.broker, {"kind": P.STATS})
+        elif ns.drain:
+            resp = _admin_request(ns.broker, {"kind": P.DRAIN},
+                                  timeout=90.0)
+        elif ns.handover:
+            resp = _admin_request(ns.broker, {"kind": P.HANDOVER},
+                                  timeout=90.0)
         else:
-            ap.error("--broker needs --suspend/--resume/--broker-stats")
+            ap.error("--broker needs --suspend/--resume/--broker-stats/"
+                     "--drain/--handover")
         print(json.dumps(resp, indent=2))
         return 0 if resp.get("ok") else 1
 

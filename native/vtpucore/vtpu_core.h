@@ -204,6 +204,12 @@ uint32_t vtpu_layout_version(void);
 int vtpu_test_poke_slot(vtpu_region* r, int slot, pid_t pid,
                         pid_t host_pid, uint64_t ns_id);
 
+/* TEST-ONLY: redirect the /proc root the host-mode liveness check
+ * reads, so hidepid-style mounts (live pid, ENOENT on /proc/<pid>) are
+ * exercisable without mount namespaces.  NULL/empty restores "/proc".
+ * Never called by product code paths. */
+void vtpu_test_set_proc_root(const char* root);
+
 #ifdef __cplusplus
 }
 #endif

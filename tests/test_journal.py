@@ -1,0 +1,532 @@
+"""Broker state journal + epoch handover (runtime/journal.py): unit
+tests for the WAL/snapshot format, and e2e crash/drain recovery — a
+SIGKILL'd broker's successor replays the journal and reconnecting
+tenants resume with HBM ledgers, arrays and cost EMAs intact, with no
+tenant-visible error on idempotent requests."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vtpu.runtime import protocol as P
+from vtpu.runtime.client import (RuntimeClient, VtpuConnectionLost,
+                                 VtpuStateLost)
+from vtpu.runtime.journal import Journal, JournalCorrupt
+from vtpu.runtime.server import make_server
+
+MB = 10**6
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Journal unit tests (no broker)
+# ---------------------------------------------------------------------------
+
+def test_journal_append_load_roundtrip(tmp_path):
+    j = Journal(str(tmp_path / "j"))
+    j.append({"op": "epoch", "epoch": "e1"})
+    j.append({"op": "bind", "name": "t", "devices": [0], "slots": [3],
+              "priority": 1, "over": False, "hbm": [MB], "core": 50})
+    j.append({"op": "put", "name": "t", "id": "x", "sha": "s1",
+              "shape": [4], "dtype": "float32", "nbytes": 16,
+              "charges": [[0, 16]], "spilled": False})
+    j.append({"op": "ema", "name": "t", "key": "e0", "ema": 123.0,
+              "execs": 7})
+    j.append({"op": "del", "name": "t", "id": "gone"})
+    j.close()
+    st = Journal(str(tmp_path / "j")).load_state()
+    assert st["epoch"] == "e1"
+    t = st["tenants"]["t"]
+    assert t["slots"] == [3] and t["hbm"] == [MB]
+    assert t["arrays"]["x"]["nbytes"] == 16
+    assert t["ema"]["e0"] == 123.0 and t["execs"] == 7
+
+
+def test_journal_close_removes_tenant(tmp_path):
+    j = Journal(str(tmp_path / "j"))
+    j.append({"op": "bind", "name": "t", "devices": [0], "slots": [0]})
+    j.append({"op": "close", "name": "t"})
+    assert Journal(str(tmp_path / "j")).load_state()["tenants"] == {}
+
+
+def test_journal_torn_tail_is_dropped(tmp_path):
+    j = Journal(str(tmp_path / "j"))
+    j.append({"op": "epoch", "epoch": "e1"})
+    j.append({"op": "bind", "name": "t", "devices": [0], "slots": [0]})
+    j.close()
+    with open(tmp_path / "j" / "journal.log", "ab") as f:
+        f.write(b"deadbeef {\"op\": \"bind\", \"name\": \"torn")
+    st = Journal(str(tmp_path / "j")).load_state()
+    assert "t" in st["tenants"] and "torn" not in st["tenants"]
+
+
+def test_journal_mid_corruption_fails_closed(tmp_path):
+    j = Journal(str(tmp_path / "j"))
+    for i in range(4):
+        j.append({"op": "bind", "name": f"t{i}", "devices": [0],
+                  "slots": [i]})
+    j.close()
+    path = tmp_path / "j" / "journal.log"
+    lines = path.read_bytes().split(b"\n")
+    lines[1] = b"00000000 {not json"
+    path.write_bytes(b"\n".join(lines))
+    with pytest.raises(JournalCorrupt):
+        Journal(str(tmp_path / "j")).load_state()
+
+
+def test_journal_snapshot_compaction_preserves_state(tmp_path):
+    j = Journal(str(tmp_path / "j"), snapshot_every=2)
+    j.append({"op": "bind", "name": "t", "devices": [0], "slots": [1],
+              "hbm": [5 * MB]})
+    j.append({"op": "ema", "name": "t", "key": "k", "ema": 9.0,
+              "execs": 1})
+    assert j.snapshot_due()
+    j.write_snapshot(lambda: j.load_state() or {})
+    # Post-snapshot records replay ON TOP of the snapshot.
+    j.append({"op": "ema", "name": "t", "key": "k", "ema": 11.0,
+              "execs": 2})
+    j.close()
+    st = Journal(str(tmp_path / "j")).load_state()
+    assert st["tenants"]["t"]["hbm"] == [5 * MB]
+    assert st["tenants"]["t"]["ema"]["k"] == 11.0
+    assert os.path.exists(tmp_path / "j" / "snapshot.json")
+    assert not os.path.exists(tmp_path / "j" / "journal.log.old")
+
+
+def test_journal_blob_store_roundtrip(tmp_path):
+    j = Journal(str(tmp_path / "j"))
+    sha = j.put_blob(b"payload-bytes")
+    assert j.put_blob(b"payload-bytes") == sha  # idempotent
+    assert j.get_blob(sha) == b"payload-bytes"
+    assert j.get_blob("nope") is None
+    assert j.get_blob("../etc/passwd") is None
+
+
+# ---------------------------------------------------------------------------
+# In-process broker: recovery, resume, grace expiry, drain refusal
+# ---------------------------------------------------------------------------
+
+def _inproc(tmp_path, name, journal_dir, **kw):
+    sock = str(tmp_path / f"{name}.sock")
+    srv = make_server(sock, hbm_limit=8 * MB, core_limit=0,
+                      region_path=str(tmp_path / f"{name}.shr"),
+                      journal_dir=journal_dir, **kw)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, sock, t
+
+
+def _crash(srv, *clients):
+    """In-process 'kill -9': stop serving and detach the journal BEFORE
+    the clients close, so the graceful-teardown path cannot write the
+    tenant-close records a real crash would never write."""
+    srv.shutdown()
+    srv.server_close()
+    if srv.state.journal is not None:
+        srv.state.journal.close()
+        srv.state.journal = None
+    for c in clients:
+        c.close()
+
+
+def test_recovered_tenant_resume_and_slot_reservation(tmp_path,
+                                                      monkeypatch):
+    """A second broker over the same journal parks the recovered tenant
+    (slots + ledger held), refuses to hand its slots to newcomers, and
+    re-adopts it on a resume HELLO with arrays restored."""
+    jdir = str(tmp_path / "journal")
+    srv1, sock1, _ = _inproc(tmp_path, "b1", jdir)
+    c = RuntimeClient(sock1, tenant="phx")
+    ep1 = c.epoch
+    c.put(np.arange(6, dtype=np.float32), "keep")
+    _crash(srv1, c)
+
+    srv2, sock2, _ = _inproc(tmp_path, "b2", jdir)
+    try:
+        state = srv2.state
+        assert "phx" in state.recovered
+        t, _dl = state.recovered["phx"]
+        slot = t.index
+        # The parked ledger holds the slot's books.
+        st = state.chips[0].region.device_stats(slot)
+        assert st.used_bytes == 24
+        # A newcomer must not be issued the parked slot.
+        c2 = RuntimeClient(sock2, tenant="newbie")
+        assert c2.tenant_index != slot
+        # Resume HELLO (raw socket: the client only resumes on
+        # reconnect) adopts the tenant with its array restored.
+        import socket as sk
+        s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+        s.connect(sock2)
+        P.send_msg(s, {"kind": P.HELLO, "tenant": "phx",
+                       "resume_epoch": ep1})
+        r = P.recv_msg(s)
+        assert r["ok"] and r["resumed"] is True, r
+        assert r["epoch"] != ep1
+        P.send_msg(s, {"kind": P.GET, "id": "keep"})
+        g = P.recv_msg(s)
+        assert g["ok"], g
+        got = np.frombuffer(g["data"], np.float32)
+        np.testing.assert_array_equal(got,
+                                      np.arange(6, dtype=np.float32))
+        s.close()
+        c2.close()
+    finally:
+        srv2.shutdown()
+        srv2.server_close()
+
+
+def test_recovered_tenant_expires_after_grace(tmp_path, monkeypatch):
+    """A recovered tenant whose client never reconnects is dropped after
+    VTPU_RESUME_GRACE_S and its ledger is released."""
+    monkeypatch.setenv("VTPU_RESUME_GRACE_S", "0.5")
+    jdir = str(tmp_path / "journal")
+    srv1, sock1, _ = _inproc(tmp_path, "b1", jdir)
+    c = RuntimeClient(sock1, tenant="ghost")
+    c.put(np.ones(8, np.float32), "x")
+    _crash(srv1, c)
+
+    srv2, _, _ = _inproc(tmp_path, "b2", jdir)
+    try:
+        state = srv2.state
+        assert "ghost" in state.recovered
+        t, _dl = state.recovered["ghost"]
+        slot = t.index
+        deadline = time.monotonic() + 15
+        while "ghost" in state.recovered:
+            assert time.monotonic() < deadline, "grace never expired"
+            time.sleep(0.1)
+        assert state.recovery["tenants_dropped_expired"] == 1
+        assert state.chips[0].region.device_stats(slot).used_bytes == 0
+    finally:
+        srv2.shutdown()
+        srv2.server_close()
+
+
+def test_plain_hello_supersedes_recovered_state(tmp_path):
+    """A fresh (non-resume) HELLO under a recovered name explicitly
+    starts over: the parked ledger is released, not leaked."""
+    jdir = str(tmp_path / "journal")
+    srv1, sock1, _ = _inproc(tmp_path, "b1", jdir)
+    c = RuntimeClient(sock1, tenant="redo")
+    c.put(np.ones(8, np.float32), "x")
+    _crash(srv1, c)
+
+    srv2, sock2, _ = _inproc(tmp_path, "b2", jdir)
+    try:
+        state = srv2.state
+        assert "redo" in state.recovered
+        c2 = RuntimeClient(sock2, tenant="redo")  # no resume_epoch
+        assert "redo" not in state.recovered
+        assert state.recovery["tenants_dropped_replaced"] == 1
+        st = c2.stats()["redo"]
+        assert st["used_bytes"] == 0  # old ledger released
+        c2.close()
+    finally:
+        srv2.shutdown()
+        srv2.server_close()
+
+
+def test_dead_client_pid_dropped_at_recovery(tmp_path):
+    """Recovery re-validates recorded client identity: a provably dead
+    pid (same pid namespace) is dropped at boot; a live one is parked.
+    The journal is crafted directly so the dead pid is real."""
+    jdir = str(tmp_path / "journal")
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait(timeout=30)
+    my_ns = os.stat("/proc/self/ns/pid").st_ino
+    j = Journal(jdir)
+    j.append({"op": "epoch", "epoch": "prev-epoch"})
+    j.append({"op": "bind", "name": "deadpod", "devices": [0],
+              "slots": [2], "priority": 1, "over": False,
+              "hbm": [MB], "core": 0, "pid": child.pid,
+              "pidns": my_ns})
+    j.append({"op": "bind", "name": "livepod", "devices": [0],
+              "slots": [3], "priority": 1, "over": False,
+              "hbm": [MB], "core": 0, "pid": os.getpid(),
+              "pidns": my_ns})
+    j.close()
+    srv, _, _ = _inproc(tmp_path, "b1", jdir)
+    try:
+        state = srv.state
+        assert "deadpod" not in state.recovered
+        assert state.recovery["tenants_dropped_dead"] == 1
+        assert "livepod" in state.recovered
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_draining_broker_refuses_new_hellos(tmp_path):
+    jdir = str(tmp_path / "journal")
+    srv, sock, _ = _inproc(tmp_path, "b1", jdir)
+    try:
+        c = RuntimeClient(sock, tenant="stay")
+        c.put(np.ones(4, np.float32), "x")
+        srv.state.drain(timeout=10.0)
+        # Existing connection keeps serving.
+        np.testing.assert_array_equal(c.get("x"), [1, 1, 1, 1])
+        # New HELLOs are refused with the typed DRAINING code.
+        with pytest.raises(Exception) as ei:
+            RuntimeClient(sock, tenant="late", reconnect_timeout=0.1)
+        assert "DRAINING" in str(ei.value) or "unreachable" in \
+            str(ei.value)
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# E2E: SIGKILL mid-metering -> respawn -> tenant-transparent resume
+# ---------------------------------------------------------------------------
+
+def _spawn_broker(sock, region, jdir):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["VTPU_JOURNAL_DIR"] = jdir
+    try:
+        os.unlink(sock)
+    except OSError:
+        pass
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "vtpu.runtime.server", "--socket", sock,
+         "--region", region, "--hbm-limit", str(8 * MB)], env=env)
+    deadline = time.monotonic() + 90
+    while not os.path.exists(sock):
+        assert proc.poll() is None, "broker died during startup"
+        assert time.monotonic() < deadline, "broker startup timeout"
+        time.sleep(0.1)
+    return proc
+
+
+def test_sigkill_recovery_resumes_ledger_and_ema(tmp_path):
+    """Acceptance (ISSUE 1): kill -9 the broker mid-metering; the
+    respawned broker recovers the tenant from the journal with its HBM
+    ledger and cost EMA intact (±1 sample), and the client resumes with
+    NO tenant-visible error on its next synchronous request."""
+    sock = str(tmp_path / "crash.sock")
+    region = str(tmp_path / "crash.shr")
+    jdir = str(tmp_path / "journal")
+    b1 = _spawn_broker(sock, region, jdir)
+    b2 = None
+    try:
+        c = RuntimeClient(sock, tenant="survivor", reconnect_timeout=60)
+        ep1 = c.epoch
+        x = np.arange(16, dtype=np.float32)
+        c.put(x, "w")
+        exe = c.compile(lambda a: a * 2.0, [x])
+        # Drive metering so the cost EMA learns (and journals) samples;
+        # delete the outputs so the pre-crash ledger holds only the
+        # journaled (restorable) PUT array.
+        for i in range(8):
+            outs = exe(c.put(x, "batch"))
+            for o in outs:
+                o.delete()
+        c.delete("batch")
+        deadline = time.monotonic() + 20
+        while c.stats()["survivor"]["executions"] < 8:
+            assert time.monotonic() < deadline, "metering never retired"
+            time.sleep(0.1)
+        pre = c.stats()["survivor"]
+        assert pre["used_bytes"] == x.nbytes
+        assert pre["cost_ema_us"], "EMA never learned"
+
+        b1.kill()  # SIGKILL mid-operation: no shutdown path runs
+        b1.wait(timeout=10)
+        b2 = _spawn_broker(sock, region, jdir)
+
+        # NO tenant-visible error: the idempotent GET transparently
+        # reconnects, resumes, and returns the restored array.
+        np.testing.assert_array_equal(c.get("w"), x)
+        assert c.epoch != ep1
+        post = c.stats()["survivor"]
+        assert post["used_bytes"] == pre["used_bytes"]
+        assert post["executions"] == pre["executions"]
+        for k, v in pre["cost_ema_us"].items():
+            # ±1 sample: the kill may race the final EMA journal line.
+            assert k in post["cost_ema_us"]
+            assert post["cost_ema_us"][k] == pytest.approx(v, rel=0.35)
+        # The executable survived under its original id too.
+        outs = exe(c.put(x, "batch"))
+        np.testing.assert_array_equal(outs[0].fetch(), x * 2.0)
+        c.close()
+    finally:
+        for p in (b1, b2):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                p.wait(timeout=15)
+
+
+def test_pipelined_executes_surface_resumed_connection_loss(tmp_path):
+    """In-flight (non-idempotent) executes lost in the crash surface as
+    VtpuConnectionLost with resumed=True — never silently retried, and
+    never the old typed state-loss when the journal recovered the
+    tenant."""
+    sock = str(tmp_path / "crash.sock")
+    region = str(tmp_path / "crash.shr")
+    jdir = str(tmp_path / "journal")
+    b1 = _spawn_broker(sock, region, jdir)
+    b2 = None
+    try:
+        c = RuntimeClient(sock, tenant="pipes", reconnect_timeout=60)
+        x = np.ones(4, np.float32)
+        c.put(x, "x")
+        exe = c.compile(lambda a: a + 1.0, [x])
+        exe(c.put(x, "x"))
+        b1.kill()
+        b1.wait(timeout=10)
+        b2 = _spawn_broker(sock, region, jdir)
+        # Either the send (broken pipe detected) or the recv surfaces
+        # the typed resumed connection loss — never a silent retry.
+        with pytest.raises(VtpuConnectionLost) as ei:
+            c.execute_send_ids(exe.id, ["x"], ["y"])
+            c.execute_recv()
+        assert ei.value.resumed is True
+        assert not isinstance(ei.value, VtpuStateLost)
+        # State is intact: the tenant re-executes by hand.
+        outs = exe(c.put(x, "x"))
+        np.testing.assert_array_equal(outs[0].fetch(), [2, 2, 2, 2])
+        c.close()
+    finally:
+        for p in (b1, b2):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                p.wait(timeout=15)
+
+
+def test_corrupt_journal_fails_closed_to_fresh_epoch(tmp_path):
+    """Mid-journal corruption: the successor quarantines the journal,
+    boots a FRESH epoch, and the client gets today's typed
+    VtpuStateLost — never half-recovered quota state."""
+    sock = str(tmp_path / "crash.sock")
+    region = str(tmp_path / "crash.shr")
+    jdir = str(tmp_path / "journal")
+    b1 = _spawn_broker(sock, region, jdir)
+    b2 = None
+    try:
+        c = RuntimeClient(sock, tenant="victim", reconnect_timeout=60)
+        c.put(np.ones(4, np.float32), "w")
+        b1.kill()
+        b1.wait(timeout=10)
+        with open(os.path.join(jdir, "snapshot.json"), "r+b") as f:
+            f.write(b"{corrupt")
+        b2 = _spawn_broker(sock, region, jdir)
+        with pytest.raises(VtpuStateLost):
+            c.get("w")
+        # Fail-closed but serving: re-put works, and the journal was
+        # quarantined rather than deleted.
+        c.put(np.ones(4, np.float32), "w")
+        assert any("corrupt" in n for n in os.listdir(jdir))
+        c.close()
+    finally:
+        for p in (b1, b2):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                p.wait(timeout=15)
+
+
+def test_journal_disabled_preserves_epoch_crash_contract(tmp_path):
+    """Without VTPU_JOURNAL_DIR nothing changes: a broker crash is the
+    typed epoch-crash (VtpuStateLost), exactly the pre-journal
+    behavior (acceptance criterion)."""
+    sock = str(tmp_path / "nc.sock")
+    region = str(tmp_path / "nc.shr")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("VTPU_JOURNAL_DIR", None)
+
+    def spawn():
+        try:
+            os.unlink(sock)
+        except OSError:
+            pass
+        p = subprocess.Popen(
+            [sys.executable, "-m", "vtpu.runtime.server", "--socket",
+             sock, "--region", region], env=env)
+        deadline = time.monotonic() + 90
+        while not os.path.exists(sock):
+            assert p.poll() is None
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        return p
+
+    b1 = spawn()
+    b2 = None
+    try:
+        c = RuntimeClient(sock, tenant="plain", reconnect_timeout=60)
+        c.put(np.ones(4, np.float32), "w")
+        b1.kill()
+        b1.wait(timeout=10)
+        b2 = spawn()
+        with pytest.raises(VtpuStateLost):
+            c.get("w")
+        c.close()
+    finally:
+        for p in (b1, b2):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                p.wait(timeout=15)
+
+
+def test_handover_verb_zero_downtime_upgrade(tmp_path):
+    """Admin HANDOVER: quiesce + final snapshot + graceful exit; the
+    successor recovers the snapshot and the client resumes."""
+    import socket as sk
+
+    sock = str(tmp_path / "ho.sock")
+    region = str(tmp_path / "ho.shr")
+    jdir = str(tmp_path / "journal")
+    b1 = _spawn_broker(sock, region, jdir)
+    b2 = None
+    try:
+        c = RuntimeClient(sock, tenant="mover", reconnect_timeout=60)
+        c.put(np.arange(4, dtype=np.float32), "w")
+        s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+        s.settimeout(60)
+        s.connect(sock + ".admin")
+        P.send_msg(s, {"kind": P.HANDOVER})
+        resp = P.recv_msg(s)
+        s.close()
+        assert resp["ok"] and resp["snapshotted"] and \
+            resp["tenants"] == 1
+        assert b1.wait(timeout=30) == 0, "handover exit must be clean"
+        b2 = _spawn_broker(sock, region, jdir)
+        np.testing.assert_array_equal(c.get("w"), [0, 1, 2, 3])
+        c.close()
+    finally:
+        for p in (b1, b2):
+            if p is not None and p.poll() is None:
+                p.terminate()
+                p.wait(timeout=15)
+
+
+def test_bind_free_stats_probe(tmp_path):
+    """STATS without HELLO (ADVICE r5 #2): no tenant slot, no chip
+    binding — and the reply carries the journal health section."""
+    import socket as sk
+
+    srv, sock, _ = _inproc(tmp_path, "bf", str(tmp_path / "journal"))
+    try:
+        c = RuntimeClient(sock, tenant="seen")
+        c.put(np.ones(4, np.float32))
+        s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+        s.connect(sock)
+        P.send_msg(s, {"kind": P.STATS})
+        r = P.recv_msg(s)
+        s.close()
+        assert r["ok"] and "seen" in r["tenants"]
+        assert r["journal"]["enabled"] is True
+        # No probe tenant was bound by the STATS.
+        assert set(c.stats()) == {"seen"}
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()

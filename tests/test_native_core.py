@@ -423,6 +423,53 @@ def test_host_sweep_reclaims_recycled_pid_in_foreign_ns(region_path):
             child.wait()
 
 
+def test_host_sweep_survives_hidepid_proc_mounts(region_path, tmp_path):
+    """ADVICE r5 #4: under hidepid-style /proc mounts, stat on a LIVE
+    foreign pid's /proc entry returns ENOENT — the old check read that
+    as death and reclaimed a live tenant's slot.  ENOENT may only count
+    as dead when kill() agrees (ESRCH).  Exercised via the test-only
+    proc-root redirect (an empty dir = every stat ENOENTs)."""
+    import ctypes
+    import subprocess
+    import sys as _sys
+
+    fake_proc = tmp_path / "fakeproc"
+    fake_proc.mkdir()
+    with SharedRegion(region_path, limits=[100 * MB]) as r:
+        lib = r.lib
+        lib.vtpu_test_poke_slot.restype = ctypes.c_int
+        lib.vtpu_test_poke_slot.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64]
+        lib.vtpu_test_set_proc_root.argtypes = [ctypes.c_char_p]
+        slot = r.register()
+        assert r.mem_acquire(0, 10 * MB)
+        child = subprocess.Popen([_sys.executable, "-c",
+                                  "import time; time.sleep(60)"])
+        try:
+            real_ns = os.stat(f"/proc/{child.pid}/ns/pid").st_ino
+            assert lib.vtpu_test_poke_slot(r.handle, slot, child.pid,
+                                           child.pid, real_ns) == 0
+            lib.vtpu_test_set_proc_root(str(fake_proc).encode())
+            try:
+                # LIVE pid + ENOENT on /proc (hidepid): must NOT be
+                # reclaimed — kill() still sees the process.
+                assert r.sweep_dead_host() == 0, \
+                    "live tenant reclaimed under hidepid"
+                assert r.device_stats(0).used_bytes == 10 * MB
+                # DEAD pid + ENOENT: kill() agrees (ESRCH) -> reclaimed.
+                child.kill()
+                child.wait()
+                assert r.sweep_dead_host() >= 1
+                assert r.device_stats(0).used_bytes == 0
+            finally:
+                lib.vtpu_test_set_proc_root(None)
+        finally:
+            if child.poll() is None:
+                child.kill()
+                child.wait()
+
+
 def test_sweep_clears_stale_undebited_credits(region_path):
     """Advisor r4: a tenant killed between an ungated rate_acquire and
     its completion rate_adjust leaves a stale admission credit; a later

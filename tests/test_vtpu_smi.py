@@ -133,3 +133,58 @@ def test_tenant_side_cli_inside_grant_env(tmp_path):
                         env={"PATH": env["PATH"]}, timeout=120)
     assert r3.returncode == 0
     assert "no vTPU grant" in r3.stdout
+
+
+def test_tenant_cli_broker_probe_is_bind_free(tmp_path):
+    """ADVICE r5 #2: the in-container CLI's broker probe uses the
+    bind-free STATS verb — no throwaway tenant is HELLO'd, no chip is
+    lazily claimed, so a read-only `vtpu-smi` in one pod can never
+    wedge a chip claim and restart the broker serving every tenant."""
+    import json as _json
+    import threading
+    import time
+
+    import numpy as np
+
+    from vtpu.runtime.client import RuntimeClient
+    from vtpu.runtime.server import make_server
+
+    sock = str(tmp_path / "rt.sock")
+    srv = make_server(sock, hbm_limit=8 * MB, core_limit=0,
+                      region_path=str(tmp_path / "rt.shr"))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = RuntimeClient(sock, tenant="workload")
+        c.put(np.ones(4, np.float32))
+        cli = os.path.join(REPO, "4paradigm-k8s-device-plugin_tpu",
+                           "shim", "vtpu_smi_lite.py")
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "VTPU_DEVICE_HBM_LIMIT_0": "2G",
+            "VTPU_DEVICE_MAP": "0:tpu-test",
+            "VTPU_RUNTIME_SOCKET": sock,
+            # The probe must NOT bind this either way.
+            "VTPU_TENANT": "workload",
+        }
+        r = subprocess.run([sys.executable, cli, "--json"],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert r.returncode == 0, r.stderr
+        out = _json.loads(r.stdout)
+        assert "broker" in out, out
+        assert set(out["broker"]) == {"workload"}, \
+            "probe bound a tenant"
+        # The journal health section rides the same bind-free reply.
+        assert "broker_journal" in out
+        # Server-side: still exactly one tenant, no probe leftovers.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if set(c.stats()) == {"workload"}:
+                break
+            time.sleep(0.1)
+        assert set(c.stats()) == {"workload"}
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
