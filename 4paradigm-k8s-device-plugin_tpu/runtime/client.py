@@ -26,6 +26,7 @@ import numpy as np
 from ..utils import envspec
 from ..utils.dtypes import np_dtype as _np_dtype
 from . import protocol as P
+from . import trace as tracing
 
 
 class VtpuQuotaError(MemoryError):
@@ -104,8 +105,18 @@ class RuntimeClient:
                  hbm_limit: Optional[int] = None,
                  core_limit: Optional[int] = None,
                  oversubscribe: Optional[bool] = None,
-                 reconnect_timeout: Optional[float] = None):
+                 reconnect_timeout: Optional[float] = None,
+                 trace: Optional[bool] = None):
         self._socket_path = socket_path
+        # vtpu-trace (docs/TRACING.md): when on, every request is
+        # stamped with a trace id + send time so the broker's flight
+        # recorder can follow it end to end.  Off (the default) adds
+        # ZERO protocol fields.  VTPU_TRACE=1 or the explicit arg.
+        self._trace_on = tracing.trace_enabled() if trace is None \
+            else bool(trace)
+        # The most recent stamp (trace id) this client attached — lets
+        # callers (and tests) correlate a request with its broker span.
+        self.last_trace_id: Optional[str] = None
         # Reconnect budget: how long a disconnected client keeps
         # redialing the socket (the daemon respawns crashed brokers
         # with backoff) before giving up.  VTPU_RECONNECT_TIMEOUT_S
@@ -337,13 +348,21 @@ class RuntimeClient:
     # EXECUTE is excluded (non-idempotent), as are staged PUT flows
     # (the per-connection staging died with the old socket).
     _RESUME_RETRY_KINDS = frozenset({P.GET, P.DELETE, P.STATS,
-                                     P.COMPILE, P.PUT})
+                                     P.TRACE, P.COMPILE, P.PUT})
+
+    def _maybe_stamp(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Attach the trace context when tracing is on; byte-identical
+        message otherwise (the zero-overhead-when-off contract)."""
+        if self._trace_on:
+            self.last_trace_id = tracing.new_trace_id()
+            msg["trace"] = {"id": self.last_trace_id, "ts": time.time()}
+        return msg
 
     # -- plumbing --
     def _rpc(self, msg: Dict[str, Any],
              _retry: bool = True) -> Dict[str, Any]:
         try:
-            P.send_msg(self.sock, msg)
+            P.send_msg(self.sock, self._maybe_stamp(msg))
             resp = P.recv_msg(self.sock)
         except (ConnectionError, P.ProtocolError, OSError):
             try:
@@ -430,7 +449,7 @@ class RuntimeClient:
         sent = 0
         try:
             for m in self._put_msgs(arr, aid):
-                P.send_msg(self.sock, m)
+                P.send_msg(self.sock, self._maybe_stamp(m))
                 sent += 1
         except (ConnectionError, P.ProtocolError, OSError):
             self._on_disconnect()
@@ -521,6 +540,20 @@ class RuntimeClient:
     def stats(self) -> Dict[str, Any]:
         return self._rpc({"kind": P.STATS})["tenants"]
 
+    def trace(self, tenant: Optional[str] = None,
+              limit: int = 0) -> Dict[str, Any]:
+        """Flight-recorder read: per-tenant span rings + slow-op
+        captures (runtime/trace.py).  Returns the full reply —
+        {"enabled": bool, "tenants": {name: {spans, captures}}}."""
+        msg: Dict[str, Any] = {"kind": P.TRACE}
+        if tenant is not None:
+            msg["tenant"] = tenant
+        if limit:
+            msg["limit"] = int(limit)
+        r = self._rpc(msg)
+        return {"enabled": r.get("enabled", False),
+                "tenants": r.get("tenants", {})}
+
     # -- pipelined execution (throughput mode) --
     # Replies are FIFO per connection, so a caller may keep several
     # executes in flight (hiding transport latency) as long as send/recv
@@ -551,7 +584,7 @@ class RuntimeClient:
         if free:
             msg["free"] = list(free)
         try:
-            P.send_msg(self.sock, msg)
+            P.send_msg(self.sock, self._maybe_stamp(msg))
         except (ConnectionError, P.ProtocolError, OSError):
             self._on_disconnect()
 

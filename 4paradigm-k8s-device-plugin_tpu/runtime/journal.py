@@ -101,6 +101,13 @@ def _apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> None:
             t.setdefault("ema", {})[rec["key"]] = rec.get("ema")
             if rec.get("execs") is not None:
                 t["execs"] = rec["execs"]
+    elif op == "wedge":
+        # The claim watchdog's dying words (runtime/server.py
+        # claim_watchdog): which claim stage hung and who held the chip
+        # lease.  The respawned broker reports it at recovery so the
+        # os._exit(3) restart is attributable, not silent.
+        state["last_wedge"] = {k: rec.get(k) for k in
+                               ("stage", "ts", "diagnosis")}
     # Unknown ops are skipped (forward compatibility): an old broker
     # replaying a newer journal must not lose the records it DOES know.
 

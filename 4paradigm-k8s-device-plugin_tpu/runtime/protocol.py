@@ -29,6 +29,14 @@ MAX_FRAME = 1 << 30
 CHUNK_BYTES = int(os.environ.get("VTPU_PUT_CHUNK_BYTES", str(256 << 20)))
 
 # message kinds (client -> server)
+#
+# Tracing (docs/TRACING.md): with VTPU_TRACE=1 the client stamps every
+# request with an optional "trace" field {id: <16-hex>, ts: <epoch s>}
+# — the broker threads it through the scheduler into the flight
+# recorder, so one id follows a request client -> queue -> bucket ->
+# device -> reply.  With tracing off the field is ABSENT (zero protocol
+# overhead), and servers ignore it when unexpected (fwd compat).
+#
 # HELLO optional fields: device (chip index on the node, default 0 — the
 # broker serves EVERY chip, each with its own scheduler + accounting
 # region); hbm_limit (bytes) / core_limit (pct): this tenant's own
@@ -66,12 +74,20 @@ COMPILE = "compile"      # {id, exported} -> {ok}
 # because a tenant queue dispatches FIFO).
 EXECUTE = "execute"      # {exe, args: [ids], outs: [ids], repeats?,
                          #  carry?, free?}
-# STATS is the one BIND-FREE verb: it may be sent before (or without)
+# STATS is a BIND-FREE verb: it may be sent before (or without)
 # HELLO — no tenant slot is claimed and no chip is lazily bound, so a
 # read-only probe (vtpu-smi) can never wedge a chip claim (ADVICE r5
 # #2).  On a bound connection it additionally quiesces the tenant's
 # dispatched work so counters are fresh.
 STATS = "stats"          # {} -> {ok, tenants: {...}, journal: {...}}
+# TRACE is bind-free too (same rationale): the flight-recorder read
+# path for vtpu-smi / operators.  Optional fields: tenant (one tenant's
+# rings only), limit (newest N spans).  Replies with the per-tenant
+# span rings + slow-op captures (runtime/trace.py).  Requests MAY carry
+# a "trace" stamp like any other verb; with VTPU_TRACE off the verb
+# still answers (enabled=false, empty rings) so probes need no
+# env-coupling.
+TRACE = "trace"          # {tenant?, limit?} -> {ok, enabled, tenants}
 
 # Admin verbs — served ONLY on the host-side admin socket
 # (<socket>.admin, never mounted into tenant containers: the tenant

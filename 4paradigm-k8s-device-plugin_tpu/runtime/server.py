@@ -80,6 +80,7 @@ from ..utils.dtypes import np_dtype as _np_dtype
 from ..utils import envspec
 from ..utils import logging as log
 from . import protocol as P
+from . import trace as tracing
 from .journal import Journal, JournalCorrupt
 
 MAX_TENANTS = 16
@@ -127,17 +128,11 @@ def sparse_batch_learn_scale(batch_est_us: float, disp_us: float,
     return disp_us / batch_est_us
 
 
-def _pid_alive(pid: int) -> bool:
-    """Provable-death check for journal recovery: only ESRCH counts as
-    dead (EPERM or any doubt keeps the slot — the native region's
-    'never reclaim live state on doubt' rule)."""
-    try:
-        os.kill(pid, 0)
-    except ProcessLookupError:
-        return False
-    except OSError:
-        return True
-    return True
+# Provable-death check for journal recovery: only ESRCH counts as dead
+# (EPERM or any doubt keeps the slot).  ONE policy, shared with the
+# lease-sidecar forensics — the recovery path and the lease diagnosis
+# must never disagree about whether the same pid is alive.
+_pid_alive = tracing.pid_alive
 
 
 def _my_pidns() -> int:
@@ -354,7 +349,8 @@ class WorkItem:
 
     __slots__ = ("tenant", "session", "exe", "key", "arg_ids", "out_ids",
                  "steps", "carry", "metered", "est_us", "first_run",
-                 "free_ids")
+                 "free_ids", "t_enq", "t_enq_wall", "t_bucket0",
+                 "bucket_wait_us", "trace_id", "trace_ts")
 
     def __init__(self, tenant, session, exe, key, arg_ids, out_ids,
                  steps=1, carry=(), free_ids=()):
@@ -376,6 +372,18 @@ class WorkItem:
         # frees are skipped; the owning connection is dying and its
         # teardown reclaims everything anyway.)
         self.free_ids = tuple(free_ids)
+        # -- vtpu-trace span timestamps (runtime/trace.py) --
+        # t_enq: monotonic enqueue time (submit); t_bucket0: first
+        # moment the item sat at queue head throttled by the token
+        # bucket (None = never throttled); bucket_wait_us: the total
+        # head-of-queue throttle wall time, fixed at dispatch.
+        # trace_id/trace_ts: the client's stamp when VTPU_TRACE is on.
+        self.t_enq = 0.0
+        self.t_enq_wall = 0.0
+        self.t_bucket0: Optional[float] = None
+        self.bucket_wait_us = 0.0
+        self.trace_id: Optional[str] = None
+        self.trace_ts: Optional[float] = None
 
 
 class DeviceScheduler:
@@ -410,6 +418,8 @@ class DeviceScheduler:
         self._completer.start()
 
     def submit(self, item: WorkItem) -> None:
+        item.t_enq = time.monotonic()
+        item.t_enq_wall = time.time()
         with self.mu:
             name = item.tenant.name
             if name not in self.queues:
@@ -526,6 +536,11 @@ class DeviceScheduler:
             if metered:
                 wait_ns = t.rate_acquire_all(int(est), t.priority)
                 if wait_ns:
+                    # Trace: the item is now provably waiting on the
+                    # token bucket, not the queue — stamp the start of
+                    # its bucket phase (first throttle at head wins).
+                    if item.t_bucket0 is None:
+                        item.t_bucket0 = now
                     nr = now + wait_ns / 1e9
                     self.not_ready_until[name] = nr
                     soonest = nr if soonest is None else min(soonest, nr)
@@ -533,6 +548,8 @@ class DeviceScheduler:
                               name, est, wait_ns / 1e6)
                     continue
             q.popleft()
+            if item.t_bucket0 is not None:
+                item.bucket_wait_us = max(now - item.t_bucket0, 0.0) * 1e6
             item.metered = metered
             item.est_us = est
             # First device execution of this (program, chain) variant:
@@ -660,6 +677,8 @@ class DeviceScheduler:
                 if item.metered:
                     t.rate_adjust_all(-int(item.est_us))
                 item.session.complete_execute(item, metas, e, 0.0)
+                self._record_span(item, t0, time.monotonic(), 0.0,
+                                  error=f"{type(e).__name__}: {e}")
                 self._retire(item)
                 continue
             # Reply NOW — shapes are static; the device is still working.
@@ -890,7 +909,96 @@ class DeviceScheduler:
                 "batch=%d obs_gap=%.0fus disp_gap=%.0fus",
                 t.name, item.est_us, busy_us, self._pool_us,
                 len(batch), obs_us, disp_us)
+            self._record_span(item, t0, t_obs, busy_us,
+                              solo=(len(batch) == 1))
             self._retire(item)
+
+    # -- vtpu-trace (runtime/trace.py) -------------------------------------
+
+    def _record_span(self, item: WorkItem, t_disp: float, t_obs: float,
+                     busy_us: float, error: Optional[str] = None,
+                     solo: bool = True) -> None:
+        """Fold one retired item's timestamps into a flight-recorder
+        span.  Phases are WALL-clock deltas that partition the item's
+        broker residency exactly (queue + bucket + device == total by
+        construction); the metered ``busy_us`` rides along as the
+        billing view."""
+        fl = self.state.flight
+        if not fl.enabled:
+            return
+        t = item.tenant
+        total_us = max(t_obs - item.t_enq, 0.0) * 1e6
+        bucket_us = min(item.bucket_wait_us, total_us)
+        queue_us = max((t_disp - item.t_enq) * 1e6 - bucket_us, 0.0)
+        device_us = max(t_obs - t_disp, 0.0) * 1e6
+        span: Dict[str, Any] = {
+            "ts": item.t_enq_wall,
+            "tenant": t.name, "chip": self.chip.index,
+            "key": item.key, "steps": item.steps,
+            "queue_us": round(queue_us, 1),
+            "bucket_us": round(bucket_us, 1),
+            "device_us": round(device_us, 1),
+            "total_us": round(total_us, 1),
+            "busy_us": round(busy_us, 1),
+            "est_us": round(item.est_us, 1),
+        }
+        if item.trace_id:
+            span["trace"] = item.trace_id
+        if item.trace_ts:
+            # Client-stamped send time: transport + session lag before
+            # the enqueue (informational — broker phases already
+            # account the broker-side wall).
+            span["client_lag_us"] = round(
+                max(item.t_enq_wall - float(item.trace_ts), 0.0) * 1e6, 1)
+        if item.first_run:
+            span["first_run"] = True
+        if error is not None:
+            span["error"] = error[:200]
+        # Slow-op eligibility: first runs embed compile/program-load
+        # (warmup, not a recurring anomaly), error spans never reached
+        # the device, and items retired in a MULTI-item batch share the
+        # batch tail's observation time — their device_us embeds
+        # co-batched predecessors' work, so judging it against a
+        # per-item estimate would fire on every pipelined batch head
+        # (est=0 disables the capture; the span itself still records).
+        est = 0.0 if (item.first_run or error is not None or not solo) \
+            else item.est_us
+        fl.record(t.name, span, est_us=est,
+                  context_fn=lambda: self._slow_context(item))
+
+    def _slow_context(self, item: WorkItem) -> Dict[str, Any]:
+        """Full context snapshot for a slow-op capture: where would the
+        time have gone — queue depth, bucket level, HBM headroom,
+        co-tenant pressure.  Locks are taken strictly one at a time
+        (scheduler.mu, then region calls, then state.mu) to respect the
+        state.mu -> scheduler.mu ordering the admin path uses."""
+        t = item.tenant
+        with self.mu:
+            qdepth = len(self.queues.get(t.name, ()))
+            inflight = dict(self.inflight)
+            queued_est = self.queued_est_us
+        st = self.chip.region.device_stats(t.index)
+        with self.state.mu:
+            co = sorted(n for n, x in self.state.tenants.items()
+                        if self.chip in x.chips and n != t.name)
+            suspended = t.name in self.state.suspended
+        return {
+            "queue_depth": qdepth,
+            "inflight": inflight,
+            "chip_queued_est_us": round(queued_est, 1),
+            "bucket_level_us": int(
+                self.chip.region.rate_level(t.index)),
+            "hbm_used_bytes": int(st.used_bytes),
+            "hbm_limit_bytes": int(st.limit_bytes),
+            "hbm_headroom_bytes": max(
+                int(st.limit_bytes) - int(st.used_bytes), 0)
+            if st.limit_bytes else -1,
+            "core_limit_pct": int(st.core_limit_pct),
+            "co_tenants": co,
+            "suspended": suspended,
+            "cost_ema_us": round(
+                float(t.cost_ema.get(item.key, 0.0)), 1),
+        }
 
     def stop(self):
         self._stop = True
@@ -898,7 +1006,26 @@ class DeviceScheduler:
             self.mu.notify_all()
 
 
-def claim_watchdog(stage: str):
+def wedge_report(stage: str, journal: Optional[Journal] = None) -> str:
+    """Compose (and journal) the claim watchdog's dying words: WHICH
+    claim stage hung and WHO holds the chip lease, from the lease
+    sidecar (runtime/trace.py chip-lease forensics).  Factored out of
+    the watchdog so the diagnosis path is testable without os._exit.
+    The journal record is the last thing written before the exit — the
+    respawned broker replays it and reports WHY it restarted
+    (recovery-time log + journal_stats last_wedge)."""
+    diag = tracing.diagnose_lease(exclude_pid=os.getpid())
+    msg = tracing.format_lease_diagnosis(diag)
+    if journal is not None:
+        try:
+            journal.append({"op": "wedge", "stage": stage,
+                            "ts": time.time(), "diagnosis": msg})
+        except Exception as e:  # noqa: BLE001 - dying words, best-effort
+            log.warn("cannot journal wedge record: %s", e)
+    return msg
+
+
+def claim_watchdog(stage: str, journal: Optional[Journal] = None):
     """Arm a deadline around a chip-claim step; returns cancel().
 
     The claim path (platform init in jax.devices(), the calibration
@@ -909,9 +1036,11 @@ def claim_watchdog(stage: str):
     socket or, worse, serves HELLOs whose dispatch blocks forever.
     Exiting lets the supervisor respawn with backoff (plugin/main.py)
     and gives clients the typed broker-epoch crash contract instead of
-    an unbounded hang.  VTPU_CLAIM_WATCHDOG_S bounds the step (default
-    180s — first-compile on a cold relayed transport takes 20-40s;
-    0 disables)."""
+    an unbounded hang.  The wedge log names the lease holder from the
+    sidecar (pid/cmdline/heartbeat age) and a final journal record
+    makes the restart attributable after the fact.
+    VTPU_CLAIM_WATCHDOG_S bounds the step (default 180s — first-compile
+    on a cold relayed transport takes 20-40s; 0 disables)."""
     deadline = float(os.environ.get("VTPU_CLAIM_WATCHDOG_S", "180"))
     done = threading.Event()
     if deadline <= 0:
@@ -919,9 +1048,9 @@ def claim_watchdog(stage: str):
     def _fire():
         if not done.wait(deadline):
             log.error(
-                "%s wedged for %.0fs (chip lease held by another "
-                "process?); exiting for supervisor respawn",
-                stage, deadline)
+                "%s wedged for %.0fs; %s; exiting for supervisor "
+                "respawn", stage, deadline,
+                wedge_report(stage, journal))
             os._exit(3)
     threading.Thread(target=_fire, daemon=True,
                      name="vtpu-claim-watchdog").start()
@@ -1035,6 +1164,15 @@ class RuntimeState:
         self.chip_latency_hints: Dict[int, float] = {}
         self.draining = False
         self._keeper_stop = threading.Event()
+        # vtpu-trace flight recorder (runtime/trace.py): per-tenant span
+        # rings, latency histograms, slow-op captures.  Enabled by
+        # VTPU_TRACE=1; a disabled recorder records nothing and the
+        # protocol carries zero extra fields.
+        self.flight = tracing.FlightRecorder()
+        # The previous instance's claim-watchdog wedge record, if its
+        # journal carries one: surfaced at recovery so an os._exit(3)
+        # restart is attributable (ISSUE 2 satellite).
+        self.last_wedge: Optional[dict] = None
         self._journal_state = None
         if journal is not None:
             try:
@@ -1057,6 +1195,13 @@ class RuntimeState:
                             self.chip_latency_hints[int(k)] = float(v)
                     except (TypeError, ValueError):
                         pass
+                self.last_wedge = self._journal_state.get("last_wedge")
+                if self.last_wedge:
+                    log.warn(
+                        "previous broker instance wedged at %r and was "
+                        "watchdog-killed: %s",
+                        self.last_wedge.get("stage"),
+                        self.last_wedge.get("diagnosis"))
         if work_conserving is None:
             work_conserving = os.environ.get(
                 "VTPU_WORK_CONSERVING", "1") != "0"
@@ -1078,7 +1223,13 @@ class RuntimeState:
         # grant's chip, from TPU_VISIBLE_CHIPS) lands on the right
         # silicon; each ChipState drives its chip's first core (the
         # core-split path handles per-core pinning via the interposer).
-        cancel = claim_watchdog("platform init (jax.devices)")
+        # Chip-lease forensics: announce THIS process as the claimer
+        # BEFORE touching the platform, so a concurrent claimer's wedged
+        # watchdog (or the bench gate) can name us — and ours can name
+        # them (exclude_pid skips our own sidecar in the diagnosis).
+        tracing.write_lease_sidecar("platform init (jax.devices)")
+        cancel = claim_watchdog("platform init (jax.devices)",
+                                journal=self.journal)
         try:
             self.devices = self._chip_leaders(jax.devices())
         finally:
@@ -1136,6 +1287,10 @@ class RuntimeState:
         # never stalls HELLO/compile/release of tenants on other chips.
         self.chips_mu = threading.Lock()
         self.chip(0)  # chip 0 eagerly: fail fast if the device is gone
+        # Claim settled: the sidecar now advertises a held, serving
+        # lease (heartbeated by make_server's keeper thread).
+        tracing.write_lease_sidecar(
+            "held (broker serving)", extra={"epoch": self.epoch})
         if self.journal is not None:
             self._recover_from_journal()
             # The epoch record goes out BEFORE the boot snapshot: a
@@ -1207,7 +1362,8 @@ class RuntimeState:
         with self.chips_mu:
             c = self.chips.get(index)
             if c is None:
-                cancel = claim_watchdog(f"chip {index} claim/calibration")
+                cancel = claim_watchdog(f"chip {index} claim/calibration",
+                                        journal=self.journal)
                 try:
                     c = ChipState(self, index, self.devices[index],
                                   self.chip_region_path(index))
@@ -1425,9 +1581,14 @@ class RuntimeState:
         with self.chips_mu:
             chips = {str(i): c._latency_us  # noqa: SLF001 - own class
                      for i, c in self.chips.items() if c._latency_us}
-        return {"version": 1, "epoch": self.epoch,
-                "recoveries_total": self.recovery["recoveries_total"],
-                "tenants": tenants, "chips": chips}
+        out = {"version": 1, "epoch": self.epoch,
+               "recoveries_total": self.recovery["recoveries_total"],
+               "tenants": tenants, "chips": chips}
+        if self.last_wedge:
+            # Survives compaction: the restart's cause stays reportable
+            # until the next wedge overwrites it.
+            out["last_wedge"] = dict(self.last_wedge)
+        return out
 
     def journal_stats(self) -> dict:
         out: Dict[str, Any] = {
@@ -1435,6 +1596,10 @@ class RuntimeState:
             "draining": self.draining,
             "epoch": self.epoch,
         }
+        if self.last_wedge:
+            # Why the previous instance restarted (claim-watchdog
+            # os._exit(3)): stage + lease-holder diagnosis.
+            out["last_wedge"] = dict(self.last_wedge)
         out.update(self.recovery)
         with self.mu:
             out["tenants_awaiting_resume"] = len(self.recovered)
@@ -1556,6 +1721,9 @@ class RuntimeState:
                 return False
             self.tenants.pop(t.name, None)
             t.chip.scheduler.forget_tenant(t.name)
+            # Flight-recorder rings die with the tenant: a reused name
+            # is a NEW tenant whose histograms must start at zero.
+            self.flight.forget(t.name)
             # Suspension dies with the tenant instance: a redeployed pod
             # reusing the name must not start silently frozen (the only
             # clue would be the admin-side STATS list).
@@ -1849,6 +2017,20 @@ class TenantSession(socketserver.BaseRequestHandler):
                     # broker when the probe HELLO'd chip 0.
                     self._send({"ok": True, "tenants": self._stats(),
                                 "journal": self.state.journal_stats()})
+                    continue
+                if kind == P.TRACE:
+                    # BIND-FREE like STATS (same no-chip-claim
+                    # rationale); on a bound connection it drains
+                    # first so the reply keeps the FIFO contract.
+                    if tenant is not None:
+                        self._drain()
+                    t_arg = msg.get("tenant")
+                    self._send({
+                        "ok": True,
+                        "enabled": self.state.flight.enabled,
+                        "tenants": self.state.flight.snapshot(
+                            tenant=str(t_arg) if t_arg else None,
+                            limit=int(msg.get("limit", 0) or 0))})
                     continue
 
                 if tenant is None:
@@ -2175,6 +2357,17 @@ class TenantSession(socketserver.BaseRequestHandler):
                         [str(x) for x in msg.get("outs", [])],
                         steps=steps, carry=carry,
                         free_ids=[str(f) for f in msg.get("free", ())])
+        tr = msg.get("trace")
+        if isinstance(tr, dict):
+            # Client-stamped trace context (VTPU_TRACE): threads this
+            # request's id through the scheduler into the recorder.
+            tid = tr.get("id")
+            item.trace_id = str(tid) if tid else None
+            try:
+                item.trace_ts = (float(tr["ts"]) if "ts" in tr
+                                 else None)
+            except (TypeError, ValueError):
+                pass
         with self.pending_cond:
             # Backpressure a client that pipelines without reading
             # replies: blocks only THIS connection's reader.
@@ -2250,6 +2443,12 @@ def collect_stats(state: RuntimeState):
                             for k, v in t.cost_ema.items()},
             "recovered": bool(t.recovered),
         }
+        # Flight-recorder rollup (latency histogram, queue/bucket wait
+        # totals): rides on STATS so the metrics server gets per-tenant
+        # latency gauges from its existing admin scrape.
+        tr = state.flight.summary(name)
+        if tr is not None:
+            out[name]["trace"] = tr
     return out
 
 
@@ -2331,6 +2530,16 @@ class AdminSession(socketserver.BaseRequestHandler):
                                 "tenants": collect_stats(self.state),
                                 "suspended": suspended,
                                 "journal": self.state.journal_stats()})
+                elif kind == P.TRACE:
+                    # Host-side flight-recorder read (vtpu-smi trace):
+                    # same body as the tenant-socket verb.
+                    t_arg = msg.get("tenant")
+                    P.send_msg(self.request, {
+                        "ok": True,
+                        "enabled": self.state.flight.enabled,
+                        "tenants": self.state.flight.snapshot(
+                            tenant=str(t_arg) if t_arg else None,
+                            limit=int(msg.get("limit", 0) or 0))})
                 elif kind in (P.DRAIN, P.HANDOVER):
                     # Zero-downtime upgrade: quiesce + final snapshot;
                     # HANDOVER then exits so the supervisor's successor
@@ -2370,6 +2579,9 @@ class _Server(socketserver.ThreadingUnixStreamServer):
         st = getattr(self, "state", None)
         if st is not None:
             st._keeper_stop.set()  # noqa: SLF001 - lifecycle owner
+            # Clean lease release: only removes a sidecar THIS process
+            # wrote, so a co-claimer's forensics stay intact.
+            tracing.clear_lease_sidecar()
         if self.admin_server is not None:
             self.admin_server.shutdown()
         super().shutdown()
@@ -2388,6 +2600,16 @@ def _journal_keeper(state: RuntimeState) -> None:
             state.journal_tick()
         except Exception as e:  # noqa: BLE001 - upkeep must survive
             log.warn("journal keeper: %s", e)
+
+
+def _lease_keeper(state: RuntimeState) -> None:
+    """Heartbeat the chip-lease sidecar while the broker holds the
+    chip: its mtime is the liveness signal the staleness judgment
+    (vtpu-smi leases, bench gate, co-claimer watchdogs) reads.  A
+    SIGKILLed broker stops beating and its sidecar goes stale — exactly
+    the evidence the forensics need."""
+    while not state._keeper_stop.wait(5.0):  # noqa: SLF001
+        tracing.heartbeat_lease_sidecar()
 
 
 def make_server(socket_path: str, hbm_limit: int, core_limit: int,
@@ -2427,6 +2649,8 @@ def make_server(socket_path: str, hbm_limit: int, core_limit: int,
     if jr is not None:
         threading.Thread(target=_journal_keeper, args=(state,),
                          daemon=True, name="vtpu-rt-journal").start()
+    threading.Thread(target=_lease_keeper, args=(state,),
+                     daemon=True, name="vtpu-rt-lease").start()
     handler = type("BoundSession", (TenantSession,), {"state": state})
     srv = _Server(socket_path, handler)
     srv.state = state  # type: ignore[attr-defined]

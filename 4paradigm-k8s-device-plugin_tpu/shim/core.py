@@ -49,6 +49,28 @@ class ProcStats(ctypes.Structure):
 MAX_PROCS = 64
 
 
+class TraceEvent(ctypes.Structure):
+    """Mirror of native vtpu_trace_event (vtpu_core.h)."""
+
+    _fields_ = [
+        ("t_ns", ctypes.c_uint64),
+        ("kind", ctypes.c_uint32),
+        ("dev", ctypes.c_uint32),
+        ("value", ctypes.c_uint64),
+        ("arg", ctypes.c_uint64),
+    ]
+
+
+# Event kinds (vtpu_core.h enum) — the shim/interposer hot-path events.
+TEV_RATE_WAIT = 1   # token-bucket block: value=waited us, arg=cost us
+TEV_MEM_STALL = 2   # mem_acquire refused: value=bytes, arg=limit
+TEV_DISPATCH = 3
+TEV_USER = 16
+
+TEV_NAMES = {TEV_RATE_WAIT: "rate_wait", TEV_MEM_STALL: "mem_stall",
+             TEV_DISPATCH: "dispatch"}
+
+
 def _find_lib() -> str:
     for p in _SEARCH_PATHS:
         if p and os.path.exists(p):
@@ -113,6 +135,34 @@ def load() -> ctypes.CDLL:
                                   ctypes.c_uint64]
     lib.vtpu_region_ndevices.restype = ctypes.c_int
     lib.vtpu_region_ndevices.argtypes = [ctypes.c_void_p]
+    # -- trace event ring (vtpu-trace) --
+    # A host-mounted libvtpucore.so can be OLDER than this shim
+    # (daemonset upgrade skew, explicitly supported elsewhere): missing
+    # trace symbols must degrade to tracing-unavailable, never break
+    # quota enforcement wholesale.
+    try:
+        lib.vtpu_trace_open.restype = ctypes.c_void_p
+        lib.vtpu_trace_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.vtpu_trace_close.argtypes = [ctypes.c_void_p]
+        lib.vtpu_trace_emit.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                        ctypes.c_uint32, ctypes.c_uint64,
+                                        ctypes.c_uint64]
+        lib.vtpu_trace_head.restype = ctypes.c_uint64
+        lib.vtpu_trace_head.argtypes = [ctypes.c_void_p]
+        lib.vtpu_trace_capacity.restype = ctypes.c_uint32
+        lib.vtpu_trace_capacity.argtypes = [ctypes.c_void_p]
+        lib.vtpu_trace_read.restype = ctypes.c_int
+        lib.vtpu_trace_read.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                        ctypes.POINTER(TraceEvent),
+                                        ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_uint64)]
+        lib.vtpu_region_trace_ring.restype = ctypes.c_void_p
+        lib.vtpu_region_trace_ring.argtypes = [ctypes.c_void_p]
+        lib.vtpu_rate_level.restype = ctypes.c_int64
+        lib.vtpu_rate_level.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib._vtpu_has_trace = True
+    except AttributeError:
+        lib._vtpu_has_trace = False
     lib.vtpu_region_active_procs.restype = ctypes.c_int
     lib.vtpu_region_active_procs.argtypes = [ctypes.c_void_p]
     lib.vtpu_core_version.restype = ctypes.c_char_p
@@ -234,6 +284,23 @@ class SharedRegion:
         """Record completed device time (duty-cycle source)."""
         self.lib.vtpu_busy_add(self.handle, dev, int(us))
 
+    def rate_level(self, dev: int) -> int:
+        """Current token-bucket level (us; negative = borrowed) — the
+        slow-op watchdog's "bucket level" context field.  0 when the
+        mounted library predates vtpu-trace."""
+        if not getattr(self.lib, "_vtpu_has_trace", False):
+            return 0
+        return int(self.lib.vtpu_rate_level(self.handle, dev))
+
+    def trace_ring(self) -> "Optional[TraceRing]":
+        """The per-process event ring auto-attached at open when
+        VTPU_TRACE is set (native emits rate waits / mem stalls into
+        it); None when tracing is off or the library predates it."""
+        if not getattr(self.lib, "_vtpu_has_trace", False):
+            return None
+        h = self.lib.vtpu_region_trace_ring(self.handle)
+        return TraceRing._adopt(self.lib, h) if h else None
+
     @property
     def ndevices(self) -> int:
         return self.lib.vtpu_region_ndevices(self.handle)
@@ -241,3 +308,78 @@ class SharedRegion:
     def active_procs(self) -> int:
         """Live registered processes (sweeps dead ones first)."""
         return self.lib.vtpu_region_active_procs(self.handle)
+
+
+class TraceRing:
+    """Lock-free mmap'd per-process trace event ring (vtpu-trace):
+    single writer (the owning process), any number of readers.  The
+    emitting side makes no syscalls — see native/vtpucore/vtpu_core.h.
+    Ring files live next to the accounting region as
+    ``<region>.trace.<pid>``."""
+
+    def __init__(self, path: str, size_kb: int = 0):
+        self.lib = load()
+        if not getattr(self.lib, "_vtpu_has_trace", False):
+            raise OSError(
+                "libvtpucore.so predates vtpu-trace (no vtpu_trace_* "
+                "symbols); redeploy the matching daemonset")
+        self.handle = self.lib.vtpu_trace_open(path.encode(),
+                                               int(size_kb))
+        if not self.handle:
+            raise OSError(f"vtpu_trace_open({path!r}) failed")
+        self.path = path
+        self._owned = True
+
+    @classmethod
+    def _adopt(cls, lib, handle) -> "TraceRing":
+        """Wrap a region-attached native ring WITHOUT owning it (the
+        region close releases it)."""
+        self = cls.__new__(cls)
+        self.lib = lib
+        self.handle = handle
+        self.path = ""
+        self._owned = False
+        return self
+
+    def close(self) -> None:
+        if self._owned and self.handle:
+            self.lib.vtpu_trace_close(self.handle)
+        self.handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def emit(self, kind: int, dev: int = 0, value: int = 0,
+             arg: int = 0) -> None:
+        self.lib.vtpu_trace_emit(self.handle, int(kind), int(dev),
+                                 int(value), int(arg))
+
+    @property
+    def head(self) -> int:
+        return int(self.lib.vtpu_trace_head(self.handle))
+
+    @property
+    def capacity(self) -> int:
+        return int(self.lib.vtpu_trace_capacity(self.handle))
+
+    def read(self, cursor: int = 0, max_events: int = 1024):
+        """Returns (events, next_cursor); each event is a dict with the
+        kind decoded.  Poll with the returned cursor."""
+        buf = (TraceEvent * max_events)()
+        nxt = ctypes.c_uint64(cursor)
+        n = self.lib.vtpu_trace_read(self.handle, int(cursor), buf,
+                                     max_events, ctypes.byref(nxt))
+        out = []
+        for i in range(max(n, 0)):
+            ev = buf[i]
+            out.append({
+                "t_ns": int(ev.t_ns),
+                "kind": TEV_NAMES.get(int(ev.kind), str(int(ev.kind))),
+                "dev": int(ev.dev),
+                "value": int(ev.value),
+                "arg": int(ev.arg),
+            })
+        return out, int(nxt.value)

@@ -137,6 +137,14 @@ class _PyEnforcer:
         self._contention_at = 0.0
         self._contended = True
 
+    def trace_ring(self):
+        """The vtpu-trace per-process event ring (VTPU_TRACE=1), or
+        None.  The native layer auto-attaches it at region open and
+        emits rate-block waits (gate()) and mem-acquire refusals
+        (charge()) into it with no syscalls — this accessor is the
+        read side for introspection (vtpu_smi_lite, tests)."""
+        return self.region.trace_ring()
+
     def _gating_active(self) -> bool:
         """Policy switch (reference GPU_CORE_UTILIZATION_POLICY): DISABLE
         never gates, FORCE always, DEFAULT only under contention."""

@@ -116,6 +116,29 @@ def main(argv=None) -> int:
         except Exception as e:  # noqa: BLE001
             out["region_error"] = str(e)
 
+    # Recent shim-side stall events (vtpu-trace, VTPU_TRACE=1): this
+    # pod's own rate-block waits and memory-acquire refusals from the
+    # native per-process rings next to the region — "am I throttled
+    # RIGHT NOW, and by what" without broker access.
+    if region_path:
+        try:
+            import glob as _glob
+
+            from vtpu.shim.core import TraceRing
+            events = []
+            for rp in sorted(_glob.glob(region_path + ".trace.*")):
+                try:
+                    with TraceRing(rp) as ring:
+                        evs, _ = ring.read(0, 4096)
+                    events.extend(evs[-32:])
+                except OSError:
+                    continue
+            if events:
+                events.sort(key=lambda e: e.get("t_ns", 0))
+                out["trace_events"] = events[-16:]
+        except Exception:  # noqa: BLE001 - forensics must not break smi
+            pass
+
     # Broker view (time-shared grants).
     if spec.runtime_socket and os.path.exists(spec.runtime_socket):
         try:
@@ -191,6 +214,10 @@ def main(argv=None) -> int:
               f"readopted {bj.get('tenants_readopted', 0)}  "
               f"dropped {dropped}"
               f"{'  DRAINING' if bj.get('draining') else ''}")
+    for ev in out.get("trace_events", []):
+        val = (f"{ev['value']}us" if ev["kind"] == "rate_wait"
+               else _fmt_bytes(ev["value"]))
+        print(f"  stall: {ev['kind']} dev {ev['dev']} {val}")
     if "region_error" in out:
         print(f"  (region unavailable: {out['region_error']})")
     if "broker_error" in out:

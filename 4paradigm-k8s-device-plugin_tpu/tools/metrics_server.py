@@ -192,6 +192,25 @@ def broker_prometheus(brokers: List[Dict]) -> str:
         "# TYPE vtpu_tenant_suspended gauge",
         "# HELP vtpu_tenant_executions_total Steps executed per tenant.",
         "# TYPE vtpu_tenant_executions_total counter",
+        # vtpu-trace flight-recorder rollups (docs/TRACING.md): where a
+        # tenant's request time goes — queue vs token bucket vs device —
+        # plus the end-to-end latency histogram.  Only present when the
+        # broker runs with VTPU_TRACE=1.
+        "# HELP vtpu_tenant_latency_us End-to-end broker residency per "
+        "execute (enqueue to device-ready), microseconds.",
+        "# TYPE vtpu_tenant_latency_us histogram",
+        "# HELP vtpu_tenant_queue_wait_us_total Cumulative scheduler-"
+        "queue wait per tenant (microseconds).",
+        "# TYPE vtpu_tenant_queue_wait_us_total counter",
+        "# HELP vtpu_tenant_bucket_wait_us_total Cumulative device-time "
+        "token-bucket wait per tenant (microseconds).",
+        "# TYPE vtpu_tenant_bucket_wait_us_total counter",
+        "# HELP vtpu_tenant_device_us_total Cumulative device-phase "
+        "wall time per tenant (microseconds).",
+        "# TYPE vtpu_tenant_device_us_total counter",
+        "# HELP vtpu_tenant_slow_op_captures Slow-op context captures "
+        "currently held in the flight recorder.",
+        "# TYPE vtpu_tenant_slow_op_captures gauge",
         # Journal health (docs/BROKER_RECOVERY.md): a growing journal
         # with an aging snapshot means compaction stalled; recoveries /
         # readopted / dropped tell operators whether broker restarts
@@ -257,6 +276,33 @@ def broker_prometheus(brokers: List[Dict]) -> str:
                          f'{1 if t.get("suspended") else 0}')
             lines.append(f'vtpu_tenant_executions_total{labels} '
                          f'{t["executions"]}')
+            tr = t.get("trace")
+            if tr:
+                base = labels[1:-1]  # strip braces; le rides alongside
+                cum = 0
+                bounds = tr.get("latency_bounds_us", [])
+                buckets = tr.get("latency_buckets", [])
+                for le, cnt in zip(list(bounds) + ["+Inf"], buckets):
+                    cum += int(cnt)
+                    lines.append(
+                        f'vtpu_tenant_latency_us_bucket{{{base},'
+                        f'le="{le}"}} {cum}')
+                lines.append(f'vtpu_tenant_latency_us_sum{labels} '
+                             f'{tr.get("latency_sum_us", 0)}')
+                lines.append(f'vtpu_tenant_latency_us_count{labels} '
+                             f'{tr.get("latency_count", 0)}')
+                lines.append(
+                    f'vtpu_tenant_queue_wait_us_total{labels} '
+                    f'{tr.get("queue_wait_us_total", 0)}')
+                lines.append(
+                    f'vtpu_tenant_bucket_wait_us_total{labels} '
+                    f'{tr.get("bucket_wait_us_total", 0)}')
+                lines.append(
+                    f'vtpu_tenant_device_us_total{labels} '
+                    f'{tr.get("device_us_total", 0)}')
+                lines.append(
+                    f'vtpu_tenant_slow_op_captures{labels} '
+                    f'{tr.get("slow_captures", 0)}')
     return "\n".join(lines) + "\n" if brokers else ""
 
 

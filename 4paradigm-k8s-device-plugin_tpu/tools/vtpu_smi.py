@@ -13,6 +13,20 @@ region from VTPU_DEVICE_MEMORY_SHARED_CACHE) or on the node against
   vtpu-smi --json               # machine-readable
   vtpu-smi --sweep-host         # reclaim slots of dead host pids (node)
 
+vtpu-trace surfaces (docs/TRACING.md):
+
+  vtpu-smi trace --broker /run/vtpu.sock             # all tenants
+  vtpu-smi trace tenant-a --broker /run/vtpu.sock    # one tenant
+  vtpu-smi trace --broker ... --dump chrome.json     # Chrome/Perfetto
+  vtpu-smi leases               # chip-lease sidecar forensics
+
+``trace`` reads the broker's flight recorder over the BIND-FREE TRACE
+verb on the MAIN socket (no tenant slot, no chip claim — the same
+no-wedge rationale as the STATS probe); ``--dump`` also merges any
+shim-side native ring events found next to the scanned regions.
+``leases`` names the current chip-lease holder (pid, cmdline, stage,
+heartbeat age) and flags stale/dead holders explicitly.
+
 Run as: python -m vtpu.tools.vtpu_smi
 """
 
@@ -135,8 +149,142 @@ def _admin_request(broker_socket: str, msg: dict,
         s.close()
 
 
+def _main_request(broker_socket: str, msg: dict,
+                  timeout: float = 10.0) -> dict:
+    """One BIND-FREE request over the broker's MAIN socket (STATS /
+    TRACE verbs answer without a HELLO, so this can never claim a
+    tenant slot or wedge a chip claim)."""
+    import socket as socketmod
+
+    from ..runtime import protocol as P
+    s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+    s.settimeout(timeout)
+    try:
+        s.connect(broker_socket)
+        P.send_msg(s, msg)
+        return P.recv_msg(s)
+    finally:
+        s.close()
+
+
+def collect_ring_events(paths: List[str]) -> List[dict]:
+    """Shim-side native ring events (rate waits, mem stalls) from the
+    ``<region>.trace.<pid>`` files next to the given regions."""
+    from ..shim.core import TraceRing
+    out: List[dict] = []
+    for rp in paths:
+        for ring_path in sorted(glob.glob(rp + ".trace.*")):
+            try:
+                pid = int(ring_path.rsplit(".", 1)[-1])
+            except ValueError:
+                pid = 0
+            try:
+                with TraceRing(ring_path) as ring:
+                    evs, _ = ring.read(0, 4096)
+            except OSError as e:
+                print(f"skipping ring {ring_path}: {e}", file=sys.stderr)
+                continue
+            for ev in evs:
+                ev["pid"] = pid
+                ev["ring"] = ring_path
+            out.extend(evs)
+    out.sort(key=lambda e: e.get("t_ns", 0))
+    return out
+
+
+def cmd_trace(ns, paths: List[str]) -> int:
+    """`vtpu-smi trace [TENANT]`: flight-recorder spans + slow-op
+    captures, human or --json, --dump FILE for Chrome/Perfetto."""
+    from ..runtime import protocol as P
+    from ..runtime import trace as tracing
+    if not ns.broker:
+        print("trace needs --broker <main socket>", file=sys.stderr)
+        return 2
+    msg: dict = {"kind": P.TRACE}
+    if ns.cmd_arg:
+        msg["tenant"] = ns.cmd_arg
+    if ns.limit:
+        msg["limit"] = ns.limit
+    resp = _main_request(ns.broker, msg)
+    if not resp.get("ok"):
+        print(json.dumps(resp, indent=2))
+        return 1
+    tenants = resp.get("tenants", {})
+    if ns.dump:
+        ring_events = collect_ring_events(paths)
+        doc = tracing.chrome_trace(tenants, ring_events)
+        with open(ns.dump, "w") as f:
+            json.dump(doc, f)
+        print(f"wrote {len(doc['traceEvents'])} trace events to "
+              f"{ns.dump} (load in chrome://tracing or Perfetto)")
+        return 0
+    if ns.json:
+        print(json.dumps(resp, indent=2))
+        return 0
+    if not resp.get("enabled"):
+        print("tracing is disabled on this broker (set VTPU_TRACE=1)")
+    for name, body in sorted(tenants.items()):
+        spans = body.get("spans", [])
+        caps = body.get("captures", [])
+        print(f"tenant {name}: {len(spans)} spans, "
+              f"{len(caps)} slow-op captures")
+        for s in spans[-(ns.limit or 10):]:
+            print(f"  {s.get('trace', '-'):>16} {s.get('key', '?'):<12}"
+                  f" queue {s.get('queue_us', 0):>9.0f}us"
+                  f" bucket {s.get('bucket_us', 0):>9.0f}us"
+                  f" device {s.get('device_us', 0):>9.0f}us"
+                  f" total {s.get('total_us', 0):>9.0f}us"
+                  + (" ERROR" if s.get("error") else ""))
+        for cap in caps[-3:]:
+            ctx = cap.get("context", {})
+            print(f"  SLOW {cap.get('factor')}x est "
+                  f"{cap.get('est_us')}us: qdepth="
+                  f"{ctx.get('queue_depth')} bucket="
+                  f"{ctx.get('bucket_level_us')}us hbm_free="
+                  f"{ctx.get('hbm_headroom_bytes')} co="
+                  f"{','.join(ctx.get('co_tenants', [])) or '-'}")
+    return 0
+
+
+def cmd_leases(ns) -> int:
+    """`vtpu-smi leases`: chip-lease sidecar forensics — who holds (or
+    last held) each chip lease, liveness, heartbeat age."""
+    from ..runtime import trace as tracing
+    lease_paths = ns.lease_file or [tracing.lease_sidecar_path()]
+    out = []
+    for p in lease_paths:
+        diag = tracing.diagnose_lease(p)
+        diag["sidecar"] = p
+        out.append(diag)
+    if ns.json:
+        print(json.dumps(out, indent=2))
+    else:
+        for diag in out:
+            print(f"{diag['sidecar']}: "
+                  f"{tracing.format_lease_diagnosis(diag)}")
+    # Non-zero when a stale lease is blocking the chip: scripts (and the
+    # bench gate) can branch on it.
+    return 1 if any(d.get("stale") for d in out) else 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
+    ap.add_argument("cmd", nargs="?", default=None,
+                    choices=("trace", "leases"),
+                    help="trace: flight-recorder spans (needs "
+                         "--broker; --dump FILE exports Chrome-trace "
+                         "JSON); leases: chip-lease sidecar forensics")
+    ap.add_argument("cmd_arg", nargs="?", default=None,
+                    help="tenant name for `trace`")
+    ap.add_argument("--dump", default=None, metavar="FILE",
+                    help="with `trace`: write Chrome-trace/Perfetto "
+                         "JSON (broker spans + shim ring events)")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="with `trace`: newest N spans per tenant")
+    ap.add_argument("--lease-file", action="append", default=[],
+                    metavar="PATH",
+                    help="with `leases`: explicit sidecar path(s); "
+                         "default VTPU_LEASE_SIDECAR")
     ap.add_argument("--scan", default=None,
                     help="directory of per-pod shared regions (node mode)")
     ap.add_argument("--region", action="append", default=[],
@@ -163,6 +311,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "the supervisor's successor recovers the "
                          "journal (zero-downtime upgrade)")
     ns = ap.parse_args(argv)
+
+    if ns.cmd == "leases":
+        return cmd_leases(ns)
+    if ns.cmd == "trace":
+        return cmd_trace(ns, ns.region or find_regions(ns.scan))
 
     admin_verbs = (ns.suspend or ns.resume or ns.broker_stats
                    or ns.drain or ns.handover)

@@ -166,17 +166,26 @@ def run_direct(steps: int, warmup: int, cfg_name: str, batch: int,
     broker tenants actually run."""
     import jax
 
+    from vtpu.runtime import trace as tracing
+
     if quick:
         # CPU smoke must not claim the real chip.
         try:
             jax.config.update("jax_platforms", "cpu")
         except RuntimeError:
             pass
-    plain = _direct_loop(steps, warmup, cfg_name, batch, seq, reps)
-    chain = 2 if steps < 16 else int(os.environ.get("VTPU_BENCH_CHAIN",
-                                                    "10"))
-    chained = _direct_chained_loop(steps, warmup, cfg_name, batch, seq,
-                                   max(reps - 1, 1), chain)
+    # Chip-lease forensics: the direct phase IS a whole-chip claimer —
+    # announce it, so if THIS process wedges or gets SIGKILLed, the
+    # next gate/watchdog names it instead of guessing.
+    tracing.write_lease_sidecar("bench direct phase")
+    try:
+        plain = _direct_loop(steps, warmup, cfg_name, batch, seq, reps)
+        chain = 2 if steps < 16 else int(os.environ.get(
+            "VTPU_BENCH_CHAIN", "10"))
+        chained = _direct_chained_loop(steps, warmup, cfg_name, batch,
+                                       seq, max(reps - 1, 1), chain)
+    finally:
+        tracing.clear_lease_sidecar()
     q.put(("direct", {"plain": plain, "chained": chained}))
 
 
@@ -726,12 +735,23 @@ print("CHIP_CLAIMABLE")
 
 
 def wait_chip_claimable(max_wait_s=None):
-    """Gate the run on the chip actually being claimable.  A stale
-    lease (a SIGKILLed previous holder on the relayed transport) makes
-    EVERY claim block indefinitely with no error; without this gate the
-    first direct phase sits in q.get for its full hour-scale timeout.
-    Patient by design: leases can settle minutes after the holder dies,
-    and a fresh-process probe is cheap relative to the run it guards."""
+    """Gate the run on the chip actually being claimable, and when it
+    is not, NAME the culprit from the chip-lease sidecar
+    (vtpu.runtime.trace) instead of burning the whole wait budget on
+    "lease held elsewhere?" (the BENCH_r05 failure mode: 900 silent
+    seconds, no holder, no pid).
+
+    Fail-fast contract:
+      - sidecar names a LIVE holder -> the lease will NOT settle while
+        they run; raise immediately with pid/cmdline/heartbeat age so
+        the harness (or operator) can reap the right process;
+      - sidecar names a DEAD/stale holder -> the driver-side lease may
+        still settle (leases release minutes after a SIGKILL on relayed
+        transports): keep probing up to max_wait_s, printing the
+        diagnosis each attempt;
+      - no sidecar -> legacy patience (the holder predates vtpu-trace
+        or claims from another container)."""
+    from vtpu.runtime import trace as tracing
     if max_wait_s is None:
         try:
             max_wait_s = float(
@@ -761,12 +781,22 @@ def wait_chip_claimable(max_wait_s=None):
                 p.kill()
                 p.communicate(timeout=10)
             err = "probe timed out (chip lease held elsewhere?)"
+        diag = tracing.diagnose_lease(exclude_pid=os.getpid())
+        diagnosis = tracing.format_lease_diagnosis(diag)
         waited = time.monotonic() - t0
         print(f"[bench] chip probe {attempt} failed after "
-              f"{waited:.0f}s: {err}", file=sys.stderr)
+              f"{waited:.0f}s: {err}; {diagnosis}", file=sys.stderr)
+        if diag.get("present") and diag.get("alive") \
+                and not diag.get("stale"):
+            # A live, heartbeating holder will not release the lease by
+            # itself — waiting out the budget would just burn it.
+            raise RuntimeError(
+                f"chip not claimable: {diagnosis} (fail-fast: holder "
+                f"is live; reap it or wait for its run to finish)")
         if waited > max_wait_s:
             raise RuntimeError(
-                f"chip not claimable after {max_wait_s}s: {err}")
+                f"chip not claimable after {max_wait_s}s: {err}; "
+                f"{diagnosis}")
         time.sleep(20.0)
 
 

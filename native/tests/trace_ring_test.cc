@@ -1,0 +1,180 @@
+/* trace_ring_test — standalone smoke test for the lock-free trace event
+ * ring (vtpu_trace_*): capacity rounding, wrap/overflow semantics,
+ * cursor resume, reopen persistence, and torn-write safety under a
+ * concurrent writer (run under ASan+UBSan in CI).
+ *
+ * Usage: trace_ring_test <scratch-dir>
+ */
+#include <assert.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include "../vtpucore/vtpu_core.h"
+
+static char g_path[512];
+
+static void test_basic_and_wrap(void) {
+  char path[560];
+  snprintf(path, sizeof(path), "%s.basic", g_path);
+  vtpu_trace_ring* t = vtpu_trace_open(path, 1); /* tiny: 64 entries */
+  assert(t);
+  uint32_t cap = vtpu_trace_capacity(t);
+  assert(cap == 64);
+  /* Overfill 3x: only the newest `cap` events stay readable. */
+  for (uint64_t i = 0; i < (uint64_t)cap * 3; i++)
+    vtpu_trace_emit(t, VTPU_TEV_RATE_WAIT, 2, i, i + 1);
+  assert(vtpu_trace_head(t) == (uint64_t)cap * 3);
+  vtpu_trace_event evs[256];
+  uint64_t next = 0;
+  int n = vtpu_trace_read(t, 0, evs, 256, &next);
+  assert(n == (int)cap);
+  assert(next == (uint64_t)cap * 3);
+  for (int i = 0; i < n; i++) {
+    assert(evs[i].kind == VTPU_TEV_RATE_WAIT);
+    assert(evs[i].dev == 2);
+    assert(evs[i].arg == evs[i].value + 1); /* payload never torn */
+    assert(evs[i].value == (uint64_t)cap * 2 + (uint64_t)i);
+  }
+  /* Cursor resume: nothing new -> 0 events, cursor unchanged. */
+  n = vtpu_trace_read(t, next, evs, 256, &next);
+  assert(n == 0);
+  vtpu_trace_emit(t, VTPU_TEV_MEM_STALL, 0, 7, 8);
+  n = vtpu_trace_read(t, next, evs, 256, &next);
+  assert(n == 1 && evs[0].kind == VTPU_TEV_MEM_STALL && evs[0].value == 7);
+  vtpu_trace_close(t);
+  /* Reopen: head and events persist in the file. */
+  t = vtpu_trace_open(path, 1);
+  assert(t && vtpu_trace_head(t) == (uint64_t)cap * 3 + 1);
+  vtpu_trace_close(t);
+}
+
+typedef struct {
+  const char* path;
+  volatile int stop;
+} WriterArgs;
+
+static void* writer_main(void* arg) {
+  WriterArgs* wa = (WriterArgs*)arg;
+  vtpu_trace_ring* t = vtpu_trace_open(wa->path, 1);
+  assert(t);
+  uint64_t i = 0;
+  while (!wa->stop) {
+    /* Invariant the reader checks: arg == value * 3 + 1.  A torn read
+     * accepted as valid would break it. */
+    vtpu_trace_emit(t, VTPU_TEV_USER, (uint32_t)(i & 7), i, i * 3 + 1);
+    i++;
+    /* Brief quiescent window every few thousand emits: the reader is
+     * guaranteed SOME accepted slots (determinism) while the spin in
+     * between keeps maximal wrap pressure on the seqlock. */
+    if ((i & 0xfff) == 0) usleep(50);
+  }
+  vtpu_trace_close(t);
+  return NULL;
+}
+
+static void test_concurrent_torn_write_safety(void) {
+  char path[576];
+  snprintf(path, sizeof(path), "%s.conc", g_path);
+  WriterArgs wa;
+  wa.path = path;
+  wa.stop = 0;
+  /* TWO concurrent writer threads: emits race on the fetch_add slot
+   * claim (JAX processes emit from multiple threads; a read-then-store
+   * head would interleave payloads under a valid seq). */
+  pthread_t th, th2;
+  pthread_create(&th, NULL, writer_main, &wa);
+  pthread_create(&th2, NULL, writer_main, &wa);
+  /* Reader races the wrapping writer: every ACCEPTED event must be
+   * internally consistent; skipped (torn) slots are fine. */
+  vtpu_trace_ring* t = NULL;
+  while (!t) t = vtpu_trace_open(path, 1);
+  /* Wait for the writer thread to actually produce before racing it
+   * (scheduling may delay its first emit past our whole read loop). */
+  for (int spin = 0; spin < 20000 && vtpu_trace_head(t) == 0; spin++)
+    usleep(100);
+  assert(vtpu_trace_head(t) > 0);
+  uint64_t cursor = 0;
+  uint64_t accepted = 0;
+  /* Phase A — race the live writer: every ACCEPTED event must be
+   * internally consistent; how many get accepted vs skipped (torn by
+   * the wrap) is timing-dependent and deliberately unchecked. */
+  for (int round = 0; round < 50000; round++) {
+    uint64_t head = vtpu_trace_head(t);
+    if (head > 8 && head - 8 > cursor) cursor = head - 8;
+    vtpu_trace_event evs[32];
+    uint64_t next = cursor;
+    int n = vtpu_trace_read(t, cursor, evs, 32, &next);
+    for (int i = 0; i < n; i++) {
+      assert(evs[i].kind == VTPU_TEV_USER);
+      assert(evs[i].arg == evs[i].value * 3 + 1);
+      assert(evs[i].dev == (uint32_t)(evs[i].value & 7));
+    }
+    accepted += (uint64_t)n;
+    assert(next >= cursor);
+    cursor = next;
+  }
+  /* Phase B — writer stopped (joined): the ring is single-writer again
+   * from this thread's handle, so appended events MUST be readable —
+   * deterministic read-path coverage independent of phase A timing. */
+  wa.stop = 1;
+  pthread_join(th, NULL);
+  pthread_join(th2, NULL);
+  uint64_t base = vtpu_trace_head(t);
+  for (uint64_t i = 0; i < 8; i++)
+    vtpu_trace_emit(t, VTPU_TEV_USER, (uint32_t)(i & 7), i, i * 3 + 1);
+  vtpu_trace_event evs[64];
+  uint64_t next = 0;
+  int n = vtpu_trace_read(t, base, evs, 64, &next);
+  assert(n == 8);
+  for (int i = 0; i < n; i++) {
+    assert(evs[i].kind == VTPU_TEV_USER);
+    assert(evs[i].arg == evs[i].value * 3 + 1);
+  }
+  assert(next == vtpu_trace_head(t));
+  (void)accepted;
+  vtpu_trace_close(t);
+}
+
+static void test_region_autoattach(void) {
+  char rpath[576];
+  snprintf(rpath, sizeof(rpath), "%s.region", g_path);
+  setenv("VTPU_TRACE", "1", 1);
+  setenv("VTPU_TRACE_RING_KB", "1", 1);
+  uint64_t limits[1] = {1000};
+  int32_t pcts[1] = {0};
+  vtpu_region* r = vtpu_region_open(rpath, 1, limits, pcts);
+  assert(r);
+  vtpu_trace_ring* t = vtpu_region_trace_ring(r);
+  assert(t && "VTPU_TRACE=1 must auto-attach a ring");
+  assert(vtpu_proc_register(r, 0) >= 0);
+  /* A refused acquire emits MEM_STALL into the attached ring. */
+  assert(vtpu_mem_acquire(r, 0, 4000, 0) != 0);
+  vtpu_trace_event evs[8];
+  uint64_t next = 0;
+  int n = vtpu_trace_read(t, 0, evs, 8, &next);
+  assert(n >= 1);
+  int found = 0;
+  for (int i = 0; i < n; i++)
+    if (evs[i].kind == VTPU_TEV_MEM_STALL && evs[i].value == 4000 &&
+        evs[i].arg == 1000)
+      found = 1;
+  assert(found);
+  assert(vtpu_rate_level(r, 0) != 0); /* bucket starts at the burst cap */
+  vtpu_region_close(r);
+  unsetenv("VTPU_TRACE");
+  unsetenv("VTPU_TRACE_RING_KB");
+}
+
+int main(int argc, char** argv) {
+  const char* dir = argc > 1 ? argv[1] : "/tmp";
+  snprintf(g_path, sizeof(g_path), "%s/vtpu_trace_test_%d", dir,
+           (int)getpid());
+  test_region_autoattach();
+  test_concurrent_torn_write_safety();
+  test_basic_and_wrap();
+  printf("trace_ring_test OK\n");
+  return 0;
+}

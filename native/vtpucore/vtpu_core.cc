@@ -105,7 +105,216 @@ struct vtpu_region {
   Region* shm;
   int fd;
   int my_slot;
+  vtpu_trace_ring* trace; /* auto-attached ring (VTPU_TRACE), else NULL */
 };
+
+/* ---- trace event ring ---------------------------------------------------
+ * Separate mmap'd file (never part of the Region layout, so the region
+ * version stays untouched).  Single writer per ring; see header. */
+
+typedef struct {
+  uint64_t seq; /* 0 = invalid/in-progress, else index+1 (published) */
+  vtpu_trace_event ev;
+} TraceSlot;
+
+typedef struct {
+  uint32_t magic;
+  uint32_t version;
+  uint32_t capacity; /* entries, power of two */
+  uint32_t pad_;
+  uint64_t head; /* total events ever written */
+  TraceSlot slots[]; /* capacity entries */
+} TraceShm;
+
+#define VTPU_TRACE_MAGIC 0x76545254u /* "vTRT" */
+#define VTPU_TRACE_VERSION 1u
+
+struct vtpu_trace_ring {
+  TraceShm* shm;
+  size_t map_len;
+  int fd;
+  pid_t owner; /* emitting pid (fork safety: child must not co-write) */
+};
+
+static uint64_t wall_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+vtpu_trace_ring* vtpu_trace_open(const char* path, uint32_t size_kb) {
+  if (size_kb == 0) size_kb = 64;
+  uint32_t cap = 64;
+  while ((uint64_t)cap * 2 * sizeof(TraceSlot) <=
+         (uint64_t)size_kb * 1024ull &&
+         cap < (1u << 24))
+    cap *= 2;
+  int fd = open(path, O_RDWR | O_CREAT, 0666);
+  if (fd < 0) return NULL;
+  if (flock(fd, LOCK_EX) != 0) {
+    close(fd);
+    return NULL;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  size_t want = sizeof(TraceShm) + (size_t)cap * sizeof(TraceSlot);
+  int fresh = st.st_size < (off_t)sizeof(TraceShm);
+  size_t map_len = fresh ? want : (size_t)st.st_size;
+  if (fresh && ftruncate(fd, (off_t)want) != 0) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  TraceShm* shm = (TraceShm*)mmap(NULL, map_len, PROT_READ | PROT_WRITE,
+                                  MAP_SHARED, fd, 0);
+  if (shm == MAP_FAILED) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  if (!fresh && shm->magic != VTPU_TRACE_MAGIC && map_len < want) {
+    /* Wrong-magic leftover SMALLER than one full ring: reinitialising
+     * in place would stamp capacity=cap over a mapping that cannot
+     * hold it — the first emit past the file tail would SIGBUS.  Grow
+     * the file and remap before adopting it (under the flock). */
+    munmap(shm, map_len);
+    if (ftruncate(fd, (off_t)want) != 0) {
+      flock(fd, LOCK_UN);
+      close(fd);
+      return NULL;
+    }
+    map_len = want;
+    shm = (TraceShm*)mmap(NULL, map_len, PROT_READ | PROT_WRITE,
+                          MAP_SHARED, fd, 0);
+    if (shm == MAP_FAILED) {
+      flock(fd, LOCK_UN);
+      close(fd);
+      return NULL;
+    }
+  }
+  if (fresh || shm->magic != VTPU_TRACE_MAGIC) {
+    memset(shm, 0, sizeof(TraceShm));
+    shm->capacity = cap;
+    shm->version = VTPU_TRACE_VERSION;
+    __sync_synchronize();
+    shm->magic = VTPU_TRACE_MAGIC;
+  } else if (shm->version != VTPU_TRACE_VERSION ||
+             shm->capacity == 0 ||
+             (shm->capacity & (shm->capacity - 1)) != 0 ||
+             sizeof(TraceShm) + (size_t)shm->capacity * sizeof(TraceSlot) >
+                 map_len) {
+    /* Foreign/corrupt layout: refuse rather than scribble. */
+    flock(fd, LOCK_UN);
+    munmap(shm, map_len);
+    close(fd);
+    errno = EPROTO;
+    return NULL;
+  }
+  flock(fd, LOCK_UN);
+  vtpu_trace_ring* t = (vtpu_trace_ring*)calloc(1, sizeof(*t));
+  if (!t) {
+    munmap(shm, map_len);
+    close(fd);
+    return NULL;
+  }
+  t->shm = shm;
+  t->map_len = map_len;
+  t->fd = fd;
+  t->owner = getpid();
+  return t;
+}
+
+void vtpu_trace_close(vtpu_trace_ring* t) {
+  if (!t) return;
+  munmap(t->shm, t->map_len);
+  close(t->fd);
+  free(t);
+}
+
+void vtpu_trace_emit(vtpu_trace_ring* t, uint32_t kind, uint32_t dev,
+                     uint64_t value, uint64_t arg) {
+  if (!t || t->owner != getpid()) return; /* forked child: own ring only */
+  TraceShm* s = t->shm;
+  /* Claim a unique slot with fetch_add: "single writer" means single
+   * PROCESS, but that process is multi-threaded (JAX is; rate_block and
+   * mem_acquire emit outside the region lock).  A relaxed read-then-
+   * store would let two threads claim the same index and interleave
+   * payloads under a valid seq. */
+  uint64_t idx = __atomic_fetch_add(&s->head, 1, __ATOMIC_ACQ_REL);
+  TraceSlot* slot = &s->slots[idx & (s->capacity - 1)];
+  /* Seqlock publish: invalidate, store-store barrier, fill, barrier,
+   * publish.  The explicit release FENCES are load-bearing — a release
+   * STORE only orders prior accesses, so without the first fence the
+   * payload stores could become visible before the invalidation and a
+   * wrap-racing reader on a weakly-ordered CPU (arm64) could accept a
+   * torn payload (the Linux write_seqcount_begin/end shape). */
+  __atomic_store_n(&slot->seq, 0, __ATOMIC_RELAXED);
+  __atomic_thread_fence(__ATOMIC_RELEASE);
+  slot->ev.t_ns = wall_ns();
+  slot->ev.kind = kind;
+  slot->ev.dev = dev;
+  slot->ev.value = value;
+  slot->ev.arg = arg;
+  __atomic_thread_fence(__ATOMIC_RELEASE);
+  __atomic_store_n(&slot->seq, idx + 1, __ATOMIC_RELEASE);
+}
+
+uint64_t vtpu_trace_head(vtpu_trace_ring* t) {
+  return t ? __atomic_load_n(&t->shm->head, __ATOMIC_ACQUIRE) : 0;
+}
+
+uint32_t vtpu_trace_capacity(vtpu_trace_ring* t) {
+  return t ? t->shm->capacity : 0;
+}
+
+int vtpu_trace_read(vtpu_trace_ring* t, uint64_t from,
+                    vtpu_trace_event* out, int max, uint64_t* next) {
+  if (!t || !out || max <= 0) {
+    if (next) *next = from;
+    return 0;
+  }
+  TraceShm* s = t->shm;
+  uint64_t head = __atomic_load_n(&s->head, __ATOMIC_ACQUIRE);
+  uint64_t lo = head > s->capacity ? head - s->capacity : 0;
+  if (from < lo) from = lo; /* overwritten: resume at oldest readable */
+  int n = 0;
+  while (from < head && n < max) {
+    TraceSlot* slot = &s->slots[from & (s->capacity - 1)];
+    uint64_t seq = __atomic_load_n(&slot->seq, __ATOMIC_ACQUIRE);
+    if (seq == from + 1) {
+      vtpu_trace_event ev = slot->ev;
+      __atomic_thread_fence(__ATOMIC_ACQUIRE);
+      /* Seqlock re-check: the copy is valid only if the slot was not
+       * re-entered (wrap) mid-copy. */
+      if (__atomic_load_n(&slot->seq, __ATOMIC_ACQUIRE) == from + 1)
+        out[n++] = ev;
+    }
+    from++;
+  }
+  if (next) *next = from;
+  return n;
+}
+
+vtpu_trace_ring* vtpu_region_trace_ring(vtpu_region* r) {
+  return r ? r->trace : NULL;
+}
+
+/* Auto-attach a per-process ring next to the region file when tracing
+ * is on: "<region>.trace.<pid>", sized VTPU_TRACE_RING_KB (default
+ * 64).  Unmodified containers get hot-path events for free. */
+static vtpu_trace_ring* trace_attach(const char* region_path) {
+  const char* on = getenv("VTPU_TRACE");
+  if (!on || !*on || strcmp(on, "0") == 0) return NULL;
+  const char* kb_s = getenv("VTPU_TRACE_RING_KB");
+  uint32_t kb = kb_s && *kb_s ? (uint32_t)strtoul(kb_s, NULL, 10) : 0;
+  char path[512];
+  snprintf(path, sizeof(path), "%s.trace.%d", region_path, (int)getpid());
+  return vtpu_trace_open(path, kb);
+}
 
 static uint64_t now_ns(void) {
   struct timespec ts;
@@ -395,6 +604,7 @@ vtpu_region* vtpu_region_open_versioned(const char* path, int ndevices,
   r->shm = g;
   r->fd = fd;
   r->my_slot = -1;
+  r->trace = trace_attach(path);
   track_region(r);
   return r;
 }
@@ -402,6 +612,7 @@ vtpu_region* vtpu_region_open_versioned(const char* path, int ndevices,
 void vtpu_region_close(vtpu_region* r) {
   if (!r) return;
   untrack_region(r);
+  if (r->trace) vtpu_trace_close(r->trace);
   munmap(r->shm, sizeof(Region));
   close(r->fd);
   free(r);
@@ -509,6 +720,8 @@ int vtpu_mem_acquire(vtpu_region* r, int dev, uint64_t bytes,
     if (ds->used_bytes + bytes > ds->limit_bytes) {
       uint64_t used = ds->used_bytes, lim = ds->limit_bytes;
       unlock_region(g);
+      vtpu_trace_emit(r->trace, VTPU_TEV_MEM_STALL, (uint32_t)dev, bytes,
+                      lim);
       fprintf(stderr, "[vtpucore] device %d OOM: requested %llu, used %llu"
               " / limit %llu\n", dev, (unsigned long long)bytes,
               (unsigned long long)used, (unsigned long long)lim);
@@ -759,14 +972,36 @@ void vtpu_rate_adjust(vtpu_region* r, int dev, int64_t delta_us) {
 
 void vtpu_rate_block(vtpu_region* r, int dev, uint64_t cost_us,
                      int priority) {
+  uint64_t waited_ns = 0;
   for (;;) {
     uint64_t wait_ns = vtpu_rate_acquire(r, dev, cost_us, priority);
-    if (wait_ns == 0) return;
+    if (wait_ns == 0) break;
+    waited_ns += wait_ns;
     struct timespec ts;
     ts.tv_sec = (time_t)(wait_ns / 1000000000ull);
     ts.tv_nsec = (long)(wait_ns % 1000000000ull);
     nanosleep(&ts, NULL);
   }
+  /* Only throttled acquires emit: the common un-throttled call stays
+   * store-free on the trace path too. */
+  if (waited_ns)
+    vtpu_trace_emit(r->trace, VTPU_TEV_RATE_WAIT, (uint32_t)dev,
+                    waited_ns / 1000ull, cost_us);
+}
+
+int64_t vtpu_rate_level(vtpu_region* r, int dev) {
+  Region* g = r->shm;
+  if (dev < 0 || dev >= g->ndevices) return 0;
+  if (lock_region(g) != 0) return 0;
+  DeviceState* ds = &g->dev[dev];
+  /* Refresh before reading so an idle bucket reports its refilled
+   * level, not a stale pre-idle balance. */
+  int32_t pct = ds->core_limit_pct;
+  if (pct > 0 && pct < 100)
+    refill_locked(ds, effective_pct_locked(g, ds, now_ns()), now_ns());
+  int64_t level = ds->tokens_us;
+  unlock_region(g);
+  return level;
 }
 
 void vtpu_busy_add(vtpu_region* r, int dev, uint64_t us) {

@@ -183,6 +183,73 @@ void vtpu_reset_slot(vtpu_region* r, int dev);
  * source for monitors. */
 void vtpu_busy_add(vtpu_region* r, int dev, uint64_t us);
 
+/* ---- trace event ring (vtpu-trace) -------------------------------------- */
+
+/* Lock-free mmap'd per-process event ring: the hot-path half of the
+ * vtpu-trace subsystem (runtime/trace.py).  Each enforced process owns
+ * ONE ring file (single writer); readers (vtpu-smi, the broker, the
+ * metrics server) attach read-only and merge.  Emitting is wait-free
+ * and makes NO syscalls — three atomic stores into the mapping — so
+ * unmodified containers contribute rate-block waits and memory-acquire
+ * stalls with no measurable overhead on the dispatch path.
+ *
+ * Torn-write safety is a per-slot seqlock: the writer invalidates the
+ * slot (seq=0), fills the payload, then publishes seq=index+1 with
+ * release ordering; a reader accepts a slot only when seq reads
+ * index+1 both before AND after the copy.  On wrap the oldest events
+ * are overwritten; readers detect the loss via the head counter. */
+
+typedef struct vtpu_trace_ring vtpu_trace_ring;
+
+typedef struct {
+  uint64_t t_ns;     /* CLOCK_REALTIME ns (cross-process mergeable) */
+  uint32_t kind;     /* VTPU_TEV_* */
+  uint32_t dev;      /* device/tenant-slot index the event concerns */
+  uint64_t value;    /* kind-specific magnitude (wait us, bytes, ...) */
+  uint64_t arg;      /* kind-specific extra (cost us, limit, ...) */
+} vtpu_trace_event;
+
+enum {
+  VTPU_TEV_RATE_WAIT = 1, /* token-bucket block: value=waited us, arg=cost us */
+  VTPU_TEV_MEM_STALL = 2, /* mem_acquire refused: value=bytes, arg=limit */
+  VTPU_TEV_DISPATCH = 3,  /* generic dispatch marker (python emitters) */
+  VTPU_TEV_USER = 16,     /* first kind free for python-level emitters */
+};
+
+/* Open (create if absent) a ring at `path` sized `size_kb` KiB of
+ * payload (rounded up to a power-of-two entry count, min 64 entries;
+ * 0 -> 64 KiB).  An existing file keeps its size.  Returns NULL on
+ * error (errno set). */
+vtpu_trace_ring* vtpu_trace_open(const char* path, uint32_t size_kb);
+void vtpu_trace_close(vtpu_trace_ring* t);
+
+/* Append one event (single-writer rings: only the creating process may
+ * emit).  Wait-free, no syscalls. */
+void vtpu_trace_emit(vtpu_trace_ring* t, uint32_t kind, uint32_t dev,
+                     uint64_t value, uint64_t arg);
+
+/* Total events ever written (monotonic; head - capacity is the oldest
+ * still-readable index). */
+uint64_t vtpu_trace_head(vtpu_trace_ring* t);
+uint32_t vtpu_trace_capacity(vtpu_trace_ring* t);
+
+/* Copy events [from, head) into `out` (at most `max`).  Skips slots
+ * torn by a concurrent wrap.  Returns the number copied and sets
+ * *next to the cursor to resume from (callers poll with it). */
+int vtpu_trace_read(vtpu_trace_ring* t, uint64_t from,
+                    vtpu_trace_event* out, int max, uint64_t* next);
+
+/* The ring auto-attached to a region at vtpu_region_open when
+ * VTPU_TRACE is set (file: "<region path>.trace.<pid>", size
+ * VTPU_TRACE_RING_KB): rate_block waits and mem_acquire refusals emit
+ * into it.  NULL when tracing is off. */
+vtpu_trace_ring* vtpu_region_trace_ring(vtpu_region* r);
+
+/* Current token-bucket level of `dev` in microseconds (may be negative:
+ * borrowed/indebted).  Observability only — the slow-op watchdog's
+ * "bucket level" context field. */
+int64_t vtpu_rate_level(vtpu_region* r, int dev);
+
 /* ---- introspection ----------------------------------------------------- */
 
 int vtpu_region_ndevices(vtpu_region* r);

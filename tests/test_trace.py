@@ -1,0 +1,521 @@
+"""vtpu-trace subsystem tests (ISSUE 2): trace-context propagation
+client -> broker -> flight recorder, native ring-buffer wrap/overflow
+and torn-write safety, slow-op auto-capture, lease-sidecar staleness
+forensics, the bind-free TRACE verb, Chrome-trace export, the bench
+gate's fail-fast lease diagnosis, and the claim watchdog's journal
+wedge record."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vtpu.runtime import protocol as P
+from vtpu.runtime import trace as tracing
+from vtpu.runtime.client import RuntimeClient
+from vtpu.runtime.journal import Journal
+from vtpu.runtime.server import make_server, wedge_report
+from vtpu.shim.core import (TEV_MEM_STALL, TEV_RATE_WAIT, SharedRegion,
+                            TraceRing)
+
+MB = 10**6
+
+
+@pytest.fixture()
+def traced_env(tmp_path, monkeypatch):
+    """Tracing on, with a test-local lease sidecar so parallel tests
+    (and other suites' brokers) never share forensics state."""
+    monkeypatch.setenv("VTPU_TRACE", "1")
+    monkeypatch.setenv("VTPU_LEASE_SIDECAR",
+                       str(tmp_path / "lease.json"))
+    return tmp_path
+
+
+@pytest.fixture()
+def traced_broker(traced_env):
+    sock = str(traced_env / "rt.sock")
+    srv = make_server(sock, hbm_limit=64 * MB, core_limit=0,
+                      region_path=str(traced_env / "rt.shr"))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield sock, srv
+    srv.shutdown()
+    srv.server_close()
+
+
+# -- trace-context propagation (client -> broker -> recorder) ------------
+
+
+def test_trace_context_propagates_end_to_end(traced_broker):
+    sock, srv = traced_broker
+    c = RuntimeClient(sock, tenant="traced")
+    exe = c.compile(lambda a: a * 2.0, [np.ones((64, 64), np.float32)])
+    h = c.put(np.ones((64, 64), np.float32))
+    # The EXECUTE's stamp is the one that lands in the span; capture it
+    # via last_trace_id (stamped at send time).
+    exe(h)
+    exec_trace_id = c.last_trace_id
+    assert exec_trace_id and len(exec_trace_id) == 16
+    c.stats()  # sync: quiesces the tenant so the span is retired
+    tr = c.trace(tenant="traced")
+    assert tr["enabled"]
+    spans = tr["tenants"]["traced"]["spans"]
+    assert spans, "execute must have produced a flight-recorder span"
+    ids = [s.get("trace") for s in spans]
+    assert exec_trace_id in ids, (exec_trace_id, ids)
+    span = spans[ids.index(exec_trace_id)]
+    # Phases partition the broker residency: queue + bucket + device
+    # account for (>= 95% of) the span's wall time by construction.
+    total = span["total_us"]
+    phases = span["queue_us"] + span["bucket_us"] + span["device_us"]
+    assert total > 0
+    assert phases >= 0.95 * total
+    assert span["tenant"] == "traced"
+    assert span.get("client_lag_us") is not None
+    c.close()
+
+
+def test_trace_disabled_adds_zero_fields(tmp_path, monkeypatch):
+    monkeypatch.delenv("VTPU_TRACE", raising=False)
+    c = RuntimeClient.__new__(RuntimeClient)
+    c._trace_on = tracing.trace_enabled()
+    c.last_trace_id = None
+    msg = {"kind": P.EXECUTE, "exe": "e0", "args": []}
+    before = dict(msg)
+    out = c._maybe_stamp(msg)
+    assert out == before and "trace" not in out
+    assert c.last_trace_id is None
+    # And the recorder records nothing when disabled.
+    fl = tracing.FlightRecorder(enabled=False)
+    fl.record("t", {"total_us": 10.0})
+    assert fl.snapshot() == {}
+
+
+def test_trace_verb_is_bind_free(traced_broker):
+    """TRACE answers WITHOUT a HELLO — no tenant slot, no chip claim
+    (same contract as the STATS probe)."""
+    sock, srv = traced_broker
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(sock)
+    try:
+        P.send_msg(s, {"kind": P.TRACE})
+        resp = P.recv_msg(s)
+        assert resp["ok"] and resp["enabled"] is True
+        assert isinstance(resp["tenants"], dict)
+    finally:
+        s.close()
+
+
+def test_throttled_tenant_span_shows_bucket_phase(traced_env):
+    """The acceptance scenario: a quota-throttled tenant's slow execute
+    must yield spans whose queue/bucket/device phases account for
+    >= 95% of its wall time — with the throttle visible as a non-zero
+    bucket phase, not smeared into 'queue'."""
+    sock = str(traced_env / "thr.sock")
+    srv = make_server(sock, hbm_limit=0, core_limit=25,
+                      region_path=str(traced_env / "thr.shr"),
+                      min_exec_cost_us=10_000, work_conserving=False)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = RuntimeClient(sock, tenant="throttled")
+        exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+        h = c.put(np.ones(4, np.float32))
+        for _ in range(60):  # drain the 400ms burst at 10ms/charge
+            exe(h)
+        c.stats()
+        spans = c.trace(tenant="throttled")["tenants"]["throttled"][
+            "spans"]
+        assert spans
+        throttled = [s for s in spans if s["bucket_us"] > 0]
+        assert throttled, "draining the burst must throttle some spans"
+        for s in spans:
+            phases = s["queue_us"] + s["bucket_us"] + s["device_us"]
+            assert phases >= 0.95 * s["total_us"], s
+        # The throttled spans' dominant phase is the bucket, and the
+        # cumulative rollup exposes it for the metrics server.
+        worst = max(throttled, key=lambda s: s["bucket_us"])
+        assert worst["bucket_us"] > worst["device_us"]
+        from vtpu.runtime.server import collect_stats
+        summary = collect_stats(srv.state)["throttled"]["trace"]
+        assert summary["bucket_wait_us_total"] > 0
+        assert summary["latency_count"] >= len(spans)
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# -- native ring buffer ---------------------------------------------------
+
+
+def test_ring_wrap_overflow_and_cursor(tmp_path):
+    ring = TraceRing(str(tmp_path / "ring"), 1)  # min size: 64 entries
+    cap = ring.capacity
+    assert cap == 64
+    for i in range(cap * 3):
+        ring.emit(TEV_RATE_WAIT, dev=1, value=i, arg=i + 7)
+    assert ring.head == cap * 3
+    evs, nxt = ring.read(0, 1024)
+    # Only the newest `cap` survive the wrap; payloads intact.
+    assert len(evs) == cap
+    assert nxt == cap * 3
+    assert [e["value"] for e in evs] == list(range(cap * 2, cap * 3))
+    assert all(e["arg"] == e["value"] + 7 for e in evs)
+    assert all(e["kind"] == "rate_wait" for e in evs)
+    # Cursor resume: nothing new -> empty; one more -> exactly one.
+    evs, nxt2 = ring.read(nxt, 1024)
+    assert evs == [] and nxt2 == nxt
+    ring.emit(TEV_MEM_STALL, dev=0, value=123, arg=456)
+    evs, _ = ring.read(nxt2, 1024)
+    assert len(evs) == 1 and evs[0]["kind"] == "mem_stall"
+    ring.close()
+
+
+def test_ring_torn_write_skipped_not_garbled(tmp_path):
+    """A slot whose seqlock does not match its index (torn by a wrap,
+    or scribbled) is SKIPPED by the reader — never returned with a
+    garbled payload."""
+    path = str(tmp_path / "ring")
+    ring = TraceRing(path, 1)
+    cap = ring.capacity
+    for i in range(cap):
+        ring.emit(TEV_RATE_WAIT, dev=0, value=i, arg=i)
+    # Corrupt one slot's seq field on disk (header is 24 bytes:
+    # magic,version,capacity,pad,head; each 40-byte slot starts with
+    # its u64 seq) — simulates a writer dying mid-publish.
+    victim = 5
+    with open(path, "r+b") as f:
+        f.seek(24 + victim * 40)
+        f.write(b"\x00" * 8)
+    evs, nxt = ring.read(0, 1024)
+    assert len(evs) == cap - 1, "torn slot skipped, not returned"
+    assert victim not in [e["value"] for e in evs]
+    assert nxt == cap, "cursor still advances past the torn slot"
+    ring.close()
+
+
+def test_region_autoattach_emits_stalls(tmp_path, monkeypatch):
+    """VTPU_TRACE=1 at region open attaches a per-process ring; a
+    refused mem_acquire emits MEM_STALL with no python-side help —
+    the 'unmodified containers contribute events' property."""
+    monkeypatch.setenv("VTPU_TRACE", "1")
+    monkeypatch.setenv("VTPU_TRACE_RING_KB", "4")
+    rpath = str(tmp_path / "shr.cache")
+    with SharedRegion(rpath, limits=[10 * MB], core_pcts=[0]) as r:
+        r.register()
+        ring = r.trace_ring()
+        assert ring is not None
+        assert not r.mem_acquire(0, 20 * MB)
+        evs, _ = ring.read(0, 64)
+        stalls = [e for e in evs if e["kind"] == "mem_stall"]
+        assert stalls and stalls[0]["value"] == 20 * MB
+        assert stalls[0]["arg"] == 10 * MB
+        # The ring file sits next to the region, named by pid.
+        assert os.path.exists(f"{rpath}.trace.{os.getpid()}")
+        assert r.rate_level(0) != 0
+
+
+def test_region_no_ring_when_disabled(tmp_path, monkeypatch):
+    monkeypatch.delenv("VTPU_TRACE", raising=False)
+    with SharedRegion(str(tmp_path / "shr"), limits=[MB]) as r:
+        assert r.trace_ring() is None
+
+
+# -- slow-op auto-capture -------------------------------------------------
+
+
+def test_slow_op_capture_triggers_with_context(traced_env, monkeypatch):
+    """An op whose device phase dwarfs its learned estimate must
+    auto-capture queue depth / bucket level / HBM headroom /
+    co-tenants.  Driven through a real broker with the factor floored
+    so every metered op is 'slow'."""
+    monkeypatch.setenv("VTPU_SLOW_OP_FACTOR", "0.000001")
+    sock = str(traced_env / "slow.sock")
+    srv = make_server(sock, hbm_limit=64 * MB, core_limit=0,
+                      region_path=str(traced_env / "slow.shr"))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = RuntimeClient(sock, tenant="victim")
+        c2 = RuntimeClient(sock, tenant="neighbor")
+        exe = c.compile(lambda a: a @ a,
+                        [np.ones((64, 64), np.float32)])
+        h = c.put(np.ones((64, 64), np.float32))
+        exe(h)  # first run: warmup-exempt, never captures
+        c.stats()  # quiesce: retire the warmup in its own batch
+        exe(h)  # second run: metered solo against the learned EMA
+        c.stats()
+        tr = c.trace(tenant="victim")
+        caps = tr["tenants"]["victim"]["captures"]
+        assert caps, "floored factor must capture the second execute"
+        cap = caps[-1]
+        # The factor is device-wall / estimate: with the threshold
+        # floored to ~0 any metered op captures, however fast.
+        assert cap["factor"] > 0
+        ctx = cap["context"]
+        for key in ("queue_depth", "bucket_level_us", "hbm_used_bytes",
+                    "hbm_limit_bytes", "hbm_headroom_bytes",
+                    "co_tenants", "inflight", "chip_queued_est_us"):
+            assert key in ctx, key
+        assert "neighbor" in ctx["co_tenants"]
+        # first-run exemption: the warmup span carries first_run and no
+        # capture references it.
+        spans = tr["tenants"]["victim"]["spans"]
+        assert any(s.get("first_run") for s in spans)
+        c.close()
+        c2.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_recorder_unit_capture_threshold():
+    fl = tracing.FlightRecorder(enabled=True, depth=8, slow_factor=4.0)
+    ctx_calls = []
+
+    def ctx():
+        ctx_calls.append(1)
+        return {"queue_depth": 3}
+
+    # Under threshold: no capture.
+    fl.record("t", {"total_us": 100.0, "device_us": 100.0},
+              est_us=50.0, context_fn=ctx)
+    assert not ctx_calls
+    # Over threshold: capture with context attached.
+    cap = fl.record("t", {"total_us": 900.0, "device_us": 900.0,
+                          "key": "e1"},
+                    est_us=50.0, context_fn=ctx)
+    assert ctx_calls and cap["context"]["queue_depth"] == 3
+    assert cap["factor"] == pytest.approx(18.0)
+    snap = fl.snapshot("t")
+    assert len(snap["t"]["captures"]) == 1
+    # Ring depth bounds the span buffer.
+    for i in range(32):
+        fl.record("t", {"total_us": 1.0}, est_us=0.0)
+    assert len(fl.snapshot("t")["t"]["spans"]) == 8
+    # Histogram is cumulative.
+    s = fl.summary("t")
+    assert s["latency_count"] == 34
+    assert sum(s["latency_buckets"]) == 34
+
+
+# -- chrome trace export --------------------------------------------------
+
+
+def test_chrome_trace_export_valid(traced_broker, tmp_path):
+    sock, srv = traced_broker
+    c = RuntimeClient(sock, tenant="ct")
+    f = c.remote_jit(lambda a: a + 1.0)
+    f(np.ones((16, 16), np.float32))
+    c.stats()
+    tr = c.trace()
+    doc = tracing.chrome_trace(tr["tenants"],
+                               [{"t_ns": 1, "kind": "rate_wait",
+                                 "dev": 0, "value": 5, "arg": 7}])
+    # Valid JSON, chrome-trace shape, phase events present.
+    blob = json.dumps(doc)
+    parsed = json.loads(blob)
+    evs = parsed["traceEvents"]
+    assert isinstance(evs, list) and evs
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert xs, "spans must become complete events"
+    for e in xs:
+        assert {"name", "ts", "dur", "pid", "tid"} <= set(e)
+    assert any(e.get("cat") == "vtpu,shim" for e in evs)
+    # And the smi-level dump path writes the same thing to disk.
+    from vtpu.tools import vtpu_smi
+    rc = vtpu_smi.main(["trace", "--broker", sock,
+                        "--dump", str(tmp_path / "chrome.json")])
+    assert rc == 0
+    with open(tmp_path / "chrome.json") as fh:
+        dumped = json.load(fh)
+    assert dumped["traceEvents"]
+    c.close()
+
+
+# -- lease sidecar forensics ----------------------------------------------
+
+
+def test_lease_sidecar_roundtrip_and_staleness(tmp_path, monkeypatch):
+    path = str(tmp_path / "lease.json")
+    monkeypatch.setenv("VTPU_LEASE_SIDECAR", path)
+    assert tracing.diagnose_lease() == {"present": False}
+    assert tracing.write_lease_sidecar("unit test")
+    d = tracing.diagnose_lease()
+    assert d["present"] and d["alive"] and not d["stale"]
+    assert d["pid"] == os.getpid()
+    assert "unit test" == d["stage"]
+    assert "python" in d["cmdline"]
+    # exclude_pid: a claimer diagnosing its OWN wedge skips itself.
+    assert tracing.diagnose_lease(exclude_pid=os.getpid()) == \
+        {"present": False}
+    # Dead holder -> stale, named as DEAD.
+    rec = json.load(open(path))
+    rec["pid"] = 2 ** 22 + 12345  # beyond pid_max: provably dead
+    json.dump(rec, open(path, "w"))
+    d = tracing.diagnose_lease()
+    assert d["present"] and not d["alive"] and d["stale"]
+    assert "DEAD" in tracing.format_lease_diagnosis(d)
+    # Live pid but ancient heartbeat -> stale too (wedged holder).
+    rec["pid"] = os.getpid()
+    json.dump(rec, open(path, "w"))
+    old = time.time() - 10 * tracing.LEASE_STALE_S
+    os.utime(path, (old, old))
+    d = tracing.diagnose_lease()
+    assert d["alive"] and d["stale"]
+    # Heartbeat refreshes mtime (holder only).
+    tracing.heartbeat_lease_sidecar()
+    assert tracing.diagnose_lease()["heartbeat_age_s"] < 5.0
+    # clear: only the owner removes.
+    tracing.clear_lease_sidecar()
+    assert tracing.diagnose_lease() == {"present": False}
+
+
+def test_lease_sidecar_never_clobbers_live_holder(tmp_path, monkeypatch):
+    """A blocked claimer must PRESERVE the live holder's calling card
+    (clobbering it would leave its own watchdog diagnosing 'no sidecar
+    found' about the very process that wedged it); dead/stale records
+    are replaced."""
+    path = str(tmp_path / "lease.json")
+    monkeypatch.setenv("VTPU_LEASE_SIDECAR", path)
+    tracing.write_lease_sidecar("holder claim")
+    rec = json.load(open(path))
+    rec["pid"] = 1  # live foreign holder, fresh heartbeat
+    json.dump(rec, open(path, "w"))
+    assert tracing.write_lease_sidecar("usurper claim") is False
+    assert tracing.read_lease_sidecar(path)["pid"] == 1
+    # Stale heartbeat: the holder is wedged/dead to the world — replace.
+    old = time.time() - 10 * tracing.LEASE_STALE_S
+    os.utime(path, (old, old))
+    assert tracing.write_lease_sidecar("usurper claim") is True
+    d = tracing.diagnose_lease()
+    assert d["pid"] == os.getpid() and d["stage"] == "usurper claim"
+
+
+def test_broker_writes_and_clears_lease_sidecar(traced_broker):
+    sock, srv = traced_broker
+    d = tracing.diagnose_lease()
+    assert d["present"] and d["pid"] == os.getpid()
+    assert "broker" in d["stage"]
+    srv.shutdown()
+    srv.server_close()
+    assert tracing.diagnose_lease() == {"present": False}
+
+
+def test_vtpu_smi_leases_reports_holder(traced_broker, capsys):
+    from vtpu.tools import vtpu_smi
+    rc = vtpu_smi.main(["leases", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0  # live holder: not stale
+    assert out[0]["present"] and out[0]["pid"] == os.getpid()
+    assert out[0]["alive"]
+
+
+# -- bench fail-fast ------------------------------------------------------
+
+
+def test_bench_gate_fails_fast_naming_live_holder(tmp_path, monkeypatch):
+    """A failing probe + a LIVE lease holder must raise IMMEDIATELY
+    with the holder's pid/cmdline — not burn the wait budget (the
+    BENCH_r05 failure mode)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    import bench
+    path = str(tmp_path / "lease.json")
+    monkeypatch.setenv("VTPU_LEASE_SIDECAR", path)
+    # A FOREIGN live process holds the lease (pid 1: always alive,
+    # never the caller — the gate excludes its own sidecar).
+    tracing.write_lease_sidecar("wedged co-claimer")
+    rec = json.load(open(path))
+    rec["pid"] = 1
+    json.dump(rec, open(path, "w"))
+    monkeypatch.setattr(bench, "_CHIP_PROBE",
+                        "raise SystemExit('claim blocked')")
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError) as ei:
+        bench.wait_chip_claimable(max_wait_s=600)
+    assert time.monotonic() - t0 < 60, "must fail fast, not wait 600s"
+    msg = str(ei.value)
+    assert "pid 1 " in msg and "fail-fast" in msg
+    assert "wedged co-claimer" in msg
+
+
+def test_bench_gate_keeps_waiting_on_stale_holder(tmp_path, monkeypatch):
+    """A DEAD holder's lease can still settle: the gate keeps probing
+    (bounded by max_wait_s) and the final error carries the
+    diagnosis."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    import bench
+    path = str(tmp_path / "lease.json")
+    monkeypatch.setenv("VTPU_LEASE_SIDECAR", path)
+    tracing.write_lease_sidecar("dead claimer")
+    rec = json.load(open(path))
+    rec["pid"] = 2 ** 22 + 54321
+    json.dump(rec, open(path, "w"))
+    monkeypatch.setattr(bench, "_CHIP_PROBE",
+                        "raise SystemExit('claim blocked')")
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    with pytest.raises(RuntimeError) as ei:
+        bench.wait_chip_claimable(max_wait_s=0.0)
+    msg = str(ei.value)
+    assert "DEAD" in msg and "dead claimer" in msg
+
+
+# -- claim watchdog journal record ---------------------------------------
+
+
+def test_wedge_report_journals_diagnosis(tmp_path, monkeypatch):
+    """The watchdog's dying words: lease diagnosis in the log line AND
+    a journal record the successor replays into last_wedge."""
+    monkeypatch.setenv("VTPU_LEASE_SIDECAR",
+                       str(tmp_path / "lease.json"))
+    # A foreign holder (not us): the diagnosis must name it.
+    tracing.write_lease_sidecar("foreign claim")
+    rec = json.load(open(tmp_path / "lease.json"))
+    rec["pid"] = 1  # pid 1: alive, not us
+    json.dump(rec, open(tmp_path / "lease.json", "w"))
+    jr = Journal(str(tmp_path / "journal"))
+    msg = wedge_report("chip 0 claim/calibration", jr)
+    assert "pid 1" in msg and "foreign claim" in msg
+    jr.close()
+    jr2 = Journal(str(tmp_path / "journal"))
+    st = jr2.load_state()
+    assert st["last_wedge"]["stage"] == "chip 0 claim/calibration"
+    assert "pid 1" in st["last_wedge"]["diagnosis"]
+    jr2.close()
+
+
+def test_recovered_broker_reports_last_wedge(tmp_path, monkeypatch):
+    """End to end: a journal carrying a wedge record boots a broker
+    whose journal_stats (STATS surface) names the previous restart's
+    cause."""
+    monkeypatch.setenv("VTPU_TRACE", "1")
+    monkeypatch.setenv("VTPU_LEASE_SIDECAR",
+                       str(tmp_path / "lease.json"))
+    jdir = str(tmp_path / "journal")
+    jr = Journal(jdir)
+    wedge_report("platform init (jax.devices)", jr)
+    jr.close()
+    sock = str(tmp_path / "rw.sock")
+    srv = make_server(sock, hbm_limit=0, core_limit=0,
+                      region_path=str(tmp_path / "rw.shr"),
+                      journal_dir=jdir)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        c = RuntimeClient(sock, tenant="after")
+        r = c._rpc({"kind": P.STATS})
+        lw = r["journal"].get("last_wedge")
+        assert lw and lw["stage"] == "platform init (jax.devices)"
+        assert "chip lease" in lw["diagnosis"] \
+            or "sidecar" in lw["diagnosis"]
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
