@@ -109,6 +109,27 @@ SHUTDOWN = "shutdown"    # {} -> {ok}  then the broker exits gracefully
 DRAIN = "drain"          # {timeout?} -> {ok, tenants, snapshotted}
 HANDOVER = "handover"    # {timeout?} -> {ok, tenants, snapshotted}
 
+# ---------------------------------------------------------------------------
+# Verb registries — the machine-checked protocol contract.
+#
+# `vtpu-smi analyze` (vtpu.tools.analyze.verbs) proves every constant
+# above is registered here, every registered verb has a dispatch arm on
+# each socket that serves it plus a sender binding (runtime/client.py
+# for tenant verbs, tools/vtpu_smi.py for admin verbs), and that
+# BIND_FREE verbs answer before the NO_HELLO guard on the tenant socket
+# AND are served on the admin socket (the no-wedge probe contract).
+# Adding a verb without completing all three halves fails CI.
+# ---------------------------------------------------------------------------
+
+# Served on the tenant socket (mounted into containers).
+TENANT_VERBS = (HELLO, PUT_PART, PUT, GET, DELETE, COMPILE, EXECUTE,
+                STATS, TRACE)
+# Served on the host-side admin socket (<socket>.admin, never mounted).
+ADMIN_VERBS = (STATS, TRACE, SUSPEND, RESUME, SHUTDOWN, DRAIN, HANDOVER)
+# Answer WITHOUT a HELLO binding — no tenant slot, no lazy chip claim,
+# so a read-only probe can never wedge a chip claim (ADVICE r5 #2).
+BIND_FREE_VERBS = (STATS, TRACE)
+
 
 class ProtocolError(RuntimeError):
     pass

@@ -61,6 +61,41 @@ instead of waiting (reference CUDA_TASK_PRIORITY semantics).
 
 Run: python -m vtpu.runtime.server --socket /tmp/vtpu-rt.sock \
         --hbm-limit 8Gi --core-limit 50
+
+lock-order ground truth (vtpu-analyze):
+
+    The broker's locks form a strict hierarchy; ``vtpu-smi analyze``
+    (vtpu.tools.analyze.locks) parses THIS block and fails CI on any
+    ``with`` nesting outside it.  ``A > B`` means A may be held while
+    acquiring B (closure is transitive); a ``leaf`` lock may never
+    hold anything else; ``no-blocking-under`` locks ban socket I/O,
+    journal/file writes, subprocess and sleeps while held (journal
+    appends from under tenant.mu/state.mu are DEFERRED via
+    Tenant.pending_journal / explicit post-release appends).
+
+        order: chips_mu > region.lock
+        order: chips_mu > journal.mu
+        order: state.mu > scheduler.mu
+        order: state.mu > tenant.mu
+        order: state.mu > flight.mu
+        order: state.mu > region.lock
+        order: scheduler.mu > region.lock
+        order: tenant.mu > region.lock
+        order: bridge.global_mu > bridge.mu
+        order: bridge.fn_mu > bridge.mu
+        leaf: region.lock, journal.mu, flight.mu, put_cache_mu
+        leaf: session.send_mu, session.pending_cond, bridge.mu
+        no-blocking-under: state.mu, tenant.mu, scheduler.mu
+        no-blocking-under: put_cache_mu, flight.mu
+
+    Deliberate NON-edges the checker enforces by omission:
+    scheduler.mu and tenant.mu are unordered siblings — the dispatcher
+    always releases scheduler.mu (_pick_locked returns) before taking
+    any tenant.mu, so a session thread blocked in t.mu-guarded staging
+    can never stall dispatch for OTHER tenants, and no lock order
+    between the two ever needs to exist; chips_mu is excluded from
+    no-blocking-under on purpose (its entire job is to serialize slow
+    chip claim/calibration without stalling state.mu).
 """
 
 from __future__ import annotations
@@ -215,6 +250,12 @@ class Tenant:
         # dies with the broker).  eid -> blob sha for executables.
         self.blob_meta: Dict[str, dict] = {}
         self.exe_shas: Dict[str, str] = {}
+        # Journal records produced while holding self.mu (array drops):
+        # journal appends are file I/O and are BANNED under fast broker
+        # locks (module docstring lock discipline) — they are deferred
+        # here and flushed by flush_tenant_journal right after release,
+        # always before the reply that acknowledges the state change.
+        self.pending_journal: List[dict] = []
         # Grant echo for the journal's bind record (per-chip HBM caps,
         # core pct) + the owning client's identity for recovery-time
         # liveness re-validation.
@@ -303,6 +344,20 @@ class Tenant:
             freed += self.staged_bytes.get(aid, 0)
             self.drop_staged(aid)
         return freed
+
+
+def flush_tenant_journal(state: "RuntimeState", t: "Tenant") -> None:
+    """Append the records a t.mu-guarded section deferred (lock
+    discipline: journal writes never run under fast broker locks).
+    Callers invoke this after releasing t.mu and BEFORE sending the
+    reply that acknowledges the change, so the durability contract —
+    once the client sees ok, the journal has it — is unchanged."""
+    jr = state.journal
+    with t.mu:
+        recs, t.pending_journal = t.pending_journal, []
+    if jr is not None:
+        for rec in recs:
+            jr.append(rec)
 
 
 class Program:
@@ -497,6 +552,7 @@ class DeviceScheduler:
                 with it.tenant.mu:
                     for fid in it.free_ids:
                         session.drop_array(it.tenant, fid)
+                flush_tenant_journal(self.state, it.tenant)
         return len(purged)
 
     # -- dispatch ----------------------------------------------------------
@@ -674,6 +730,7 @@ class DeviceScheduler:
             except Exception as e:  # noqa: BLE001 - reply with error
                 # Failed before reaching the device: credit the up-front
                 # charge back and retire the item immediately.
+                flush_tenant_journal(self.state, t)
                 if item.metered:
                     t.rate_adjust_all(-int(item.est_us))
                 item.session.complete_execute(item, metas, e, 0.0)
@@ -681,6 +738,9 @@ class DeviceScheduler:
                                   error=f"{type(e).__name__}: {e}")
                 self._retire(item)
                 continue
+            # Journal records deferred by the free/drop paths above go
+            # out before the reply (durability contract unchanged).
+            flush_tenant_journal(self.state, t)
             # Reply NOW — shapes are static; the device is still working.
             item.exe.warmed.add((item.steps, item.carry))
             item.session.complete_execute(item, metas, None, item.est_us)
@@ -1523,9 +1583,13 @@ class RuntimeState:
                 log.warn("journal: cannot restore program %s of %r: %s",
                          eid, t.name, e)
 
-    def _release_recovered(self, t: Tenant, counter: str) -> None:
+    def _release_recovered(self, t: Tenant,
+                           counter: str) -> Optional[dict]:
         """Drop a parked recovered tenant: release its re-applied
-        ledger and journal the close (slots recycle)."""
+        ledger (slots recycle).  Returns the close record for the
+        CALLER to journal once it holds no fast lock (tenant() invokes
+        this under state.mu — lock discipline bans the file I/O
+        there)."""
         for aid, charges in list(t.charges.items()):
             for pos, nb in charges:
                 t.chips[pos].region.mem_release(t.slots[pos], nb)
@@ -1533,7 +1597,8 @@ class RuntimeState:
         t.blob_meta.clear()
         self.recovery[counter] += 1
         if self.journal is not None:
-            self.journal.append({"op": "close", "name": t.name})
+            return {"op": "close", "name": t.name}
+        return None
 
     def journal_tick(self) -> None:
         """Periodic journal upkeep (keeper thread): expire parked
@@ -1549,7 +1614,9 @@ class RuntimeState:
         for t in expired:
             log.info("journal: recovered tenant %r never reconnected "
                      "within %.0fs; dropping", t.name, self.resume_grace)
-            self._release_recovered(t, "tenants_dropped_expired")
+            rec = self._release_recovered(t, "tenants_dropped_expired")
+            if rec is not None and self.journal is not None:
+                self.journal.append(rec)
         if self.journal is not None and self.journal.snapshot_due():
             self.journal.write_snapshot(self._snapshot_dict)
 
@@ -1644,15 +1711,19 @@ class RuntimeState:
             raise ValueError(f"INVALID_DEVICE: duplicate chips {dev_list}")
         chips = [self.chip(d) for d in dev_list]
         created = False
+        deferred_close = None
         with self.mu:
             # A plain (non-resume) HELLO under a journal-recovered name
             # supersedes the parked state: the client explicitly started
             # fresh — release the old ledger before the slot search so
-            # a recycled slot starts with clean books.
+            # a recycled slot starts with clean books.  The close record
+            # is journaled after release (no file I/O under state.mu);
+            # this thread appends it before _journal_bind writes the
+            # superseding bind, so replay order holds.
             ent = self.recovered.pop(name, None)
             if ent is not None:
-                self._release_recovered(ent[0],
-                                        "tenants_dropped_replaced")
+                deferred_close = self._release_recovered(
+                    ent[0], "tenants_dropped_replaced")
             t = self.tenants.get(name)
             if t is None:
                 created = True
@@ -1695,7 +1766,9 @@ class RuntimeState:
                         else self.default_core)
                 self.tenants[name] = t
             t.connections += 1
-            return t, created
+        if deferred_close is not None and self.journal is not None:
+            self.journal.append(deferred_close)
+        return t, created
 
     def release_tenant(self, t: Tenant) -> bool:
         """Drop one connection; True when the tenant's state should be
@@ -1728,9 +1801,13 @@ class RuntimeState:
             # reusing the name must not start silently frozen (the only
             # clue would be the admin-side STATS list).
             self.suspended.discard(t.name)
-            if self.journal is not None:
-                self.journal.append({"op": "close", "name": t.name})
-            return True
+        # The close record goes out AFTER state.mu is released (lock
+        # discipline: journal file I/O never runs under fast locks) but
+        # before this thread's _cleanup drops the arrays — replay order
+        # for this tenant is unchanged.
+        if self.journal is not None:
+            self.journal.append({"op": "close", "name": t.name})
+        return True
 
     def cached_blob(self, blob: bytes) -> "Program":
         """Dedup identical programs across tenants: same blob -> same
@@ -2283,9 +2360,13 @@ class TenantSession(socketserver.BaseRequestHandler):
         return 0
 
     def _journal_drop(self, t: Tenant, aid: str) -> None:
+        """Caller holds t.mu: the record is DEFERRED (journal file I/O
+        is banned under fast locks) and flushed by the caller's
+        flush_tenant_journal once t.mu is released."""
         jr = self.state.journal
         if jr is not None and t.blob_meta.pop(aid, None) is not None:
-            jr.append({"op": "del", "name": t.name, "id": aid})
+            t.pending_journal.append(
+                {"op": "del", "name": t.name, "id": aid})
 
     def _journal_bind(self, t: Tenant, msg) -> None:
         """Record a tenant binding (creation, reconnect or resume) so
@@ -2318,7 +2399,9 @@ class TenantSession(socketserver.BaseRequestHandler):
 
     def _drop_array(self, t: Tenant, aid: str) -> int:
         with t.mu:
-            return self.drop_array(t, aid)
+            n = self.drop_array(t, aid)
+        flush_tenant_journal(self.state, t)
+        return n
 
     # -- execute path ------------------------------------------------------
 

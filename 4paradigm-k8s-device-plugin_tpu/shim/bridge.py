@@ -446,7 +446,10 @@ def get_bridge() -> Optional[Bridge]:
         return None
     with _bridge_mu:
         if _bridge is None:
-            path = os.environ["VTPU_RUNTIME_SOCKET"]
+            # bridge_enabled() already proved the socket env is set;
+            # .get keeps the read on the envspec-auditable path (the
+            # analyzer bans raw VTPU_* subscript reads).
+            path = os.environ.get("VTPU_RUNTIME_SOCKET", "")
             # The daemon only injects the socket when the broker answered
             # at Allocate, but the pod may start while the broker is
             # mid-respawn (the daemon restarts crashed brokers with

@@ -45,8 +45,9 @@ def _default_interposer() -> Optional[str]:
 
 
 def _find_real_libtpu() -> Optional[str]:
-    if os.environ.get("VTPU_REAL_LIBTPU"):
-        return os.environ["VTPU_REAL_LIBTPU"]
+    real = os.environ.get("VTPU_REAL_LIBTPU")
+    if real:
+        return real
     try:
         import libtpu  # type: ignore
         p = os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")

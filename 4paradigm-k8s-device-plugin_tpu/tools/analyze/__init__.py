@@ -1,0 +1,95 @@
+"""vtpu-analyze — project-specific cross-layer invariant linters.
+
+The reference repo's CI stops at ``golint``/``go vet``; this reproduction
+has grown hand-maintained contracts that generic linters cannot see:
+
+  - **locks** — the broker's lock web (seven locks/conditions across
+    ``runtime/server.py``): every observed ``with <lock>`` nesting must
+    be covered by the canonical lock order declared in the server module
+    docstring, and no blocking call (socket I/O, journal writes, fsync,
+    subprocess, sleeps) may run under a fast broker lock.
+  - **verbs** — every protocol verb must have a broker dispatch arm, a
+    client binding, and (bind-free verbs) precede the NO_HELLO guard on
+    the tenant socket and be served on the admin socket.
+  - **envflags** — every ``VTPU_*`` env var read anywhere in Python or
+    C++ must be declared in ``utils/envspec.py``'s flag registry,
+    documented in ``docs/FLAGS.md``, surfaced in the Helm values when
+    marked as an operator tunable, and never read via a raw
+    ``os.environ["VTPU_*"]`` subscript.
+  - **journal** — every record type the broker writes must have a
+    replay handler in ``runtime/journal.py`` recovery (and vice versa:
+    no dead replay arms).
+
+Run as ``python -m vtpu.tools.analyze`` or ``vtpu-smi analyze``; CI runs
+it in the ``analyze`` job and fails on any finding.  There is NO
+baseline/suppression mechanism on purpose: the tree stays at zero.
+
+Extending: each checker is a module exposing ``check(root) -> list
+[Finding]`` plus pure helpers that tests drive with seeded-violation
+fixture sources (tests/test_analyze.py) — see docs/ANALYSIS.md.
+
+This package is deliberately stdlib-only (ast + re): the CI job that
+runs it needs no jax/msgpack install.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+PKG_DIR = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+REPO_ROOT = os.path.dirname(PKG_DIR)
+PKG_NAME = os.path.basename(PKG_DIR)
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str   # locks | verbs | envflags | journal
+    path: str      # repo-relative
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+def read_text(root: str, relpath: str) -> Optional[str]:
+    """Source text of ``relpath`` under ``root``; None when absent (a
+    fixture tree may carry only the files a test seeds)."""
+    path = os.path.join(root, relpath)
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def run_all(root: Optional[str] = None) -> List[Finding]:
+    from . import envflags, journal_schema, locks, verbs
+    root = root or REPO_ROOT
+    out: List[Finding] = []
+    for mod in (locks, verbs, envflags, journal_schema):
+        out.extend(mod.check(root))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="vtpu-analyze",
+        description="cross-layer invariant linters (docs/ANALYSIS.md)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: this checkout)")
+    ns = ap.parse_args(argv)
+    findings = run_all(ns.root)
+    if ns.json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"vtpu-analyze: {len(findings)} finding(s)")
+    return 1 if findings else 0

@@ -1,0 +1,6 @@
+"""``python -m vtpu.tools.analyze`` console entry."""
+
+from . import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,261 @@
+"""Env-flag contract checker.
+
+``VTPU_*`` environment variables are the ONLY channel between the
+daemon and the in-container enforcement layer, and they are read from
+four different languages/layers (Python shim/broker/daemon, native
+C++, bench tooling).  The contract lives in ``utils/envspec.py``'s
+flag registry; this checker proves:
+
+  - every ``VTPU_*`` literal read anywhere in the Python tree (through
+    ``os.environ.get`` / ``os.getenv`` / ``"X" in os.environ`` /
+    config's ``_env`` helper) or the native tree (``getenv("VTPU_…")``)
+    is declared in the registry (per-ordinal ``VTPU_DEVICE_HBM_LIMIT_<i>``
+    forms match their declared prefix);
+  - no raw ``os.environ["VTPU_*"]`` subscript read bypasses the
+    ``.get()``/envspec path (subscript WRITES — the producer side — are
+    fine);
+  - every registered flag is documented in ``docs/FLAGS.md``;
+  - every flag marked as a Helm-surfaced operator tunable appears in
+    ``deployments/helm/vtpu-device-plugin/values.yaml``.
+
+The registry itself is parsed from envspec with ``ast.literal_eval``
+(this checker must not import product modules — CI runs it without the
+runtime deps installed).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, read_text, PKG_NAME
+
+ENVSPEC = f"{PKG_NAME}/utils/envspec.py"
+FLAGS_MD = "docs/FLAGS.md"
+HELM_VALUES = "deployments/helm/vtpu-device-plugin/values.yaml"
+
+# Python files scanned for reads: the whole package + bench tooling.
+PY_SCAN_DIRS = (PKG_NAME,)
+PY_SCAN_FILES = ("bench.py", "__graft_entry__.py")
+NATIVE_DIR = "native"
+
+ENV_READ_FUNCS = {"getenv", "_env"}
+_GETENV_RE = re.compile(r'getenv\(\s*"(VTPU_[A-Z0-9_]+)"')
+_TOKEN_RE = re.compile(r"VTPU_[A-Z0-9_]+")
+
+
+def parse_registry(envspec_src: str, path: str = ENVSPEC
+                   ) -> Tuple[Dict[str, bool], Tuple[str, ...],
+                              List[Finding]]:
+    """(declared {flag: helm?}, prefixes, findings) from envspec's
+    ``ENV_FLAGS`` / ``ENV_FLAG_PREFIXES`` / ``ALL_ENV_VARS`` blocks —
+    extracted syntactically, no import."""
+    findings: List[Finding] = []
+    declared: Dict[str, bool] = {}
+    prefixes: List[str] = []
+    try:
+        tree = ast.parse(envspec_src)
+    except SyntaxError as e:
+        return {}, (), [Finding("envflags", path, e.lineno or 1,
+                                f"syntax error: {e.msg}")]
+    consts: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+
+    def resolve(el: ast.AST) -> Optional[str]:
+        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+            return el.value
+        if isinstance(el, ast.Name):
+            return consts.get(el.id)
+        if isinstance(el, ast.BinOp) and isinstance(el.op, ast.Add):
+            a, b = resolve(el.left), resolve(el.right)
+            return a + b if a is not None and b is not None else None
+        return None
+
+    found_registry = False
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            continue
+        name = node.targets[0].id
+        if name == "ENV_FLAGS" and isinstance(node.value, ast.Dict):
+            found_registry = True
+            for k, v in zip(node.value.keys, node.value.values):
+                flag = resolve(k) if k is not None else None
+                if flag is None:
+                    findings.append(Finding(
+                        "envflags", path, node.lineno,
+                        "ENV_FLAGS key is not a resolvable string"))
+                    continue
+                helm = False
+                if isinstance(v, (ast.Tuple, ast.List)) and v.elts:
+                    last = v.elts[-1]
+                    helm = isinstance(last, ast.Constant) and \
+                        last.value is True
+                declared[flag] = helm
+        elif name == "ENV_FLAG_PREFIXES" and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            for el in node.value.elts:
+                p = resolve(el)
+                if p:
+                    prefixes.append(p)
+    if not found_registry:
+        findings.append(Finding(
+            "envflags", path, 1,
+            "utils/envspec.py has no ENV_FLAGS registry"))
+    return declared, tuple(prefixes), findings
+
+
+def _env_chain(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return _env_chain(node.value) + "." + node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return "?"
+
+
+def python_reads(src: str, rel: str) -> Tuple[List[Tuple[str, int]],
+                                              List[Tuple[str, int]]]:
+    """(env reads [(flag, line)], raw subscript reads [(flag, line)])
+    of VTPU_* literals in one Python source."""
+    reads: List[Tuple[str, int]] = []
+    raw: List[Tuple[str, int]] = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return reads, raw
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            lit: Optional[str] = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) \
+                    and _TOKEN_RE.fullmatch(node.args[0].value):
+                lit = node.args[0].value
+            if lit is None:
+                continue
+            if isinstance(fn, ast.Attribute):
+                chain = _env_chain(fn)
+                if chain.endswith("environ.get") or \
+                        chain.endswith("os.getenv"):
+                    reads.append((lit, node.lineno))
+            elif isinstance(fn, ast.Name) and fn.id in ENV_READ_FUNCS:
+                reads.append((lit, node.lineno))
+        elif isinstance(node, ast.Subscript):
+            if not _env_chain(node.value).endswith("environ"):
+                continue
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and \
+                    isinstance(sl.value, str) and \
+                    _TOKEN_RE.fullmatch(sl.value):
+                if isinstance(node.ctx, ast.Load):
+                    reads.append((sl.value, node.lineno))
+                    raw.append((sl.value, node.lineno))
+        elif isinstance(node, ast.Compare) and \
+                isinstance(node.left, ast.Constant) and \
+                isinstance(node.left.value, str) and \
+                _TOKEN_RE.fullmatch(node.left.value) and \
+                any(isinstance(op, ast.In) for op in node.ops) and \
+                any(_env_chain(c).endswith("environ")
+                    for c in node.comparators):
+            reads.append((node.left.value, node.lineno))
+    return reads, raw
+
+
+def native_reads(src: str) -> List[Tuple[str, int]]:
+    out = []
+    for i, line in enumerate(src.splitlines(), 1):
+        for m in _GETENV_RE.finditer(line):
+            out.append((m.group(1), i))
+    return out
+
+
+def _declared(flag: str, declared: Dict[str, bool],
+              prefixes: Tuple[str, ...]) -> bool:
+    if flag in declared:
+        return True
+    return any(flag.startswith(p) and flag[len(p):].isdigit()
+               for p in prefixes)
+
+
+def check_tree(py_sources: Dict[str, str], native_sources: Dict[str, str],
+               envspec_src: str, flags_md: str, helm_values: str
+               ) -> List[Finding]:
+    declared, prefixes, findings = parse_registry(envspec_src)
+    if not declared:
+        return findings
+    for rel, src in sorted(py_sources.items()):
+        reads, raw = python_reads(src, rel)
+        for flag, line in raw:
+            findings.append(Finding(
+                "envflags", rel, line,
+                f'raw os.environ["{flag}"] subscript read bypasses '
+                f"envspec — use .get() (or the envspec accessor)"))
+        for flag, line in reads:
+            if not _declared(flag, declared, prefixes):
+                findings.append(Finding(
+                    "envflags", rel, line,
+                    f"{flag} is read here but not declared in "
+                    f"utils/envspec.py ENV_FLAGS"))
+    for rel, src in sorted(native_sources.items()):
+        for flag, line in native_reads(src):
+            if not _declared(flag, declared, prefixes):
+                findings.append(Finding(
+                    "envflags", rel, line,
+                    f"{flag} is read by native code but not declared "
+                    f"in utils/envspec.py ENV_FLAGS"))
+    md_tokens = set(_TOKEN_RE.findall(flags_md))
+    helm_tokens = set(_TOKEN_RE.findall(helm_values))
+    for flag in sorted(declared):
+        if flag not in md_tokens:
+            findings.append(Finding(
+                "envflags", FLAGS_MD, 1,
+                f"{flag} is declared in envspec but undocumented in "
+                f"docs/FLAGS.md"))
+        if declared[flag] and flag not in helm_tokens:
+            findings.append(Finding(
+                "envflags", HELM_VALUES, 1,
+                f"{flag} is marked helm-surfaced but absent from the "
+                f"chart values"))
+    return findings
+
+
+def check(root: str) -> List[Finding]:
+    envspec_src = read_text(root, ENVSPEC)
+    flags_md = read_text(root, FLAGS_MD)
+    helm_values = read_text(root, HELM_VALUES)
+    if envspec_src is None or flags_md is None or helm_values is None:
+        return []
+    py_sources: Dict[str, str] = {}
+    for base in PY_SCAN_DIRS:
+        basedir = os.path.join(root, base)
+        for dirpath, _dirs, files in os.walk(basedir):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fname)
+                rel = os.path.relpath(full, root)
+                text = read_text(root, rel)
+                if text is not None:
+                    py_sources[rel] = text
+    for rel in PY_SCAN_FILES:
+        text = read_text(root, rel)
+        if text is not None:
+            py_sources[rel] = text
+    native_sources: Dict[str, str] = {}
+    for dirpath, _dirs, files in os.walk(os.path.join(root, NATIVE_DIR)):
+        for fname in files:
+            if fname.endswith((".cc", ".h", ".c")):
+                full = os.path.join(dirpath, fname)
+                rel = os.path.relpath(full, root)
+                text = read_text(root, rel)
+                if text is not None:
+                    native_sources[rel] = text
+    return check_tree(py_sources, native_sources, envspec_src,
+                      flags_md, helm_values)

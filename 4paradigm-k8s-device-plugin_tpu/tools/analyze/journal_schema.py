@@ -1,0 +1,161 @@
+"""Journal record-schema checker.
+
+The crash-safe journal (runtime/journal.py) is only as good as its
+replay: a record type the broker writes but ``_apply_record`` does not
+handle silently loses that state class on every recovery (the
+forward-compat "skip unknown ops" clause turns a typo into data loss).
+This checker extracts:
+
+  - **writers** — every ``{"op": "<literal>", ...}`` dict passed to a
+    journal ``append`` call anywhere in the runtime package;
+  - **handlers** — every ``op == "<literal>"`` comparison inside
+    ``_apply_record``;
+
+and proves writers == handlers, both directions: an unreplayed written
+op is recovery data loss, a handler nothing writes is a dead replay arm
+(usually a renamed writer that silently orphaned its records).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, read_text, PKG_NAME
+
+JOURNAL = f"{PKG_NAME}/runtime/journal.py"
+WRITER_FILES = (
+    f"{PKG_NAME}/runtime/server.py",
+    f"{PKG_NAME}/runtime/journal.py",
+    f"{PKG_NAME}/runtime/trace.py",
+)
+JOURNAL_BASES = ("journal", "jr")
+
+
+def _chain(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return _chain(node.value) + "." + node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        return _chain(node.value) + "[]"
+    if isinstance(node, ast.Call):
+        return _chain(node.func) + "()"
+    return "?"
+
+
+def written_ops(src: str, rel: str) -> Dict[str, Tuple[str, int]]:
+    """{op: (file, line)} for every journal append of an op-bearing
+    record literal."""
+    out: Dict[str, Tuple[str, int]] = {}
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return out
+
+    def dict_op(node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Dict):
+            return None
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and k.value == "op" and \
+                    isinstance(v, ast.Constant) and \
+                    isinstance(v.value, str):
+                return v.value
+        return None
+
+    # `rec = {"op": ...}` then `jr.append(rec)` is the common shape —
+    # resolve simple Name arguments through local record literals.
+    named: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            op = dict_op(node.value)
+            if op is not None:
+                named[node.targets[0].id] = op
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute) or \
+                node.func.attr != "append":
+            continue
+        base_parts = [p.rstrip("[]()") for p in
+                      _chain(node.func.value).split(".")]
+        if not any(b in JOURNAL_BASES for b in base_parts) and \
+                "pending_journal" not in base_parts:
+            continue
+        for arg in node.args:
+            op = dict_op(arg)
+            if op is None and isinstance(arg, ast.Name):
+                op = named.get(arg.id)
+            if op is not None:
+                out.setdefault(op, (rel, node.lineno))
+    return out
+
+
+def handled_ops(journal_src: str) -> Set[str]:
+    """Ops ``_apply_record`` replays: ``op == "<lit>"`` comparisons."""
+    out: Set[str] = set()
+    try:
+        tree = ast.parse(journal_src)
+    except SyntaxError:
+        return out
+    fn = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "_apply_record":
+            fn = node
+            break
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        names = [s for s in sides
+                 if isinstance(s, ast.Name) and s.id == "op"]
+        lits = [s.value for s in sides
+                if isinstance(s, ast.Constant) and isinstance(s.value, str)]
+        if names and lits:
+            out.update(lits)
+    return out
+
+
+def check_texts(sources: Dict[str, str], journal_rel: str = JOURNAL
+                ) -> List[Finding]:
+    journal_src = sources.get(journal_rel)
+    if journal_src is None:
+        return [Finding("journal", journal_rel, 1,
+                        "runtime/journal.py missing — cannot check "
+                        "replay coverage")]
+    handled = handled_ops(journal_src)
+    if not handled:
+        return [Finding("journal", journal_rel, 1,
+                        "cannot locate _apply_record op handlers")]
+    written: Dict[str, Tuple[str, int]] = {}
+    for rel, src in sorted(sources.items()):
+        for op, where in written_ops(src, rel).items():
+            written.setdefault(op, where)
+    findings: List[Finding] = []
+    for op in sorted(set(written) - handled):
+        rel, line = written[op]
+        findings.append(Finding(
+            "journal", rel, line,
+            f'journal record op "{op}" is written here but has no '
+            f"replay handler in _apply_record — it is silently lost "
+            f"on recovery"))
+    for op in sorted(handled - set(written)):
+        findings.append(Finding(
+            "journal", journal_rel, 1,
+            f'_apply_record handles op "{op}" but nothing writes it '
+            f"(dead replay arm / renamed writer)"))
+    return findings
+
+
+def check(root: str) -> List[Finding]:
+    sources = {}
+    for rel in WRITER_FILES:
+        text = read_text(root, rel)
+        if text is not None:
+            sources[rel] = text
+    if JOURNAL not in sources:
+        return []
+    return check_texts(sources)

@@ -1,0 +1,512 @@
+"""Lock-discipline checker for the broker's lock web.
+
+Extracts every ``with <lock>`` nesting arc from the runtime + shim
+modules and checks it against the canonical lock order declared in the
+``runtime/server.py`` module docstring (the ground truth operators read
+— keeping it machine-checked is the whole point).  Also bans blocking
+calls (socket I/O, journal writes, fsync, subprocess, sleeps,
+condition waits) under the locks the docstring lists as
+``no-blocking-under``, with call summaries propagated transitively one
+module-set-wide fixpoint deep, so ``drop_array -> _journal_drop ->
+journal.append`` is caught even though no journal call is textually
+inside the ``with``.
+
+Ground-truth grammar (parsed out of the server docstring)::
+
+    lock-order ground truth (vtpu-analyze):
+        order: A > B          # A may be held while acquiring B
+        leaf: X, Y            # nothing may be acquired while holding X
+        no-blocking-under: X, Y
+
+Declared arcs are closed transitively; an observed arc outside the
+closure, an arc out of a ``leaf:`` lock, a same-lock re-entry, or a
+cycle in the declared graph itself each produce a finding.  A lock
+expression the canonicalizer cannot classify is ALSO a finding — new
+locks must be added to the tables below and to the docstring.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, read_text, PKG_NAME
+
+SERVER = f"{PKG_NAME}/runtime/server.py"
+
+# Files whose lock behavior is analyzed (the broker web + everything
+# that runs inside tenant processes).
+ANALYZED = [
+    f"{PKG_NAME}/runtime/server.py",
+    f"{PKG_NAME}/runtime/client.py",
+    f"{PKG_NAME}/runtime/journal.py",
+    f"{PKG_NAME}/runtime/trace.py",
+    f"{PKG_NAME}/shim/bridge.py",
+    f"{PKG_NAME}/shim/core.py",
+    f"{PKG_NAME}/shim/pyshim.py",
+    f"{PKG_NAME}/shim/sitecustomize.py",
+    f"{PKG_NAME}/shim/vtpu_smi_lite.py",
+]
+
+# (enclosing class, self-attribute) -> canonical lock name.
+CLASS_LOCKS: Dict[Tuple[str, str], str] = {
+    ("DeviceScheduler", "mu"): "scheduler.mu",
+    ("RuntimeState", "mu"): "state.mu",
+    ("RuntimeState", "chips_mu"): "chips_mu",
+    ("RuntimeState", "put_cache_mu"): "put_cache_mu",
+    ("Tenant", "mu"): "tenant.mu",
+    ("TenantSession", "send_mu"): "session.send_mu",
+    ("TenantSession", "pending_cond"): "session.pending_cond",
+    ("Journal", "mu"): "journal.mu",
+    ("FlightRecorder", "mu"): "flight.mu",
+    ("Bridge", "_mu"): "bridge.mu",
+    ("BridgedFunction", "_mu"): "bridge.fn_mu",
+}
+
+# Bare-name locks (module-level objects).
+NAME_LOCKS: Dict[str, str] = {
+    "_bridge_mu": "bridge.global_mu",
+}
+
+# Non-self attribute tails: (previous chain element, attr) -> canonical.
+CHAIN_LOCKS: Dict[Tuple[str, str], str] = {
+    ("scheduler", "mu"): "scheduler.mu",
+    ("state", "mu"): "state.mu",
+    ("state", "chips_mu"): "chips_mu",
+    ("tenant", "mu"): "tenant.mu",
+    ("t", "mu"): "tenant.mu",
+    ("pending_cond", ""): "session.pending_cond",
+}
+
+# SharedRegion / native-region methods: each takes the region's robust
+# process-shared mutex (canonical innermost lock "region.lock").
+REGION_METHODS = {
+    "mem_acquire", "mem_acquire_capped", "mem_release", "mem_info",
+    "device_stats", "proc_stats", "rate_acquire", "rate_adjust",
+    "rate_block", "rate_level", "set_core_limit", "set_mem_limit",
+    "set_work_conserving", "reset_slot", "busy_add", "register",
+    "deregister", "sweep_dead", "sweep_dead_host", "active_procs",
+}
+
+# Directly-blocking callables: attribute tails that do socket I/O,
+# durable file I/O or sleeps.  ``wait`` is handled specially (a
+# condition wait on the HELD lock releases it and is the sanctioned
+# pattern; any other wait is a block).
+BLOCKING_ATTRS = {
+    "sendall", "recv", "recv_into", "connect", "accept", "fsync",
+    "sleep", "send_msg", "recv_msg", "check_call", "check_output",
+    "run", "Popen", "communicate",
+}
+# Journal write methods: file I/O under journal.mu — blocking AND an
+# arc to journal.mu.  Matched only when the receiver chain mentions the
+# journal (``self.journal.append`` / ``jr.append`` / ``journal.append``)
+# so list.append etc. never false-positive.
+JOURNAL_WRITE_ATTRS = {"append", "put_blob", "write_snapshot"}
+JOURNAL_BASES = ("journal", "jr")
+
+_COMMON_METHODS = {
+    # never resolved through the unique-name fallback: too generic
+    "append", "extend", "get", "pop", "add", "remove", "close", "read",
+    "write", "items", "values", "keys", "clear", "update", "join",
+    "start", "copy", "popitem", "move_to_end", "discard", "put",
+    "send", "setdefault", "split", "strip", "encode", "decode", "wait",
+    "notify", "notify_all", "acquire", "release", "get_nowait", "stop",
+    "main", "check", "render", "fetch", "delete", "flush", "emit",
+}
+
+
+def _chain(node: ast.AST) -> str:
+    """Dotted-ish text of an attribute chain: ``self.chips[0].region.x``
+    -> ``self.chips[].region.x`` (subscripts/calls flattened)."""
+    if isinstance(node, ast.Attribute):
+        return _chain(node.value) + "." + node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        return _chain(node.value) + "[]"
+    if isinstance(node, ast.Call):
+        return _chain(node.func) + "()"
+    return "?"
+
+
+def canon_lock(node: ast.AST, cls: Optional[str]) -> Optional[str]:
+    """Canonical lock name for a ``with`` context expression, or None
+    when the expression is not lock-shaped (e.g. ``with open(...)``)."""
+    if isinstance(node, ast.Name):
+        return NAME_LOCKS.get(node.id)
+    if not isinstance(node, ast.Attribute):
+        return None
+    chain = _chain(node)
+    parts = chain.split(".")
+    tail = parts[-1]
+    if tail not in ("mu", "chips_mu", "put_cache_mu", "send_mu",
+                    "pending_cond", "_mu"):
+        return None
+    if len(parts) == 2 and parts[0] == "self" and cls:
+        return CLASS_LOCKS.get((cls, tail))
+    prev = parts[-2] if len(parts) >= 2 else ""
+    prev = prev.rstrip("[]()")
+    if tail == "chips_mu":
+        return "chips_mu"
+    if tail == "put_cache_mu":
+        return "put_cache_mu"
+    if tail == "send_mu":
+        return "session.send_mu"
+    if tail == "pending_cond":
+        return "session.pending_cond"
+    return CHAIN_LOCKS.get((prev, tail))
+
+
+# -- ground truth ---------------------------------------------------------
+
+GT_HEADER = "lock-order ground truth (vtpu-analyze):"
+
+
+class GroundTruth:
+    def __init__(self) -> None:
+        self.arcs: Set[Tuple[str, str]] = set()
+        self.leaves: Set[str] = set()
+        self.no_blocking: Set[str] = set()
+        self.known: Set[str] = set()
+
+    def closure(self) -> Set[Tuple[str, str]]:
+        closed = set(self.arcs)
+        changed = True
+        while changed:
+            changed = False
+            for a, b in list(closed):
+                for c, d in list(closed):
+                    if b == c and (a, d) not in closed and a != d:
+                        closed.add((a, d))
+                        changed = True
+        return closed
+
+    def cycle(self) -> Optional[Tuple[str, str]]:
+        return next(((a, b) for a, b in self.closure()
+                     if (b, a) in self.closure()), None)
+
+
+def parse_ground_truth(server_src: str) -> Optional[GroundTruth]:
+    """Pull the declared order out of the server module docstring."""
+    try:
+        tree = ast.parse(server_src)
+    except SyntaxError:
+        return None
+    doc = ast.get_docstring(tree) or ""
+    if GT_HEADER not in doc:
+        return None
+    gt = GroundTruth()
+    block = doc.split(GT_HEADER, 1)[1]
+    # The block ends at the first blank-line-separated paragraph that
+    # carries none of our directives.
+    for raw in block.splitlines():
+        line = raw.strip()
+        m = re.match(r"order:\s*(\S+)\s*>\s*(\S+)", line)
+        if m:
+            gt.arcs.add((m.group(1), m.group(2)))
+            gt.known.update(m.groups())
+            continue
+        m = re.match(r"(leaf|no-blocking-under):\s*(.+)", line)
+        if m:
+            names = [t.strip() for t in m.group(2).split(",") if t.strip()]
+            if m.group(1) == "leaf":
+                gt.leaves.update(names)
+            else:
+                gt.no_blocking.update(names)
+            gt.known.update(names)
+    return gt
+
+
+# -- per-function facts ---------------------------------------------------
+
+class FnFacts:
+    def __init__(self, qualname: str, name: str, path: str) -> None:
+        self.qualname = qualname
+        self.name = name
+        self.path = path
+        self.locks: Set[str] = set()      # locks acquired directly
+        self.blocking: List[Tuple[int, str]] = []  # direct blocking sites
+        self.calls: Set[str] = set()      # bare callee names (fallback)
+
+
+class _FnVisitor(ast.NodeVisitor):
+    """Collects, per with-block, the held-lock stack; records arcs,
+    direct blocking calls and callee names for the summary fixpoint."""
+
+    def __init__(self, checker: "_Checker", facts: FnFacts,
+                 cls: Optional[str]) -> None:
+        self.c = checker
+        self.facts = facts
+        self.cls = cls
+        self.stack: List[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # nested defs get their own facts via _Checker
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            lock = canon_lock(item.context_expr, self.cls)
+            if lock is None:
+                expr = item.context_expr
+                if isinstance(expr, (ast.Attribute, ast.Name)) and \
+                        _chain(expr).split(".")[-1].endswith("mu"):
+                    self.c.finding(
+                        self.facts.path, expr.lineno,
+                        f"unclassifiable lock expression "
+                        f"`{_chain(expr)}` in {self.facts.qualname} — "
+                        f"extend tools/analyze/locks.py tables and the "
+                        f"server docstring ground truth")
+                continue
+            self.facts.locks.add(lock)
+            for held in self.stack:
+                self.c.observe(held, lock, self.facts.path,
+                               item.context_expr.lineno,
+                               self.facts.qualname)
+            if lock in self.stack:
+                self.c.finding(
+                    self.facts.path, item.context_expr.lineno,
+                    f"{self.facts.qualname} re-enters {lock} already "
+                    f"held (non-reentrant deadlock)")
+            self.stack.append(lock)
+            pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.stack.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        held = list(self.stack)
+        if isinstance(fn, ast.Attribute):
+            chain = _chain(fn)
+            base_parts = [p.rstrip("[]()")
+                          for p in chain.split(".")[:-1]]
+            attr = fn.attr
+            if attr in REGION_METHODS and "region" in base_parts:
+                self.c.touch_lock("region.lock", held, self.facts,
+                                  node.lineno)
+            elif attr in JOURNAL_WRITE_ATTRS and \
+                    any(b in JOURNAL_BASES for b in base_parts):
+                self.c.touch_lock("journal.mu", held, self.facts,
+                                  node.lineno)
+                self.c.block_site(self.facts, held, node.lineno,
+                                  f"journal write `{chain}`")
+            elif attr in BLOCKING_ATTRS:
+                self.c.block_site(self.facts, held, node.lineno,
+                                  f"blocking call `{chain}`")
+            elif attr == "wait":
+                base = canon_lock(fn.value, self.cls)
+                if held and base != held[-1]:
+                    # waiting on something other than the innermost held
+                    # lock blocks while still holding it
+                    self.c.block_site(self.facts, held, node.lineno,
+                                      f"wait on `{chain}` while holding "
+                                      f"{held[-1]}")
+                self.facts.blocking.append(
+                    (node.lineno, f"condition wait `{chain}`"))
+            elif attr not in _COMMON_METHODS:
+                self.facts.calls.add(attr)
+                self.c.call_site(self.facts, attr, held, node.lineno)
+        elif isinstance(fn, ast.Name):
+            if fn.id in BLOCKING_ATTRS:
+                self.c.block_site(self.facts, held, node.lineno,
+                                  f"blocking call `{fn.id}`")
+            else:
+                self.facts.calls.add(fn.id)
+                self.c.call_site(self.facts, fn.id, held, node.lineno)
+        self.generic_visit(node)
+
+
+class _Checker:
+    def __init__(self, gt: GroundTruth) -> None:
+        self.gt = gt
+        self.closure = gt.closure()
+        self.findings: List[Finding] = []
+        self.fns: Dict[str, List[FnFacts]] = {}
+        # (caller facts, callee name, held locks, line)
+        self.deferred_calls: List[Tuple[FnFacts, str, List[str], int]] = []
+
+    def finding(self, path: str, line: int, msg: str) -> None:
+        self.findings.append(Finding("locks", path, line, msg))
+
+    def observe(self, outer: str, inner: str, path: str, line: int,
+                where: str) -> None:
+        if outer == inner:
+            return
+        if outer in self.gt.leaves:
+            self.finding(path, line,
+                         f"{where} acquires {inner} while holding leaf "
+                         f"lock {outer}")
+        elif (outer, inner) not in self.closure:
+            self.finding(path, line,
+                         f"{where} nests {inner} under {outer}: edge not "
+                         f"in the declared lock order (server docstring)")
+
+    def touch_lock(self, lock: str, held: List[str], facts: FnFacts,
+                   line: int) -> None:
+        facts.locks.add(lock)
+        for h in held:
+            self.observe(h, lock, facts.path, line, facts.qualname)
+
+    def block_site(self, facts: FnFacts, held: List[str], line: int,
+                   what: str) -> None:
+        facts.blocking.append((line, what))
+        for h in held:
+            if h in self.gt.no_blocking:
+                self.finding(facts.path, line,
+                             f"{facts.qualname}: {what} while holding "
+                             f"{h} (no-blocking-under)")
+
+    def call_site(self, facts: FnFacts, callee: str, held: List[str],
+                  line: int) -> None:
+        if held:
+            self.deferred_calls.append((facts, callee, list(held), line))
+
+    # -- summaries --------------------------------------------------------
+
+    def resolve(self, name: str) -> Optional[FnFacts]:
+        """Unique-name resolution: a callee name matching exactly one
+        analyzed function resolves to it; ambiguous or generic names
+        are skipped (over-approximation kept one-sided: misses are
+        possible, false positives are not)."""
+        if name in _COMMON_METHODS:
+            return None
+        cands = self.fns.get(name, ())
+        return cands[0] if len(cands) == 1 else None
+
+    def fixpoint(self) -> Tuple[Dict[str, Set[str]], Dict[str, str]]:
+        """Transitive (locks-acquired, blocks?) summaries per function
+        qualname, via the unique-name call graph."""
+        eff_locks: Dict[str, Set[str]] = {}
+        eff_block: Dict[str, str] = {}
+        for fl in self.fns.values():
+            for f in fl:
+                eff_locks[f.qualname] = set(f.locks)
+                if f.blocking:
+                    eff_block[f.qualname] = f.blocking[0][1]
+        changed = True
+        while changed:
+            changed = False
+            for fl in self.fns.values():
+                for f in fl:
+                    for callee in f.calls:
+                        tgt = self.resolve(callee)
+                        if tgt is None:
+                            continue
+                        add = eff_locks.get(tgt.qualname, set()) \
+                            - eff_locks[f.qualname]
+                        if add:
+                            eff_locks[f.qualname] |= add
+                            changed = True
+                        if tgt.qualname in eff_block and \
+                                f.qualname not in eff_block:
+                            eff_block[f.qualname] = (
+                                f"calls {tgt.qualname} which does "
+                                f"{eff_block[tgt.qualname]}")
+                            changed = True
+        return eff_locks, eff_block
+
+    def check_deferred(self) -> None:
+        eff_locks, eff_block = self.fixpoint()
+        for facts, callee, held, line in self.deferred_calls:
+            tgt = self.resolve(callee)
+            if tgt is None:
+                continue
+            for lock in eff_locks.get(tgt.qualname, ()):
+                for h in held:
+                    self.observe(h, lock, facts.path, line,
+                                 f"{facts.qualname} (via {callee})")
+            if tgt.qualname in eff_block:
+                for h in held:
+                    if h in self.gt.no_blocking:
+                        self.finding(
+                            facts.path, line,
+                            f"{facts.qualname}: call to {callee} "
+                            f"({eff_block[tgt.qualname]}) while holding "
+                            f"{h} (no-blocking-under)")
+
+
+def check_sources(sources: Dict[str, str],
+                  server_rel: str = SERVER) -> List[Finding]:
+    """Analyze a {relpath: text} tree (tests feed fixture snippets)."""
+    server_src = sources.get(server_rel)
+    if server_src is None:
+        return [Finding("locks", server_rel, 1,
+                        "server module missing — cannot load lock-order "
+                        "ground truth")]
+    gt = parse_ground_truth(server_src)
+    if gt is None:
+        return [Finding("locks", server_rel, 1,
+                        f"module docstring has no `{GT_HEADER}` block — "
+                        f"the canonical lock order must be declared")]
+    cyc = gt.cycle()
+    if cyc is not None:
+        return [Finding("locks", server_rel, 1,
+                        f"declared lock order is cyclic: "
+                        f"{cyc[0]} <-> {cyc[1]}")]
+    checker = _Checker(gt)
+    # pass 1: per-function facts
+    visits: List[Tuple[_FnVisitor, ast.FunctionDef]] = []
+    for rel, src in sorted(sources.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            checker.finding(rel, e.lineno or 1, f"syntax error: {e.msg}")
+            continue
+        # Innermost enclosing class per function (ast.walk is BFS, so a
+        # nested class's pass overwrites its outer class's entry).
+        cls_of: Dict[ast.AST, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        cls_of[sub] = node.name
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            cls = cls_of.get(node)
+            qual = f"{cls}.{node.name}" if cls else node.name
+            name = cls if node.name == "__init__" and cls else node.name
+            facts = FnFacts(f"{rel}:{qual}", name, rel)
+            checker.fns.setdefault(name, []).append(facts)
+            visits.append((_FnVisitor(checker, facts, cls), node))
+    for visitor, node in visits:
+        for stmt in node.body:
+            visitor.visit(stmt)
+    # pass 2: transitive summaries against the recorded call sites
+    checker.check_deferred()
+    # every canonical lock seen must be declared somewhere in the GT
+    for fl in checker.fns.values():
+        for f in fl:
+            for lock in f.locks:
+                if lock not in gt.known:
+                    checker.finding(
+                        f.path, 1,
+                        f"lock {lock} (used in {f.qualname}) is not "
+                        f"mentioned in the ground-truth block")
+    # dedup (the same arc can be observed via many paths)
+    seen: Set[Tuple[str, int, str]] = set()
+    out = []
+    for f in checker.findings:
+        key = (f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def check(root: str) -> List[Finding]:
+    sources = {}
+    for rel in ANALYZED:
+        text = read_text(root, rel)
+        if text is not None:
+            sources[rel] = text
+    if SERVER not in sources:
+        return []
+    return check_sources(sources)

@@ -1,0 +1,225 @@
+"""Protocol-verb exhaustiveness checker.
+
+The wire protocol is three hand-maintained halves — verb constants in
+``runtime/protocol.py``, dispatch arms in the broker
+(``TenantSession._serve`` / ``AdminSession.handle``), and senders in
+``runtime/client.py`` / ``tools/vtpu_smi.py``.  Nothing ties them
+together at runtime (an unknown verb just earns BAD_KIND), so a new
+verb can silently ship with no broker arm or no client binding.  This
+checker proves, per verb:
+
+  - membership in exactly the protocol registries
+    (``TENANT_VERBS`` / ``ADMIN_VERBS`` / ``BIND_FREE_VERBS``);
+  - a dispatch arm on every socket that serves it;
+  - a sender binding (client for tenant verbs, vtpu-smi for admin);
+  - bind-free verbs answered BEFORE the NO_HELLO guard on the tenant
+    socket and present on the admin socket too (the no-wedge probe
+    contract, ADVICE r5 #2).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, read_text, PKG_NAME
+
+PROTOCOL = f"{PKG_NAME}/runtime/protocol.py"
+SERVER = f"{PKG_NAME}/runtime/server.py"
+CLIENT = f"{PKG_NAME}/runtime/client.py"
+SMI = f"{PKG_NAME}/tools/vtpu_smi.py"
+
+
+def parse_protocol(src: str, path: str = PROTOCOL
+                   ) -> Tuple[Dict[str, int], Dict[str, Set[str]],
+                              List[Finding]]:
+    """(verb constants {NAME: line}, registries {REGISTRY: {NAME}},
+    findings)."""
+    findings: List[Finding] = []
+    verbs: Dict[str, int] = {}
+    registries: Dict[str, Set[str]] = {}
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return {}, {}, [Finding("verbs", path, e.lineno or 1,
+                                f"syntax error: {e.msg}")]
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name) or not tgt.id.isupper():
+            continue
+        val = node.value
+        if isinstance(val, ast.Constant) and isinstance(val.value, str):
+            verbs[tgt.id] = node.lineno
+        elif isinstance(val, (ast.Tuple, ast.List)) and \
+                tgt.id.endswith("_VERBS"):
+            names = set()
+            for el in val.elts:
+                if isinstance(el, ast.Name):
+                    names.add(el.id)
+                else:
+                    findings.append(Finding(
+                        "verbs", path, el.lineno,
+                        f"{tgt.id} entry is not a verb constant name"))
+            registries[tgt.id] = names
+    for reg in ("TENANT_VERBS", "ADMIN_VERBS", "BIND_FREE_VERBS"):
+        if reg not in registries:
+            findings.append(Finding(
+                "verbs", path, 1,
+                f"protocol registry {reg} is missing"))
+            registries[reg] = set()
+    known = registries["TENANT_VERBS"] | registries["ADMIN_VERBS"]
+    for name, line in verbs.items():
+        if name not in known:
+            findings.append(Finding(
+                "verbs", path, line,
+                f"verb {name} is in neither TENANT_VERBS nor "
+                f"ADMIN_VERBS"))
+    for reg, names in registries.items():
+        for name in names:
+            if name not in verbs:
+                findings.append(Finding(
+                    "verbs", path, 1,
+                    f"{reg} names unknown verb constant {name}"))
+    for name in registries["BIND_FREE_VERBS"]:
+        for reg in ("TENANT_VERBS", "ADMIN_VERBS"):
+            if name in verbs and name not in registries[reg]:
+                findings.append(Finding(
+                    "verbs", path, verbs.get(name, 1),
+                    f"bind-free verb {name} must be served on both "
+                    f"sockets but is missing from {reg}"))
+    return verbs, registries, findings
+
+
+def _find_func(tree: ast.AST, cls: str, fn: str
+               ) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) and sub.name == fn:
+                    return sub
+    return None
+
+
+def dispatch_arms(fn: ast.FunctionDef) -> Dict[str, int]:
+    """{verb constant name: first line} for every ``kind == P.X`` /
+    ``kind in (P.X, ...)`` comparison in the handler."""
+    arms: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        involved = [node.left] + list(node.comparators)
+        names = []
+        for part in involved:
+            if isinstance(part, ast.Attribute) and \
+                    isinstance(part.value, ast.Name) and \
+                    part.value.id == "P":
+                names.append(part.attr)
+            elif isinstance(part, (ast.Tuple, ast.List)):
+                for el in part.elts:
+                    if isinstance(el, ast.Attribute) and \
+                            isinstance(el.value, ast.Name) and \
+                            el.value.id == "P":
+                        names.append(el.attr)
+        for name in names:
+            arms.setdefault(name, node.lineno)
+    return arms
+
+
+def no_hello_line(fn: ast.FunctionDef) -> Optional[int]:
+    """Line of the ``NO_HELLO`` bind guard in _serve."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Constant) and node.value == "NO_HELLO":
+            return node.lineno
+    return None
+
+
+def sender_bindings(src: str) -> Set[str]:
+    """Verb constants sent by a module: dict literals carrying
+    ``"kind": P.X``."""
+    out: Set[str] = set()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and k.value == "kind" and \
+                    isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and v.value.id == "P":
+                out.add(v.attr)
+    return out
+
+
+def check_texts(protocol_src: str, server_src: str, client_src: str,
+                smi_src: str) -> List[Finding]:
+    verbs, registries, findings = parse_protocol(protocol_src)
+    if not verbs:
+        return findings
+    try:
+        server_tree = ast.parse(server_src)
+    except SyntaxError as e:
+        return findings + [Finding("verbs", SERVER, e.lineno or 1,
+                                   f"syntax error: {e.msg}")]
+    serve = _find_func(server_tree, "TenantSession", "_serve")
+    admin = _find_func(server_tree, "AdminSession", "handle")
+    if serve is None or admin is None:
+        return findings + [Finding(
+            "verbs", SERVER, 1,
+            "cannot locate TenantSession._serve / AdminSession.handle")]
+    tenant_arms = dispatch_arms(serve)
+    admin_arms = dispatch_arms(admin)
+    for name in sorted(registries["TENANT_VERBS"]):
+        if name not in tenant_arms:
+            findings.append(Finding(
+                "verbs", SERVER, serve.lineno,
+                f"tenant verb {name} has no dispatch arm in "
+                f"TenantSession._serve"))
+    for name in sorted(registries["ADMIN_VERBS"]):
+        if name not in admin_arms:
+            findings.append(Finding(
+                "verbs", SERVER, admin.lineno,
+                f"admin verb {name} has no dispatch arm in "
+                f"AdminSession.handle"))
+    guard = no_hello_line(serve)
+    if guard is None:
+        findings.append(Finding(
+            "verbs", SERVER, serve.lineno,
+            "cannot locate the NO_HELLO bind guard in _serve"))
+    else:
+        for name in sorted(registries["BIND_FREE_VERBS"]):
+            line = tenant_arms.get(name)
+            if line is not None and line > guard:
+                findings.append(Finding(
+                    "verbs", SERVER, line,
+                    f"bind-free verb {name} is dispatched AFTER the "
+                    f"NO_HELLO guard (line {guard}) — an unbound probe "
+                    f"would be refused"))
+    client_sends = sender_bindings(client_src)
+    for name in sorted(registries["TENANT_VERBS"]):
+        if name not in client_sends:
+            findings.append(Finding(
+                "verbs", CLIENT, 1,
+                f"tenant verb {name} has no client binding in "
+                f"runtime/client.py"))
+    smi_sends = sender_bindings(smi_src)
+    for name in sorted(registries["ADMIN_VERBS"]):
+        # STATS/TRACE ride the main socket from vtpu-smi too; any P.X
+        # dict in the module counts as the operator binding.
+        if name not in smi_sends:
+            findings.append(Finding(
+                "verbs", SMI, 1,
+                f"admin verb {name} has no vtpu-smi binding"))
+    return findings
+
+
+def check(root: str) -> List[Finding]:
+    srcs = {rel: read_text(root, rel)
+            for rel in (PROTOCOL, SERVER, CLIENT, SMI)}
+    if any(v is None for v in srcs.values()):
+        return []
+    return check_texts(srcs[PROTOCOL], srcs[SERVER], srcs[CLIENT],
+                       srcs[SMI])

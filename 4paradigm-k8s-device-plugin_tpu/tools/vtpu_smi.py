@@ -270,10 +270,12 @@ def cmd_leases(ns) -> int:
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
     ap.add_argument("cmd", nargs="?", default=None,
-                    choices=("trace", "leases"),
+                    choices=("trace", "leases", "analyze"),
                     help="trace: flight-recorder spans (needs "
                          "--broker; --dump FILE exports Chrome-trace "
-                         "JSON); leases: chip-lease sidecar forensics")
+                         "JSON); leases: chip-lease sidecar forensics; "
+                         "analyze: cross-layer invariant linters "
+                         "(docs/ANALYSIS.md)")
     ap.add_argument("cmd_arg", nargs="?", default=None,
                     help="tenant name for `trace`")
     ap.add_argument("--dump", default=None, metavar="FILE",
@@ -310,18 +312,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="--drain, then exit the broker gracefully so "
                          "the supervisor's successor recovers the "
                          "journal (zero-downtime upgrade)")
+    ap.add_argument("--shutdown", action="store_true",
+                    help="stop the broker gracefully WITHOUT the drain "
+                         "quiesce/snapshot (SHUTDOWN verb; prefer "
+                         "--handover for zero-downtime upgrades)")
     ns = ap.parse_args(argv)
 
     if ns.cmd == "leases":
         return cmd_leases(ns)
     if ns.cmd == "trace":
         return cmd_trace(ns, ns.region or find_regions(ns.scan))
+    if ns.cmd == "analyze":
+        # Static-analysis suite (tools/analyze): lock discipline, verb
+        # exhaustiveness, env-flag contract, journal replay coverage.
+        from .analyze import main as analyze_main
+        return analyze_main(["--json"] if ns.json else [])
 
     admin_verbs = (ns.suspend or ns.resume or ns.broker_stats
-                   or ns.drain or ns.handover)
+                   or ns.drain or ns.handover or ns.shutdown)
     if admin_verbs and not ns.broker:
-        ap.error("--suspend/--resume/--broker-stats/--drain/--handover "
-                 "need --broker <main socket>")
+        ap.error("--suspend/--resume/--broker-stats/--drain/--handover/"
+                 "--shutdown need --broker <main socket>")
     if ns.broker:
         from ..runtime import protocol as P
         if ns.suspend:
@@ -338,9 +349,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif ns.handover:
             resp = _admin_request(ns.broker, {"kind": P.HANDOVER},
                                   timeout=90.0)
+        elif ns.shutdown:
+            resp = _admin_request(ns.broker, {"kind": P.SHUTDOWN})
         else:
             ap.error("--broker needs --suspend/--resume/--broker-stats/"
-                     "--drain/--handover")
+                     "--drain/--handover/--shutdown")
         print(json.dumps(resp, indent=2))
         return 0 if resp.get("ok") else 1
 

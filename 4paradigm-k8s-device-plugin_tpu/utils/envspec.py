@@ -102,6 +102,107 @@ ALL_ENV_VARS = [
     ENV_PCIBUS_FILE,
 ]
 
+# ---------------------------------------------------------------------------
+# Flag registry — the single declaration point for EVERY `VTPU_*` env
+# var any layer reads (the Allocate contract vars above included).
+#
+# Machine-checked by `vtpu-smi analyze` (vtpu.tools.analyze.envflags):
+# a VTPU_* literal read anywhere in the Python or native tree that is
+# not declared here, a declared flag missing from docs/FLAGS.md, or a
+# helm-marked flag absent from deployments/helm/.../values.yaml each
+# fail CI.  Adding a flag means adding all three.
+#
+# Value is (scope, helm): scope documents the reading layer —
+# "contract" (daemon-injected Allocate env), "daemon", "broker",
+# "shim" (in-container client/bridge/interposer), "native" (C++-only),
+# "trace", "tools", "bench" — and helm=True marks an operator tunable
+# surfaced in the chart values.
+# ---------------------------------------------------------------------------
+
+ENV_FLAGS = {
+    # Allocate contract (producer plugin/server.py; consumers shim +
+    # native interposer + broker).
+    ENV_HBM_LIMIT: ("contract", False),
+    ENV_CORE_LIMIT: ("contract", False),
+    ENV_DEVICE_MAP: ("contract", False),
+    ENV_SHARED_CACHE: ("contract", False),
+    ENV_OVERSUBSCRIBE: ("contract", False),
+    ENV_TASK_PRIORITY: ("contract", False),
+    ENV_UTILIZATION_POLICY: ("contract", False),
+    ENV_ACTIVE_OOM_KILLER: ("contract", False),
+    ENV_MIN_EXEC_COST: ("contract", True),
+    ENV_VISIBLE_DEVICES: ("contract", False),
+    ENV_RUNTIME_SOCKET: ("contract", False),
+    ENV_LOG_LEVEL: ("contract", False),
+    ENV_PCIBUS_FILE: ("contract", False),
+    # Daemon (plugin/config.py, discovery, health).
+    "VTPU_DISCOVERY": ("daemon", False),
+    "VTPU_ENABLE_RUNTIME": ("daemon", False),
+    "VTPU_MONITOR_MODE": ("daemon", False),
+    "VTPU_HOST_LIB_DIR": ("daemon", False),
+    "VTPU_POD_INFORMER": ("daemon", True),
+    "VTPU_DISABLE_HEALTHCHECKS": ("daemon", False),
+    "VTPU_HEALTH_INTERVAL": ("daemon", False),
+    "VTPU_ALLOW_FAKE": ("daemon", False),
+    "VTPU_FAKE_CHIPS": ("daemon", False),
+    "VTPU_FAKE_GENERATION": ("daemon", False),
+    "VTPU_FAKE_FAULT_DIR": ("daemon", False),
+    # Broker (runtime/server.py, journal.py, protocol.py).
+    "VTPU_JOURNAL_DIR": ("broker", True),
+    "VTPU_JOURNAL_FSYNC": ("broker", True),
+    "VTPU_JOURNAL_SNAPSHOT_EVERY": ("broker", False),
+    "VTPU_RESUME_GRACE_S": ("broker", True),
+    "VTPU_MAX_QUEUE_US": ("broker", True),
+    "VTPU_WORK_CONSERVING": ("broker", True),
+    "VTPU_PUT_DEDUP": ("broker", True),
+    "VTPU_PUT_CHUNK_BYTES": ("broker", False),
+    "VTPU_SPILL_RESIDENT_OVERSHOOT": ("broker", True),
+    "VTPU_CLAIM_WATCHDOG_S": ("broker", True),
+    "VTPU_COMPILE_CACHE_DIR": ("broker", True),
+    # In-container shim / client / bridge / native interposer.
+    "VTPU_TENANT": ("shim", False),
+    "VTPU_RECONNECT_TIMEOUT_S": ("shim", False),
+    "VTPU_BRIDGE": ("shim", False),
+    "VTPU_BRIDGE_CONNECT_TIMEOUT": ("shim", False),
+    "VTPU_EXTRA_PYTHONPATH": ("shim", False),
+    "VTPU_FORCE_PY_ENFORCEMENT": ("shim", False),
+    "VTPU_REAL_LIBTPU": ("shim", False),
+    "VTPU_INTERPOSER_LIB": ("shim", False),
+    "VTPU_CORE_LIB": ("shim", False),
+    "VTPU_INTERPOSER_PATH": ("native", False),
+    "VTPU_PRELOAD_DISABLE": ("native", False),
+    "VTPU_EXEC_COST_US": ("native", False),
+    "VTPU_CORE_INDICES": ("native", False),
+    "VTPU_HOST_PID": ("native", False),
+    "VTPU_WC_WINDOW_US": ("native", False),
+    "VTPU_FOREIGN_LIVE_WINDOW_US": ("native", False),
+    # vtpu-trace (docs/TRACING.md).
+    "VTPU_TRACE": ("trace", True),
+    "VTPU_TRACE_RING": ("trace", True),
+    "VTPU_TRACE_RING_KB": ("trace", True),
+    "VTPU_SLOW_OP_FACTOR": ("trace", True),
+    "VTPU_LEASE_SIDECAR": ("trace", True),
+    # Tools / bench.
+    "VTPU_METRICS_PORT": ("tools", True),
+    "VTPU_BENCH_CHAIN": ("bench", False),
+    "VTPU_BENCH_RESNET_CHAIN": ("bench", False),
+    "VTPU_BENCH_CHIP_WAIT_S": ("bench", False),
+}
+
+# Per-ordinal derived forms: VTPU_DEVICE_HBM_LIMIT_<i>.
+ENV_FLAG_PREFIXES = (ENV_HBM_LIMIT + "_",)
+
+
+def flag_declared(name: str) -> bool:
+    """True when `name` is a registered flag (or a per-ordinal form of
+    a registered prefix) — the env-flag contract the analyzer holds
+    the whole tree to."""
+    if name in ENV_FLAGS:
+        return True
+    return any(name.startswith(p) and name[len(p):].isdigit()
+               for p in ENV_FLAG_PREFIXES)
+
+
 # Hard cap mirrored in native/vtpucore/shrreg.h (reference: "Max Gpus Per
 # Node can't excced 16").
 MAX_DEVICES_PER_NODE = 16

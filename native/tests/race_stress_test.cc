@@ -1,0 +1,254 @@
+/* race_stress_test — ThreadSanitizer workload for the vtpucore
+ * concurrency surfaces (CI job `analyze`: make -C native tsan).
+ *
+ * Phases:
+ *  1. trace ring — 4+ writer threads emitting into a deliberately tiny
+ *     ring (constant wrap) while 2 readers chase the head; every event
+ *     a reader accepts must be internally consistent (the seqlock's
+ *     whole contract: torn payloads are discarded, never surfaced).
+ *  2. shared region — 8 threads hammering mem_acquire/mem_release,
+ *     rate_acquire/rate_adjust, busy_add, stats reads and rate_level
+ *     on overlapping device slots, plus a sweeper thread injecting
+ *     dead slots (vtpu_test_poke_slot) and reclaiming them mid-flight.
+ *     Books must balance to zero once joined.
+ *  3. fork/atfork — fork while the region is open; the child (re-
+ *     registered by the atfork handler) does real accounting work and
+ *     exits cleanly.
+ *  4. holder death — a forked child takes the robust region mutex
+ *     (vtpu_test_lock_region) and dies holding it; the parent's next
+ *     operation must adopt via EOWNERDEAD and keep the books sane.
+ *
+ * Run: race_stress_test <scratch-dir>
+ */
+#include "vtpu_core.h"
+
+#include <assert.h>
+#include <errno.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      fprintf(stderr, "CHECK failed %s:%d: %s\n", __FILE__, __LINE__,   \
+              #cond);                                                   \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+/* ---- phase 1: trace ring ----------------------------------------------- */
+
+enum { kWriters = 4, kEventsPerWriter = 20000, kReaders = 2 };
+static const uint64_t kArgSalt = 0x5eed5a17u;
+
+static vtpu_trace_ring* g_ring;
+static std::atomic<int> g_writers_done{0};
+static std::atomic<long> g_torn{0};
+
+static void* ring_writer(void* p) {
+  uintptr_t tid = (uintptr_t)p;
+  for (uint64_t i = 0; i < kEventsPerWriter; i++) {
+    uint64_t value = (tid << 32) | i;
+    vtpu_trace_emit(g_ring, VTPU_TEV_USER + (uint32_t)tid, (uint32_t)tid,
+                    value, value ^ kArgSalt);
+  }
+  g_writers_done.fetch_add(1);
+  return NULL;
+}
+
+static void* ring_reader(void*) {
+  uint64_t cursor = 0;
+  vtpu_trace_event evs[256];
+  long seen = 0;
+  for (;;) {
+    int done = g_writers_done.load() == kWriters;
+    int n = vtpu_trace_read(g_ring, cursor, evs, 256, &cursor);
+    for (int i = 0; i < n; i++) {
+      /* Integrity: any event the seqlock SURFACES must be whole.  A
+       * mixed payload (one writer's value, another's arg/kind) means a
+       * torn read escaped the re-check. */
+      uint64_t tid = evs[i].value >> 32;
+      if (evs[i].arg != (evs[i].value ^ kArgSalt) ||
+          evs[i].kind != VTPU_TEV_USER + tid || evs[i].dev != tid) {
+        g_torn.fetch_add(1);
+      }
+      seen++;
+    }
+    if (done && n == 0) break;
+  }
+  return (void*)seen;
+}
+
+static void phase_ring(const char* dir) {
+  char path[512];
+  snprintf(path, sizeof(path), "%s/race_ring.%d", dir, (int)getpid());
+  unlink(path);
+  g_ring = vtpu_trace_open(path, 1); /* 1 KiB -> min 64 slots: wraps hard */
+  CHECK(g_ring != NULL);
+  CHECK(vtpu_trace_capacity(g_ring) >= 64);
+  pthread_t w[kWriters], r[kReaders];
+  for (uintptr_t i = 0; i < kWriters; i++)
+    pthread_create(&w[i], NULL, ring_writer, (void*)i);
+  for (int i = 0; i < kReaders; i++)
+    pthread_create(&r[i], NULL, ring_reader, NULL);
+  for (int i = 0; i < kWriters; i++) pthread_join(w[i], NULL);
+  long seen = 0;
+  for (int i = 0; i < kReaders; i++) {
+    void* out = NULL;
+    pthread_join(r[i], &out);
+    seen += (long)(intptr_t)out;
+  }
+  CHECK(vtpu_trace_head(g_ring) ==
+        (uint64_t)kWriters * kEventsPerWriter);
+  CHECK(g_torn.load() == 0);
+  CHECK(seen > 0);
+  vtpu_trace_close(g_ring);
+  unlink(path);
+  printf("phase 1 ring: %ld events surfaced, 0 torn\n", seen);
+}
+
+/* ---- phase 2: region accounting ---------------------------------------- */
+
+enum { kRegionThreads = 8, kIters = 4000, kDevs = 4 };
+
+static vtpu_region* g_region;
+static std::atomic<int> g_region_done{0};
+
+static void* region_worker(void* p) {
+  uintptr_t tid = (uintptr_t)p;
+  int dev = (int)(tid % kDevs);
+  for (int i = 0; i < kIters; i++) {
+    if (vtpu_mem_acquire(g_region, dev, 4096, 0) == 0)
+      vtpu_mem_release(g_region, dev, 4096);
+    uint64_t wait = vtpu_rate_acquire(g_region, dev, 50, 1);
+    if (wait == 0) vtpu_rate_adjust(g_region, dev, 10);
+    vtpu_busy_add(g_region, dev, 5);
+    if ((i & 63) == 0) {
+      vtpu_device_stats st;
+      CHECK(vtpu_device_get_stats(g_region, dev, &st) == 0);
+      uint64_t fb, tb;
+      CHECK(vtpu_mem_info(g_region, dev, &fb, &tb) == 0);
+      (void)vtpu_rate_level(g_region, dev);
+    }
+  }
+  g_region_done.fetch_add(1);
+  return NULL;
+}
+
+static void* region_sweeper(void* p) {
+  pid_t dead_pid = (pid_t)(intptr_t)p;
+  int slot = VTPU_MAX_PROCS - 1;
+  while (g_region_done.load() < kRegionThreads) {
+    /* Fabricate a dead same-namespace slot, then reclaim it: the
+     * adoption path racing live accounting. */
+    vtpu_test_poke_slot(g_region, slot, dead_pid, dead_pid, 0);
+    (void)vtpu_sweep_dead_host(g_region);
+    (void)vtpu_region_active_procs(g_region);
+    struct timespec ts = {0, 2000000}; /* 2ms */
+    nanosleep(&ts, NULL);
+  }
+  /* Leave the poked slot reclaimed. */
+  vtpu_test_poke_slot(g_region, slot, dead_pid, dead_pid, 0);
+  vtpu_sweep_dead_host(g_region);
+  return NULL;
+}
+
+static pid_t make_dead_pid(void) {
+  pid_t pid = fork();
+  CHECK(pid >= 0);
+  if (pid == 0) _exit(0);
+  int st = 0;
+  CHECK(waitpid(pid, &st, 0) == pid);
+  return pid; /* reaped: provably dead, number not yet recycled */
+}
+
+static void phase_region(const char* dir) {
+  char path[512];
+  snprintf(path, sizeof(path), "%s/race_region.%d", dir, (int)getpid());
+  unlink(path);
+  uint64_t limits[kDevs] = {1 << 26, 1 << 26, 1 << 26, 1 << 26};
+  int32_t pcts[kDevs] = {50, 50, 0, 100};
+  g_region = vtpu_region_open(path, kDevs, limits, pcts);
+  CHECK(g_region != NULL);
+  CHECK(vtpu_proc_register(g_region, 0) >= 0);
+  pid_t dead_pid = make_dead_pid();
+  pthread_t th[kRegionThreads], sw;
+  for (uintptr_t i = 0; i < kRegionThreads; i++)
+    pthread_create(&th[i], NULL, region_worker, (void*)i);
+  pthread_create(&sw, NULL, region_sweeper, (void*)(intptr_t)dead_pid);
+  for (int i = 0; i < kRegionThreads; i++) pthread_join(th[i], NULL);
+  pthread_join(sw, NULL);
+  for (int d = 0; d < kDevs; d++) {
+    vtpu_device_stats st;
+    CHECK(vtpu_device_get_stats(g_region, d, &st) == 0);
+    CHECK(st.used_bytes == 0); /* every acquire released or swept */
+  }
+  printf("phase 2 region: books balanced across %d threads\n",
+         kRegionThreads + 1);
+}
+
+/* ---- phase 3: fork / atfork -------------------------------------------- */
+
+static void phase_fork(void) {
+  pid_t pid = fork();
+  CHECK(pid >= 0);
+  if (pid == 0) {
+    /* atfork_child re-registered this process under its own pid; its
+     * accounting must work and be attributable. */
+    if (vtpu_mem_acquire(g_region, 0, 8192, 0) != 0) _exit(2);
+    vtpu_busy_add(g_region, 0, 3);
+    vtpu_mem_release(g_region, 0, 8192);
+    vtpu_proc_deregister(g_region);
+    _exit(0);
+  }
+  int st = 0;
+  CHECK(waitpid(pid, &st, 0) == pid);
+  CHECK(WIFEXITED(st) && WEXITSTATUS(st) == 0);
+  printf("phase 3 fork: child accounted and exited clean\n");
+}
+
+/* ---- phase 4: robust-mutex holder death -------------------------------- */
+
+static void phase_holder_death(void) {
+  pid_t pid = fork();
+  CHECK(pid >= 0);
+  if (pid == 0) {
+    /* Die holding the region mutex: the EOWNERDEAD path every locker
+     * must recover through. */
+    if (vtpu_test_lock_region(g_region) != 0) _exit(2);
+    _exit(0);
+  }
+  int st = 0;
+  CHECK(waitpid(pid, &st, 0) == pid);
+  CHECK(WIFEXITED(st) && WEXITSTATUS(st) == 0);
+  /* Next lock must adopt, stay consistent, and the books still work. */
+  CHECK(vtpu_mem_acquire(g_region, 1, 4096, 0) == 0);
+  vtpu_mem_release(g_region, 1, 4096);
+  CHECK(vtpu_sweep_dead(g_region) >= 0);
+  vtpu_device_stats stt;
+  CHECK(vtpu_device_get_stats(g_region, 1, &stt) == 0);
+  CHECK(stt.used_bytes == 0);
+  printf("phase 4 holder death: EOWNERDEAD adopted, books sane\n");
+}
+
+int main(int argc, char** argv) {
+  /* Forked children inherit stdio buffers; unbuffered stdout keeps the
+   * phase log from duplicating when a child exits. */
+  setbuf(stdout, NULL);
+  const char* dir = argc > 1 ? argv[1] : ".";
+  phase_ring(dir);
+  phase_region(dir);
+  phase_fork();
+  phase_holder_death();
+  vtpu_proc_deregister(g_region);
+  vtpu_region_close(g_region);
+  printf("race_stress_test OK\n");
+  return 0;
+}

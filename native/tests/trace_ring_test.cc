@@ -53,7 +53,10 @@ static void test_basic_and_wrap(void) {
 
 typedef struct {
   const char* path;
-  volatile int stop;
+  /* Read/written cross-thread: atomic builtins, not volatile —
+   * volatile is not a synchronization primitive and the plain access
+   * is a formal data race (flagged by make -C native tsan). */
+  int stop;
 } WriterArgs;
 
 static void* writer_main(void* arg) {
@@ -61,7 +64,7 @@ static void* writer_main(void* arg) {
   vtpu_trace_ring* t = vtpu_trace_open(wa->path, 1);
   assert(t);
   uint64_t i = 0;
-  while (!wa->stop) {
+  while (!__atomic_load_n(&wa->stop, __ATOMIC_ACQUIRE)) {
     /* Invariant the reader checks: arg == value * 3 + 1.  A torn read
      * accepted as valid would break it. */
     vtpu_trace_emit(t, VTPU_TEV_USER, (uint32_t)(i & 7), i, i * 3 + 1);
@@ -119,7 +122,7 @@ static void test_concurrent_torn_write_safety(void) {
   /* Phase B — writer stopped (joined): the ring is single-writer again
    * from this thread's handle, so appended events MUST be readable —
    * deterministic read-path coverage independent of phase A timing. */
-  wa.stop = 1;
+  __atomic_store_n(&wa.stop, 1, __ATOMIC_RELEASE);
   pthread_join(th, NULL);
   pthread_join(th2, NULL);
   uint64_t base = vtpu_trace_head(t);

@@ -235,6 +235,31 @@ void vtpu_trace_close(vtpu_trace_ring* t) {
   free(t);
 }
 
+/* Seqlock payload accessors: the payload fields themselves are
+ * accessed with RELAXED atomics, not plain loads/stores.  A plain copy
+ * racing a concurrent wrap re-fill is a data race in the C++ memory
+ * model even though the seq re-check discards the torn value —
+ * ThreadSanitizer (make -C native tsan) flags it, and the standard
+ * makes the racing read undefined rather than merely garbage.  Relaxed
+ * per-field atomics cost nothing on x86/arm64 and make the discard
+ * pattern well-defined (the Linux kernel's READ_ONCE/WRITE_ONCE
+ * seqlock discipline). */
+static void ev_store(vtpu_trace_event* dst, const vtpu_trace_event* src) {
+  __atomic_store_n(&dst->t_ns, src->t_ns, __ATOMIC_RELAXED);
+  __atomic_store_n(&dst->kind, src->kind, __ATOMIC_RELAXED);
+  __atomic_store_n(&dst->dev, src->dev, __ATOMIC_RELAXED);
+  __atomic_store_n(&dst->value, src->value, __ATOMIC_RELAXED);
+  __atomic_store_n(&dst->arg, src->arg, __ATOMIC_RELAXED);
+}
+
+static void ev_load(vtpu_trace_event* dst, const vtpu_trace_event* src) {
+  dst->t_ns = __atomic_load_n(&src->t_ns, __ATOMIC_RELAXED);
+  dst->kind = __atomic_load_n(&src->kind, __ATOMIC_RELAXED);
+  dst->dev = __atomic_load_n(&src->dev, __ATOMIC_RELAXED);
+  dst->value = __atomic_load_n(&src->value, __ATOMIC_RELAXED);
+  dst->arg = __atomic_load_n(&src->arg, __ATOMIC_RELAXED);
+}
+
 void vtpu_trace_emit(vtpu_trace_ring* t, uint32_t kind, uint32_t dev,
                      uint64_t value, uint64_t arg) {
   if (!t || t->owner != getpid()) return; /* forked child: own ring only */
@@ -246,6 +271,12 @@ void vtpu_trace_emit(vtpu_trace_ring* t, uint32_t kind, uint32_t dev,
    * payloads under a valid seq. */
   uint64_t idx = __atomic_fetch_add(&s->head, 1, __ATOMIC_ACQ_REL);
   TraceSlot* slot = &s->slots[idx & (s->capacity - 1)];
+  vtpu_trace_event ev;
+  ev.t_ns = wall_ns();
+  ev.kind = kind;
+  ev.dev = dev;
+  ev.value = value;
+  ev.arg = arg;
   /* Seqlock publish: invalidate, store-store barrier, fill, barrier,
    * publish.  The explicit release FENCES are load-bearing — a release
    * STORE only orders prior accesses, so without the first fence the
@@ -254,11 +285,7 @@ void vtpu_trace_emit(vtpu_trace_ring* t, uint32_t kind, uint32_t dev,
    * torn payload (the Linux write_seqcount_begin/end shape). */
   __atomic_store_n(&slot->seq, 0, __ATOMIC_RELAXED);
   __atomic_thread_fence(__ATOMIC_RELEASE);
-  slot->ev.t_ns = wall_ns();
-  slot->ev.kind = kind;
-  slot->ev.dev = dev;
-  slot->ev.value = value;
-  slot->ev.arg = arg;
+  ev_store(&slot->ev, &ev);
   __atomic_thread_fence(__ATOMIC_RELEASE);
   __atomic_store_n(&slot->seq, idx + 1, __ATOMIC_RELEASE);
 }
@@ -286,7 +313,8 @@ int vtpu_trace_read(vtpu_trace_ring* t, uint64_t from,
     TraceSlot* slot = &s->slots[from & (s->capacity - 1)];
     uint64_t seq = __atomic_load_n(&slot->seq, __ATOMIC_ACQUIRE);
     if (seq == from + 1) {
-      vtpu_trace_event ev = slot->ev;
+      vtpu_trace_event ev;
+      ev_load(&ev, &slot->ev);
       __atomic_thread_fence(__ATOMIC_ACQUIRE);
       /* Seqlock re-check: the copy is valid only if the slot was not
        * re-entered (wrap) mid-copy. */
@@ -380,12 +408,18 @@ static int proc_alive_host(pid_t host_pid, uint64_t ns_id) {
 }
 
 static uint64_t my_ns_id(void) {
+  /* Lazy init with RELAXED atomics: callers usually hold a region lock,
+   * but two threads on DIFFERENT regions (or pre-register paths) can
+   * race here — both compute the same value, yet the plain load/store
+   * was still a formal data race (TSan, make -C native tsan). */
   static uint64_t cached = 0;
-  if (cached == 0) {
+  uint64_t v = __atomic_load_n(&cached, __ATOMIC_RELAXED);
+  if (v == 0) {
     struct stat st;
-    cached = (stat("/proc/self/ns/pid", &st) == 0) ? (uint64_t)st.st_ino : 1;
+    v = (stat("/proc/self/ns/pid", &st) == 0) ? (uint64_t)st.st_ino : 1;
+    __atomic_store_n(&cached, v, __ATOMIC_RELAXED);
   }
-  return cached;
+  return v;
 }
 
 /* Sweep under lock: reclaim usage of dead processes (reference
@@ -855,11 +889,15 @@ static void refill_locked(DeviceState* ds, int32_t pct, uint64_t t) {
  * the next refill, and the burst cap bounds the transient).  Default
  * 500ms; VTPU_WC_WINDOW_US overrides (ops tuning + tests). */
 static uint64_t wc_window_ns(void) {
-  static uint64_t v = 0;
+  /* Relaxed atomics: same idempotent-lazy-init shape as my_ns_id —
+   * two regions' lock holders may race the first call. */
+  static uint64_t cache = 0;
+  uint64_t v = __atomic_load_n(&cache, __ATOMIC_RELAXED);
   if (v == 0) {
     const char* s = getenv("VTPU_WC_WINDOW_US");
     uint64_t us = s && *s ? strtoull(s, NULL, 10) : 0;
     v = us ? us * 1000ull : 500ull * 1000000ull;
+    __atomic_store_n(&cache, v, __ATOMIC_RELAXED);
   }
   return v;
 }
@@ -1071,11 +1109,14 @@ int vtpu_region_ndevices(vtpu_region* r) { return r->shm->ndevices; }
  * heartbeated for this long stops counting as contention.  Default 30s;
  * VTPU_FOREIGN_LIVE_WINDOW_US overrides (ops tuning + tests). */
 static uint64_t foreign_live_window_ns(void) {
-  static uint64_t v = 0;
+  /* Relaxed atomics: see wc_window_ns. */
+  static uint64_t cache = 0;
+  uint64_t v = __atomic_load_n(&cache, __ATOMIC_RELAXED);
   if (v == 0) {
     const char* s = getenv("VTPU_FOREIGN_LIVE_WINDOW_US");
     uint64_t us = s && *s ? strtoull(s, NULL, 10) : 0;
     v = us ? us * 1000ull : 30ull * 1000000000ull;
+    __atomic_store_n(&cache, v, __ATOMIC_RELAXED);
   }
   return v;
 }
@@ -1121,6 +1162,17 @@ int vtpu_test_poke_slot(vtpu_region* r, int slot, pid_t pid,
   p->last_seen_ns = now_ns();
   unlock_region(g);
   return 0;
+}
+
+int vtpu_test_lock_region(vtpu_region* r) {
+  /* TEST-ONLY (see header): take the robust region mutex and RETURN
+   * while holding it.  A forked child calls this then _exits, leaving
+   * the lock held by a dead owner — the parent's next lock_region must
+   * observe EOWNERDEAD, mark the state consistent and carry on (the
+   * recovery path race_stress_test proves under TSan).  Product code
+   * never calls this. */
+  if (!r) return -1;
+  return lock_region(r->shm);
 }
 
 uint32_t vtpu_layout_version(void) { return VTPU_VERSION; }

@@ -271,6 +271,12 @@ uint32_t vtpu_layout_version(void);
 int vtpu_test_poke_slot(vtpu_region* r, int slot, pid_t pid,
                         pid_t host_pid, uint64_t ns_id);
 
+/* TEST-ONLY: acquire the region's robust mutex and RETURN holding it —
+ * callers (forked test children) then _exit so the next locker
+ * exercises the EOWNERDEAD adoption path.  Never called by product
+ * code paths. */
+int vtpu_test_lock_region(vtpu_region* r);
+
 /* TEST-ONLY: redirect the /proc root the host-mode liveness check
  * reads, so hidepid-style mounts (live pid, ENOENT on /proc/<pid>) are
  * exercisable without mount namespaces.  NULL/empty restores "/proc".

@@ -1,0 +1,356 @@
+"""vtpu-analyze checker tests (tools/analyze, docs/ANALYSIS.md).
+
+Two halves per checker: a seeded-violation fixture proving the checker
+actually CATCHES its bug class, and a real-tree run proving the
+current tree is clean (the CI gate's exact condition — no baseline
+suppressions exist, so any regression here is a product regression).
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from vtpu.tools import analyze  # noqa: E402
+from vtpu.tools.analyze import (  # noqa: E402
+    envflags, journal_schema, locks, verbs)
+
+SERVER_REL = locks.SERVER
+
+GT_DOC = '''"""fixture broker
+
+lock-order ground truth (vtpu-analyze):
+
+    order: state.mu > tenant.mu
+    order: state.mu > scheduler.mu
+    order: tenant.mu > region.lock
+    leaf: journal.mu, region.lock
+    no-blocking-under: state.mu, tenant.mu, scheduler.mu
+"""
+'''
+
+
+# ---------------------------------------------------------------------------
+# locks
+# ---------------------------------------------------------------------------
+
+def _lock_findings(body):
+    return locks.check_sources({SERVER_REL: GT_DOC + body})
+
+
+def test_locks_undeclared_nesting_caught():
+    msgs = [f.message for f in _lock_findings('''
+class Tenant:
+    def bad(self, state):
+        with self.mu:
+            with state.mu:
+                pass
+''')]
+    assert any("nests state.mu under tenant.mu" in m for m in msgs), msgs
+
+
+def test_locks_cycle_against_declared_order_caught():
+    # Declared: state.mu > scheduler.mu.  Observed: the inverse — the
+    # classic AB/BA deadlock seed.
+    msgs = [f.message for f in _lock_findings('''
+class DeviceScheduler:
+    def bad(self, state):
+        with self.mu:
+            with state.mu:
+                pass
+''')]
+    assert any("nests state.mu under scheduler.mu" in m for m in msgs), msgs
+
+
+def test_locks_blocking_under_lock_caught_transitively():
+    # journal write reached through a helper call, not textually inside
+    # the with: the summary fixpoint must still see it.
+    msgs = [f.message for f in _lock_findings('''
+class RuntimeState:
+    def bad(self, t):
+        with self.mu:
+            self.helper(t)
+
+    def helper(self, t):
+        self.journal.append({"op": "close", "name": t.name})
+''')]
+    assert any("no-blocking-under" in m for m in msgs), msgs
+
+
+def test_locks_socket_send_under_lock_caught():
+    msgs = [f.message for f in _lock_findings('''
+class DeviceScheduler:
+    def bad(self, sock, msg):
+        with self.mu:
+            sock.sendall(msg)
+''')]
+    assert any("blocking call `sock.sendall`" in m for m in msgs), msgs
+
+
+def test_locks_reentry_caught():
+    msgs = [f.message for f in _lock_findings('''
+class Tenant:
+    def bad(self):
+        with self.mu:
+            with self.mu:
+                pass
+''')]
+    assert any("re-enters tenant.mu" in m for m in msgs), msgs
+
+
+def test_locks_leaf_violation_caught():
+    msgs = [f.message for f in _lock_findings('''
+class Journal:
+    def bad(self, t):
+        with self.mu:
+            with t.mu:
+                pass
+''')]
+    assert any("leaf lock journal.mu" in m for m in msgs), msgs
+
+
+def test_locks_declared_nesting_clean():
+    assert _lock_findings('''
+class RuntimeState:
+    def ok(self, t):
+        with self.mu:
+            with t.mu:
+                t.chip.region.mem_release(0, 1)
+''') == []
+
+
+def test_locks_missing_ground_truth_is_a_finding():
+    fs = locks.check_sources({SERVER_REL: '"""no block here"""\n'})
+    assert any("ground truth" in f.message.lower() or
+               "lock-order" in f.message for f in fs)
+
+
+def test_locks_real_tree_clean():
+    assert locks.check(REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# verbs
+# ---------------------------------------------------------------------------
+
+FIX_PROTOCOL = '''
+HELLO = "hello"
+PING = "ping"
+TENANT_VERBS = (HELLO, PING)
+ADMIN_VERBS = ()
+BIND_FREE_VERBS = ()
+'''
+
+FIX_SERVER = '''
+class TenantSession:
+    def _serve(self, sock):
+        kind = "x"
+        if kind == P.HELLO:
+            pass
+        if tenant is None:
+            self._send_err("NO_HELLO", "hello required")
+class AdminSession:
+    def handle(self):
+        kind = "x"
+'''
+
+FIX_CLIENT = 'def hello(self):\n    return {"kind": P.HELLO}\n'
+FIX_SMI = "x = 1\n"
+
+
+def test_verbs_missing_dispatch_arm_and_binding_caught():
+    msgs = [f.message for f in verbs.check_texts(
+        FIX_PROTOCOL, FIX_SERVER, FIX_CLIENT, FIX_SMI)]
+    assert any("PING has no dispatch arm" in m for m in msgs), msgs
+    assert any("PING has no client binding" in m for m in msgs), msgs
+
+
+def test_verbs_unregistered_verb_caught():
+    proto = 'HELLO = "hello"\nROGUE = "rogue"\n' \
+            'TENANT_VERBS = (HELLO,)\nADMIN_VERBS = ()\n' \
+            'BIND_FREE_VERBS = ()\n'
+    msgs = [f.message for f in verbs.check_texts(
+        proto, FIX_SERVER, FIX_CLIENT, FIX_SMI)]
+    assert any("ROGUE is in neither" in m for m in msgs), msgs
+
+
+def test_verbs_bind_free_after_guard_caught():
+    proto = 'HELLO = "hello"\nSTATS = "stats"\n' \
+            'TENANT_VERBS = (HELLO, STATS)\nADMIN_VERBS = (STATS,)\n' \
+            'BIND_FREE_VERBS = (STATS,)\n'
+    server = '''
+class TenantSession:
+    def _serve(self, sock):
+        kind = "x"
+        if kind == P.HELLO:
+            pass
+        if tenant is None:
+            self._send_err("NO_HELLO", "hello required")
+        if kind == P.STATS:
+            pass
+class AdminSession:
+    def handle(self):
+        kind = "x"
+        if kind == P.STATS:
+            pass
+'''
+    client = ('def hello(self):\n    return {"kind": P.HELLO}\n'
+              'def stats(self):\n    return {"kind": P.STATS}\n')
+    smi = 'def stats():\n    return {"kind": P.STATS}\n'
+    msgs = [f.message for f in verbs.check_texts(proto, server, client,
+                                                 smi)]
+    assert any("AFTER the NO_HELLO guard" in m for m in msgs), msgs
+
+
+def test_verbs_real_tree_clean():
+    assert verbs.check(REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# envflags
+# ---------------------------------------------------------------------------
+
+FIX_ENVSPEC = '''
+ENV_HBM_LIMIT = "VTPU_DEVICE_HBM_LIMIT"
+ENV_FLAGS = {
+    ENV_HBM_LIMIT: ("contract", False),
+    "VTPU_TRACE": ("trace", True),
+}
+ENV_FLAG_PREFIXES = (ENV_HBM_LIMIT + "_",)
+'''
+FIX_MD = "VTPU_DEVICE_HBM_LIMIT VTPU_TRACE\n"
+FIX_HELM = "#   VTPU_TRACE: '1'\n"
+
+
+def _env_findings(py=None, native=None, md=FIX_MD, helm=FIX_HELM):
+    return envflags.check_tree(py or {}, native or {}, FIX_ENVSPEC, md,
+                               helm)
+
+
+def test_envflags_undeclared_read_caught():
+    fs = _env_findings(
+        py={"pkg/x.py": 'import os\nv = os.environ.get("VTPU_MYSTERY")\n'})
+    assert any("VTPU_MYSTERY" in f.message and "not declared" in f.message
+               for f in fs), [f.message for f in fs]
+
+
+def test_envflags_raw_subscript_caught():
+    fs = _env_findings(
+        py={"pkg/x.py": 'import os\nv = os.environ["VTPU_TRACE"]\n'})
+    assert any("subscript read bypasses envspec" in f.message
+               for f in fs), [f.message for f in fs]
+
+
+def test_envflags_subscript_write_allowed():
+    fs = _env_findings(
+        py={"pkg/x.py": 'import os\nos.environ["VTPU_TRACE"] = "1"\n'})
+    assert fs == []
+
+
+def test_envflags_prefix_forms_declared():
+    fs = _env_findings(
+        py={"pkg/x.py":
+            'import os\nv = os.environ.get("VTPU_DEVICE_HBM_LIMIT_3")\n'})
+    assert fs == []
+
+
+def test_envflags_native_undeclared_read_caught():
+    fs = _env_findings(
+        native={"native/x.cc": 'const char* s = getenv("VTPU_NOPE");\n'})
+    assert any("VTPU_NOPE" in f.message for f in fs), \
+        [f.message for f in fs]
+
+
+def test_envflags_undocumented_and_unhelmed_caught():
+    fs = _env_findings(md="nothing here\n", helm="nothing here\n")
+    msgs = [f.message for f in fs]
+    assert any("undocumented in docs/FLAGS.md" in m for m in msgs), msgs
+    assert any("absent from the chart values" in m for m in msgs), msgs
+
+
+def test_envflags_real_tree_clean():
+    assert envflags.check(REPO_ROOT) == []
+
+
+def test_envspec_registry_importable_and_consistent():
+    # The registry is also a runtime API (flag_declared); keep it in
+    # sync with the contract var list.
+    from vtpu.utils import envspec
+    for name in envspec.ALL_ENV_VARS:
+        assert envspec.flag_declared(name), name
+    assert envspec.flag_declared("VTPU_DEVICE_HBM_LIMIT_7")
+    assert not envspec.flag_declared("VTPU_DEVICE_HBM_LIMIT_X")
+    assert not envspec.flag_declared("VTPU_NOT_A_FLAG")
+
+
+# ---------------------------------------------------------------------------
+# journal schema
+# ---------------------------------------------------------------------------
+
+def _journal_sources(extra_writer=""):
+    with open(os.path.join(REPO_ROOT, journal_schema.JOURNAL)) as f:
+        jr = f.read()
+    srcs = {journal_schema.JOURNAL: jr}
+    if extra_writer:
+        # Replace the real server as the writer set so fixtures are
+        # self-contained.
+        srcs[journal_schema.WRITER_FILES[0]] = extra_writer
+    else:
+        for rel in journal_schema.WRITER_FILES:
+            with open(os.path.join(REPO_ROOT, rel)) as f:
+                srcs[rel] = f.read()
+    return srcs
+
+
+def test_journal_unreplayed_record_caught():
+    writer = '\n'.join(
+        'def w%d(jr):\n    jr.append({"op": "%s"})' % (i, op)
+        for i, op in enumerate(
+            ["epoch", "chip", "bind", "close", "put", "del", "compile",
+             "ema", "wedge", "frob"]))
+    fs = journal_schema.check_texts(_journal_sources(writer))
+    assert any('"frob"' in f.message and "no replay handler" in f.message
+               for f in fs), [f.message for f in fs]
+
+
+def test_journal_dead_replay_arm_caught():
+    writer = 'def w(jr):\n    jr.append({"op": "epoch"})\n'
+    fs = journal_schema.check_texts(_journal_sources(writer))
+    assert any("dead replay arm" in f.message for f in fs)
+
+
+def test_journal_assigned_record_literal_resolved():
+    # rec = {...}; jr.append(rec) — the PUT path's shape.
+    writer = ('def w(jr, name):\n'
+              '    rec = {"op": "bind", "name": name}\n'
+              '    jr.append(rec)\n')
+    fs = journal_schema.check_texts(
+        {journal_schema.JOURNAL:
+         _journal_sources()[journal_schema.JOURNAL],
+         journal_schema.WRITER_FILES[0]: writer})
+    assert not any('"bind"' in f.message for f in fs)
+
+
+def test_journal_real_tree_clean():
+    assert journal_schema.check(REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# suite entrypoints
+# ---------------------------------------------------------------------------
+
+def test_run_all_real_tree_green():
+    assert analyze.run_all(REPO_ROOT) == []
+
+
+def test_console_entry_exits_zero(capsys):
+    assert analyze.main([]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+
+
+def test_vtpu_smi_analyze_subcommand():
+    from vtpu.tools import vtpu_smi
+    assert vtpu_smi.main(["analyze"]) == 0
