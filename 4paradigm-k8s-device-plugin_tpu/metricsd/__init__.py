@@ -1,0 +1,40 @@
+"""vtpu-metricsd — per-tenant virtualized libtpu MetricService.
+
+The reference's flagship transparency trick is lying to the *stock*
+monitoring tool: its NVML hooks make an unmodified ``nvidia-smi`` report
+only the container's quota (SURVEY §2.9f).  The TPU analogue of NVML is
+libtpu's localhost gRPC metrics service on port 8431, which the stock
+``tpu-info`` CLI reads.  This subsystem implements that protocol
+(``proto/tpu_metrics.proto``) and serves QUOTA-VIRTUALIZED answers:
+
+  - HBM total   = the tenant's HBM limit (not the raw chip capacity),
+  - HBM usage   = the tenant's accounted ledger usage (the vtpucore
+    shared region / broker STATS — the same source of truth as
+    ``vtpu-smi``),
+  - duty cycle  = the tenant's own device time, rescaled so 100% means
+    "my full core quota", and
+  - devices     = only the ordinals of the grant (TPU_VISIBLE_CHIPS /
+    VTPU_DEVICE_MAP), never co-tenants' chips.
+
+Non-sensitive metrics (uptime, versions) are proxied through to the real
+libtpu service when one is running (moved off 8431 by the daemon's
+``TPU_RUNTIME_METRICS_PORTS`` injection); anything that would disclose
+raw capacity or co-tenant load is always answered virtualized.  A fake
+backend (``backend.FakeBackend``) makes the whole path testable on
+CPU-only CI.  Full protocol coverage, threat model and pass-through
+rules: docs/METRICSD.md.
+"""
+
+from __future__ import annotations
+
+# RPC registry — machine-checked by `vtpu-smi analyze` (tools/analyze/
+# verbs.py): every RPC named here must have a stub binding and a servicer
+# method in proto/tpu_metrics_grpc.py AND an implementation override in
+# metricsd/server.py; an RPC implemented but not registered fails too.
+METRICSD_RPCS = ("GetRuntimeMetric", "ListSupportedMetrics")
+
+# Stock tpu-info dials localhost:8431; vtpu-metricsd binds it and the
+# real libtpu service (if any) is moved to 8431 + OFFSET via
+# TPU_RUNTIME_METRICS_PORTS at Allocate, where metricsd proxies it.
+DEFAULT_PORT = 8431
+UPSTREAM_PORT_OFFSET = 10

@@ -1,0 +1,6 @@
+"""``python -m vtpu.metricsd`` — run the virtualized MetricService."""
+
+from .server import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

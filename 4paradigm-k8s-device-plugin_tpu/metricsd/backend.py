@@ -1,0 +1,210 @@
+"""Metric backends for vtpu-metricsd.
+
+A backend answers one question: what does THIS tenant's grant look like
+right now — per granted device ordinal, the HBM quota, the ledger usage,
+the raw chip capacity and the tenant's own duty cycle.  The server layer
+(metricsd/server.py) turns that into wire metrics; the virtualization
+rules (clamp, scale, filter) live there so every backend benefits.
+
+Backends:
+
+  - ``RegionBackend``: the production path.  Reads the vtpucore shared
+    accounting region named by the Allocate env contract — the same
+    source of truth ``vtpu-smi`` and the replacement ``tpu-info`` read.
+    BIND-FREE by design: it never registers a process slot and never
+    speaks HELLO to the broker, so a metrics probe can never claim a
+    chip or wedge a tenant slot (the PR-1 STATS lesson).  Optionally
+    enriches usage from the broker's bind-free STATS verb for brokered
+    grants whose ledger lives broker-side.
+  - ``FakeBackend``: deterministic synthetic tenant for CPU-only CI and
+    the ``--selftest`` smoke — no native lib, no region file needed.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..utils import envspec
+from ..utils import logging as log
+
+# Raw per-chip HBM capacity fallback when the discovery inventory is not
+# available in-container (v5e-class default; the real total only shows
+# for UNLIMITED grants, a quota-bearing grant reports the quota).
+_RAW_HBM_FALLBACK = 16 * 2**30
+
+
+@dataclass
+class DeviceView:
+    """One granted device ordinal as the tenant may see it."""
+
+    ordinal: int
+    chip_id: str = ""
+    hbm_limit_bytes: int = 0       # 0 = unlimited grant
+    hbm_used_bytes: int = 0
+    hbm_raw_total_bytes: int = _RAW_HBM_FALLBACK
+    duty_cycle_pct: float = 0.0    # tenant's own, of the WHOLE chip
+    core_limit_pct: int = 0        # 0 = no core quota
+
+
+class Backend:
+    def devices(self) -> List[DeviceView]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class RegionBackend(Backend):
+    """Shared-region-backed tenant view (bind-free: stats reads only)."""
+
+    def __init__(self, region_path: Optional[str] = None,
+                 quota: Optional[envspec.QuotaSpec] = None,
+                 broker_socket: Optional[str] = None,
+                 tenant: Optional[str] = None):
+        self.quota = quota if quota is not None else envspec.quota_from_env()
+        self.region_path = region_path or self.quota.shared_cache
+        self.broker_socket = broker_socket
+        self.tenant = tenant
+        # Duty cycle needs two samples: ordinal -> (busy_us, t).
+        self._prev: Dict[int, tuple] = {}
+
+    # -- region --
+
+    def _open_region(self):
+        """Fresh open per sample: the region file can be recreated under
+        pod churn, and holding no fd keeps the probe side-effect free.
+        Never registers a proc slot — stats stay bind-free."""
+        if not self.region_path or not os.path.exists(self.region_path):
+            return None
+        from ..shim.core import SharedRegion
+        try:
+            return SharedRegion(self.region_path)
+        except OSError as e:
+            log.warn("metricsd: region %s unreadable: %s",
+                     self.region_path, e)
+            return None
+
+    def _broker_usage(self) -> Optional[Dict]:
+        """Per-tenant ledger from the broker's BIND-FREE STATS verb on
+        the MAIN socket (no HELLO, no tenant slot, no chip claim)."""
+        if not self.broker_socket:
+            return None
+        from ..runtime import protocol as P
+        from ..tools.vtpu_smi import _main_request
+        try:
+            resp = _main_request(self.broker_socket, {"kind": P.STATS},
+                                 timeout=2.0)
+        except (OSError, P.ProtocolError) as e:
+            log.warn("metricsd: broker %s unreachable: %s",
+                     self.broker_socket, e)
+            return None
+        if not resp.get("ok"):
+            return None
+        tenants = resp.get("tenants", {})
+        if self.tenant:
+            return tenants.get(self.tenant)
+        if len(tenants) == 1:
+            return next(iter(tenants.values()))
+        return None
+
+    def _ordinals(self, region) -> List[int]:
+        if self.quota.device_map:
+            return [e.ordinal for e in self.quota.device_map]
+        if region is not None:
+            return list(range(region.ndevices))
+        ords = sorted(self.quota.hbm_limit_bytes)
+        return [o for o in ords if o >= 0] or [0]
+
+    def devices(self) -> List[DeviceView]:
+        region = self._open_region()
+        chip_of = {e.ordinal: e.chip_uuid for e in self.quota.device_map}
+        broker = self._broker_usage()
+        now = time.monotonic()
+        out: List[DeviceView] = []
+        try:
+            for o in self._ordinals(region):
+                view = DeviceView(ordinal=o, chip_id=chip_of.get(o, ""))
+                view.hbm_limit_bytes = self.quota.limit_for(o)
+                view.core_limit_pct = self.quota.core_limit_pct
+                if region is not None and o < region.ndevices:
+                    st = region.device_stats(o)
+                    if st.limit_bytes:
+                        view.hbm_limit_bytes = int(st.limit_bytes)
+                    view.hbm_used_bytes = int(st.used_bytes)
+                    if st.core_limit_pct:
+                        view.core_limit_pct = int(st.core_limit_pct)
+                    prev = self._prev.get(o)
+                    self._prev[o] = (int(st.busy_us), now)
+                    if prev is not None and now > prev[1]:
+                        duty = (int(st.busy_us) - prev[0]) \
+                            / ((now - prev[1]) * 1e6) * 100.0
+                        view.duty_cycle_pct = min(max(duty, 0.0), 100.0)
+                out.append(view)
+        finally:
+            if region is not None:
+                region.close()
+        # Brokered grants: the ledger lives broker-side; its usage wins
+        # over a region the interposer never touched (used == 0).
+        if broker and out and not any(v.hbm_used_bytes for v in out):
+            used = int(broker.get("used_bytes", 0))
+            limit = int(broker.get("limit_bytes", 0))
+            out[0].hbm_used_bytes = used
+            if limit and not out[0].hbm_limit_bytes:
+                out[0].hbm_limit_bytes = limit
+        return out
+
+
+class FakeBackend(Backend):
+    """Deterministic synthetic tenant (CPU CI / --selftest).
+
+    Defaults model the canonical acceptance scenario: a 16 GiB chip
+    granted at 50% HBM / 50% core, with the ledger at 1 GiB and the
+    tenant running at 40% of the whole chip (=> 80% of its quota)."""
+
+    def __init__(self, n_devices: int = 2,
+                 hbm_limit_bytes: int = 8 * 2**30,
+                 hbm_raw_total_bytes: int = 16 * 2**30,
+                 hbm_used_bytes: int = 1 * 2**30,
+                 duty_cycle_pct: float = 40.0,
+                 core_limit_pct: int = 50,
+                 generation: str = "v5e"):
+        self.n_devices = n_devices
+        self.hbm_limit_bytes = hbm_limit_bytes
+        self.hbm_raw_total_bytes = hbm_raw_total_bytes
+        self.hbm_used_bytes = hbm_used_bytes
+        self.duty_cycle_pct = duty_cycle_pct
+        self.core_limit_pct = core_limit_pct
+        self.generation = generation
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "FakeBackend":
+        """Honor the quota env contract when present so a fake-backend
+        container still reflects its Allocate grant; fall back to the
+        canonical 50%/50% scenario."""
+        e = dict(os.environ if env is None else env)
+        spec = envspec.quota_from_env(e)
+        n = len(spec.device_map) or int(e.get("VTPU_FAKE_CHIPS", "2"))
+        kw = {}
+        if spec.limit_for(0):
+            kw["hbm_limit_bytes"] = spec.limit_for(0)
+        if spec.core_limit_pct:
+            kw["core_limit_pct"] = spec.core_limit_pct
+        return cls(n_devices=n,
+                   generation=e.get("VTPU_FAKE_GENERATION", "v5e"), **kw)
+
+    def devices(self) -> List[DeviceView]:
+        return [
+            DeviceView(
+                ordinal=i,
+                chip_id=f"TPU-fake-{self.generation}-{i:02d}",
+                hbm_limit_bytes=self.hbm_limit_bytes,
+                hbm_used_bytes=self.hbm_used_bytes,
+                hbm_raw_total_bytes=self.hbm_raw_total_bytes,
+                duty_cycle_pct=self.duty_cycle_pct,
+                core_limit_pct=self.core_limit_pct,
+            )
+            for i in range(self.n_devices)
+        ]

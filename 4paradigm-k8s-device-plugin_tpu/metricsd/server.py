@@ -1,0 +1,400 @@
+"""The vtpu-metricsd gRPC server: libtpu MetricService, quota-virtualized.
+
+Serves ``tpu.monitoring.runtime.v2alpha1.RuntimeMetricService`` (the
+protocol stock ``tpu-info`` speaks to localhost:8431) with the tenant's
+view instead of the raw chip's:
+
+  =============================  =========================================
+  metric                         virtualization rule
+  =============================  =========================================
+  hbm.memory.total.bytes         the HBM quota (raw capacity only for an
+                                 unlimited grant); never the chip total
+  hbm.memory.usage.bytes         the tenant's ledger usage, clamped to
+                                 the reported total
+  tensorcore.dutycycle.percent   the tenant's own device time, rescaled
+                                 by the core quota so 100% = "all of MY
+                                 share" (a 50% tenant running flat out
+                                 reads 100, not 50)
+  (device enumeration)           granted ordinals only — co-tenant chips
+                                 do not exist on this wire
+  =============================  =========================================
+
+Everything else is either proxied to the real libtpu service (moved off
+8431 by the daemon's ``TPU_RUNTIME_METRICS_PORTS`` injection) when its
+name is provably non-sensitive, or answered NOT_FOUND.  Pass-through is
+deny-by-default: a metric name matching any raw-capacity/-utilization
+pattern is NEVER forwarded (docs/METRICSD.md, "Pass-through rules").
+
+Started per-container by the shim bootstrap (``maybe_start_in_container``
+from sitecustomize, port race = first process wins) or standalone::
+
+    python -m vtpu.metricsd --port 8431            # region backend (env)
+    python -m vtpu.metricsd --fake --port 8431     # CPU CI fake backend
+    python -m vtpu.metricsd --selftest             # e2e smoke, exits 0/1
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+from concurrent import futures
+from typing import Dict, List, Optional
+
+from ..utils import logging as log
+from . import DEFAULT_PORT, UPSTREAM_PORT_OFFSET
+from .backend import Backend, DeviceView, FakeBackend, RegionBackend
+
+# Wire metric names (the set stock tpu-info queries).
+METRIC_HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+METRIC_HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
+METRIC_DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
+# metricsd self-gauges, served on the same wire so node tooling
+# (tools/metrics_server.py --metricsd) can scrape them without a side
+# channel.
+METRIC_SELF_REQUESTS = "vtpu.metricsd.requests.total"
+METRIC_SELF_PASSTHROUGH = "vtpu.metricsd.passthrough.total"
+METRIC_SELF_DENIED = "vtpu.metricsd.passthrough.denied.total"
+
+VIRTUALIZED_METRICS = (METRIC_HBM_TOTAL, METRIC_HBM_USAGE,
+                       METRIC_DUTY_CYCLE)
+SELF_METRICS = (METRIC_SELF_REQUESTS, METRIC_SELF_PASSTHROUGH,
+                METRIC_SELF_DENIED)
+
+# Deny-by-default pass-through: any metric name containing one of these
+# substrings discloses raw capacity or co-tenant load and is never
+# forwarded, whatever the upstream offers (docs/METRICSD.md).
+SENSITIVE_PATTERNS = ("hbm", "memory", "dutycycle", "duty_cycle",
+                      "utilization", "tensorcore", "bandwidth", "power")
+
+
+def is_sensitive(name: str) -> bool:
+    low = name.lower()
+    return any(p in low for p in SENSITIVE_PATTERNS)
+
+
+def virtual_duty_pct(raw_pct: float, core_limit_pct: int) -> float:
+    """Rescale the tenant's whole-chip duty to quota-relative: with a
+    50% core quota, 40% of the chip reads as 80% "of my share"."""
+    if core_limit_pct <= 0:
+        return min(max(raw_pct, 0.0), 100.0)
+    return min(max(raw_pct, 0.0) * 100.0 / core_limit_pct, 100.0)
+
+
+class MetricsdServicer:
+    """RuntimeMetricService implementation over a tenant Backend."""
+
+    def __init__(self, backend: Backend,
+                 upstream: Optional[str] = None):
+        from ..proto import tpu_metrics_grpc as mrpc
+        from ..proto import tpu_metrics_pb2 as mpb
+        self.mpb = mpb
+        self.mrpc = mrpc
+        self.backend = backend
+        self.upstream = upstream
+        self._upstream_stub = None
+        self._upstream_mu = threading.Lock()
+        self.started_at = time.time()
+        # Self-gauges (also folded into tools/metrics_server.py).
+        self.requests_total = 0
+        self.passthrough_total = 0
+        self.passthrough_denied_total = 0
+        self.stats_mu = threading.Lock()
+
+    # -- upstream proxy --
+
+    def _stub(self):
+        if not self.upstream:
+            return None
+        with self._upstream_mu:
+            if self._upstream_stub is None:
+                import grpc
+                ch = grpc.insecure_channel(self.upstream)
+                self._upstream_stub = self.mrpc.RuntimeMetricServiceStub(ch)
+            return self._upstream_stub
+
+    def _passthrough(self, request, context):
+        import grpc
+        stub = self._stub()
+        if stub is None:
+            context.set_code(grpc.StatusCode.NOT_FOUND)
+            context.set_details(
+                f"unknown metric {request.metric_name!r} "
+                f"(no upstream libtpu service)")
+            return self.mpb.MetricResponse()
+        try:
+            resp = stub.GetRuntimeMetric(request, timeout=2.0)
+        except grpc.RpcError as e:
+            context.set_code(grpc.StatusCode.NOT_FOUND)
+            context.set_details(
+                f"upstream libtpu service: {e.code().name}")
+            return self.mpb.MetricResponse()
+        with self.stats_mu:
+            self.passthrough_total += 1
+        return resp
+
+    # -- virtualized answers --
+
+    def _gauge_metric(self, name: str, views: List[DeviceView],
+                      value_of) -> "object":
+        resp = self.mpb.MetricResponse()
+        resp.metric.name = name
+        for v in views:
+            m = resp.metric.metrics.add()
+            m.attribute.key = "device-id"
+            m.attribute.value.int_attr = v.ordinal
+            m.timestamp.GetCurrentTime()
+            val = value_of(v)
+            if isinstance(val, float):
+                m.gauge.as_double = val
+            else:
+                m.gauge.as_int = int(val)
+        return resp
+
+    def _self_metric(self, name: str) -> "object":
+        resp = self.mpb.MetricResponse()
+        resp.metric.name = name
+        m = resp.metric.metrics.add()
+        m.timestamp.GetCurrentTime()
+        with self.stats_mu:
+            vals = {
+                METRIC_SELF_REQUESTS: self.requests_total,
+                METRIC_SELF_PASSTHROUGH: self.passthrough_total,
+                METRIC_SELF_DENIED: self.passthrough_denied_total,
+            }
+        m.gauge.as_int = int(vals[name])
+        return resp
+
+    # -- RPCs (registry: metricsd/__init__.py METRICSD_RPCS) --
+
+    def GetRuntimeMetric(self, request, context):
+        with self.stats_mu:
+            self.requests_total += 1
+        name = request.metric_name
+        if name in SELF_METRICS:
+            return self._self_metric(name)
+        if name == METRIC_HBM_TOTAL:
+            return self._gauge_metric(
+                name, self.backend.devices(),
+                lambda v: v.hbm_limit_bytes or v.hbm_raw_total_bytes)
+        if name == METRIC_HBM_USAGE:
+            return self._gauge_metric(
+                name, self.backend.devices(),
+                lambda v: min(v.hbm_used_bytes,
+                              v.hbm_limit_bytes or v.hbm_raw_total_bytes))
+        if name == METRIC_DUTY_CYCLE:
+            return self._gauge_metric(
+                name, self.backend.devices(),
+                lambda v: float(virtual_duty_pct(v.duty_cycle_pct,
+                                                 v.core_limit_pct)))
+        if is_sensitive(name):
+            # Never forwarded: a raw-capacity metric the virtualizer does
+            # not model must not leak through the proxy either.
+            import grpc
+            with self.stats_mu:
+                self.passthrough_denied_total += 1
+            context.set_code(grpc.StatusCode.NOT_FOUND)
+            context.set_details(
+                f"metric {name!r} is quota-sensitive and not virtualized")
+            return self.mpb.MetricResponse()
+        return self._passthrough(request, context)
+
+    def ListSupportedMetrics(self, request, context):
+        with self.stats_mu:
+            self.requests_total += 1
+        resp = self.mpb.ListSupportedMetricsResponse()
+        names = list(VIRTUALIZED_METRICS) + list(SELF_METRICS)
+        stub = self._stub()
+        if stub is not None:
+            import grpc
+            try:
+                up = stub.ListSupportedMetrics(
+                    self.mpb.ListSupportedMetricsRequest(), timeout=2.0)
+                for sm in up.supported_metric:
+                    if sm.metric_name not in names \
+                            and not is_sensitive(sm.metric_name):
+                        names.append(sm.metric_name)
+            except grpc.RpcError:
+                pass  # upstream down: advertise the virtualized set only
+        for n in names:
+            resp.supported_metric.add().metric_name = n
+        return resp
+
+
+def make_server(port: int, backend: Backend, host: str = "127.0.0.1",
+                upstream: Optional[str] = None):
+    """Build + start a metricsd gRPC server; returns (server, servicer,
+    bound_port).  port=0 binds an ephemeral port (tests)."""
+    import grpc
+
+    from ..proto import tpu_metrics_grpc as mrpc
+    servicer = MetricsdServicer(backend, upstream=upstream)
+    # so_reuseport OFF: the per-container singleton is a port-bind RACE
+    # (maybe_start_in_container) — with grpc's default SO_REUSEPORT every
+    # process would "win" the bind and a container would run one server
+    # per Python process.
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=4,
+                                   thread_name_prefix="vtpu-metricsd"),
+        options=[("grpc.so_reuseport", 0)])
+    mrpc.add_RuntimeMetricServiceServicer_to_server(servicer, server)
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise OSError(f"metricsd cannot bind {host}:{port}")
+    server.start()
+    return server, servicer, bound
+
+
+def backend_from_env(env: Optional[Dict[str, str]] = None) -> Backend:
+    e = dict(os.environ if env is None else env)
+    if e.get("VTPU_METRICSD_FAKE") == "1":
+        return FakeBackend.from_env(e)
+    return RegionBackend(
+        broker_socket=e.get("VTPU_METRICSD_BROKER")
+        or e.get("VTPU_RUNTIME_SOCKET"),
+        tenant=e.get("VTPU_TENANT"))
+
+
+def upstream_from_env(e: Dict[str, str], port: int) -> Optional[str]:
+    """Pass-through target: explicit VTPU_METRICSD_UPSTREAM wins; else
+    the first TPU_RUNTIME_METRICS_PORTS entry (where Allocate moved the
+    real libtpu service) unless it is our own port."""
+    explicit = e.get("VTPU_METRICSD_UPSTREAM")
+    if explicit:
+        return explicit
+    raw = (e.get("TPU_RUNTIME_METRICS_PORTS") or "").split(",")[0].strip()
+    if raw.isdigit() and int(raw) != port:
+        return f"localhost:{raw}"
+    return None
+
+
+_started = None
+_started_mu = threading.Lock()
+
+
+def maybe_start_in_container():
+    """Shim-bootstrap entry (sitecustomize): serve the tenant's metricsd
+    when the Allocate contract asked for one.  Per-container singleton by
+    port-bind race — every process tries, the first bind wins, the rest
+    skip silently.  Never raises: a broken metricsd must not take down
+    user containers."""
+    global _started
+    e = os.environ
+    port_s = e.get("VTPU_METRICSD_PORT", "")
+    if not port_s or e.get("VTPU_METRICSD_AUTOSTART", "1") == "0":
+        return None
+    with _started_mu:
+        if _started is not None:
+            return _started
+        try:
+            port = int(port_s)
+            upstream = upstream_from_env(dict(e), port)
+            server, servicer, bound = make_server(
+                port, backend_from_env(), upstream=upstream)
+        except (OSError, ValueError, RuntimeError):
+            # Port taken (grpc surfaces the failed bind as RuntimeError):
+            # a sibling process already serves this container's metricsd
+            # (the common fork/exec case).
+            return None
+        except Exception as exc:  # noqa: BLE001 - never break user startup
+            log.warn("metricsd bootstrap failed: %s", exc)
+            return None
+        _started = (server, servicer, bound)
+        log.info("vtpu-metricsd serving MetricService on 127.0.0.1:%d%s",
+                 bound, f" (pass-through {upstream})" if upstream else "")
+        return _started
+
+
+def selftest() -> int:
+    """CPU-only e2e smoke (CI): stock-protocol client against a fake
+    50% HBM / 50% core tenant; asserts the quota clamp end to end."""
+    import grpc
+
+    from ..proto import tpu_metrics_grpc as mrpc
+    from ..proto import tpu_metrics_pb2 as mpb
+    backend = FakeBackend()  # 16 GiB chip, 8 GiB/50% grant, duty 40%
+    server, _, port = make_server(0, backend)
+    try:
+        ch = grpc.insecure_channel(f"localhost:{port}")
+        stub = mrpc.RuntimeMetricServiceStub(ch)
+        total = stub.GetRuntimeMetric(
+            mpb.MetricRequest(metric_name=METRIC_HBM_TOTAL), timeout=5)
+        usage = stub.GetRuntimeMetric(
+            mpb.MetricRequest(metric_name=METRIC_HBM_USAGE), timeout=5)
+        duty = stub.GetRuntimeMetric(
+            mpb.MetricRequest(metric_name=METRIC_DUTY_CYCLE), timeout=5)
+        listed = stub.ListSupportedMetrics(
+            mpb.ListSupportedMetricsRequest(), timeout=5)
+        ch.close()
+        ok = (
+            len(total.metric.metrics) == backend.n_devices
+            and all(m.gauge.as_int == backend.hbm_limit_bytes
+                    for m in total.metric.metrics)
+            and all(m.gauge.as_int == backend.hbm_used_bytes
+                    for m in usage.metric.metrics)
+            and all(abs(m.gauge.as_double - 80.0) < 1e-6
+                    for m in duty.metric.metrics)
+            and {METRIC_HBM_TOTAL, METRIC_HBM_USAGE, METRIC_DUTY_CYCLE}
+            <= {sm.metric_name for sm in listed.supported_metric}
+        )
+        print("metricsd selftest:",
+              "ok — stock client sees 8 GiB total / 1 GiB used / 80% "
+              "of-quota duty on 2 granted devices" if ok else "FAILED")
+        return 0 if ok else 1
+    finally:
+        server.stop(grace=0.5)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="vtpu-metricsd",
+        description="per-tenant virtualized libtpu MetricService")
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("VTPU_METRICSD_PORT",
+                                               str(DEFAULT_PORT))))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--region", default=None,
+                    help="explicit accounting region (default: the "
+                         "Allocate env contract)")
+    ap.add_argument("--broker", default=None, metavar="SOCKET",
+                    help="broker MAIN socket for bind-free STATS ledger "
+                         "enrichment")
+    ap.add_argument("--upstream", default=None, metavar="HOST:PORT",
+                    help="real libtpu MetricService for non-sensitive "
+                         "pass-through")
+    ap.add_argument("--fake", action="store_true",
+                    help="synthetic tenant backend (CPU CI)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="start a fake-backend server, query it with a "
+                         "stock-protocol client, assert the quota clamp")
+    ns = ap.parse_args(argv)
+    if ns.selftest:
+        return selftest()
+    if ns.fake:
+        backend: Backend = FakeBackend.from_env()
+    else:
+        backend = RegionBackend(
+            region_path=ns.region,
+            broker_socket=ns.broker
+            or os.environ.get("VTPU_METRICSD_BROKER"),
+            tenant=os.environ.get("VTPU_TENANT"))
+    upstream = ns.upstream or upstream_from_env(dict(os.environ), ns.port)
+    try:
+        server, _, bound = make_server(ns.port, backend, host=ns.host,
+                                       upstream=upstream)
+    except (OSError, RuntimeError) as e:
+        log.error("vtpu-metricsd cannot bind %s:%d: %s",
+                  ns.host, ns.port, e)
+        return 1
+    log.info("vtpu-metricsd serving on %s:%d (upstream: %s)",
+             ns.host, bound, upstream or "none")
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        server.stop(grace=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,6 +21,20 @@ first-principles:
 
 Falls back to first-N available when no connected set exists (reference
 server.go:298-300 falls back the same way).
+
+Two policies (``--allocation-policy``, the reference's gpuallocator
+policy choice, server.go:66 / mig-strategy.go:68):
+
+  - ``pack`` (default): the scoring above — ICI-compact, fill already-
+    fragmented chips first, keep whole chips free for future multi-chip
+    pods.
+  - ``spread``: maximize inter-tenant distance — prefer chip sets with
+    the LARGEST pairwise torus distance and chips with the MOST free
+    vdevices (emptiest first), so co-tenants land far apart and per-chip
+    contention is minimized.  The connected-subgraph preference is
+    dropped under spread (a maximally-spread set is by construction not
+    ICI-adjacent): spread is for fleets of independent single-/few-chip
+    tenants; a collectives-bound multi-chip pod wants ``pack``.
 """
 
 from __future__ import annotations
@@ -48,9 +62,11 @@ def preferred_allocation(
     must_include: Sequence[VDevice],
     size: int,
     topology: Optional[TpuTopology] = None,
+    policy: str = "pack",
 ) -> List[VDevice]:
     """Pick ``size`` vdevices from ``available`` (superset of
-    ``must_include``), at most one per physical chip, ICI-compact."""
+    ``must_include``), at most one per physical chip; ``policy`` selects
+    pack (ICI-compact) or spread (max inter-tenant distance) scoring."""
     if size <= 0:
         return []
     if size > len(available):
@@ -97,9 +113,16 @@ def preferred_allocation(
                      or len(chips) <= 1
                      or chips_connected(chips, topology))
         cost = _pairwise_cost(chips, topology)
-        # Fragmentation pressure: prefer chips with fewer free vdevices.
+        # Fragmentation pressure: pack prefers chips with fewer free
+        # vdevices (fill fragmented chips, keep whole chips free);
+        # spread inverts both axes — farthest-apart chip sets, emptiest
+        # chips first (max inter-tenant distance) — and ignores
+        # connectivity, which would force adjacency.
         frag = sum(len(by_chip[u]) for u in uuids)
-        key = (not connected, cost, frag)
+        if policy == "spread":
+            key = (-cost, -frag)
+        else:
+            key = (not connected, cost, frag)
         if best_key is None or key < best_key:
             best_key = key
             best = uuids

@@ -21,6 +21,11 @@ HOST_LIB_DIR = "/usr/local/vtpu"
 SPLIT_STRATEGIES = ("none", "core", "mixed")
 DEVICE_LIST_STRATEGIES = ("envvar", "device-specs")
 DEVICE_ID_STRATEGIES = ("uuid", "index")
+# GetPreferredAllocation scoring policy (the reference's gpuallocator
+# policy choice, server.go:66 / mig-strategy.go:68): pack = ICI-compact +
+# fill fragmented chips first; spread = maximize inter-tenant distance +
+# prefer empty chips.
+ALLOCATION_POLICIES = ("pack", "spread")
 
 
 @dataclass
@@ -52,6 +57,12 @@ class Config:
     # monitor mode: per-pod shared cache dirs under host_lib_dir/shared
     monitor_mode: bool = False
     node_name: Optional[str] = None
+    # vdevice scoring policy for GetPreferredAllocation: pack | spread
+    allocation_policy: str = "pack"
+    # vtpu-metricsd: inject the in-container virtualized MetricService
+    # (stock tpu-info compatibility, docs/METRICSD.md) at Allocate
+    enable_metricsd: bool = True
+    metricsd_port: int = 8431
 
     def validate(self) -> List[str]:
         """Up-front validation (reference main.go:143-161)."""
@@ -73,6 +84,11 @@ class Config:
         if self.enable_legacy_preferred and not (
                 self.node_name or os.environ.get("NODE_NAME")):
             errors.append("--enable-legacy-preferred requires NODE_NAME")
+        if self.allocation_policy not in ALLOCATION_POLICIES:
+            errors.append(
+                f"invalid --allocation-policy {self.allocation_policy!r}")
+        if not (0 < self.metricsd_port < 65536):
+            errors.append("--metricsd-port must be in 1..65535")
         return errors
 
     @property
@@ -123,6 +139,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--monitor-mode", type=_bool,
                    default=_bool(_env("VTPU_MONITOR_MODE", "false")))
     p.add_argument("--node-name", default=_env("NODE_NAME", None))
+    p.add_argument("--allocation-policy",
+                   default=_env("VTPU_ALLOCATION_POLICY", "pack"),
+                   help="pack|spread (GetPreferredAllocation scoring)")
+    p.add_argument("--enable-metricsd", type=_bool,
+                   default=_bool(_env("VTPU_METRICSD_ENABLE", "true")))
+    p.add_argument("--metricsd-port", type=int,
+                   default=int(_env("VTPU_METRICSD_PORT", "8431")))
     return p
 
 
@@ -154,6 +177,9 @@ def parse_args(argv: Optional[List[str]] = None) -> Config:
         runtime_socket=ns.runtime_socket,
         monitor_mode=ns.monitor_mode,
         node_name=ns.node_name,
+        allocation_policy=ns.allocation_policy,
+        enable_metricsd=ns.enable_metricsd,
+        metricsd_port=ns.metricsd_port,
     )
     errors = cfg.validate()
     if errors:

@@ -23,6 +23,7 @@ import grpc
 
 from ..discovery.types import Health, TpuTopology
 from ..k8s.client import CachedPodLister
+from ..metricsd import UPSTREAM_PORT_OFFSET
 from ..proto import DEVICE_PLUGIN_VERSION, pb, rpc
 from ..utils import envspec
 from ..utils import logging as log
@@ -250,7 +251,8 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
                                    creq.must_include_deviceIDs)
             chosen = preferred_allocation(available, must,
                                           creq.allocation_size,
-                                          self.topology)
+                                          self.topology,
+                                          policy=self.cfg.allocation_policy)
             resp.container_responses.add(deviceIDs=[v.id for v in chosen])
         return resp
 
@@ -275,14 +277,15 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
             self._fill_allocate_response(car, vdevs, ids)
         return resp
 
-    def _shared_cache_path(self, n_vdevices: int) -> str:
-        """Per-allocation shared-region path; in monitor mode a per-pod dir
-        under the host lib dir so the node monitor can read it (reference
-        server.go:494-504)."""
+    def _shared_cache_path(self, n_vdevices: int):
+        """(region path, matched pod-declared env) for this allocation; in
+        monitor mode a per-pod dir under the host lib dir so the node
+        monitor can read it (reference server.go:494-504).  The pod env is
+        {} when pod identity is unknown (non-monitor mode / no match)."""
         if self.cfg.monitor_mode and self.pod_lister is not None:
             match = self._match_pending_pod(n_vdevices)
             if match is not None:
-                ns, pod, container, uid = match
+                ns, pod, container, uid, pod_env = match
                 # Namespace + UID keep distinct same-named pods from
                 # colliding on one accounting region.
                 name = f"{ns}_{pod}_{container}_{uid[:8]}"
@@ -297,15 +300,19 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
                     # Release the claim: the pod was not actually served.
                     with self._matched_mu:
                         self._matched_pods.pop((uid, container), None)
-                    return f"/tmp/vtpu_{uuidlib.uuid4().hex[:12]}.cache"
+                    return (f"/tmp/vtpu_{uuidlib.uuid4().hex[:12]}.cache",
+                            pod_env)
                 d = os.path.join(CONTAINER_LIB_DIR, "shared", name)
-                return os.path.join(d, "vtpushr.cache")
-        return f"/tmp/vtpu_{uuidlib.uuid4().hex[:12]}.cache"
+                return os.path.join(d, "vtpushr.cache"), pod_env
+        return f"/tmp/vtpu_{uuidlib.uuid4().hex[:12]}.cache", {}
 
     def _match_pending_pod(self, n_vdevices: int):
         """Identify the pod this Allocate serves by matching pending pods'
         per-container vtpu limits against the request size — crude, but
         Allocate carries no pod identity (reference server.go:365-406).
+        The match also carries the container's pod-declared env (plain
+        name/value entries) so injection can MERGE with a user-declared
+        PYTHONPATH instead of clobbering it.
         Containers already matched in this plugin generation are skipped so
         two same-sized pending pods resolve to distinct shared dirs.
 
@@ -331,8 +338,11 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
                     cname = ctr.get("name", "ctr")
                     if want is None or int(want) != n_vdevices:
                         continue
+                    env = {ev.get("name"): ev.get("value", "")
+                           for ev in ctr.get("env", []) or []
+                           if ev.get("name") and "valueFrom" not in ev}
                     cand.append((meta.get("namespace", "default"),
-                                 meta.get("name", "pod"), cname, uid))
+                                 meta.get("name", "pod"), cname, uid, env))
             return cand, live_
 
         try:
@@ -425,7 +435,8 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         if core_ids:
             envs["VTPU_CORE_INDICES"] = ",".join(core_ids)
 
-        envs[envspec.ENV_SHARED_CACHE] = self._shared_cache_path(len(vdevs))
+        shared_cache, pod_env = self._shared_cache_path(len(vdevs))
+        envs[envspec.ENV_SHARED_CACHE] = shared_cache
         if self.cfg.oversubscribe:
             envs[envspec.ENV_OVERSUBSCRIBE] = "true"
         # Only advertise/mount the broker socket when it answers: a bind
@@ -461,16 +472,43 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         envs["TPU_LIBRARY_PATH"] = os.path.join(CONTAINER_LIB_DIR,
                                                 "libvtpu_pjrt.so")
         # Python-level preload for CPU-backend fallback + runtime client
-        # bootstrap.  Allocate cannot see the image's own PYTHONPATH, so
-        # this REPLACES it (kubelet merges plugin envs over image ENV) —
-        # images needing extra paths use VTPU_EXTRA_PYTHONPATH, which the
-        # shim's sitecustomize appends to sys.path (docs/FLAGS.md).
-        envs["PYTHONPATH"] = os.path.join(CONTAINER_LIB_DIR, "shim")
-        # Operators debugging a pod whose image-ENV PYTHONPATH vanished
-        # land here: the replacement is invisible in-container.
-        log.info("allocate: injecting PYTHONPATH=%s (replaces any "
-                 "image-ENV PYTHONPATH; see docs/FLAGS.md "
-                 "VTPU_EXTRA_PYTHONPATH)", envs["PYTHONPATH"])
+        # bootstrap.  Allocate cannot see Dockerfile ENV, but a
+        # pod-DECLARED PYTHONPATH (visible via the monitor-mode pod
+        # match) is APPENDED rather than clobbered; the shim reads
+        # VTPU_SHIM_PYTHONPATH to tell its own injected entry from the
+        # user's and warns in-container when a merge happened.  Images
+        # whose PYTHONPATH lives only in Dockerfile ENV still lose it
+        # (kubelet merges plugin envs over image ENV) — those use
+        # VTPU_EXTRA_PYTHONPATH, which sitecustomize appends to sys.path
+        # (docs/FLAGS.md).
+        shim_pp = os.path.join(CONTAINER_LIB_DIR, "shim")
+        envs["VTPU_SHIM_PYTHONPATH"] = shim_pp
+        user_pp = (pod_env or {}).get("PYTHONPATH", "")
+        if user_pp:
+            envs["PYTHONPATH"] = shim_pp + os.pathsep + user_pp
+            log.info("allocate: merging PYTHONPATH=%s (pod-declared "
+                     "entries preserved after the shim)",
+                     envs["PYTHONPATH"])
+        else:
+            envs["PYTHONPATH"] = shim_pp
+            # Operators debugging a pod whose image-ENV PYTHONPATH
+            # vanished land here: the replacement is invisible
+            # in-container.
+            log.info("allocate: injecting PYTHONPATH=%s (replaces any "
+                     "image-ENV PYTHONPATH; see docs/FLAGS.md "
+                     "VTPU_EXTRA_PYTHONPATH)", envs["PYTHONPATH"])
+
+        # vtpu-metricsd (docs/METRICSD.md): the shim bootstrap serves the
+        # virtualized libtpu MetricService on the stock port tpu-info
+        # dials, and the REAL libtpu metrics service is moved to
+        # port+offset via TPU_RUNTIME_METRICS_PORTS, where metricsd
+        # proxies its non-sensitive metrics.
+        if self.cfg.enable_metricsd:
+            mport = self.cfg.metricsd_port
+            upstream = mport + UPSTREAM_PORT_OFFSET
+            envs["VTPU_METRICSD_PORT"] = str(mport)
+            envs["TPU_RUNTIME_METRICS_PORTS"] = str(upstream)
+            envs["VTPU_METRICSD_UPSTREAM"] = f"localhost:{upstream}"
 
         for k, v in envs.items():
             car.envs[k] = v
@@ -504,6 +542,16 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
                 (os.path.join(CONTAINER_LIB_DIR, "libvtpu_preload.so"),
                  preload_lib, True))
             mounts.append(("/etc/ld.so.preload", preload_list, True))
+        # Host-consent marker for the preload env kill-switch: staged by
+        # entrypoint.sh only when the operator set
+        # VTPU_ALLOW_ENV_OVERRIDE=1 on the daemon.  Without this
+        # read-only mount the preload hook ignores VTPU_PRELOAD_DISABLE
+        # / VTPU_INTERPOSER_PATH (fail closed — a tenant env var alone
+        # cannot disable enforcement).
+        env_override_marker = os.path.join(host, "allow-env-override")
+        if os.path.exists(env_override_marker):
+            mounts.append(("/var/run/vtpu/allow-env-override",
+                           env_override_marker, True))
         if self.cfg.pcibus_file:
             mounts.append((os.path.join(CONTAINER_LIB_DIR, "tpuinfo.vtpu"),
                            self.cfg.pcibus_file, True))

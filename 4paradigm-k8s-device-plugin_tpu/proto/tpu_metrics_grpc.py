@@ -1,0 +1,61 @@
+"""gRPC service glue for the libtpu runtime MetricService protocol.
+
+Hand-written (the image has grpcio but not grpcio-tools), equivalent to
+what ``protoc --grpc_python_out`` would emit for tpu_metrics.proto: the
+stub + servicer + registration helper for
+``tpu.monitoring.runtime.v2alpha1.RuntimeMetricService`` — the localhost
+service the stock ``tpu-info`` CLI dials on port 8431.  Served
+quota-virtualized by vtpu-metricsd (vtpu/metricsd/server.py); the stub is
+also how metricsd proxies pass-through metrics from a real libtpu.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import tpu_metrics_pb2 as mpb
+
+_SVC = "tpu.monitoring.runtime.v2alpha1.RuntimeMetricService"
+
+
+class RuntimeMetricServiceStub:
+    def __init__(self, channel: grpc.Channel):
+        self.GetRuntimeMetric = channel.unary_unary(
+            f"/{_SVC}/GetRuntimeMetric",
+            request_serializer=mpb.MetricRequest.SerializeToString,
+            response_deserializer=mpb.MetricResponse.FromString,
+        )
+        self.ListSupportedMetrics = channel.unary_unary(
+            f"/{_SVC}/ListSupportedMetrics",
+            request_serializer=(
+                mpb.ListSupportedMetricsRequest.SerializeToString),
+            response_deserializer=mpb.ListSupportedMetricsResponse.FromString,
+        )
+
+
+class RuntimeMetricServiceServicer:
+    def GetRuntimeMetric(self, request, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        raise NotImplementedError()
+
+    def ListSupportedMetrics(self, request, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        raise NotImplementedError()
+
+
+def add_RuntimeMetricServiceServicer_to_server(servicer, server):
+    handlers = {
+        "GetRuntimeMetric": grpc.unary_unary_rpc_method_handler(
+            servicer.GetRuntimeMetric,
+            request_deserializer=mpb.MetricRequest.FromString,
+            response_serializer=mpb.MetricResponse.SerializeToString,
+        ),
+        "ListSupportedMetrics": grpc.unary_unary_rpc_method_handler(
+            servicer.ListSupportedMetrics,
+            request_deserializer=mpb.ListSupportedMetricsRequest.FromString,
+            response_serializer=(
+                mpb.ListSupportedMetricsResponse.SerializeToString),
+        ),
+    }
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler(_SVC, handlers),))

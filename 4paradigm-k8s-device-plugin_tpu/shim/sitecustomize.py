@@ -40,16 +40,42 @@ def _insert_extra_paths():
             at += 1
 
 
+def _warn_pythonpath_merge():
+    """One visible line when Allocate MERGED a user-declared PYTHONPATH
+    behind the shim entry (plugin/server.py): the user's entries are
+    live, but positioned after ours — say so in-container instead of
+    leaving the reordering silent."""
+    shim_pp = os.environ.get("VTPU_SHIM_PYTHONPATH", _SHIM_DIR)
+    merged = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+              if p and os.path.abspath(p) != os.path.abspath(shim_pp)]
+    if merged:
+        print("[vtpu shim] PYTHONPATH merged: kept "
+              f"{os.pathsep.join(merged)} after the vTPU shim entry "
+              "(docs/FLAGS.md)", file=sys.stderr)
+
+
 def _main():
     if _SHIM_DIR not in sys.path:
         sys.path.insert(0, _SHIM_DIR)
     _insert_extra_paths()
+    _warn_pythonpath_merge()
     try:
         from vtpu.shim import pyshim
     except ImportError:
         # Staged copy keeps the package next to this file.
         return
     pyshim.bootstrap()
+    # vtpu-metricsd (docs/METRICSD.md): serve the virtualized libtpu
+    # MetricService so a stock in-container `tpu-info` sees only the
+    # grant.  Port-bind race makes this a per-container singleton; any
+    # failure is swallowed — metrics must never break user startup.
+    if os.environ.get("VTPU_METRICSD_PORT"):
+        try:
+            from vtpu.metricsd import server as _metricsd
+            _metricsd.maybe_start_in_container()
+        except Exception as e:  # noqa: BLE001
+            print(f"[vtpu shim] metricsd start failed: {e}",
+                  file=sys.stderr)
     # Transparent broker bridge (shim/bridge.py): a time-shared grant
     # carries VTPU_RUNTIME_SOCKET — route plain `import jax` workloads
     # through the broker.  The local backend is pinned to CPU so this

@@ -15,6 +15,14 @@ checker proves, per verb:
   - bind-free verbs answered BEFORE the NO_HELLO guard on the tenant
     socket and present on the admin socket too (the no-wedge probe
     contract, ADVICE r5 #2).
+
+vtpu-metricsd's gRPC surface has the same three-hands problem (the
+``METRICSD_RPCS`` registry in ``metricsd/__init__.py``, the hand-written
+stub/servicer glue in ``proto/tpu_metrics_grpc.py``, and the
+implementation in ``metricsd/server.py``), so the same exhaustiveness is
+proven for it: every registered RPC must have a stub binding, a glue
+servicer method, a registration-handler entry AND an implementation
+override; an implemented-but-unregistered RPC fails too.
 """
 
 from __future__ import annotations
@@ -28,6 +36,9 @@ PROTOCOL = f"{PKG_NAME}/runtime/protocol.py"
 SERVER = f"{PKG_NAME}/runtime/server.py"
 CLIENT = f"{PKG_NAME}/runtime/client.py"
 SMI = f"{PKG_NAME}/tools/vtpu_smi.py"
+METRICSD_INIT = f"{PKG_NAME}/metricsd/__init__.py"
+METRICSD_SERVER = f"{PKG_NAME}/metricsd/server.py"
+METRICS_GRPC = f"{PKG_NAME}/proto/tpu_metrics_grpc.py"
 
 
 def parse_protocol(src: str, path: str = PROTOCOL
@@ -216,10 +227,140 @@ def check_texts(protocol_src: str, server_src: str, client_src: str,
     return findings
 
 
+def parse_metricsd_registry(src: str, path: str = METRICSD_INIT
+                            ) -> Tuple[Set[str], List[Finding]]:
+    """METRICSD_RPCS string-literal tuple from metricsd/__init__.py."""
+    findings: List[Finding] = []
+    rpcs: Set[str] = set()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return set(), [Finding("verbs", path, e.lineno or 1,
+                               f"syntax error: {e.msg}")]
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "METRICSD_RPCS" and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            for el in node.value.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    rpcs.add(el.value)
+                else:
+                    findings.append(Finding(
+                        "verbs", path, el.lineno,
+                        "METRICSD_RPCS entry is not a string literal"))
+    if not rpcs and not findings:
+        findings.append(Finding(
+            "verbs", path, 1,
+            "metricsd/__init__.py has no METRICSD_RPCS registry"))
+    return rpcs, findings
+
+
+def _class_methods(tree: ast.AST, cls: str) -> Set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            return {sub.name for sub in node.body
+                    if isinstance(sub, ast.FunctionDef)}
+    return set()
+
+
+def _stub_bindings(tree: ast.AST, cls: str) -> Set[str]:
+    """``self.X = channel.…`` assignments in a stub class __init__."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef) and node.name == cls):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Attribute) and \
+                    isinstance(sub.targets[0].value, ast.Name) and \
+                    sub.targets[0].value.id == "self":
+                out.add(sub.targets[0].attr)
+    return out
+
+
+def _handler_keys(tree: ast.AST, fn_name: str) -> Set[str]:
+    """String keys of the ``handlers = {...}`` dict in a registration
+    helper."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef) and
+                node.name == fn_name):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                for k in sub.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        out.add(k.value)
+    return out
+
+
+def check_metricsd_texts(init_src: str, glue_src: str,
+                         impl_src: str) -> List[Finding]:
+    rpcs, findings = parse_metricsd_registry(init_src)
+    if not rpcs:
+        return findings
+    try:
+        glue_tree = ast.parse(glue_src)
+    except SyntaxError as e:
+        return findings + [Finding("verbs", METRICS_GRPC, e.lineno or 1,
+                                   f"syntax error: {e.msg}")]
+    try:
+        impl_tree = ast.parse(impl_src)
+    except SyntaxError as e:
+        return findings + [Finding("verbs", METRICSD_SERVER,
+                                   e.lineno or 1,
+                                   f"syntax error: {e.msg}")]
+    stub = _stub_bindings(glue_tree, "RuntimeMetricServiceStub")
+    glue_servicer = _class_methods(glue_tree, "RuntimeMetricServiceServicer")
+    handlers = _handler_keys(
+        glue_tree, "add_RuntimeMetricServiceServicer_to_server")
+    impl = _class_methods(impl_tree, "MetricsdServicer")
+    for rpc in sorted(rpcs):
+        if rpc not in stub:
+            findings.append(Finding(
+                "verbs", METRICS_GRPC, 1,
+                f"metricsd RPC {rpc} has no RuntimeMetricServiceStub "
+                f"binding"))
+        if rpc not in glue_servicer:
+            findings.append(Finding(
+                "verbs", METRICS_GRPC, 1,
+                f"metricsd RPC {rpc} has no RuntimeMetricServiceServicer "
+                f"method"))
+        if rpc not in handlers:
+            findings.append(Finding(
+                "verbs", METRICS_GRPC, 1,
+                f"metricsd RPC {rpc} is missing from the "
+                f"add_RuntimeMetricServiceServicer_to_server handlers"))
+        if rpc not in impl:
+            findings.append(Finding(
+                "verbs", METRICSD_SERVER, 1,
+                f"metricsd RPC {rpc} has no MetricsdServicer "
+                f"implementation"))
+    # Reverse direction: a CamelCase method on the implementation that
+    # the registry does not know is an unregistered wire surface.
+    for name in sorted(impl):
+        if name[:1].isupper() and name not in rpcs:
+            findings.append(Finding(
+                "verbs", METRICSD_SERVER, 1,
+                f"MetricsdServicer.{name} is implemented but not in "
+                f"METRICSD_RPCS"))
+    return findings
+
+
 def check(root: str) -> List[Finding]:
     srcs = {rel: read_text(root, rel)
             for rel in (PROTOCOL, SERVER, CLIENT, SMI)}
     if any(v is None for v in srcs.values()):
         return []
-    return check_texts(srcs[PROTOCOL], srcs[SERVER], srcs[CLIENT],
-                       srcs[SMI])
+    findings = check_texts(srcs[PROTOCOL], srcs[SERVER], srcs[CLIENT],
+                           srcs[SMI])
+    msrcs = {rel: read_text(root, rel)
+             for rel in (METRICSD_INIT, METRICS_GRPC, METRICSD_SERVER)}
+    if all(v is not None for v in msrcs.values()):
+        findings.extend(check_metricsd_texts(
+            msrcs[METRICSD_INIT], msrcs[METRICS_GRPC],
+            msrcs[METRICSD_SERVER]))
+    return findings

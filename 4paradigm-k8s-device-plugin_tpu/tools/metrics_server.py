@@ -36,10 +36,12 @@ from .vtpu_smi import find_regions
 
 class MetricsState:
     def __init__(self, scan: Optional[str], regions: List[str],
-                 brokers: Optional[List[str]] = None):
+                 brokers: Optional[List[str]] = None,
+                 metricsd: Optional[List[str]] = None):
         self.scan = scan
         self.explicit = regions
         self.brokers = brokers or []
+        self.metricsd = metricsd or []
         # Duty cycle: previous (busy_us, t) sample per (region, device).
         self._prev: Dict[tuple, tuple] = {}
         self.mu = threading.Lock()
@@ -80,6 +82,50 @@ class MetricsState:
                                                 8)) as ex:
             return [r for r in ex.map(scrape, self.brokers)
                     if r is not None]
+
+    def collect_metricsd(self) -> List[Dict]:
+        """vtpu-metricsd self-gauges + virtualized device view over its
+        own gRPC wire (docs/METRICSD.md) — node operators see what each
+        tenant's stock tpu-info is being told, and whether pass-through
+        denials are happening.  Best-effort: a dead metricsd is skipped,
+        never fails the scrape."""
+        from ..metricsd import server as metricsd_server
+
+        out = []
+        for addr in self.metricsd:
+            try:
+                import grpc
+
+                from ..proto import tpu_metrics_grpc as mrpc
+                from ..proto import tpu_metrics_pb2 as mpb
+                ch = grpc.insecure_channel(addr)
+                stub = mrpc.RuntimeMetricServiceStub(ch)
+                item: Dict = {"metricsd": addr, "up": 1,
+                              "self": {}, "devices": {}}
+                for name in metricsd_server.SELF_METRICS:
+                    resp = stub.GetRuntimeMetric(
+                        mpb.MetricRequest(metric_name=name), timeout=2.0)
+                    if resp.metric.metrics:
+                        item["self"][name] = int(
+                            resp.metric.metrics[0].gauge.as_int)
+                for name in metricsd_server.VIRTUALIZED_METRICS:
+                    resp = stub.GetRuntimeMetric(
+                        mpb.MetricRequest(metric_name=name), timeout=2.0)
+                    per_dev = {}
+                    for m in resp.metric.metrics:
+                        dev = int(m.attribute.value.int_attr)
+                        val = (m.gauge.as_double
+                               if m.gauge.WhichOneof("value") == "as_double"
+                               else m.gauge.as_int)
+                        per_dev[dev] = val
+                    item["devices"][name] = per_dev
+                ch.close()
+                out.append(item)
+            except Exception as e:  # noqa: BLE001 - scrape is best-effort
+                log.warn("metricsd %s unreachable: %s", addr, e)
+                out.append({"metricsd": addr, "up": 0,
+                            "self": {}, "devices": {}})
+        return out
 
     def collect(self) -> List[Dict]:
         out = []
@@ -306,6 +352,47 @@ def broker_prometheus(brokers: List[Dict]) -> str:
     return "\n".join(lines) + "\n" if brokers else ""
 
 
+def metricsd_prometheus(items: List[Dict]) -> str:
+    """vtpu-metricsd gauges (docs/METRICSD.md): liveness, request and
+    pass-through counters, and the quota-virtualized per-device values
+    each tenant's stock tpu-info observes."""
+    if not items:
+        return ""
+    lines = [
+        "# HELP vtpu_metricsd_up 1 when the tenant metricsd answers its "
+        "MetricService port.",
+        "# TYPE vtpu_metricsd_up gauge",
+        "# HELP vtpu_metricsd_requests_total MetricService RPCs served.",
+        "# TYPE vtpu_metricsd_requests_total counter",
+        "# HELP vtpu_metricsd_passthrough_total Non-sensitive metrics "
+        "proxied from the real libtpu service.",
+        "# TYPE vtpu_metricsd_passthrough_total counter",
+        "# HELP vtpu_metricsd_passthrough_denied_total Quota-sensitive "
+        "metric requests refused instead of proxied.",
+        "# TYPE vtpu_metricsd_passthrough_denied_total counter",
+        "# HELP vtpu_metricsd_virtual_value The quota-virtualized value "
+        "served to the tenant, per metric name and device ordinal.",
+        "# TYPE vtpu_metricsd_virtual_value gauge",
+    ]
+    suffix = {
+        "vtpu.metricsd.requests.total": "requests_total",
+        "vtpu.metricsd.passthrough.total": "passthrough_total",
+        "vtpu.metricsd.passthrough.denied.total": "passthrough_denied_total",
+    }
+    for item in items:
+        tgt = _esc(item["metricsd"])
+        lines.append(f'vtpu_metricsd_up{{target="{tgt}"}} {item["up"]}')
+        for name, val in sorted(item["self"].items()):
+            lines.append(
+                f'vtpu_metricsd_{suffix[name]}{{target="{tgt}"}} {val}')
+        for name, per_dev in sorted(item["devices"].items()):
+            for dev, val in sorted(per_dev.items()):
+                lines.append(
+                    f'vtpu_metricsd_virtual_value{{target="{tgt}",'
+                    f'metric="{_esc(name)}",device="{dev}"}} {val}')
+    return "\n".join(lines) + "\n"
+
+
 def to_prometheus(infos: List[Dict]) -> str:
     lines = [
         "# HELP vtpu_hbm_used_bytes Accounted HBM usage per vTPU device.",
@@ -365,12 +452,14 @@ def make_handler(state: MetricsState):
         def do_GET(self):  # noqa: N802 - stdlib API
             if self.path.startswith("/metrics"):
                 body = to_prometheus(state.collect()) + \
-                    broker_prometheus(state.collect_brokers())
+                    broker_prometheus(state.collect_brokers()) + \
+                    metricsd_prometheus(state.collect_metricsd())
                 self._reply(200, body, "text/plain; version=0.0.4")
             elif self.path.startswith("/json"):
                 self._reply(200, json.dumps(
                     {"regions": state.collect(),
-                     "brokers": state.collect_brokers()}, indent=2),
+                     "brokers": state.collect_brokers(),
+                     "metricsd": state.collect_metricsd()}, indent=2),
                     "application/json")
             elif self.path.startswith("/healthz"):
                 self._reply(200, "ok\n", "text/plain")
@@ -383,9 +472,11 @@ def make_handler(state: MetricsState):
 def make_server(port: int, scan: Optional[str] = None,
                 regions: Optional[List[str]] = None,
                 host: str = "127.0.0.1",
-                brokers: Optional[List[str]] = None
+                brokers: Optional[List[str]] = None,
+                metricsd: Optional[List[str]] = None
                 ) -> ThreadingHTTPServer:
-    state = MetricsState(scan, regions or [], brokers or [])
+    state = MetricsState(scan, regions or [], brokers or [],
+                         metricsd or [])
     srv = ThreadingHTTPServer((host, port), make_handler(state))
     srv.state = state  # type: ignore[attr-defined]
     return srv
@@ -403,8 +494,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="broker MAIN socket (repeatable): adds "
                          "per-tenant gauges (spill, residency, "
                          "suspension) via the host-side admin socket")
+    ap.add_argument("--metricsd", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="vtpu-metricsd MetricService address "
+                         "(repeatable): adds vtpu_metricsd_* gauges — "
+                         "liveness, pass-through counters and the "
+                         "virtualized values tenants observe")
     ns = ap.parse_args(argv)
-    srv = make_server(ns.port, ns.scan, ns.region, ns.host, ns.broker)
+    srv = make_server(ns.port, ns.scan, ns.region, ns.host, ns.broker,
+                      ns.metricsd)
     log.info("vtpu-metrics serving on %s:%d (/metrics /json /healthz)",
              ns.host, ns.port)
     try:

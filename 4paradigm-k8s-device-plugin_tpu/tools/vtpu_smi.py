@@ -246,6 +246,63 @@ def cmd_trace(ns, paths: List[str]) -> int:
     return 0
 
 
+def cmd_metricsd(ns) -> int:
+    """`vtpu-smi metricsd [ADDR]`: query a vtpu-metricsd instance over
+    its own MetricService wire and print the quota-virtualized view a
+    stock in-container tpu-info would see (docs/METRICSD.md)."""
+    import grpc
+
+    from ..metricsd import DEFAULT_PORT
+    from ..metricsd import server as metricsd_server
+    from ..proto import tpu_metrics_grpc as mrpc
+    from ..proto import tpu_metrics_pb2 as mpb
+    addr = ns.cmd_arg or os.environ.get("VTPU_METRICSD_BROKER") \
+        or f"localhost:{os.environ.get('VTPU_METRICSD_PORT', DEFAULT_PORT)}"
+    ch = grpc.insecure_channel(addr)
+    stub = mrpc.RuntimeMetricServiceStub(ch)
+    out: Dict = {"metricsd": addr, "metrics": {}}
+    try:
+        listed = stub.ListSupportedMetrics(
+            mpb.ListSupportedMetricsRequest(), timeout=3.0)
+        out["supported"] = [sm.metric_name
+                            for sm in listed.supported_metric]
+        for name in metricsd_server.VIRTUALIZED_METRICS + \
+                metricsd_server.SELF_METRICS:
+            resp = stub.GetRuntimeMetric(
+                mpb.MetricRequest(metric_name=name), timeout=3.0)
+            vals = {}
+            for m in resp.metric.metrics:
+                dev = int(m.attribute.value.int_attr) \
+                    if m.attribute.key else -1
+                vals[dev] = (m.gauge.as_double
+                             if m.gauge.WhichOneof("value") == "as_double"
+                             else int(m.gauge.as_int))
+            out["metrics"][name] = vals
+    except grpc.RpcError as e:
+        print(f"metricsd {addr} unreachable: {e.code().name}",
+              file=sys.stderr)
+        return 1
+    finally:
+        ch.close()
+    if ns.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    print(f"vtpu-metricsd @ {addr} (the stock tpu-info view)")
+    totals = out["metrics"].get(metricsd_server.METRIC_HBM_TOTAL, {})
+    usages = out["metrics"].get(metricsd_server.METRIC_HBM_USAGE, {})
+    duties = out["metrics"].get(metricsd_server.METRIC_DUTY_CYCLE, {})
+    print(f"{'Dev':<5} {'HBM usage':<26} {'Duty (of quota)':<16}")
+    for dev in sorted(totals):
+        used, total = usages.get(dev, 0), totals[dev]
+        print(f"{dev:<5} {_mb(used) + ' / ' + _mb(total):<26} "
+              f"{str(duties.get(dev, 0.0)) + '%':<16}")
+    reqs = out["metrics"].get(metricsd_server.METRIC_SELF_REQUESTS, {})
+    denied = out["metrics"].get(metricsd_server.METRIC_SELF_DENIED, {})
+    print(f"requests served: {sum(reqs.values())}, "
+          f"pass-through denials: {sum(denied.values())}")
+    return 0
+
+
 def cmd_leases(ns) -> int:
     """`vtpu-smi leases`: chip-lease sidecar forensics — who holds (or
     last held) each chip lease, liveness, heartbeat age."""
@@ -270,14 +327,17 @@ def cmd_leases(ns) -> int:
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
     ap.add_argument("cmd", nargs="?", default=None,
-                    choices=("trace", "leases", "analyze"),
+                    choices=("trace", "leases", "analyze", "metricsd"),
                     help="trace: flight-recorder spans (needs "
                          "--broker; --dump FILE exports Chrome-trace "
                          "JSON); leases: chip-lease sidecar forensics; "
                          "analyze: cross-layer invariant linters "
-                         "(docs/ANALYSIS.md)")
+                         "(docs/ANALYSIS.md); metricsd: the quota-"
+                         "virtualized view stock tpu-info sees "
+                         "(docs/METRICSD.md)")
     ap.add_argument("cmd_arg", nargs="?", default=None,
-                    help="tenant name for `trace`")
+                    help="tenant name for `trace`; HOST:PORT for "
+                         "`metricsd`")
     ap.add_argument("--dump", default=None, metavar="FILE",
                     help="with `trace`: write Chrome-trace/Perfetto "
                          "JSON (broker spans + shim ring events)")
@@ -320,6 +380,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if ns.cmd == "leases":
         return cmd_leases(ns)
+    if ns.cmd == "metricsd":
+        return cmd_metricsd(ns)
     if ns.cmd == "trace":
         return cmd_trace(ns, ns.region or find_regions(ns.scan))
     if ns.cmd == "analyze":

@@ -135,8 +135,19 @@ ENV_FLAGS = {
     ENV_RUNTIME_SOCKET: ("contract", False),
     ENV_LOG_LEVEL: ("contract", False),
     ENV_PCIBUS_FILE: ("contract", False),
+    # vtpu-metricsd (docs/METRICSD.md): injected redirect + in-container
+    # server knobs.
+    "VTPU_METRICSD_PORT": ("contract", True),
+    "VTPU_METRICSD_UPSTREAM": ("contract", False),
+    "VTPU_METRICSD_AUTOSTART": ("shim", False),
+    "VTPU_METRICSD_FAKE": ("tools", False),
+    "VTPU_METRICSD_BROKER": ("tools", False),
+    "VTPU_SHIM_PYTHONPATH": ("contract", False),
     # Daemon (plugin/config.py, discovery, health).
     "VTPU_DISCOVERY": ("daemon", False),
+    "VTPU_ALLOCATION_POLICY": ("daemon", True),
+    "VTPU_METRICSD_ENABLE": ("daemon", True),
+    "VTPU_ALLOW_ENV_OVERRIDE": ("daemon", True),
     "VTPU_ENABLE_RUNTIME": ("daemon", False),
     "VTPU_MONITOR_MODE": ("daemon", False),
     "VTPU_HOST_LIB_DIR": ("daemon", False),

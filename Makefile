@@ -10,10 +10,16 @@ native:
 native-test:
 	$(MAKE) -C native test
 
-proto: $(PKG)/proto/deviceplugin_pb2.py
+proto: $(PKG)/proto/deviceplugin_pb2.py proto-metrics
 
 $(PKG)/proto/deviceplugin_pb2.py: $(PKG)/proto/deviceplugin.proto
 	cd $(PKG)/proto && protoc --python_out=. deviceplugin.proto
+
+# tpu_metrics_pb2.py is built with the protobuf runtime (no protoc /
+# grpcio-tools in the image): gen_tpu_metrics.py mirrors
+# tpu_metrics.proto and embeds the serialized descriptor protoc-style.
+proto-metrics:
+	cd $(PKG)/proto && python3 gen_tpu_metrics.py
 
 test: native
 	python -m pytest tests/ -q
@@ -24,4 +30,4 @@ bench-smoke:
 clean:
 	$(MAKE) -C native clean
 
-.PHONY: all native native-test proto test bench-smoke clean
+.PHONY: all native native-test proto proto-metrics test bench-smoke clean

@@ -23,4 +23,16 @@ if [ -f "$VTPU_HOST_LIB_DIR/libvtpu_preload.so" ]; then
         > "$VTPU_HOST_LIB_DIR/ld.so.preload"
 fi
 
+# Host-consent marker for the tenant-reachable preload env knobs
+# (VTPU_PRELOAD_DISABLE / VTPU_INTERPOSER_PATH): absent by default, the
+# preload hook fails CLOSED and ignores them.  An operator who wants the
+# documented cooperative kill-switch back sets VTPU_ALLOW_ENV_OVERRIDE=1
+# on the daemonset; Allocate() then mounts the marker read-only at
+# /var/run/vtpu/allow-env-override inside grants (docs/FLAGS.md).
+if [ "${VTPU_ALLOW_ENV_OVERRIDE:-0}" = "1" ]; then
+    touch "$VTPU_HOST_LIB_DIR/allow-env-override"
+else
+    rm -f "$VTPU_HOST_LIB_DIR/allow-env-override"
+fi
+
 exec python3 -m vtpu.plugin.main "$@"

@@ -75,3 +75,55 @@ def test_size_larger_than_available():
     vdevs, topo = make(2, split=1)
     chosen = preferred_allocation(vdevs, [], 5, topo)
     assert len(chosen) == 2
+
+
+# ---------------------------------------------------------------------------
+# --allocation-policy pack|spread (VERDICT missing #2): the two policies
+# must produce opposite orderings on the same node state.
+# ---------------------------------------------------------------------------
+
+def test_spread_pair_maximizes_distance():
+    vdevs, topo = make(8)  # 2x4 mesh: max pairwise distance is 1+3=4
+    chosen = preferred_allocation(vdevs, [], 2, topo, policy="spread")
+    assert len(chosen) == 2
+    (a, b) = [v.chip for v in chosen]
+    dist = a.ici_distance(b, topo)
+    # pack picks adjacent (distance 1); spread must pick the farthest
+    # connected pair the torus offers.
+    assert dist > 1
+    packed = preferred_allocation(vdevs, [], 2, topo, policy="pack")
+    pdist = packed[0].chip.ici_distance(packed[1].chip, topo)
+    assert dist > pdist
+
+
+def test_spread_tiebreak_prefers_empty_chips():
+    vdevs, topo = make(4, split=2)
+    # Chip 0 fragmented (one vdevice already gone): pack fills it,
+    # spread avoids it for an untouched chip.
+    available = [v for v in vdevs if v.id != vdevs[0].id]
+    packed = preferred_allocation(available, [], 1, topo, policy="pack")
+    spread = preferred_allocation(available, [], 1, topo, policy="spread")
+    assert packed[0].chip.index == 0
+    assert spread[0].chip.index != 0
+
+
+def test_spread_still_respects_must_include():
+    vdevs, topo = make(8)
+    forced = vdevs[0]
+    chosen = preferred_allocation(vdevs, [forced], 2, topo,
+                                  policy="spread")
+    assert forced.id in [v.id for v in chosen]
+
+
+def test_unknown_policy_behaves_as_pack():
+    vdevs, topo = make(8)
+    default = preferred_allocation(vdevs, [], 2, topo)
+    odd = preferred_allocation(vdevs, [], 2, topo, policy="???")
+    assert [v.id for v in default] == [v.id for v in odd]
+
+
+def test_config_validates_allocation_policy():
+    from vtpu.plugin.config import Config
+    assert Config(allocation_policy="spread").validate() == []
+    errs = Config(allocation_policy="roundrobin").validate()
+    assert any("allocation-policy" in e for e in errs)

@@ -209,6 +209,79 @@ def test_verbs_real_tree_clean():
 
 
 # ---------------------------------------------------------------------------
+# verbs: metricsd RPC registry (METRICSD_RPCS <-> grpc glue <-> server)
+# ---------------------------------------------------------------------------
+
+MFIX_INIT = 'METRICSD_RPCS = ("GetRuntimeMetric", "ListSupportedMetrics")\n'
+
+MFIX_GLUE = '''
+class RuntimeMetricServiceStub:
+    def __init__(self, channel):
+        self.GetRuntimeMetric = channel.unary_unary("/x")
+        self.ListSupportedMetrics = channel.unary_unary("/y")
+class RuntimeMetricServiceServicer:
+    def GetRuntimeMetric(self, request, context):
+        pass
+    def ListSupportedMetrics(self, request, context):
+        pass
+def add_RuntimeMetricServiceServicer_to_server(servicer, server):
+    handlers = {
+        "GetRuntimeMetric": 1,
+        "ListSupportedMetrics": 2,
+    }
+'''
+
+MFIX_IMPL = '''
+class MetricsdServicer:
+    def GetRuntimeMetric(self, request, context):
+        pass
+    def ListSupportedMetrics(self, request, context):
+        pass
+'''
+
+
+def test_metricsd_registry_clean_fixture():
+    assert verbs.check_metricsd_texts(MFIX_INIT, MFIX_GLUE,
+                                      MFIX_IMPL) == []
+
+
+def test_metricsd_missing_stub_binding_and_handler_caught():
+    glue = MFIX_GLUE.replace(
+        'self.ListSupportedMetrics = channel.unary_unary("/y")', "pass"
+    ).replace('"ListSupportedMetrics": 2,', "")
+    msgs = [f.message for f in verbs.check_metricsd_texts(
+        MFIX_INIT, glue, MFIX_IMPL)]
+    assert any("ListSupportedMetrics has no RuntimeMetricServiceStub"
+               in m for m in msgs), msgs
+    assert any("missing from the add_RuntimeMetricServiceServicer"
+               in m for m in msgs), msgs
+
+
+def test_metricsd_missing_implementation_caught():
+    impl = 'class MetricsdServicer:\n' \
+           '    def GetRuntimeMetric(self, request, context):\n' \
+           '        pass\n'
+    msgs = [f.message for f in verbs.check_metricsd_texts(
+        MFIX_INIT, MFIX_GLUE, impl)]
+    assert any("ListSupportedMetrics has no MetricsdServicer" in m
+               for m in msgs), msgs
+
+
+def test_metricsd_unregistered_rpc_caught():
+    impl = MFIX_IMPL + '    def StreamSecrets(self, request, context):\n' \
+                       '        pass\n'
+    msgs = [f.message for f in verbs.check_metricsd_texts(
+        MFIX_INIT, MFIX_GLUE, impl)]
+    assert any("StreamSecrets is implemented but not in METRICSD_RPCS"
+               in m for m in msgs), msgs
+
+
+def test_metricsd_missing_registry_caught():
+    msgs = [f.message for f in verbs.parse_metricsd_registry("x = 1\n")[1]]
+    assert any("no METRICSD_RPCS registry" in m for m in msgs), msgs
+
+
+# ---------------------------------------------------------------------------
 # envflags
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,418 @@
+"""vtpu-metricsd: a stock-protocol MetricService client against a tenant
+with a 50% HBM / 50% core grant must observe only quota-clamped capacity,
+ledger-accurate usage, quota-scaled duty cycle and grant-filtered devices
+— plus pass-through rules, the bind-free probe regression, the shim
+autostart race, and the metrics_server/vtpu-smi foldings."""
+
+import os
+import socket
+import threading
+
+import grpc
+import pytest
+
+from vtpu.metricsd import server as metricsd_server
+from vtpu.metricsd.backend import DeviceView, FakeBackend, RegionBackend
+from vtpu.metricsd.server import (METRIC_DUTY_CYCLE, METRIC_HBM_TOTAL,
+                                  METRIC_HBM_USAGE, MetricsdServicer,
+                                  make_server, virtual_duty_pct)
+from vtpu.proto import tpu_metrics_grpc as mrpc
+from vtpu.proto import tpu_metrics_pb2 as mpb
+from vtpu.utils import envspec
+
+GIB = 2**30
+
+
+@pytest.fixture()
+def fake_srv():
+    """Fake 50%/50% tenant: 16 GiB chip, 8 GiB quota, 50% cores, ledger
+    at 1 GiB, running at 40% of the whole chip, 2 granted devices."""
+    backend = FakeBackend()
+    server, servicer, port = make_server(0, backend)
+    ch = grpc.insecure_channel(f"localhost:{port}")
+    stub = mrpc.RuntimeMetricServiceStub(ch)
+    yield backend, servicer, stub, port
+    ch.close()
+    server.stop(grace=0.2)
+
+
+def _get(stub, name):
+    return stub.GetRuntimeMetric(mpb.MetricRequest(metric_name=name),
+                                 timeout=5)
+
+
+def test_total_is_quota_not_chip(fake_srv):
+    backend, _, stub, _ = fake_srv
+    resp = _get(stub, METRIC_HBM_TOTAL)
+    assert resp.metric.name == METRIC_HBM_TOTAL
+    assert len(resp.metric.metrics) == 2
+    for m in resp.metric.metrics:
+        # 8 GiB (the grant), never the 16 GiB raw chip.
+        assert m.gauge.as_int == 8 * GIB
+        assert m.attribute.key == "device-id"
+
+
+def test_usage_is_ledger(fake_srv):
+    backend, _, stub, _ = fake_srv
+    resp = _get(stub, METRIC_HBM_USAGE)
+    assert all(m.gauge.as_int == backend.hbm_used_bytes
+               for m in resp.metric.metrics)
+    # Ledger past the quota is clamped to the reported total: the wire
+    # must stay self-consistent (used <= total) even mid-overshoot.
+    backend.hbm_used_bytes = 12 * GIB
+    resp = _get(stub, METRIC_HBM_USAGE)
+    assert all(m.gauge.as_int == 8 * GIB for m in resp.metric.metrics)
+
+
+def test_duty_cycle_scaled_by_core_quota(fake_srv):
+    backend, _, stub, _ = fake_srv
+    resp = _get(stub, METRIC_DUTY_CYCLE)
+    # 40% of the whole chip under a 50% quota reads 80% "of my share".
+    assert all(abs(m.gauge.as_double - 80.0) < 1e-9
+               for m in resp.metric.metrics)
+    backend.duty_cycle_pct = 75.0  # above quota (borrowed/work-conserving)
+    resp = _get(stub, METRIC_DUTY_CYCLE)
+    assert all(m.gauge.as_double == 100.0 for m in resp.metric.metrics)
+
+
+def test_devices_filtered_to_grant(fake_srv):
+    _, _, stub, _ = fake_srv
+    resp = _get(stub, METRIC_HBM_TOTAL)
+    assert sorted(m.attribute.value.int_attr
+                  for m in resp.metric.metrics) == [0, 1]
+
+
+def test_virtual_duty_pct_unit():
+    assert virtual_duty_pct(40.0, 50) == 80.0
+    assert virtual_duty_pct(75.0, 50) == 100.0   # clamped
+    assert virtual_duty_pct(40.0, 0) == 40.0     # no core quota: raw
+    assert virtual_duty_pct(-5.0, 50) == 0.0
+
+
+def test_unknown_metric_not_found_without_upstream(fake_srv):
+    _, _, stub, _ = fake_srv
+    with pytest.raises(grpc.RpcError) as ei:
+        _get(stub, "tpu.runtime.uptime.seconds")
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_list_supported_metrics(fake_srv):
+    _, _, stub, _ = fake_srv
+    listed = stub.ListSupportedMetrics(mpb.ListSupportedMetricsRequest(),
+                                       timeout=5)
+    names = {sm.metric_name for sm in listed.supported_metric}
+    assert {METRIC_HBM_TOTAL, METRIC_HBM_USAGE,
+            METRIC_DUTY_CYCLE} <= names
+
+
+# ---------------------------------------------------------------------------
+# Pass-through: non-sensitive upstream metrics flow, sensitive never do.
+# ---------------------------------------------------------------------------
+
+class _RawUpstream(mrpc.RuntimeMetricServiceServicer):
+    """Stands in for the real libtpu service: answers EVERYTHING with
+    raw-chip numbers, including metrics that must never reach tenants."""
+
+    def GetRuntimeMetric(self, request, context):
+        resp = mpb.MetricResponse()
+        resp.metric.name = request.metric_name
+        m = resp.metric.metrics.add()
+        m.gauge.as_int = 16 * GIB  # raw chip capacity, co-tenant load...
+        return resp
+
+    def ListSupportedMetrics(self, request, context):
+        resp = mpb.ListSupportedMetricsResponse()
+        for n in ("tpu.runtime.uptime.seconds",
+                  "tpu.runtime.hbm.bandwidth.bytes",  # sensitive!
+                  METRIC_HBM_TOTAL):
+            resp.supported_metric.add().metric_name = n
+        return resp
+
+
+@pytest.fixture()
+def passthrough_pair():
+    from concurrent import futures as _f
+    up = grpc.server(_f.ThreadPoolExecutor(max_workers=2))
+    mrpc.add_RuntimeMetricServiceServicer_to_server(_RawUpstream(), up)
+    up_port = up.add_insecure_port("127.0.0.1:0")
+    up.start()
+    server, servicer, port = make_server(
+        0, FakeBackend(), upstream=f"localhost:{up_port}")
+    ch = grpc.insecure_channel(f"localhost:{port}")
+    stub = mrpc.RuntimeMetricServiceStub(ch)
+    yield servicer, stub
+    ch.close()
+    server.stop(grace=0.2)
+    up.stop(grace=0.2)
+
+
+def test_passthrough_non_sensitive(passthrough_pair):
+    servicer, stub = passthrough_pair
+    resp = _get(stub, "tpu.runtime.uptime.seconds")
+    assert resp.metric.metrics[0].gauge.as_int == 16 * GIB
+    assert servicer.passthrough_total == 1
+
+
+def test_sensitive_metrics_never_proxied(passthrough_pair):
+    servicer, stub = passthrough_pair
+    # The upstream would happily answer this raw-capacity metric; the
+    # virtualizer must refuse rather than forward.
+    with pytest.raises(grpc.RpcError) as ei:
+        _get(stub, "tpu.runtime.hbm.bandwidth.bytes")
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    assert servicer.passthrough_denied_total == 1
+    # And the virtualized names still answer the QUOTA, not the raw 16.
+    resp = _get(stub, METRIC_HBM_TOTAL)
+    assert all(m.gauge.as_int == 8 * GIB for m in resp.metric.metrics)
+
+
+def test_list_supported_merges_only_non_sensitive(passthrough_pair):
+    _, stub = passthrough_pair
+    listed = stub.ListSupportedMetrics(mpb.ListSupportedMetricsRequest(),
+                                       timeout=5)
+    names = {sm.metric_name for sm in listed.supported_metric}
+    assert "tpu.runtime.uptime.seconds" in names
+    assert "tpu.runtime.hbm.bandwidth.bytes" not in names
+
+
+# ---------------------------------------------------------------------------
+# Region backend: ledger-tracked usage off the real shared region, and the
+# bind-free regression (a probe claims NO proc slot, no chip, no HELLO).
+# ---------------------------------------------------------------------------
+
+def _have_native():
+    try:
+        from vtpu.shim.core import load
+        load()
+        return True
+    except (OSError, FileNotFoundError):
+        return False
+
+
+needs_native = pytest.mark.skipif(not _have_native(),
+                                  reason="libvtpucore.so not built")
+
+
+@needs_native
+def test_region_backend_ledger_and_quota(tmp_path):
+    from vtpu.shim.core import SharedRegion
+    path = str(tmp_path / "shr.cache")
+    quota = envspec.QuotaSpec(hbm_limit_bytes={0: 8 * GIB},
+                              core_limit_pct=50)
+    tenant = SharedRegion(path, limits=[8 * GIB], core_pcts=[50])
+    tenant.register()
+    assert tenant.mem_acquire(0, 1 * GIB)
+    try:
+        backend = RegionBackend(region_path=path, quota=quota)
+        server, _, port = make_server(0, backend)
+        try:
+            ch = grpc.insecure_channel(f"localhost:{port}")
+            stub = mrpc.RuntimeMetricServiceStub(ch)
+            total = _get(stub, METRIC_HBM_TOTAL)
+            usage = _get(stub, METRIC_HBM_USAGE)
+            assert [m.gauge.as_int for m in total.metric.metrics] \
+                == [8 * GIB]
+            assert [m.gauge.as_int for m in usage.metric.metrics] \
+                == [1 * GIB]
+            ch.close()
+        finally:
+            server.stop(grace=0.2)
+    finally:
+        tenant.deregister()
+        tenant.close()
+
+
+@needs_native
+def test_region_probe_is_bind_free(tmp_path):
+    """PR-1 STATS lesson: a monitoring probe must never claim a slot.
+    After a full serve-and-query cycle the region reports ZERO active
+    processes — metricsd reads stats without registering."""
+    from vtpu.shim.core import SharedRegion
+    path = str(tmp_path / "shr.cache")
+    region = SharedRegion(path, limits=[8 * GIB], core_pcts=[50])
+    try:
+        backend = RegionBackend(
+            region_path=path,
+            quota=envspec.QuotaSpec(hbm_limit_bytes={0: 8 * GIB}))
+        server, _, port = make_server(0, backend)
+        try:
+            ch = grpc.insecure_channel(f"localhost:{port}")
+            stub = mrpc.RuntimeMetricServiceStub(ch)
+            for _ in range(3):
+                _get(stub, METRIC_HBM_USAGE)
+                _get(stub, METRIC_DUTY_CYCLE)
+            ch.close()
+        finally:
+            server.stop(grace=0.2)
+        assert region.active_procs() == 0
+    finally:
+        region.close()
+
+
+def test_broker_enrichment_uses_bind_free_stats(tmp_path):
+    """The broker ledger read must be the BIND-FREE STATS verb on the
+    main socket — no HELLO first (a HELLO would claim a tenant slot and
+    can wedge a chip claim)."""
+    from vtpu.runtime import protocol as P
+
+    sock_path = str(tmp_path / "broker.sock")
+    seen = []
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(1)
+
+    def serve_one():
+        conn, _ = srv.accept()
+        msg = P.recv_msg(conn)
+        seen.append(msg)
+        P.send_msg(conn, {"ok": True, "tenants": {
+            "t1": {"chip": 0, "used_bytes": 3 * GIB,
+                   "limit_bytes": 8 * GIB}}})
+        conn.close()
+
+    t = threading.Thread(target=serve_one, daemon=True)
+    t.start()
+    backend = RegionBackend(
+        region_path=str(tmp_path / "missing.cache"),
+        quota=envspec.QuotaSpec(hbm_limit_bytes={0: 8 * GIB}),
+        broker_socket=sock_path, tenant="t1")
+    views = backend.devices()
+    t.join(timeout=5)
+    srv.close()
+    assert seen and seen[0]["kind"] == P.STATS
+    assert seen[0]["kind"] != P.HELLO
+    assert views[0].hbm_used_bytes == 3 * GIB
+
+
+# ---------------------------------------------------------------------------
+# Bootstrap + CLI + foldings.
+# ---------------------------------------------------------------------------
+
+def test_selftest_passes():
+    assert metricsd_server.selftest() == 0
+
+
+def test_backend_from_env_fake(monkeypatch):
+    monkeypatch.setenv("VTPU_METRICSD_FAKE", "1")
+    monkeypatch.setenv(f"{envspec.ENV_HBM_LIMIT}_0", "4Gi")
+    monkeypatch.setenv(envspec.ENV_CORE_LIMIT, "25")
+    monkeypatch.setenv(envspec.ENV_DEVICE_MAP, "0:TPU-x-00")
+    backend = metricsd_server.backend_from_env()
+    assert isinstance(backend, FakeBackend)
+    views = backend.devices()
+    assert len(views) == 1
+    assert views[0].hbm_limit_bytes == 4 * GIB
+    assert views[0].core_limit_pct == 25
+
+
+def test_upstream_from_env():
+    f = metricsd_server.upstream_from_env
+    assert f({"VTPU_METRICSD_UPSTREAM": "h:1"}, 8431) == "h:1"
+    assert f({"TPU_RUNTIME_METRICS_PORTS": "8441,8442"}, 8431) \
+        == "localhost:8441"
+    # Our own port must never be our upstream (proxy loop).
+    assert f({"TPU_RUNTIME_METRICS_PORTS": "8431"}, 8431) is None
+    assert f({}, 8431) is None
+
+
+def test_maybe_start_in_container_port_race(monkeypatch):
+    # Occupy a port, then ask the bootstrap to bind it: it must decline
+    # silently (a sibling process already serves this container).
+    placeholder = socket.socket()
+    placeholder.bind(("127.0.0.1", 0))
+    port = placeholder.getsockname()[1]
+    monkeypatch.setenv("VTPU_METRICSD_PORT", str(port))
+    monkeypatch.setenv("VTPU_METRICSD_FAKE", "1")
+    monkeypatch.setattr(metricsd_server, "_started", None)
+    assert metricsd_server.maybe_start_in_container() is None
+    placeholder.close()
+    # Autostart off: no server even with a free port.
+    monkeypatch.setenv("VTPU_METRICSD_AUTOSTART", "0")
+    assert metricsd_server.maybe_start_in_container() is None
+
+
+def test_maybe_start_in_container_serves(monkeypatch):
+    free = socket.socket()
+    free.bind(("127.0.0.1", 0))
+    port = free.getsockname()[1]
+    free.close()
+    monkeypatch.setenv("VTPU_METRICSD_PORT", str(port))
+    monkeypatch.setenv("VTPU_METRICSD_FAKE", "1")
+    monkeypatch.delenv("VTPU_METRICSD_AUTOSTART", raising=False)
+    monkeypatch.setattr(metricsd_server, "_started", None)
+    started = metricsd_server.maybe_start_in_container()
+    assert started is not None
+    server, _, bound = started
+    try:
+        assert bound == port
+        # Singleton: a second bootstrap call returns the same triple.
+        assert metricsd_server.maybe_start_in_container() is started
+        ch = grpc.insecure_channel(f"localhost:{port}")
+        stub = mrpc.RuntimeMetricServiceStub(ch)
+        resp = _get(stub, METRIC_HBM_TOTAL)
+        assert resp.metric.metrics
+        ch.close()
+    finally:
+        server.stop(grace=0.2)
+        monkeypatch.setattr(metricsd_server, "_started", None)
+
+
+def test_metrics_server_folds_metricsd_gauges():
+    from vtpu.tools import metrics_server as ms
+    server, servicer, port = make_server(0, FakeBackend())
+    try:
+        state = ms.MetricsState(None, [], [], [f"localhost:{port}"])
+        items = state.collect_metricsd()
+        assert items[0]["up"] == 1
+        body = ms.metricsd_prometheus(items)
+        assert "vtpu_metricsd_up" in body
+        assert "vtpu_metricsd_requests_total" in body
+        assert "vtpu_metricsd_virtual_value" in body
+        assert f'{8 * GIB}' in body  # the clamped total rides the gauge
+        # A dead metricsd is reported down, not an exception.
+        dead = ms.MetricsState(None, [], [], ["localhost:1"])
+        items = dead.collect_metricsd()
+        assert items[0]["up"] == 0
+        assert "vtpu_metricsd_up" in ms.metricsd_prometheus(items)
+    finally:
+        server.stop(grace=0.2)
+
+
+def test_vtpu_smi_metricsd_subcommand(capsys):
+    from vtpu.tools import vtpu_smi
+    server, _, port = make_server(0, FakeBackend())
+    try:
+        rc = vtpu_smi.main(["metricsd", f"localhost:{port}", "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert METRIC_HBM_TOTAL in out
+        rc = vtpu_smi.main(["metricsd", f"localhost:{port}"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "stock tpu-info view" in out
+    finally:
+        server.stop(grace=0.2)
+
+
+def test_servicer_counts_requests():
+    servicer = MetricsdServicer(FakeBackend())
+    before = servicer.requests_total
+    server, servicer2, port = make_server(0, FakeBackend())
+    try:
+        ch = grpc.insecure_channel(f"localhost:{port}")
+        stub = mrpc.RuntimeMetricServiceStub(ch)
+        _get(stub, METRIC_HBM_TOTAL)
+        resp = _get(stub, metricsd_server.METRIC_SELF_REQUESTS)
+        # The self-gauge read itself is request #2.
+        assert resp.metric.metrics[0].gauge.as_int == 2
+        ch.close()
+    finally:
+        server.stop(grace=0.2)
+    assert before == 0
+
+
+def test_fake_backend_devices_shape():
+    views = FakeBackend(n_devices=3).devices()
+    assert [v.ordinal for v in views] == [0, 1, 2]
+    assert all(isinstance(v, DeviceView) for v in views)
+    assert all(v.chip_id for v in views)

@@ -168,6 +168,77 @@ def test_allocate_ld_preload_mount_when_staged(env):
     ch.close()
 
 
+def test_allocate_env_override_marker_mount(env):
+    """The host-consent marker (preload env kill-switch gate) is mounted
+    read-only at /var/run/vtpu/allow-env-override ONLY when the operator
+    staged it (entrypoint.sh VTPU_ALLOW_ENV_OVERRIDE=1); absent marker =
+    no mount = the preload hook fails closed."""
+    sim, plugin, cfg = env
+    reg = sim.wait_registration()
+    stub, ch = sim.plugin_stub(reg.endpoint)
+
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=[plugin.vdevices[0].id])
+    resp = stub.Allocate(req)
+    mounts = {m.container_path for m in resp.container_responses[0].mounts}
+    assert "/var/run/vtpu/allow-env-override" not in mounts
+
+    os.makedirs(cfg.host_lib_dir, exist_ok=True)
+    marker = os.path.join(cfg.host_lib_dir, "allow-env-override")
+    with open(marker, "w") as f:
+        f.write("")
+    resp = stub.Allocate(req)
+    mounts = {m.container_path: (m.host_path, m.read_only)
+              for m in resp.container_responses[0].mounts}
+    assert mounts["/var/run/vtpu/allow-env-override"] == (marker, True)
+    ch.close()
+
+
+def test_allocate_metricsd_redirect(env):
+    """vtpu-metricsd injection (docs/METRICSD.md): the stock tpu-info
+    port goes to metricsd, the real libtpu metrics service is moved to
+    port+10 and advertised back as metricsd's pass-through upstream."""
+    sim, plugin, cfg = env
+    reg = sim.wait_registration()
+    stub, ch = sim.plugin_stub(reg.endpoint)
+    req = pb.AllocateRequest()
+    req.container_requests.add(devicesIDs=[plugin.vdevices[0].id])
+    resp = stub.Allocate(req)
+    envs = dict(resp.container_responses[0].envs)
+    assert envs["VTPU_METRICSD_PORT"] == "8431"
+    assert envs["TPU_RUNTIME_METRICS_PORTS"] == "8441"
+    assert envs["VTPU_METRICSD_UPSTREAM"] == "localhost:8441"
+    ch.close()
+
+
+def test_allocate_metricsd_disabled(tmp_path):
+    cfg = Config(
+        device_plugin_path=str(tmp_path) + "/",
+        device_split_count=2,
+        host_lib_dir=str(tmp_path / "vtpu"),
+        runtime_socket=str(tmp_path / "vtpu" / "rt.sock"),
+        enable_metricsd=False,
+    )
+    backend = FakeChipBackend(num_chips=2)
+    specs = build_plugin_specs(cfg, backend)
+    plugin = VtpuDevicePlugin(specs[0], cfg, topology=backend.topology())
+    sim = KubeletSim(str(tmp_path)).start()
+    plugin.start()
+    try:
+        reg = sim.wait_registration()
+        stub, ch = sim.plugin_stub(reg.endpoint)
+        req = pb.AllocateRequest()
+        req.container_requests.add(devicesIDs=[plugin.vdevices[0].id])
+        resp = stub.Allocate(req)
+        envs = dict(resp.container_responses[0].envs)
+        assert "VTPU_METRICSD_PORT" not in envs
+        assert "TPU_RUNTIME_METRICS_PORTS" not in envs
+        ch.close()
+    finally:
+        plugin.stop()
+        sim.stop()
+
+
 def test_allocate_min_exec_cost_operator_override(env, monkeypatch):
     """An operator-set VTPU_MIN_EXEC_COST_US on the daemon wins over the
     generation default (0 disables the floor)."""
@@ -334,6 +405,56 @@ def test_monitor_mode_distinct_shared_dirs(tmp_path):
         for c in caches:
             name = os.path.basename(os.path.dirname(c))
             assert os.path.isdir(tmp_path / "vtpu" / "shared" / name)
+        ch.close()
+    finally:
+        plugin.stop()
+        sim.stop()
+
+
+def test_monitor_mode_pythonpath_merged_not_clobbered(tmp_path):
+    """A pod-DECLARED PYTHONPATH survives Allocate: the injection becomes
+    shim-first + declared entries, with VTPU_SHIM_PYTHONPATH marking the
+    injected entry so the shim can warn about the merge in-container.
+    Pods without a declared PYTHONPATH keep the plain shim injection."""
+    cfg = Config(
+        device_plugin_path=str(tmp_path) + "/",
+        device_split_count=2,
+        host_lib_dir=str(tmp_path / "vtpu"),
+        runtime_socket=str(tmp_path / "vtpu" / "rt.sock"),
+        monitor_mode=True,
+        node_name="node1",
+    )
+    pod = _pending_pod("job-pp", "uid-pp000000", 1)
+    pod["spec"]["containers"][0]["env"] = [
+        {"name": "PYTHONPATH", "value": "/app/lib:/app/vendor"},
+        {"name": "OTHER", "value": "x"},
+        {"name": "FROMREF", "valueFrom": {"fieldRef": {}}},
+    ]
+    plain = _pending_pod("job-plain", "uid-pl000000", 1)
+    pods = [pod, plain]
+    backend = FakeChipBackend(num_chips=2)
+    specs = build_plugin_specs(cfg, backend)
+    plugin = VtpuDevicePlugin(specs[0], cfg, topology=backend.topology(),
+                              pod_lister=lambda node: pods)
+    sim = KubeletSim(str(tmp_path)).start()
+    plugin.start()
+    try:
+        reg = sim.wait_registration()
+        stub, ch = sim.plugin_stub(reg.endpoint)
+        got = {}
+        for i in (0, 1):
+            req = pb.AllocateRequest()
+            req.container_requests.add(devicesIDs=[plugin.vdevices[i].id])
+            envs = dict(stub.Allocate(req)
+                        .container_responses[0].envs)
+            key = "merged" if "job-pp" in envs[envspec.ENV_SHARED_CACHE] \
+                else "plain"
+            got[key] = envs
+        shim = "/usr/local/vtpu/shim"
+        assert got["merged"]["PYTHONPATH"] == \
+            f"{shim}{os.pathsep}/app/lib:/app/vendor"
+        assert got["merged"]["VTPU_SHIM_PYTHONPATH"] == shim
+        assert got["plain"]["PYTHONPATH"] == shim
         ch.close()
     finally:
         plugin.stop()
