@@ -149,11 +149,28 @@ class RegionBackend(Backend):
         # Brokered grants: the ledger lives broker-side; its usage wins
         # over a region the interposer never touched (used == 0).
         if broker and out and not any(v.hbm_used_bytes for v in out):
-            used = int(broker.get("used_bytes", 0))
-            limit = int(broker.get("limit_bytes", 0))
-            out[0].hbm_used_bytes = used
-            if limit and not out[0].hbm_limit_bytes:
-                out[0].hbm_limit_bytes = limit
+            per_chip = broker.get("per_chip")
+            if isinstance(per_chip, list) and per_chip:
+                # Grant order matches the tenant's ordinal order: the
+                # i-th broker chip is the i-th granted ordinal.
+                for view, pc in zip(out, per_chip):
+                    view.hbm_used_bytes = int(pc.get("used_bytes", 0))
+                    lim = int(pc.get("limit_bytes", 0))
+                    if lim and not view.hbm_limit_bytes:
+                        view.hbm_limit_bytes = lim
+            else:
+                # Pre-per_chip broker: the ledger is aggregate-only.
+                # Attribute it evenly rather than dumping the whole
+                # grant's usage on ordinal 0.
+                used = int(broker.get("used_bytes", 0))
+                limit = int(broker.get("limit_bytes", 0))
+                n = len(out)
+                for i, view in enumerate(out):
+                    view.hbm_used_bytes = \
+                        used // n + (1 if i < used % n else 0)
+                    if limit and not view.hbm_limit_bytes:
+                        view.hbm_limit_bytes = \
+                            limit // n + (1 if i < limit % n else 0)
         return out
 
 

@@ -9,6 +9,8 @@ import os
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..metricsd import UPSTREAM_PORT_OFFSET
+
 DEVICE_PLUGIN_PATH = "/var/lib/kubelet/device-plugins/"
 KUBELET_SOCKET = DEVICE_PLUGIN_PATH + "kubelet.sock"
 RESOURCE_NAME = "4paradigm.com/vtpu"
@@ -87,8 +89,15 @@ class Config:
         if self.allocation_policy not in ALLOCATION_POLICIES:
             errors.append(
                 f"invalid --allocation-policy {self.allocation_policy!r}")
-        if not (0 < self.metricsd_port < 65536):
-            errors.append("--metricsd-port must be in 1..65535")
+        # Allocate moves the real libtpu service to port+offset
+        # (TPU_RUNTIME_METRICS_PORTS), so that port must be valid too.
+        if not (0 < self.metricsd_port
+                and self.metricsd_port + UPSTREAM_PORT_OFFSET < 65536):
+            errors.append(
+                f"--metricsd-port must be in "
+                f"1..{65535 - UPSTREAM_PORT_OFFSET} (port+"
+                f"{UPSTREAM_PORT_OFFSET} is the relocated upstream "
+                f"libtpu metrics port)")
         return errors
 
     @property

@@ -486,6 +486,10 @@ class VtpuDevicePlugin(rpc.DevicePluginServicer):
         user_pp = (pod_env or {}).get("PYTHONPATH", "")
         if user_pp:
             envs["PYTHONPATH"] = shim_pp + os.pathsep + user_pp
+            # Explicit merge flag: sitecustomize warns only when this is
+            # set, not whenever PYTHONPATH happens to carry non-shim
+            # entries (which runtime/Dockerfile ENV legitimately does).
+            envs["VTPU_PYTHONPATH_MERGED"] = "1"
             log.info("allocate: merging PYTHONPATH=%s (pod-declared "
                      "entries preserved after the shim)",
                      envs["PYTHONPATH"])

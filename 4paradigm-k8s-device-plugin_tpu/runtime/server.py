@@ -2512,6 +2512,15 @@ def collect_stats(state: RuntimeState):
             "used_bytes": sum(int(s.used_bytes) for s in per_chip),
             "limit_bytes": sum(int(s.limit_bytes) for s in per_chip),
             "peak_bytes": sum(int(s.peak_bytes) for s in per_chip),
+            # Per-chip breakdown in grant order (same order as "chips"):
+            # consumers rendering per-device usage (metricsd, vtpu-smi)
+            # must not attribute the whole multi-chip ledger to one
+            # ordinal.
+            "per_chip": [{"chip": c.index,
+                          "used_bytes": int(s.used_bytes),
+                          "limit_bytes": int(s.limit_bytes),
+                          "peak_bytes": int(s.peak_bytes)}
+                         for c, s in zip(t.chips, per_chip)],
             "core_limit_pct": int(st.core_limit_pct),
             "arrays": len(t.arrays),
             "host_spill_bytes": int(t.host_bytes),

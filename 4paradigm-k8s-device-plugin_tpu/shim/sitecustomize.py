@@ -44,7 +44,11 @@ def _warn_pythonpath_merge():
     """One visible line when Allocate MERGED a user-declared PYTHONPATH
     behind the shim entry (plugin/server.py): the user's entries are
     live, but positioned after ours — say so in-container instead of
-    leaving the reordering silent."""
+    leaving the reordering silent.  Gated on the explicit merge flag the
+    plugin sets alongside the merge: PYTHONPATH entries added at runtime
+    or via Dockerfile ENV are not a merge and must not trigger it."""
+    if os.environ.get("VTPU_PYTHONPATH_MERGED") != "1":
+        return
     shim_pp = os.environ.get("VTPU_SHIM_PYTHONPATH", _SHIM_DIR)
     merged = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
               if p and os.path.abspath(p) != os.path.abspath(shim_pp)]

@@ -143,6 +143,7 @@ ENV_FLAGS = {
     "VTPU_METRICSD_FAKE": ("tools", False),
     "VTPU_METRICSD_BROKER": ("tools", False),
     "VTPU_SHIM_PYTHONPATH": ("contract", False),
+    "VTPU_PYTHONPATH_MERGED": ("contract", False),
     # Daemon (plugin/config.py, discovery, health).
     "VTPU_DISCOVERY": ("daemon", False),
     "VTPU_ALLOCATION_POLICY": ("daemon", True),

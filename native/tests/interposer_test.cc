@@ -644,10 +644,28 @@ static int sc_preload(const char* dir, const char* shr) {
          (abs_dir + "/libvtpu_pjrt.so").c_str(), 1);
   set_marker(1);  /* later scenarios keep the documented dev-mode knobs */
 
+  /* Production host-consent verifier (the test-build gate trusts bare
+   * existence; see native/Makefile): a tenant-forgeable plain file must
+   * NOT count as a host mount even though it exists, while a genuine
+   * mount point must.  Checked via the exported helper so the mountinfo
+   * parsing itself is exercised without needing mount(2) privileges. */
+  void* hp = dlopen((abs_dir + "/libvtpu_preload_test.so").c_str(),
+                    RTLD_NOW | RTLD_LOCAL);
+  CHECK(hp != nullptr);
+  typedef int (*host_mount_fn)(const char*);
+  auto is_host_mount =
+      (host_mount_fn)dlsym(hp, "vtpu_marker_is_host_mount");
+  CHECK(is_host_mount != nullptr);
+  CHECK(is_host_mount(TEST_ENV_OVERRIDE_MARKER) == 0); /* plain file */
+  CHECK(is_host_mount("/") == 1);                      /* real mount */
+  CHECK(is_host_mount("/nonexistent/vtpu-marker") == 0);
+  dlclose(hp);
+
   unlink(fake_libtpu.c_str());
   rmdir(tmp);
   printf("preload: forced injection redirects + enforces, kill-switch "
-         "honored only with host consent, hostile env fails closed\n");
+         "honored only with host consent, hostile env fails closed, "
+         "marker must be a host mount\n");
   return 0;
 }
 

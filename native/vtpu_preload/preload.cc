@@ -36,11 +36,15 @@
  *     CLOSED (VERDICT weak #4: a container env var alone must not
  *     disable enforcement).  Same gate for VTPU_INTERPOSER_PATH, which
  *     would otherwise let a tenant redirect the hook at an arbitrary
- *     library.  The marker is a file only the host can create
- *     (/var/run/vtpu/allow-env-override, mounted by the daemon when the
- *     operator stages it — entrypoint.sh VTPU_ALLOW_ENV_OVERRIDE=1);
- *     tenants cannot write /var/run/vtpu inside the container because
- *     the mount is read-only and absent by default.
+ *     library.  The marker (/var/run/vtpu/allow-env-override) is
+ *     bind-mounted read-only by the daemon at Allocate when the
+ *     operator staged it (entrypoint.sh VTPU_ALLOW_ENV_OVERRIDE=1).
+ *     Existence alone does NOT prove host consent: when the operator
+ *     did not stage it there is no mount at the path at all, and
+ *     container root could mkdir+touch the same path in its writable
+ *     layer.  The gate therefore requires the marker to be a MOUNT
+ *     POINT in /proc/self/mountinfo — creating one inside the
+ *     container needs CAP_SYS_ADMIN, which tenants do not have.
  *
  * Known limit (shared with the dlopen-hook approach generally): a binary
  * with libtpu in DT_NEEDED gets the real library mapped by the loader
@@ -53,6 +57,7 @@
  */
 #define _GNU_SOURCE 1
 #include <dlfcn.h>
+#include <limits.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -71,12 +76,78 @@
 
 static __thread int t_bypass = 0;
 
+/* Is `resolved` (a symlink-free absolute path) a mount point in this
+ * mount namespace?  Field 5 of each /proc/self/mountinfo line is the
+ * mount point, with whitespace octal-escaped (\040 etc.).  Lines longer
+ * than the buffer are skipped at the continuation chunks (a chunk that
+ * does not start a line cannot be parsed as fields 1..5).  Unreadable
+ * mountinfo answers 0: the gate fails CLOSED. */
+static int is_mountpoint(const char* resolved) {
+  FILE* f = fopen("/proc/self/mountinfo", "re");
+  if (!f) return 0;
+  char line[4096];
+  int found = 0, at_line_start = 1;
+  while (!found && fgets(line, sizeof line, f)) {
+    size_t len = strlen(line);
+    int starts = at_line_start;
+    at_line_start = len > 0 && line[len - 1] == '\n';
+    if (!starts) continue;
+    char* p = line; /* skip 4 fields: id parent major:minor root */
+    for (int i = 0; i < 4 && p; ++i) {
+      p = strchr(p, ' ');
+      if (p) ++p;
+    }
+    if (!p) continue;
+    char* end = strchr(p, ' ');
+    if (end) *end = '\0';
+    char* w = p; /* unescape \OOO in place */
+    for (const char* r = p; *r;) {
+      if (r[0] == '\\' && r[1] >= '0' && r[1] <= '7' && r[2] >= '0' &&
+          r[2] <= '7' && r[3] >= '0' && r[3] <= '7') {
+        *w++ = (char)(((r[1] - '0') << 6) | ((r[2] - '0') << 3) |
+                      (r[3] - '0'));
+        r += 4;
+      } else {
+        *w++ = *r++;
+      }
+    }
+    *w = '\0';
+    found = strcmp(p, resolved) == 0;
+  }
+  fclose(f);
+  return found;
+}
+
+/* Is `path` a HOST-provided consent marker?  Present alone is not
+ * enough (a tenant running as container root can create the path in
+ * its own writable filesystem when no mount is staged there); the
+ * daemon stages the marker as a read-only bind mount, so the
+ * symlink-resolved path (/var/run is usually a /run symlink; mountinfo
+ * records resolved mount points) must appear as a mount point.
+ * Exported for the native tests, which exercise it against paths that
+ * are / are not mount points. */
+extern "C" int vtpu_marker_is_host_mount(const char* path) {
+  char resolved[PATH_MAX];
+  if (access(path, F_OK) != 0) return 0;
+  if (!realpath(path, resolved)) return 0;
+  return is_mountpoint(resolved);
+}
+
 /* Host-consent gate for the tenant-reachable env knobs: the kill-switch
- * and the interposer-path override are honored only when the marker file
- * exists.  access(2) each time (no caching): the hook is cold-path only
- * (TPU library loads), and a daemon may mount the marker after exec. */
+ * and the interposer-path override are honored only when the marker is
+ * a host-staged bind mount (see above).  Checked each time (no
+ * caching): the hook is cold-path only (TPU library loads), and a
+ * daemon may mount the marker after exec.  The test build trusts bare
+ * existence (-DVTPU_MARKER_TRUST_EXISTENCE, native/Makefile): its
+ * marker is a plain tmpfile, and mount(2) needs privileges the test
+ * runner may lack — the mountinfo verifier itself is tested directly
+ * via vtpu_marker_is_host_mount. */
 static int env_override_allowed(void) {
+#ifdef VTPU_MARKER_TRUST_EXISTENCE
   return access(VTPU_ENV_OVERRIDE_MARKER, F_OK) == 0;
+#else
+  return vtpu_marker_is_host_mount(VTPU_ENV_OVERRIDE_MARKER);
+#endif
 }
 
 /* Re-entrancy guard for cooperating vTPU components (the interposer

@@ -284,6 +284,67 @@ def test_broker_enrichment_uses_bind_free_stats(tmp_path):
     assert views[0].hbm_used_bytes == 3 * GIB
 
 
+def _serve_stats_once(tmp_path, payload):
+    """One-shot fake broker MAIN socket answering a single STATS."""
+    sock_path = str(tmp_path / "broker.sock")
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(1)
+
+    def serve_one():
+        from vtpu.runtime import protocol as P
+        conn, _ = srv.accept()
+        P.recv_msg(conn)
+        P.send_msg(conn, payload)
+        conn.close()
+
+    t = threading.Thread(target=serve_one, daemon=True)
+    t.start()
+    return sock_path, srv, t
+
+
+def test_broker_enrichment_distributes_per_chip(tmp_path):
+    """A multi-device brokered grant reports each ordinal's own ledger
+    (STATS per_chip, grant order), not the whole grant on ordinal 0."""
+    sock_path, srv, t = _serve_stats_once(tmp_path, {
+        "ok": True, "tenants": {"t1": {
+            "chip": 0, "used_bytes": 5 * GIB, "limit_bytes": 16 * GIB,
+            "per_chip": [
+                {"chip": 0, "used_bytes": 3 * GIB,
+                 "limit_bytes": 8 * GIB},
+                {"chip": 1, "used_bytes": 2 * GIB,
+                 "limit_bytes": 8 * GIB},
+            ]}}})
+    backend = RegionBackend(
+        region_path=str(tmp_path / "missing.cache"),
+        quota=envspec.QuotaSpec(
+            hbm_limit_bytes={0: 8 * GIB, 1: 8 * GIB}),
+        broker_socket=sock_path, tenant="t1")
+    views = backend.devices()
+    t.join(timeout=5)
+    srv.close()
+    assert [v.hbm_used_bytes for v in views] == [3 * GIB, 2 * GIB]
+    assert all(v.hbm_limit_bytes == 8 * GIB for v in views)
+
+
+def test_broker_enrichment_aggregate_fallback_spreads_evenly(tmp_path):
+    """A pre-per_chip broker reports only the aggregate ledger; it is
+    attributed evenly across granted ordinals instead of all-on-0."""
+    sock_path, srv, t = _serve_stats_once(tmp_path, {
+        "ok": True, "tenants": {"t1": {
+            "chip": 0, "used_bytes": 4 * GIB, "limit_bytes": 16 * GIB}}})
+    backend = RegionBackend(
+        region_path=str(tmp_path / "missing.cache"),
+        quota=envspec.QuotaSpec(
+            hbm_limit_bytes={0: 8 * GIB, 1: 8 * GIB}),
+        broker_socket=sock_path, tenant="t1")
+    views = backend.devices()
+    t.join(timeout=5)
+    srv.close()
+    assert [v.hbm_used_bytes for v in views] == [2 * GIB, 2 * GIB]
+    assert sum(v.hbm_used_bytes for v in views) == 4 * GIB
+
+
 # ---------------------------------------------------------------------------
 # Bootstrap + CLI + foldings.
 # ---------------------------------------------------------------------------

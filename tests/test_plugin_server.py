@@ -454,7 +454,11 @@ def test_monitor_mode_pythonpath_merged_not_clobbered(tmp_path):
         assert got["merged"]["PYTHONPATH"] == \
             f"{shim}{os.pathsep}/app/lib:/app/vendor"
         assert got["merged"]["VTPU_SHIM_PYTHONPATH"] == shim
+        # The merge flag gates the shim's in-container warning: set only
+        # when a pod-declared PYTHONPATH was actually merged.
+        assert got["merged"]["VTPU_PYTHONPATH_MERGED"] == "1"
         assert got["plain"]["PYTHONPATH"] == shim
+        assert "VTPU_PYTHONPATH_MERGED" not in got["plain"]
         ch.close()
     finally:
         plugin.stop()
