@@ -393,6 +393,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = parse_args(argv)
     if cfg.verbose:
         os.environ.setdefault("VTPU_LOG_LEVEL", "4")
+        log.refresh_level()
     return run(cfg)
 
 

@@ -15,6 +15,7 @@ RESOURCE_EXHAUSTED message (the reference shim's early-OOM contract).
 
 from __future__ import annotations
 
+import collections
 import itertools
 import os
 import socket
@@ -127,6 +128,36 @@ class RuntimeClient:
         self._reconnect_timeout = reconnect_timeout
         self._closed = False
         self._ids = itertools.count()
+        # -- broker hot path (docs/PERF.md) --
+        # Zero-copy raw framing for PUT/GET payloads (VTPU_RAW_FRAMES=0
+        # restores the legacy msgpack-bin framing — any broker old
+        # enough to lack raw frames predates this client, but the
+        # toggle keeps A/B benchmarking honest).
+        self._raw = os.environ.get("VTPU_RAW_FRAMES", "1") != "0"
+        # Auto-coalescing: execute_send_ids buffers items and ships up
+        # to this many as ONE EXEC_BATCH frame.  <= 1 disables (every
+        # execute goes out as the legacy single-frame verb).
+        try:
+            self._batch_max = int(os.environ.get("VTPU_EXEC_BATCH",
+                                                 "64") or 0)
+        except ValueError:
+            self._batch_max = 64
+        self._pending_batch: List[Dict[str, Any]] = []
+        # Logical replies already read off the wire (batch replies
+        # explode into per-item results; sync requests absorb whatever
+        # is outstanding) — recv_reply serves these, in wire order,
+        # before touching the socket.  _wire_out counts logical replies
+        # still expected FROM the wire, so a synchronous request knows
+        # exactly how much to absorb to keep FIFO intact.
+        self._ready: "collections.deque[dict]" = collections.deque()
+        self._wire_out = 0
+        # Rate lease mirrored from reply piggybacks (docs/PERF.md):
+        # remaining µs budget + wall-clock expiry.  Advisory on the
+        # client — enforcement stays broker-owned; pipelined callers
+        # (the bridge) use it to pace sends without a round trip.
+        self.lease_us = 0.0
+        self.lease_exp = 0.0
+        self.lease_revocations = 0
         spec = envspec.quota_from_env()
         self.tenant = tenant or os.environ.get(
             "VTPU_TENANT", self._default_tenant())
@@ -228,6 +259,14 @@ class RuntimeClient:
         self.tenant_index = resp["tenant_index"]
         self.chip = resp.get("chip", 0)
         self.chips = list(resp.get("chips", [self.chip]))
+        # Anything buffered or pre-split belonged to the old socket:
+        # un-flushed batch items were never sent, outstanding replies'
+        # producers are gone, and any lease grant died with the epoch.
+        self._pending_batch.clear()
+        self._ready.clear()
+        self._wire_out = 0
+        self.lease_us = 0.0
+        self.lease_exp = 0.0
         # ``created`` defaults FALSE: True asserts state loss, and a
         # pre-contract broker (daemonset upgrade: new shim, old broker
         # kept alive across the plugin restart) sends neither key — a
@@ -359,8 +398,90 @@ class RuntimeClient:
         return msg
 
     # -- plumbing --
+
+    def _absorb_lease(self, resp: Dict[str, Any]) -> None:
+        """Mirror a reply's rate-lease piggyback (docs/PERF.md):
+        µs budget + wall-clock expiry, or a broker revoke.  Advisory —
+        enforcement stays broker-owned; pipelined callers use it to
+        pace sends without a round trip."""
+        lease = resp.get("lease")
+        if not isinstance(lease, dict):
+            return
+        if lease.get("revoke"):
+            self.lease_us = 0.0
+            self.lease_exp = 0.0
+            self.lease_revocations += 1
+            return
+        self.lease_us = float(lease.get("us", 0) or 0)
+        self.lease_exp = time.monotonic() + float(
+            lease.get("ttl_s", 0) or 0)
+
+    def lease_remaining_us(self) -> float:
+        """Unexpired remaining budget of the mirrored lease (0 when
+        expired or never granted)."""
+        if time.monotonic() >= self.lease_exp:
+            return 0.0
+        return self.lease_us
+
+    def burn_lease(self, us: float) -> bool:
+        """Burn ``us`` of the mirrored lease locally; True while budget
+        remains.  Where a native accounting region is mounted, the shim
+        burns through region atomics instead (shim/core.py RateLease) —
+        this is the region-less client's bookkeeping twin."""
+        if time.monotonic() >= self.lease_exp:
+            self.lease_us = 0.0
+            return False
+        self.lease_us = max(self.lease_us - us, 0.0)
+        return self.lease_us > 0.0
+
+    def _explode(self, resp: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """One wire frame -> its logical replies: an EXEC_BATCH reply
+        yields its positional per-item results; anything else is
+        itself."""
+        if resp.get("ok") and isinstance(resp.get("results"), list):
+            self._absorb_lease(resp)
+            return list(resp["results"])
+        return [resp]
+
+    def _flush_batch(self) -> None:
+        """Ship the coalesced execute items: one item goes out as the
+        legacy single-frame EXECUTE (protocol-identical to a
+        pre-batching client), more ride ONE EXEC_BATCH frame."""
+        items = self._pending_batch
+        if not items:
+            return
+        self._pending_batch = []
+        if len(items) == 1:
+            msg: Dict[str, Any] = dict(items[0])
+            msg["kind"] = P.EXECUTE
+        else:
+            msg = {"kind": P.EXEC_BATCH, "items": items}
+        try:
+            P.send_msg(self.sock, self._maybe_stamp(msg))
+        except (ConnectionError, P.ProtocolError, OSError):
+            self._on_disconnect()
+        self._wire_out += len(items)
+
+    def _sync_prelude(self) -> None:
+        """FIFO guard for synchronous requests: ship any buffered batch
+        and absorb every logical reply still on the wire into the ready
+        queue, so the NEXT frame read belongs to the sync request.
+        Callers that paired their sends and recvs (the documented
+        pipelining contract) hit the zero-iteration fast path."""
+        self._flush_batch()
+        while self._wire_out > 0:
+            try:
+                raw = P.recv_msg(self.sock)
+            except (ConnectionError, P.ProtocolError, OSError):
+                self._on_disconnect()
+                raise AssertionError("unreachable")
+            out = self._explode(raw)
+            self._wire_out -= len(out)
+            self._ready.extend(out)
+
     def _rpc(self, msg: Dict[str, Any],
              _retry: bool = True) -> Dict[str, Any]:
+        self._sync_prelude()
         try:
             P.send_msg(self.sock, self._maybe_stamp(msg))
             resp = P.recv_msg(self.sock)
@@ -376,6 +497,36 @@ class RuntimeClient:
                         and msg.get("kind") in self._RESUME_RETRY_KINDS:
                     return self._rpc(msg, _retry=False)
                 raise
+        self._absorb_lease(resp)
+        if not resp.get("ok"):
+            code = resp.get("code", "")
+            if code == "RESOURCE_EXHAUSTED":
+                raise VtpuQuotaError(resp.get("error", code))
+            raise RuntimeError_(f"{code}: {resp.get('error', '')}")
+        return resp
+
+    def _rpc_frames(self, msg: Dict[str, Any], payloads,
+                    _retry: bool = True) -> Dict[str, Any]:
+        """Synchronous request whose payload rides as raw frames in ONE
+        gather write (zero-copy PUT); reply handling mirrors _rpc,
+        including the transparent idempotent retry on a journal-resumed
+        reconnect."""
+        self._sync_prelude()
+        try:
+            bufs = [P.frame_header(self._maybe_stamp(msg))]
+            for p in payloads:
+                bufs.extend(P.raw_frames(p))
+            P.send_frames(self.sock, bufs)
+            resp = P.recv_msg(self.sock)
+        except (ConnectionError, P.ProtocolError, OSError):
+            try:
+                self._on_disconnect()
+                raise AssertionError("unreachable")
+            except VtpuConnectionLost as e:
+                if e.resumed and _retry:
+                    return self._rpc_frames(msg, payloads, _retry=False)
+                raise
+        self._absorb_lease(resp)
         if not resp.get("ok"):
             code = resp.get("code", "")
             if code == "RESOURCE_EXHAUSTED":
@@ -399,15 +550,35 @@ class RuntimeClient:
             # scalar args).  0-d arrays are always contiguous.
             arr = np.ascontiguousarray(arr)
         aid = aid or f"a{next(self._ids)}"
-        # One framing implementation (_put_msgs) serves both the sync
-        # and pipelined paths; the sync path consumes each ack before
-        # the next send — streaming every part first would deadlock on
-        # the ack backlog once it outgrows the socket buffer (the
-        # server's reply writes block, so it stops reading parts).
         arr = np.asarray(arr)
+        if self._raw:
+            # Zero-copy upload: header + payload segments leave in one
+            # gather write straight from the numpy buffer, answered by
+            # ONE ack regardless of size (docs/PERF.md).
+            hdr, payload = self._put_raw_parts(arr, aid)
+            self._rpc_frames(hdr, [payload])
+            return RemoteArray(self, aid, arr.shape, arr.dtype)
+        # Legacy framing (VTPU_RAW_FRAMES=0): one framing implementation
+        # (_put_msgs) serves both the sync and pipelined paths; the sync
+        # path consumes each ack before the next send — streaming every
+        # part first would deadlock on the ack backlog once it outgrows
+        # the socket buffer (the server's reply writes block, so it
+        # stops reading parts).
         for m in self._put_msgs(arr, aid):
             self._rpc(m)
         return RemoteArray(self, aid, arr.shape, arr.dtype)
+
+    @staticmethod
+    def _put_raw_parts(arr: np.ndarray, aid: str):
+        """(header msg, flat byte view) for a zero-copy PUT."""
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        flat = arr.reshape(-1).view(np.uint8)
+        nbytes = int(arr.nbytes)
+        hdr = {"kind": P.PUT, "id": aid, "shape": list(arr.shape),
+               "dtype": arr.dtype.name, "nbytes": nbytes,
+               "raw_parts": P.raw_part_count(nbytes)}
+        return hdr, flat
 
     @staticmethod
     def _put_msgs(arr: np.ndarray, aid: str):
@@ -439,30 +610,65 @@ class RuntimeClient:
     # keeps real uploads far below it.
     MAX_PIPELINED_PUT_PARTS = 32
 
+    def put_parts(self, arr: np.ndarray) -> int:
+        """Reply frames a put_send of ``arr`` will cost: 1 on the raw
+        path (one ack for any size), else one per PUT_PART + one for
+        the PUT — pipelined callers budget their ack backlog with
+        this."""
+        if self._raw:
+            return 1
+        nbytes = int(np.asarray(arr).nbytes)
+        return nbytes // max(P.CHUNK_BYTES, 1) + 1
+
     def put_send(self, arr: np.ndarray, aid: str) -> int:
         """Pipelined PUT: send without consuming the ack(s).  Returns
         the number of reply frames the caller must consume (FIFO on
-        this connection) — one per PUT_PART plus one for the PUT.
-        Lets a bridged train loop feed a fresh host batch every step
-        without draining its in-flight executes."""
+        this connection) — one per PUT_PART plus one for the PUT on
+        the legacy framing, always exactly one on the raw path.  Lets
+        a bridged train loop feed a fresh host batch every step
+        without draining its in-flight executes.  Buffered executes
+        flush first so frame order matches the caller's send order."""
         arr = np.asarray(arr)
+        self._flush_batch()
         sent = 0
         try:
-            for m in self._put_msgs(arr, aid):
-                P.send_msg(self.sock, self._maybe_stamp(m))
-                sent += 1
+            if self._raw:
+                hdr, payload = self._put_raw_parts(arr, aid)
+                P.send_frames(
+                    self.sock,
+                    [P.frame_header(self._maybe_stamp(hdr))]
+                    + P.raw_frames(payload))
+                sent = 1
+            else:
+                for m in self._put_msgs(arr, aid):
+                    P.send_msg(self.sock, self._maybe_stamp(m))
+                    sent += 1
         except (ConnectionError, P.ProtocolError, OSError):
             self._on_disconnect()
+        self._wire_out += sent
         return sent
 
     def recv_reply(self) -> Dict[str, Any]:
-        """Consume one pipelined reply frame (FIFO); raises the typed
-        error for non-ok replies, exactly like the synchronous path."""
-        try:
-            resp = P.recv_msg(self.sock)
-        except (ConnectionError, P.ProtocolError, OSError):
-            self._on_disconnect()
-            raise AssertionError("unreachable")
+        """Consume one pipelined logical reply (FIFO); raises the typed
+        error for non-ok replies, exactly like the synchronous path.
+        Results pre-split out of an EXEC_BATCH reply (or absorbed by a
+        sync request) are served in wire order before touching the
+        socket; buffered executes flush first so the awaited reply is
+        actually in flight."""
+        if self._ready:
+            resp = self._ready.popleft()
+        else:
+            self._flush_batch()
+            try:
+                raw = P.recv_msg(self.sock)
+            except (ConnectionError, P.ProtocolError, OSError):
+                self._on_disconnect()
+                raise AssertionError("unreachable")
+            out = self._explode(raw)
+            self._wire_out -= len(out)
+            resp = out[0]
+            self._ready.extend(out[1:])
+        self._absorb_lease(resp)
         if not resp.get("ok"):
             code = resp.get("code", "")
             if code == "RESOURCE_EXHAUSTED":
@@ -471,6 +677,8 @@ class RuntimeClient:
         return resp
 
     def get(self, aid: str) -> np.ndarray:
+        if self._raw:
+            return self._get_raw(aid)
         r = self._rpc({"kind": P.GET, "id": aid})
         if "parts" in r:
             # Chunked reply: the header frame is followed by N data
@@ -495,6 +703,42 @@ class RuntimeClient:
             data = r["data"]
         return np.frombuffer(data, dtype=_np_dtype(r["dtype"])).reshape(
             r["shape"]).copy()
+
+    def _get_raw(self, aid: str, _retry: bool = True) -> np.ndarray:
+        """Zero-copy fetch: the header announces size and raw-frame
+        count; the payload recv_into's ONE exact-size buffer the
+        returned array owns — no chunk list, no join, no final copy."""
+        self._sync_prelude()
+        try:
+            P.send_msg(self.sock, self._maybe_stamp(
+                {"kind": P.GET, "id": aid, "raw": True}))
+            r = P.recv_msg(self.sock)
+            arr = None
+            if r.get("ok"):
+                buf = bytearray(int(r["nbytes"]))
+                mv = memoryview(buf)
+                got = 0
+                for _ in range(int(r["raw_parts"])):
+                    got += P.recv_raw_into(self.sock, mv[got:])
+                arr = np.frombuffer(buf, dtype=_np_dtype(r["dtype"])
+                                    ).reshape(r["shape"])
+        except (ConnectionError, P.ProtocolError, OSError):
+            try:
+                self._on_disconnect()
+                raise AssertionError("unreachable")
+            except VtpuConnectionLost as e:
+                # GET is idempotent: re-run against the journal-resumed
+                # broker instance, exactly like the _rpc path.
+                if e.resumed and _retry:
+                    return self._get_raw(aid, _retry=False)
+                raise
+        self._absorb_lease(r)
+        if not r.get("ok"):
+            code = r.get("code", "")
+            if code == "RESOURCE_EXHAUSTED":
+                raise VtpuQuotaError(r.get("error", code))
+            raise RuntimeError_(f"{code}: {r.get('error', '')}")
+        return arr
 
     def delete(self, aid: str) -> None:
         self._rpc({"kind": P.DELETE, "id": aid})
@@ -575,18 +819,33 @@ class RuntimeClient:
         mapping each step's output indices back into argument indices.
         ``free`` ids are dropped at this item's DISPATCH (after every
         earlier item of this tenant queue has resolved its own args) —
-        zero-round-trip garbage collection for pipelined callers."""
-        msg = {"kind": P.EXECUTE, "exe": eid, "args": list(arg_ids),
-               "outs": list(out_ids)}
+        zero-round-trip garbage collection for pipelined callers.
+
+        Auto-coalescing (docs/PERF.md): with VTPU_EXEC_BATCH > 1 the
+        item is buffered and ships with up to that many batch-mates as
+        ONE EXEC_BATCH frame.  The batch flushes when full, before any
+        other send (frame order == call order), and before any recv
+        (the awaited reply must be in flight) — callers pairing sends
+        with recv_reply/execute_recv observe identical semantics."""
+        item: Dict[str, Any] = {"exe": eid, "args": list(arg_ids),
+                                "outs": list(out_ids)}
         if repeats > 1:
-            msg["repeats"] = int(repeats)
-            msg["carry"] = [list(p) for p in carry]
+            item["repeats"] = int(repeats)
+            item["carry"] = [list(p) for p in carry]
         if free:
-            msg["free"] = list(free)
+            item["free"] = list(free)
+        if self._batch_max > 1:
+            self._pending_batch.append(item)
+            if len(self._pending_batch) >= self._batch_max:
+                self._flush_batch()
+            return
+        msg = dict(item)
+        msg["kind"] = P.EXECUTE
         try:
             P.send_msg(self.sock, self._maybe_stamp(msg))
         except (ConnectionError, P.ProtocolError, OSError):
             self._on_disconnect()
+        self._wire_out += 1
 
     def execute_recv(self) -> List[RemoteArray]:
         resp = self.recv_reply()

@@ -1,7 +1,14 @@
 """Wire protocol for the vTPU runtime multiplexer.
 
 Length-prefixed msgpack frames over a unix stream socket.  Binary tensor
-payloads ride as msgpack bin fields (zero-copy on the numpy side).
+payloads ride either as msgpack bin fields (the legacy framing every old
+client still speaks) or — the hot path — as RAW FRAMES: a length-prefixed
+run of naked tensor bytes following a msgpack header that announced them
+(``raw_parts``/``nbytes``).  Raw frames are only ever read when the
+header said they are coming, so the stream stays self-describing; the
+sender pushes them straight out of the numpy buffer with one
+``sendmsg`` gather write and the receiver ``recv_into``s a pooled
+buffer — no msgpack bin copy on either side.
 
 Why this exists: libtpu admits ONE process per chip, so the reference's
 approach — every tenant process talks to the device directly and an
@@ -17,7 +24,7 @@ from __future__ import annotations
 import os
 import socket
 import struct
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import msgpack
 
@@ -58,9 +65,21 @@ HELLO = "hello"          # {tenant, priority, device?, hbm_limit?,
 # the staged parts.  GET replies larger than CHUNK_BYTES come back as
 # {ok, shape, dtype, parts: N} followed by N frames {data} (FIFO on the
 # same connection).
+#
+# Zero-copy framing (docs/PERF.md): a PUT header may instead carry
+# {raw_parts: K, nbytes: N} and be FOLLOWED by K raw frames (<=
+# CHUNK_BYTES each, N bytes total) — one ack for the whole upload, no
+# PUT_PART round trips, no msgpack bin copies; the server recv_into's a
+# pooled per-connection buffer.  A GET sent with {raw: true} replies
+# {ok, shape, dtype, nbytes, raw_parts: K} followed by K raw frames
+# gathered straight from the device array's host view.  Old clients
+# never set these fields and keep the legacy framing bit-for-bit.
 PUT_PART = "put_part"    # {id, data} -> {ok, staged_bytes}
-PUT = "put"              # {id, shape, dtype, data | staged} -> {ok, nbytes}
-GET = "get"              # {id} -> {ok, shape, dtype, data | parts: N}
+PUT = "put"              # {id, shape, dtype, data | staged |
+                         #  raw_parts+nbytes (+K raw frames)}
+                         # -> {ok, nbytes}
+GET = "get"              # {id, raw?} -> {ok, shape, dtype,
+                         #  data | parts: N | raw_parts: K}
 DELETE = "delete"        # {id} -> {ok, freed}
 COMPILE = "compile"      # {id, exported} -> {ok}
 # EXECUTE optional fields: repeats (int, default 1) runs the program as a
@@ -74,6 +93,18 @@ COMPILE = "compile"      # {id, exported} -> {ok}
 # because a tenant queue dispatches FIFO).
 EXECUTE = "execute"      # {exe, args: [ids], outs: [ids], repeats?,
                          #  carry?, free?}
+# Pipelined batch execute (docs/PERF.md): N executes — each item the
+# same shape as an EXECUTE body ({exe, args, outs, repeats?, carry?,
+# free?}) — ride ONE frame, are enqueued under one scheduler-lock
+# acquisition, and are answered with ONE reply whose ``results`` list
+# is positional (results[i] is item i's {ok, outs, device_time_us} or
+# {ok: false, code, error} — errors are isolated per item; a failed
+# item never poisons its batch-mates).  The reply goes out when the
+# LAST item of the batch has dispatched, so a client pipelines batches
+# the way it pipelined single executes.  Replies may piggyback a
+# ``lease`` grant (client-side rate leases, docs/PERF.md).
+EXEC_BATCH = "exec_batch"  # {items: [{exe, args, outs, ...}, ...]}
+                           # -> {ok, results: [...], lease?}
 # STATS is a BIND-FREE verb: it may be sent before (or without)
 # HELLO — no tenant slot is claimed and no chip is lazily bound, so a
 # read-only probe (vtpu-smi) can never wedge a chip claim (ADVICE r5
@@ -123,7 +154,7 @@ HANDOVER = "handover"    # {timeout?} -> {ok, tenants, snapshotted}
 
 # Served on the tenant socket (mounted into containers).
 TENANT_VERBS = (HELLO, PUT_PART, PUT, GET, DELETE, COMPILE, EXECUTE,
-                STATS, TRACE)
+                EXEC_BATCH, STATS, TRACE)
 # Served on the host-side admin socket (<socket>.admin, never mounted).
 ADMIN_VERBS = (STATS, TRACE, SUSPEND, RESUME, SHUTDOWN, DRAIN, HANDOVER)
 # Answer WITHOUT a HELLO binding — no tenant slot, no lazy chip claim,
@@ -140,6 +171,80 @@ def send_msg(sock: socket.socket, msg: Dict[str, Any]) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
+# Gather writes batch at most this many iovecs per sendmsg (IOV_MAX is
+# 1024 on Linux; staying well under leaves headroom for the kernel).
+_IOV_BATCH = 256
+
+
+def send_frames(sock: socket.socket, bufs) -> None:
+    """Vectored send of pre-framed buffers: ONE syscall (sendmsg with
+    an iovec per buffer) pushes a header frame plus its raw payload
+    segments, instead of a send per frame — and the payload iovecs
+    point straight into the caller's numpy/bytes memory (no join, no
+    copy).  Falls back to sendall when the platform lacks sendmsg."""
+    views = [v if isinstance(v, memoryview) else memoryview(v)
+             for v in bufs]
+    views = [v.cast("B") if v.format != "B" or v.ndim != 1 else v
+             for v in views]
+    if not hasattr(sock, "sendmsg"):
+        for v in views:
+            sock.sendall(v)
+        return
+    while views:
+        batch = views[:_IOV_BATCH]
+        total = sum(len(v) for v in batch)
+        sent = sock.sendmsg(batch)
+        while sent < total:
+            # Partial write: drop fully-sent iovecs, trim the boundary
+            # one, and re-enter sendmsg with the remainder.
+            rest = []
+            for v in batch:
+                if sent >= len(v):
+                    sent -= len(v)
+                elif sent:
+                    rest.append(v[sent:])
+                    sent = 0
+                else:
+                    rest.append(v)
+            batch = rest
+            total = sum(len(v) for v in batch)
+            sent = sock.sendmsg(batch)
+        views = views[_IOV_BATCH:]
+
+
+def frame_header(msg: Dict[str, Any]) -> bytes:
+    """One length-prefixed msgpack frame as bytes (for send_frames)."""
+    payload = msgpack.packb(msg, use_bin_type=True)
+    return _LEN.pack(len(payload)) + payload
+
+
+def raw_frames(payload) -> list:
+    """Length-prefix + segment views for one raw payload, split at
+    CHUNK_BYTES — ready to append to a send_frames buffer list.  The
+    segments are memoryviews into the caller's buffer: nothing is
+    copied until the kernel reads the iovecs."""
+    mv = payload if isinstance(payload, memoryview) else memoryview(payload)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    out = []
+    n = len(mv)
+    off = 0
+    while True:
+        seg = mv[off:off + CHUNK_BYTES]
+        out.append(_LEN.pack(len(seg)))
+        out.append(seg)
+        off += len(seg)
+        if off >= n:
+            break
+    return out
+
+
+def raw_part_count(nbytes: int) -> int:
+    """How many raw frames ``raw_frames`` will emit for a payload (a
+    zero-byte payload still sends one empty frame)."""
+    return max(-(-nbytes // CHUNK_BYTES), 1)
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
     got = 0
@@ -150,6 +255,74 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         chunks.append(b)
         got += len(b)
     return b"".join(chunks)
+
+
+def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` from the socket with recv_into — no intermediate
+    chunk list, no join."""
+    got = 0
+    n = len(view)
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
+            raise ConnectionError("peer closed")
+        got += r
+
+
+def recv_raw_into(sock: socket.socket, view: memoryview) -> int:
+    """Read ONE raw frame into ``view`` (which must be large enough);
+    returns the frame's byte count.  Only called when a header
+    announced the frame, so the stream stays unambiguous."""
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise ProtocolError(f"raw frame too large: {n}")
+    if n > len(view):
+        raise ProtocolError(
+            f"raw frame ({n} bytes) exceeds announced size {len(view)}")
+    recv_exact_into(sock, view[:n])
+    return n
+
+
+class RecvPool:
+    """Per-connection receive-buffer pool for raw tensor frames: one
+    reusable bytearray, grown on demand and retained up to a byte cap
+    (VTPU_RECV_POOL_MB) so steady-state PUT traffic allocates nothing.
+    Counters land in an optional shared stats dict (exposed via the
+    broker's STATS verb)."""
+
+    def __init__(self, cap_bytes: Optional[int] = None,
+                 stats: Optional[Dict[str, int]] = None):
+        if cap_bytes is None:
+            cap_bytes = int(float(os.environ.get(
+                "VTPU_RECV_POOL_MB", "256")) * (1 << 20))
+        self.cap = max(int(cap_bytes), 0)
+        self._buf: Optional[bytearray] = None
+        self.stats = stats if stats is not None else {}
+        for k in ("hits", "misses", "bytes_reused", "bytes_alloc",
+                  "drops"):
+            self.stats.setdefault(k, 0)
+
+    def take(self, n: int) -> bytearray:
+        """A buffer of at least ``n`` bytes (detached from the pool
+        until ``give``)."""
+        buf = self._buf
+        self._buf = None
+        if buf is not None and len(buf) >= n:
+            self.stats["hits"] += 1
+            self.stats["bytes_reused"] += n
+            return buf
+        self.stats["misses"] += 1
+        self.stats["bytes_alloc"] += n
+        return bytearray(n)
+
+    def give(self, buf: bytearray) -> None:
+        """Return a buffer for reuse; oversized buffers are dropped so
+        one huge upload cannot pin the cap forever."""
+        if len(buf) <= self.cap and (self._buf is None
+                                     or len(buf) > len(self._buf)):
+            self._buf = buf
+        else:
+            self.stats["drops"] += 1
 
 
 def recv_msg(sock: socket.socket) -> Dict[str, Any]:

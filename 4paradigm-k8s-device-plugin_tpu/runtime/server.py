@@ -81,12 +81,21 @@ lock-order ground truth (vtpu-analyze):
         order: state.mu > region.lock
         order: scheduler.mu > region.lock
         order: tenant.mu > region.lock
+        order: lease.mu > region.lock
         order: bridge.global_mu > bridge.mu
         order: bridge.fn_mu > bridge.mu
         leaf: region.lock, journal.mu, flight.mu, put_cache_mu
         leaf: session.send_mu, session.pending_cond, bridge.mu
+        leaf: batch.mu
         no-blocking-under: state.mu, tenant.mu, scheduler.mu
-        no-blocking-under: put_cache_mu, flight.mu
+        no-blocking-under: put_cache_mu, flight.mu, batch.mu
+
+    New in the hot-path overhaul (docs/PERF.md): ``batch.mu`` guards
+    one EXEC_BATCH reply's result slots — strictly leaf, and the
+    filler of the LAST slot (``fill``) sends the frame after
+    releasing it;
+    ``lease.mu`` is the shim-side RateLease's internal lock
+    (shim/core.py), which wraps the region's token-bucket calls.
 
     Deliberate NON-edges the checker enforces by omission:
     scheduler.mu and tenant.mu are unordered siblings — the dispatcher
@@ -142,6 +151,21 @@ MAX_PENDING_REPLIES = 128
 # collapsed throughput 13x (deep-queue pathologies), while a ~4s bound
 # keeps the device saturated (it only needs a few programs of runway).
 MAX_QUEUED_US = int(os.environ.get("VTPU_MAX_QUEUE_US", "4000000"))
+# One scheduler quantum (µs): the hard ceiling on a rate lease — a
+# tenant can never hold more pre-debited device time than one quantum,
+# so fairness degrades by at most a quantum even if the holder stalls
+# (the expiry refund returns the rest).
+SCHED_QUANTUM_US = 100_000
+# Client-side rate leases (docs/PERF.md): one token-bucket acquire
+# funds a µs quantum burned with plain arithmetic across subsequent
+# dispatches — the per-item native bucket round trip disappears from
+# the hot path.  0 disables (per-item rate_acquire, the pre-lease
+# behavior).  Clamped to one scheduler quantum.
+RATE_LEASE_US = min(int(os.environ.get("VTPU_RATE_LEASE_US", "20000")),
+                    SCHED_QUANTUM_US)
+# Items the dispatcher drains per wake (one scheduler-lock acquisition
+# picks up to this many ready items); 1 restores pick-per-wake.
+WAKE_BATCH = max(int(os.environ.get("VTPU_WAKE_BATCH", "32")), 1)
 
 
 def sparse_batch_learn_scale(batch_est_us: float, disp_us: float,
@@ -264,6 +288,22 @@ class Tenant:
         self.client_pidns: Optional[int] = None
         # True between journal recovery and the owner's resume HELLO.
         self.recovered = False
+        # -- rate lease (docs/PERF.md) --
+        # Pre-debited device-time budget burned locally by the
+        # dispatcher (and echoed to the client in execute replies).
+        # GUARDED BY the primary chip's scheduler.mu; the reply
+        # piggyback reads it unlocked (advisory — a stale value only
+        # mis-sizes the client's hint, never the enforcement).  A
+        # recovered tenant starts at zero: its previous lease's debit
+        # died with the old region file and reset_slot re-seeded the
+        # bucket — that IS the journal-replay reclamation.
+        self.lease_us = 0.0
+        self.lease_exp = 0.0
+        self.lease_revoked = False
+        self.lease_grants = 0
+        # Cached metered? verdict (core_limit_pct > 0): device_stats is
+        # a native region call and was paid once per DISPATCH.
+        self._metered_cache: Optional[Tuple[bool, float]] = None
 
     # -- chip-set accounting ------------------------------------------------
 
@@ -321,6 +361,27 @@ class Tenant:
         for chip, slot in zip(self.chips, self.slots):
             chip.region.rate_adjust(slot, delta_us)
 
+    def metered_on(self, chip, now: float) -> bool:
+        """core-limit check for the dispatcher, cached ~0.5 s: the limit
+        is seeded at bind and never changes mid-life, so re-reading the
+        region every dispatch bought nothing."""
+        v = self._metered_cache
+        if v is None or now >= v[1]:
+            pct = chip.region.device_stats(self.index).core_limit_pct
+            v = (pct > 0, now + 0.5)
+            self._metered_cache = v
+        return v[0]
+
+    def lease_release(self) -> None:
+        """Refund the unburned lease to the bucket(s) — called on
+        expiry, suspend/revoke and tenant teardown (caller holds the
+        primary chip's scheduler.mu, or owns the tenant exclusively)."""
+        left = int(self.lease_us)
+        self.lease_us = 0.0
+        self.lease_exp = 0.0
+        if left > 0:
+            self.rate_adjust_all(-left)
+
     def busy_add_all(self, us: int) -> None:
         for chip, slot in zip(self.chips, self.slots):
             chip.region.busy_add(slot, us)
@@ -369,7 +430,8 @@ class Program:
     set (``variants``)."""
 
     __slots__ = ("fn", "avals", "n_outs", "warmed", "nr_devices",
-                 "exported", "variants", "in_shardings", "sha")
+                 "exported", "variants", "in_shardings", "sha",
+                 "out_meta")
 
     def __init__(self, fn, avals, n_outs, nr_devices=1, exported=None,
                  in_shardings=None, sha=None):
@@ -390,6 +452,11 @@ class Program:
         self.warmed = set()
         # sha256 of the serialized export blob (journal blob store key).
         self.sha = sha
+        # Static output metadata ({shape, dtype, nbytes} per output),
+        # filled at first dispatch: AOT programs have static out avals,
+        # so the per-step jax property walks (``.nbytes``,
+        # ``str(.dtype)``) were pure hot-path waste (docs/PERF.md).
+        self.out_meta: Optional[List[dict]] = None
 
 
 class WorkItem:
@@ -405,7 +472,8 @@ class WorkItem:
     __slots__ = ("tenant", "session", "exe", "key", "arg_ids", "out_ids",
                  "steps", "carry", "metered", "est_us", "first_run",
                  "free_ids", "t_enq", "t_enq_wall", "t_bucket0",
-                 "bucket_wait_us", "trace_id", "trace_ts")
+                 "bucket_wait_us", "trace_id", "trace_ts", "batch",
+                 "batch_idx")
 
     def __init__(self, tenant, session, exe, key, arg_ids, out_ids,
                  steps=1, carry=(), free_ids=()):
@@ -439,6 +507,43 @@ class WorkItem:
         self.bucket_wait_us = 0.0
         self.trace_id: Optional[str] = None
         self.trace_ts: Optional[float] = None
+        # EXEC_BATCH membership: (reply aggregator, positional slot).
+        # None for a plain EXECUTE — its reply is a frame of its own.
+        self.batch: "Optional[_BatchReply]" = None
+        self.batch_idx = 0
+
+
+class _ItemError(Exception):
+    """Typed validation failure of one execute body: fails the single
+    request, or just its EXEC_BATCH slot."""
+
+    def __init__(self, code: str, msg: str):
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
+class _BatchReply:
+    """Aggregates one EXEC_BATCH's per-item results into the single
+    positional reply frame.  Slots fill from the dispatcher (dispatch
+    order), the validation path, or abandon() on teardown; ``fill``
+    returns True to EXACTLY ONE caller — the one that filled the last
+    slot — which then sends the frame OUTSIDE the lock (batch.mu is a
+    strict leaf; no I/O ever runs under it)."""
+
+    __slots__ = ("mu", "results", "left")
+
+    def __init__(self, n: int):
+        self.mu = threading.Lock()
+        self.results: List[Optional[dict]] = [None] * n
+        self.left = n
+
+    def fill(self, idx: int, result: dict) -> bool:
+        with self.mu:
+            if self.results[idx] is None:
+                self.results[idx] = result
+                self.left -= 1
+            return self.left == 0
 
 
 class DeviceScheduler:
@@ -462,6 +567,11 @@ class DeviceScheduler:
         # Estimated device time of dispatched-but-unretired items (the
         # chip's queue depth in time units); guarded by self.mu.
         self.queued_est_us = 0.0
+        # Threads parked in a self.mu.wait (dispatcher + quiesce
+        # callers); guarded by self.mu.  Producers skip the notify when
+        # nobody is waiting — on a hot queue every submit/retire used
+        # to signal a condition no one was sleeping on.
+        self._waiting = 0
         self._stop = False
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
@@ -473,14 +583,34 @@ class DeviceScheduler:
         self._completer.start()
 
     def submit(self, item: WorkItem) -> None:
-        item.t_enq = time.monotonic()
-        item.t_enq_wall = time.time()
+        self.submit_many((item,))
+
+    def submit_many(self, items) -> None:
+        """Enqueue a whole EXEC_BATCH under ONE lock acquisition with
+        at most one wake (and none when the dispatcher is already
+        running hot) — the per-item lock/notify churn was measurable at
+        sub-ms step sizes."""
+        now_m = time.monotonic()
+        now_w = time.time()
         with self.mu:
-            name = item.tenant.name
-            if name not in self.queues:
-                self.queues[name] = collections.deque()
-                self.rr.append(name)
-            self.queues[name].append(item)
+            for item in items:
+                item.t_enq = now_m
+                item.t_enq_wall = now_w
+                name = item.tenant.name
+                if name not in self.queues:
+                    self.queues[name] = collections.deque()
+                    self.rr.append(name)
+                self.queues[name].append(item)
+            self._notify_locked()
+
+    def _notify_locked(self) -> None:
+        if self._waiting:
+            self.mu.notify_all()
+
+    def kick(self) -> None:
+        """Unconditional wake (admin resume, shutdown): correctness
+        paths never rely on the waiter-count fast path."""
+        with self.mu:
             self.mu.notify_all()
 
     def quiesce(self, name: str, timeout: float = 30.0) -> None:
@@ -495,7 +625,13 @@ class DeviceScheduler:
             while self.inflight.get(name, 0) > 0:
                 if time.monotonic() >= deadline:
                     break
-                self.mu.wait(timeout=0.1)
+                # Counted wait (see _notify_locked): producers skip the
+                # notify when nobody sleeps here.
+                self._waiting += 1
+                try:
+                    self.mu.wait(timeout=0.1)
+                finally:
+                    self._waiting -= 1
 
     def quiesce_all(self, timeout: float = 30.0) -> bool:
         """Drain-for-handover: wait until every tenant's queued AND
@@ -509,7 +645,11 @@ class DeviceScheduler:
                            if n not in self.state.suspended):
                 if time.monotonic() >= deadline:
                     return False
-                self.mu.wait(timeout=0.1)
+                self._waiting += 1
+                try:
+                    self.mu.wait(timeout=0.1)
+                finally:
+                    self._waiting -= 1
         return True
 
     def forget_tenant(self, name: str) -> None:
@@ -538,7 +678,7 @@ class DeviceScheduler:
                     q.clear()
                     q.extend(kept)
             if purged:
-                self.mu.notify_all()
+                self._notify_locked()
         for it in purged:
             session.abandon(it)
             # Apply the purged items' piggybacked frees: if the client
@@ -587,10 +727,9 @@ class DeviceScheduler:
             t = item.tenant
             est = max(t.cost_ema.get(item.key, 5000.0),
                       float(self.state.min_exec_cost_us)) * item.steps
-            metered = (self.chip.region.device_stats(t.index)
-                       .core_limit_pct > 0)
+            metered = t.metered_on(self.chip, now)
             if metered:
-                wait_ns = t.rate_acquire_all(int(est), t.priority)
+                wait_ns = self._lease_admit_locked(t, est, now)
                 if wait_ns:
                     # Trace: the item is now provably waiting on the
                     # token bucket, not the queue — stamp the start of
@@ -623,137 +762,214 @@ class DeviceScheduler:
             return item, soonest
         return None, soonest
 
+    def _lease_admit_locked(self, t: Tenant, est: float,
+                            now: float) -> int:
+        """Admit ``est`` µs of device time for one item.  With leases
+        on, most admissions are a plain float decrement: one
+        rate_acquire funds a quantum (pre-debited from the SAME token
+        bucket, so co-tenants see the debit immediately) that later
+        items burn locally.  Returns the nanoseconds to wait (0 =
+        admitted), exactly like rate_acquire_all.  Caller holds
+        self.mu — lease state is scheduler.mu-guarded."""
+        q = float(self.state.rate_lease_us)
+        if q <= 0:
+            return t.rate_acquire_all(int(est), t.priority)
+        if t.lease_us > 0.0 and now >= t.lease_exp:
+            # Expired: refund the remainder so an idling tenant's
+            # pre-debit flows back to its co-tenants.
+            t.lease_release()
+        if t.lease_us >= est:
+            t.lease_us -= est
+            return 0
+        wait_ns = t.rate_acquire_all(int(est + q), t.priority)
+        if wait_ns == 0:
+            t.lease_us += q
+            t.lease_exp = now + self.state.rate_lease_ttl_s
+            t.lease_grants += 1
+            t.lease_revoked = False
+            return 0
+        # The bucket cannot fund a fresh quantum right now: fall back
+        # to the exact ask (plus whatever lease remains), so a
+        # throttled tenant is never punished for the lease's extra.
+        need = max(est - t.lease_us, 1.0)
+        wait_ns = t.rate_acquire_all(int(need), t.priority)
+        if wait_ns == 0:
+            t.lease_us = 0.0
+            return 0
+        return wait_ns
+
     def _dispatch_loop(self):
-        jax = self.state.jax
         while not self._stop:
             with self.mu:
-                item, soonest = self._pick_locked()
-                if item is None:
+                items = []
+                soonest = None
+                # Drain up to WAKE_BATCH ready items per wake: one lock
+                # acquisition admits a whole pipelined burst instead of
+                # a lock/pick/release cycle per item.
+                while len(items) < WAKE_BATCH:
+                    item, soonest = self._pick_locked()
+                    if item is None:
+                        break
+                    items.append(item)
+                if not items:
                     timeout = 0.5
                     if soonest is not None:
                         timeout = max(min(soonest - time.monotonic(), 0.5),
                                       0.001)
-                    self.mu.wait(timeout=timeout)
+                    self._waiting += 1
+                    try:
+                        self.mu.wait(timeout=timeout)
+                    finally:
+                        self._waiting -= 1
                     continue
-            t = item.tenant
-            t0 = time.monotonic()
-            metas = []
-            try:
-                args = []
-                with t.mu:
-                    for fid in item.free_ids:
-                        item.session.drop_array(t, fid)
-                    for aid in item.arg_ids:
-                        a = t.arrays.get(aid)
-                        if a is None and aid in t.host_arrays:
-                            # Spilled operand: reuse the resident staged
-                            # copy when one exists; otherwise stage and,
-                            # if the quota has headroom, KEEP the copy
-                            # (residency cache — re-staging a hot
-                            # operand every step cost overcommit ~17%
-                            # vs direct).  No headroom -> transient
-                            # staging, the old behavior.
-                            a = t.staged.get(aid)
-                            if a is not None:
-                                t.staged.move_to_end(aid)
-                            else:
-                                host_np = t.host_arrays[aid]
-                                a = jax.device_put(host_np,
-                                                   self.chip.device)
-                                nb = int(host_np.nbytes)
-                                admit = self.chip.region.mem_acquire(
-                                    t.index, nb, False)
-                                if not admit:
-                                    # Bounded overshoot residency (the
-                                    # unified-memory analogue): cache
-                                    # past the quota while books stay
-                                    # under limit*(1+overshoot) —
-                                    # checked ATOMICALLY, so concurrent
-                                    # allocations cannot push past the
-                                    # advertised ceiling.
-                                    ov = (t.spill_overshoot
-                                          if t.spill_overshoot
-                                          is not None else
-                                          self.state.spill_overshoot)
-                                    st = self.chip.region.device_stats(
-                                        t.index)
-                                    cap = int(st.limit_bytes * (1 + ov))
-                                    if ov > 0 and st.limit_bytes:
-                                        admit = (self.chip.region
-                                                 .mem_acquire_capped(
-                                                     t.index, nb, cap))
-                                if admit:
-                                    t.staged[aid] = a
-                                    t.staged_bytes[aid] = nb
-                                    t.staged_total += nb
-                        if a is None:
-                            raise KeyError(f"NOT_FOUND: {aid}")
-                        args.append(a)
-                ish = item.exe.in_shardings
-                if ish:
-                    # Multi-chip program: args committed elsewhere (a
-                    # PUT lands whole on the primary chip) are re-placed
-                    # onto the program's sharding; args already on the
-                    # mesh (previous outputs) match and pass through.
-                    for k in range(len(args)):
-                        s = ish[k] if k < len(ish) else None
-                        if s is not None and \
-                                getattr(args[k], "sharding", None) != s:
-                            args[k] = jax.device_put(args[k], s)
-                fn = item.exe.fn
-                if item.steps > 1:
-                    fn = self.state.chain_fn(item.exe.fn, item.steps,
-                                             item.carry)
-                outs = fn(*args)
-                out_list = (outs if isinstance(outs, (list, tuple))
-                            else [outs])
-                # Register outputs NOW (future-backed arrays): dependent
-                # pipelined steps resolve them at their own dispatch and
-                # XLA chains the programs on-device.  Shapes/shardings
-                # are static, so accounting needs no wait either — each
-                # granted chip is charged its shard footprint
-                # (oversubscribe-admit: can't refuse outputs post-hoc;
-                # the next put/execute hits the cap).
-                with t.mu:
-                    for i, o in enumerate(out_list):
-                        if i < len(item.out_ids):
-                            oid = item.out_ids[i]
+            done = []
+            for item in items:
+                r = self._dispatch_item(item)
+                if r is not None:
+                    done.append(r)
+            if done:
+                # ONE completion-queue put (lock + not-empty wake) per
+                # dispatch batch — the per-item put was a futex/GIL
+                # handoff per step under pipelined load.
+                self._completion_q.put(done)
+
+    def _dispatch_item(self, item: WorkItem):
+        jax = self.state.jax
+        t = item.tenant
+        t0 = time.monotonic()
+        metas = []
+        try:
+            args = []
+            with t.mu:
+                for fid in item.free_ids:
+                    item.session.drop_array(t, fid)
+                for aid in item.arg_ids:
+                    a = t.arrays.get(aid)
+                    if a is None and aid in t.host_arrays:
+                        # Spilled operand: reuse the resident staged
+                        # copy when one exists; otherwise stage and,
+                        # if the quota has headroom, KEEP the copy
+                        # (residency cache — re-staging a hot
+                        # operand every step cost overcommit ~17%
+                        # vs direct).  No headroom -> transient
+                        # staging, the old behavior.
+                        a = t.staged.get(aid)
+                        if a is not None:
+                            t.staged.move_to_end(aid)
                         else:
-                            t.anon_seq += 1
-                            oid = f"_anon{t.anon_seq}"
-                        item.session.drop_array(t, oid)
-                        t.arrays[oid] = o
-                        t.nbytes[oid] = int(o.nbytes)
-                        t.charge_array(oid, t.shard_charges(o), True)
-                        metas.append({"id": oid, "shape": list(o.shape),
-                                      "dtype": str(o.dtype)})
-            except Exception as e:  # noqa: BLE001 - reply with error
-                # Failed before reaching the device: credit the up-front
-                # charge back and retire the item immediately.
-                flush_tenant_journal(self.state, t)
-                if item.metered:
-                    t.rate_adjust_all(-int(item.est_us))
-                item.session.complete_execute(item, metas, e, 0.0)
-                self._record_span(item, t0, time.monotonic(), 0.0,
-                                  error=f"{type(e).__name__}: {e}")
-                self._retire(item)
-                continue
-            # Journal records deferred by the free/drop paths above go
-            # out before the reply (durability contract unchanged).
+                            host_np = t.host_arrays[aid]
+                            a = jax.device_put(host_np,
+                                               self.chip.device)
+                            nb = int(host_np.nbytes)
+                            admit = self.chip.region.mem_acquire(
+                                t.index, nb, False)
+                            if not admit:
+                                # Bounded overshoot residency (the
+                                # unified-memory analogue): cache
+                                # past the quota while books stay
+                                # under limit*(1+overshoot) —
+                                # checked ATOMICALLY, so concurrent
+                                # allocations cannot push past the
+                                # advertised ceiling.
+                                ov = (t.spill_overshoot
+                                      if t.spill_overshoot
+                                      is not None else
+                                      self.state.spill_overshoot)
+                                st = self.chip.region.device_stats(
+                                    t.index)
+                                cap = int(st.limit_bytes * (1 + ov))
+                                if ov > 0 and st.limit_bytes:
+                                    admit = (self.chip.region
+                                             .mem_acquire_capped(
+                                                 t.index, nb, cap))
+                            if admit:
+                                t.staged[aid] = a
+                                t.staged_bytes[aid] = nb
+                                t.staged_total += nb
+                    if a is None:
+                        raise KeyError(f"NOT_FOUND: {aid}")
+                    args.append(a)
+            ish = item.exe.in_shardings
+            if ish:
+                # Multi-chip program: args committed elsewhere (a
+                # PUT lands whole on the primary chip) are re-placed
+                # onto the program's sharding; args already on the
+                # mesh (previous outputs) match and pass through.
+                for k in range(len(args)):
+                    s = ish[k] if k < len(ish) else None
+                    if s is not None and \
+                            getattr(args[k], "sharding", None) != s:
+                        args[k] = jax.device_put(args[k], s)
+            fn = item.exe.fn
+            if item.steps > 1:
+                fn = self.state.chain_fn(item.exe.fn, item.steps,
+                                         item.carry)
+            outs = fn(*args)
+            out_list = (outs if isinstance(outs, (list, tuple))
+                        else [outs])
+            # Register outputs NOW (future-backed arrays): dependent
+            # pipelined steps resolve them at their own dispatch and
+            # XLA chains the programs on-device.  Shapes/shardings
+            # are static, so accounting needs no wait either — each
+            # granted chip is charged its shard footprint
+            # (oversubscribe-admit: can't refuse outputs post-hoc;
+            # the next put/execute hits the cap).
+            tmpl = item.exe.out_meta
+            if tmpl is None or len(tmpl) != len(out_list):
+                tmpl = [{"shape": list(o.shape), "dtype": str(o.dtype),
+                         "nbytes": int(o.nbytes)} for o in out_list]
+                item.exe.out_meta = tmpl
+            single_chip = len(t.chips) == 1
+            with t.mu:
+                for i, o in enumerate(out_list):
+                    if i < len(item.out_ids):
+                        oid = item.out_ids[i]
+                    else:
+                        t.anon_seq += 1
+                        oid = f"_anon{t.anon_seq}"
+                    m = tmpl[i]
+                    nb = m["nbytes"]
+                    item.session.drop_array(t, oid)
+                    t.arrays[oid] = o
+                    t.nbytes[oid] = nb
+                    t.charge_array(oid, [(0, nb)] if single_chip
+                                   else t.shard_charges(o), True)
+                    metas.append({"id": oid, "shape": m["shape"],
+                                  "dtype": m["dtype"]})
+        except Exception as e:  # noqa: BLE001 - reply with error
+            # Failed before reaching the device: credit the up-front
+            # charge back and retire the item immediately.
             flush_tenant_journal(self.state, t)
-            # Reply NOW — shapes are static; the device is still working.
-            item.exe.warmed.add((item.steps, item.carry))
-            item.session.complete_execute(item, metas, None, item.est_us)
-            self._completion_q.put((item, t0, out_list))
+            if item.metered:
+                t.rate_adjust_all(-int(item.est_us))
+            item.session.complete_execute(item, metas, e, 0.0)
+            self._record_span(item, t0, time.monotonic(), 0.0,
+                              error=f"{type(e).__name__}: {e}")
+            self._retire(item)
+            return None
+        # Journal records deferred by the free/drop paths above go
+        # out before the reply (durability contract unchanged).
+        flush_tenant_journal(self.state, t)
+        # Reply NOW — shapes are static; the device is still working.
+        item.exe.warmed.add((item.steps, item.carry))
+        item.session.complete_execute(item, metas, None, item.est_us)
+        return (item, t0, out_list)
 
     def _retire(self, item: WorkItem) -> None:
+        self._retire_many((item,))
+
+    def _retire_many(self, items) -> None:
+        """Retire a whole metered batch under one lock acquisition with
+        at most one wake (wake batching: the per-item notify_all was a
+        futex storm under pipelined load)."""
         with self.mu:
-            name = item.tenant.name
-            if name in self.inflight:  # forgotten tenants stay forgotten
-                self.inflight[name] = max(self.inflight[name] - 1, 0)
-            self.queued_est_us = max(self.queued_est_us - item.est_us,
-                                     0.0)
-            self.mu.notify_all()
+            for item in items:
+                name = item.tenant.name
+                if name in self.inflight:  # forgotten stay forgotten
+                    self.inflight[name] = max(self.inflight[name] - 1, 0)
+                self.queued_est_us = max(
+                    self.queued_est_us - item.est_us, 0.0)
+            self._notify_locked()
 
     # -- metering ----------------------------------------------------------
 
@@ -804,15 +1020,18 @@ class DeviceScheduler:
             # enough for retirement to outpace the device.
             lat_us_now = self.chip.calibrate_latency_us()
             drain_cap_us = max(3.0 * lat_us_now, 50_000.0)
-            batch = [first]
-            batch_est = first[0].est_us
+            # Queue entries are LISTS (one per dispatcher wake-batch);
+            # the est cap applies at list granularity — a long-chain
+            # item still travels in a list of its own size class.
+            batch = list(first)
+            batch_est = sum(it.est_us for it, _, _ in batch)
             while batch_est < drain_cap_us:
                 try:
                     nxt = self._completion_q.get_nowait()
                 except queue.Empty:
                     break
-                batch.append(nxt)
-                batch_est += nxt[0].est_us
+                batch.extend(nxt)
+                batch_est += sum(it.est_us for it, _, _ in nxt)
             self._meter_batch(batch)
 
     def _meter_batch(self, batch) -> None:
@@ -885,6 +1104,7 @@ class DeviceScheduler:
         if not continuous:
             learn_scale = sparse_batch_learn_scale(batch_est, disp_us,
                                                    len(batch))
+        ema_recs: List[dict] = []
         for item, t0, outs in batch:
             t = item.tenant
             prev_ema = t.cost_ema.get(item.key, 5000.0)
@@ -960,7 +1180,11 @@ class DeviceScheduler:
                 # Learned samples are journaled so a crashed broker's
                 # successor recovers the tenant's cost model within
                 # one sample of pre-crash (docs/BROKER_RECOVERY.md).
-                self.state.journal.append(
+                # Collected here and appended in ONE journal write per
+                # metered batch (wake batching — the per-item
+                # write+flush serialized the metering loop on file
+                # I/O under pipelined load).
+                ema_recs.append(
                     {"op": "ema", "name": t.name, "key": item.key,
                      "ema": t.cost_ema[item.key],
                      "execs": t.executions})
@@ -971,7 +1195,9 @@ class DeviceScheduler:
                 len(batch), obs_us, disp_us)
             self._record_span(item, t0, t_obs, busy_us,
                               solo=(len(batch) == 1))
-            self._retire(item)
+        if ema_recs and self.state.journal is not None:
+            self.state.journal.append_many(ema_recs)
+        self._retire_many([item for item, _, _ in batch])
 
     # -- vtpu-trace (runtime/trace.py) -------------------------------------
 
@@ -1277,6 +1503,17 @@ class RuntimeState:
         # 0 disables (staged copies then stay strictly within quota).
         self.spill_overshoot = float(os.environ.get(
             "VTPU_SPILL_RESIDENT_OVERSHOOT", "1.0"))
+        # -- broker hot path (docs/PERF.md) --
+        # Rate-lease quantum (µs; 0 = per-item rate_acquire) and the
+        # wall-clock TTL after which an unburned lease refunds to the
+        # bucket (sized at a few quanta of real time so a stalling
+        # tenant cannot park device-time budget).
+        self.rate_lease_us = RATE_LEASE_US
+        self.rate_lease_ttl_s = max(4.0 * RATE_LEASE_US / 1e6, 0.05)
+        # Receive-pool counters shared by every connection's RecvPool
+        # (exposed via STATS).  Plain-int increments: a lost update
+        # under-counts a stat, never corrupts enforcement state.
+        self.pool_stats: Dict[str, int] = {}
         # The broker's "device" axis is CHIPS: PJRT devices are
         # TensorCores, and multi-core generations (v4/v5p) expose two
         # per chip.  Group by chip coords so HELLO's device index (the
@@ -1681,6 +1918,15 @@ class RuntimeState:
         of tenants the snapshot carries."""
         self.draining = True
         deadline = time.monotonic() + max(timeout, 0.0)
+        with self.mu:
+            tenants = list(self.tenants.values())
+        for t in tenants:
+            # Handover reclaims every rate lease: the successor broker
+            # seeds fresh buckets, so budget parked client-side would
+            # otherwise be double-granted.
+            with t.chip.scheduler.mu:
+                t.lease_release()
+                t.lease_revoked = True
         with self.chips_mu:
             chips = list(self.chips.values())
         for chip in chips:
@@ -1801,6 +2047,11 @@ class RuntimeState:
             # reusing the name must not start silently frozen (the only
             # clue would be the admin-side STATS list).
             self.suspended.discard(t.name)
+        # Reclaim the unburned rate lease BEFORE the slot recycles: the
+        # next tenant on this slot must not inherit (or lose) the
+        # pre-debited budget.  scheduler.mu guards lease state.
+        with t.chip.scheduler.mu:
+            t.lease_release()
         # The close record goes out AFTER state.mu is released (lock
         # discipline: journal file I/O never runs under fast locks) but
         # before this thread's _cleanup drops the arrays — replay order
@@ -1967,6 +2218,10 @@ class TenantSession(socketserver.BaseRequestHandler):
         # the session.
         self._staging: Dict[str, List[bytes]] = {}
         self._staging_bytes = 0
+        # Raw-frame receive pool (docs/PERF.md): steady-state PUT
+        # traffic recv_into's one reused buffer; counters aggregate
+        # broker-wide in state.pool_stats (STATS "pool").
+        self._pool = P.RecvPool(stats=self.state.pool_stats)
 
     def _send(self, msg) -> None:
         with self.send_mu:
@@ -1985,7 +2240,14 @@ class TenantSession(socketserver.BaseRequestHandler):
 
     def abandon(self, item: WorkItem) -> None:
         """A queued (never-dispatched) item of this dead connection was
-        purged: release its reply slot so teardown's drain completes."""
+        purged: release its reply slot so teardown's drain completes.
+        A batch member fills its slot so batch-mates that DID dispatch
+        can complete the aggregate (the send then no-ops on the dead
+        socket)."""
+        if item.batch is not None:
+            item.batch.fill(item.batch_idx,
+                           {"ok": False, "code": "PURGED",
+                            "error": "connection closed"})
         with self.pending_cond:
             self.pending -= 1
             self.pending_cond.notify_all()
@@ -2093,7 +2355,8 @@ class TenantSession(socketserver.BaseRequestHandler):
                     # the path that wedged claims and os._exit(3)'d the
                     # broker when the probe HELLO'd chip 0.
                     self._send({"ok": True, "tenants": self._stats(),
-                                "journal": self.state.journal_stats()})
+                                "journal": self.state.journal_stats(),
+                                "pool": dict(self.state.pool_stats)})
                     continue
                 if kind == P.TRACE:
                     # BIND-FREE like STATS (same no-chip-claim
@@ -2116,6 +2379,13 @@ class TenantSession(socketserver.BaseRequestHandler):
 
                 if kind == P.EXECUTE:
                     self._enqueue_execute(tenant, msg)
+                    continue
+
+                if kind == P.EXEC_BATCH:
+                    # Pipelined batch: N executes ride one frame, are
+                    # enqueued under ONE scheduler-lock acquisition and
+                    # answered with one positional reply (docs/PERF.md).
+                    self._enqueue_batch(tenant, msg)
                     continue
 
                 # Synchronous requests keep FIFO reply order by draining
@@ -2149,7 +2419,35 @@ class TenantSession(socketserver.BaseRequestHandler):
                                 "staged_bytes": self._staging_bytes})
 
                 elif kind == P.PUT:
-                    if msg.get("staged"):
+                    pool_buf = None
+                    pool_adopted = False
+                    raw_parts = int(msg.get("raw_parts", 0) or 0)
+                    if raw_parts:
+                        # Zero-copy framing: the header announced
+                        # raw_parts length-prefixed runs of naked
+                        # tensor bytes — recv_into a pooled buffer at
+                        # increasing offsets; no msgpack bin decode,
+                        # no staged-part join.
+                        want = int(msg["nbytes"])
+                        if want > raw_parts * P.CHUNK_BYTES:
+                            raise P.ProtocolError(
+                                f"raw PUT {want} bytes in {raw_parts} "
+                                f"part(s) exceeds CHUNK_BYTES framing")
+                        pool_buf = self._pool.take(want)
+                        mv = memoryview(pool_buf)
+                        got = 0
+                        for _ in range(raw_parts):
+                            got += P.recv_raw_into(sock, mv[got:want])
+                        if got != want:
+                            raise P.ProtocolError(
+                                f"raw PUT: announced {want} bytes, "
+                                f"received {got}")
+                        # Read-only view: device_put of a WRITABLE
+                        # bytearray-backed array takes a jax path that
+                        # retains an extra ArrayImpl; read-only matches
+                        # the legacy bytes framing bit-for-bit.
+                        buf = mv[:want].toreadonly()
+                    elif msg.get("staged"):
                         parts = self._staging.pop(str(msg["id"]), [])
                         self._staging_bytes -= sum(len(p) for p in parts)
                         buf = b"".join(parts)
@@ -2229,6 +2527,31 @@ class TenantSession(socketserver.BaseRequestHandler):
                                 tenant.chip.region.mem_release(
                                     tenant.index, nbytes)
                                 raise
+                            if pool_buf is not None:
+                                # CPU backends may ADOPT an aligned
+                                # host buffer (zero-copy device_put —
+                                # the whole PUT is then socket -> pool
+                                # buffer -> device array with no copy
+                                # at all); the pool must never reuse
+                                # memory a live array aliases.  The
+                                # check compares the zero-copy host
+                                # view's bounds against the pool buffer
+                                # (unsafe_buffer_pointer would retain
+                                # an extra ArrayImpl wrapper).  Only
+                                # CPU shardings can alias host memory;
+                                # on device backends np.asarray would
+                                # be a full transfer, so skip it.  When
+                                # aliasing can't be disproven, keep the
+                                # buffer out of the pool.
+                                try:
+                                    dev0 = next(iter(
+                                        dev_arr.sharding.device_set))
+                                    pool_adopted = (
+                                        dev0.platform == "cpu"
+                                        and np.may_share_memory(
+                                            np.asarray(dev_arr), arr))
+                                except Exception:  # noqa: BLE001
+                                    pool_adopted = True
                             if dedup_key is not None:
                                 self.state.put_cache_add(dedup_key,
                                                          dev_arr)
@@ -2261,6 +2584,8 @@ class TenantSession(socketserver.BaseRequestHandler):
                                 ("sha", "shape", "dtype", "nbytes",
                                  "charges", "spilled")}
                         jr.append(rec)
+                    if pool_buf is not None and not pool_adopted:
+                        self._pool.give(pool_buf)
                     self._send({"ok": True, "nbytes": nbytes,
                                 "spilled": spilled})
 
@@ -2275,7 +2600,24 @@ class TenantSession(socketserver.BaseRequestHandler):
                         self._send_err("NOT_FOUND", aid)
                         continue
                     nbytes = int(host.nbytes)
-                    if nbytes > P.CHUNK_BYTES:
+                    if msg.get("raw"):
+                        # Zero-copy reply (docs/PERF.md): header + every
+                        # payload segment leave in ONE gather write,
+                        # with the iovecs pointing straight into the
+                        # host view of the array — no tobytes() copy,
+                        # no frame-per-chunk syscalls.
+                        if not host.flags["C_CONTIGUOUS"]:
+                            host = np.ascontiguousarray(host)
+                        flat = host.reshape(-1).view(np.uint8)
+                        hdr = {"ok": True, "shape": list(host.shape),
+                               "dtype": host.dtype.name,
+                               "nbytes": nbytes,
+                               "raw_parts": P.raw_part_count(nbytes)}
+                        with self.send_mu:
+                            P.send_frames(
+                                sock, [P.frame_header(hdr)]
+                                + P.raw_frames(flat))
+                    elif nbytes > P.CHUNK_BYTES:
                         # Multi-frame reply (FIFO-safe: executes were
                         # drained above, and this thread is the only
                         # producer of further replies until it returns).
@@ -2331,7 +2673,8 @@ class TenantSession(socketserver.BaseRequestHandler):
                     # everything this tenant has dispatched.
                     tenant.chip.scheduler.quiesce(tenant.name)
                     self._send({"ok": True, "tenants": self._stats(),
-                                "journal": self.state.journal_stats()})
+                                "journal": self.state.journal_stats(),
+                                "pool": dict(self.state.pool_stats)})
 
                 else:
                     self._send_err("BAD_KIND", str(kind))
@@ -2405,26 +2748,27 @@ class TenantSession(socketserver.BaseRequestHandler):
 
     # -- execute path ------------------------------------------------------
 
-    def _enqueue_execute(self, t: Tenant, msg) -> None:
-        prog = t.executables.get(str(msg["exe"]))
+    def _build_item(self, t: Tenant, spec, trace=None) -> WorkItem:
+        """Validate one execute body ({exe, args, outs, repeats?,
+        carry?, free?}) into a WorkItem — shared by the single EXECUTE
+        arm and EXEC_BATCH.  Raises _ItemError with the typed code on
+        bad input (the caller decides whether that fails the request or
+        just the batch slot)."""
+        prog = t.executables.get(str(spec["exe"]))
         if prog is None:
-            self._drain()
-            self._send_err("NOT_FOUND", str(msg["exe"]))
-            return
-        steps = int(msg.get("repeats", 1))
+            raise _ItemError("NOT_FOUND", str(spec["exe"]))
+        steps = int(spec.get("repeats", 1))
         # Carry map for chained steps; [[0, 0]] (first output feeds first
         # argument) is the common next-token/train-state shape.
         carry = tuple(tuple(int(x) for x in pair)
-                      for pair in msg.get("carry", ((0, 0),)))
-        n_args = len(msg["args"])
+                      for pair in spec.get("carry", ((0, 0),)))
+        n_args = len(spec["args"])
         if steps > 1:
             bad = [p for p in carry
                    if len(p) != 2 or not 0 <= p[0] < prog.n_outs
                    or not 0 <= p[1] < n_args]
             if bad:
-                self._drain()
-                self._send_err("BAD_CARRY", f"invalid carry map {bad}")
-                return
+                raise _ItemError("BAD_CARRY", f"invalid carry map {bad}")
             # Build (and AOT-compile) the chain wrapper HERE, in the
             # session thread, so the dispatcher never head-of-line
             # blocks every tenant on an XLA compile.
@@ -2435,49 +2779,149 @@ class TenantSession(socketserver.BaseRequestHandler):
                 log.warn("chain precompile failed (%s); deferring", e)
         # Argument ids resolve at DISPATCH (scheduler), so a pipelined
         # step may name the previous step's not-yet-completed output.
-        item = WorkItem(t, self, prog, str(msg["exe"]),
-                        [str(a) for a in msg["args"]],
-                        [str(x) for x in msg.get("outs", [])],
+        item = WorkItem(t, self, prog, str(spec["exe"]),
+                        [str(a) for a in spec["args"]],
+                        [str(x) for x in spec.get("outs", [])],
                         steps=steps, carry=carry,
-                        free_ids=[str(f) for f in msg.get("free", ())])
-        tr = msg.get("trace")
-        if isinstance(tr, dict):
+                        free_ids=[str(f) for f in spec.get("free", ())])
+        if isinstance(trace, dict):
             # Client-stamped trace context (VTPU_TRACE): threads this
             # request's id through the scheduler into the recorder.
-            tid = tr.get("id")
+            tid = trace.get("id")
             item.trace_id = str(tid) if tid else None
             try:
-                item.trace_ts = (float(tr["ts"]) if "ts" in tr
+                item.trace_ts = (float(trace["ts"]) if "ts" in trace
                                  else None)
             except (TypeError, ValueError):
                 pass
+        return item
+
+    def _reserve_pending(self, n: int) -> None:
+        """Backpressure a client that pipelines without reading
+        replies: blocks only THIS connection's reader.  A batch larger
+        than the cap is still admitted once the connection is fully
+        drained (pending == 0) so it can never deadlock itself."""
         with self.pending_cond:
-            # Backpressure a client that pipelines without reading
-            # replies: blocks only THIS connection's reader.
-            while self.pending >= MAX_PENDING_REPLIES:
+            while self.pending and \
+                    self.pending + n > MAX_PENDING_REPLIES:
                 self.pending_cond.wait(timeout=0.5)
-            self.pending += 1
+            self.pending += n
+
+    def _enqueue_execute(self, t: Tenant, msg) -> None:
+        try:
+            item = self._build_item(t, msg, trace=msg.get("trace"))
+        except _ItemError as e:
+            self._drain()
+            self._send_err(e.code, e.msg)
+            return
+        self._reserve_pending(1)
         t.chip.scheduler.submit(item)
+
+    def _enqueue_batch(self, t: Tenant, msg) -> None:
+        specs = msg.get("items")
+        if not isinstance(specs, list) or not specs:
+            self._drain()
+            self._send_err("BAD_BATCH", "items must be a non-empty list")
+            return
+        batch = _BatchReply(len(specs))
+        trace = msg.get("trace")
+        items: List[WorkItem] = []
+        prefail: List[Tuple[int, dict]] = []
+        for i, spec in enumerate(specs):
+            try:
+                item = self._build_item(t, spec, trace=trace)
+            except _ItemError as e:
+                # Error isolation: a bad item fails ITS slot only; its
+                # batch-mates run normally.
+                prefail.append((i, {"ok": False, "code": e.code,
+                                    "error": e.msg}))
+                continue
+            except (KeyError, TypeError, ValueError) as e:
+                prefail.append((i, {"ok": False, "code": "BAD_ITEM",
+                                    "error": f"{type(e).__name__}: {e}"}))
+                continue
+            item.batch = batch
+            item.batch_idx = i
+            items.append(item)
+        self._reserve_pending(len(specs))
+        # Pre-fill validation failures BEFORE submitting, so whichever
+        # thread fills the last slot (usually the dispatcher) sees a
+        # consistent remainder count.
+        done = False
+        for i, res in prefail:
+            done = batch.fill(i, res)
+            with self.pending_cond:
+                self.pending -= 1
+                self.pending_cond.notify_all()
+        if items:
+            # ONE scheduler-lock acquisition + at most one wake for the
+            # whole batch (docs/PERF.md).
+            t.chip.scheduler.submit_many(items)
+        elif done:
+            # Every item failed validation: no scheduler involvement —
+            # drain first so this reply cannot overtake in-flight
+            # execute replies (FIFO contract), then answer.
+            self._drain()
+            try:
+                self._send_batch(batch, t)
+            except OSError:
+                pass
+
+    def _attach_lease(self, t: Tenant, msg: Dict[str, Any]) -> None:
+        """Piggyback the tenant's rate-lease grant on an execute reply
+        (docs/PERF.md): µs budget + TTL, or a one-shot revoke flag
+        after suspend/drain.  Unlocked read of scheduler.mu-guarded
+        floats — advisory only (a stale hint mis-sizes the client's
+        local pacing, never the broker-owned enforcement)."""
+        st = self.state
+        if st.rate_lease_us <= 0:
+            return
+        if t.lease_revoked:
+            t.lease_revoked = False
+            msg["lease"] = {"us": 0, "ttl_s": 0.0, "revoke": True}
+        elif t.lease_us > 0:
+            msg["lease"] = {
+                "us": int(t.lease_us),
+                "ttl_s": round(max(t.lease_exp - time.monotonic(),
+                                   0.0), 3)}
+
+    @staticmethod
+    def _exec_result(metas, exc, actual_us: float) -> dict:
+        """One execute's wire result — the body of a single reply or a
+        batch slot."""
+        if exc is None:
+            return {"ok": True, "outs": metas,
+                    "device_time_us": actual_us}
+        msg = str(exc)
+        if isinstance(exc, MemoryError) or "RESOURCE_EXHAUSTED" in msg:
+            return {"ok": False, "code": "RESOURCE_EXHAUSTED",
+                    "error": msg}
+        if isinstance(exc, KeyError) and "NOT_FOUND" in msg:
+            return {"ok": False, "code": "NOT_FOUND",
+                    "error": msg.strip("'")}
+        return {"ok": False, "code": "INTERNAL",
+                "error": f"{type(exc).__name__}: {exc}"}
+
+    def _send_batch(self, batch: "_BatchReply", t: Tenant) -> None:
+        msg: Dict[str, Any] = {"ok": True, "results": batch.results}
+        self._attach_lease(t, msg)
+        self._send(msg)
 
     def complete_execute(self, item: WorkItem, metas, exc,
                          actual_us: float) -> None:
-        """Called by the scheduler's completion thread, in dispatch
-        order; output bookkeeping happened at dispatch — this sends the
-        reply."""
+        """Called by the scheduler's dispatcher, in dispatch order;
+        output bookkeeping happened at dispatch — this sends the reply
+        (or fills the item's EXEC_BATCH slot; the filler of the last
+        slot sends the aggregate)."""
+        res = self._exec_result(metas, exc, actual_us)
         try:
-            if exc is not None:
-                msg = str(exc)
-                if isinstance(exc, MemoryError) or \
-                        "RESOURCE_EXHAUSTED" in msg:
-                    self._send_err("RESOURCE_EXHAUSTED", msg)
-                elif isinstance(exc, KeyError) and "NOT_FOUND" in msg:
-                    self._send_err("NOT_FOUND", msg.strip("'"))
-                else:
-                    self._send_err("INTERNAL",
-                                   f"{type(exc).__name__}: {exc}")
-                return
-            self._send({"ok": True, "outs": metas,
-                        "device_time_us": actual_us})
+            if item.batch is not None:
+                if item.batch.fill(item.batch_idx, res):
+                    self._send_batch(item.batch, item.tenant)
+            else:
+                if res.get("ok"):
+                    self._attach_lease(item.tenant, res)
+                self._send(res)
         except OSError:
             pass  # client went away; state torn down on disconnect
         finally:
@@ -2534,6 +2978,10 @@ def collect_stats(state: RuntimeState):
             "cost_ema_us": {k: round(float(v), 1)
                             for k, v in t.cost_ema.items()},
             "recovered": bool(t.recovered),
+            # Rate lease (docs/PERF.md): unburned pre-debited budget +
+            # grant count.  Unlocked read — advisory observability.
+            "lease_us": int(t.lease_us),
+            "lease_grants": int(t.lease_grants),
         }
         # Flight-recorder rollup (latency histogram, queue/bucket wait
         # totals): rides on STATS so the metrics server gets per-tenant
@@ -2598,18 +3046,25 @@ class AdminSession(socketserver.BaseRequestHandler):
                         # not read as a successful suspend of the real
                         # tenant.
                         known = name in self.state.tenants
+                        t_obj = self.state.tenants.get(name)
                         if kind == P.SUSPEND:
                             self.state.suspended.add(name)
                         else:
                             self.state.suspended.discard(name)
+                    if kind == P.SUSPEND and t_obj is not None:
+                        # Revoke the rate lease: a frozen tenant must
+                        # not park pre-debited device time, and its
+                        # next reply tells the client to re-sync.
+                        with t_obj.chip.scheduler.mu:
+                            t_obj.lease_release()
+                            t_obj.lease_revoked = True
                     # Wake every chip's dispatcher: a resumed tenant
                     # must not wait out a scheduler sleep.  chips is
                     # mutated under chips_mu (first HELLO on a chip).
                     with self.state.chips_mu:
                         chips = list(self.state.chips.values())
                     for chip in chips:
-                        with chip.scheduler.mu:
-                            chip.scheduler.mu.notify_all()
+                        chip.scheduler.kick()
                     log.info("admin: %s tenant %r (known=%s)", kind,
                              name, known)
                     P.send_msg(self.request,
@@ -2621,7 +3076,8 @@ class AdminSession(socketserver.BaseRequestHandler):
                                {"ok": True,
                                 "tenants": collect_stats(self.state),
                                 "suspended": suspended,
-                                "journal": self.state.journal_stats()})
+                                "journal": self.state.journal_stats(),
+                                "pool": dict(self.state.pool_stats)})
                 elif kind == P.TRACE:
                     # Host-side flight-recorder read (vtpu-smi trace):
                     # same body as the tenant-socket verb.

@@ -287,6 +287,12 @@ def chrome_trace(tenants: Dict[str, Any],
 # looks alive (the holder may be wedged itself).
 LEASE_STALE_S = 60.0
 
+# Writers beat this often (runtime/server.py _lease_keeper, bench.py's
+# direct phase).  A holder silent for 3 consecutive intervals is not
+# coming back on its own — the takeover threshold.
+LEASE_HEARTBEAT_S = 5.0
+LEASE_TAKEOVER_S = 3 * LEASE_HEARTBEAT_S
+
 
 def lease_sidecar_path() -> str:
     """Default: next to libtpu's conventional lockfile; override with
@@ -367,6 +373,50 @@ def clear_lease_sidecar(path: Optional[str] = None) -> None:
             os.unlink(path)
     except (OSError, ValueError):
         pass
+
+
+def takeover_lease_sidecar(path: Optional[str] = None,
+                           stage: str = "takeover") -> bool:
+    """Reclaim a dead or silent holder's sidecar record.
+
+    The reclaim rule is the satellite of BENCH_r06: holder pid provably
+    dead, OR heartbeat silent past LEASE_TAKEOVER_S (3 missed beats) —
+    either way nobody is coming back for the lease, and a claimer that
+    keeps deferring to the corpse burns its whole wait budget.  A live
+    holder inside the heartbeat window is never touched.
+
+    Unlike write_lease_sidecar (which keeps any holder fresher than
+    LEASE_STALE_S as a courtesy), this writes unconditionally once the
+    takeover judgment is made — the caller has decided.  The previous
+    holder is recorded in the new sidecar for the audit trail.
+    Returns True iff the record now names this process."""
+    path = path or lease_sidecar_path()
+    rec = read_lease_sidecar(path)
+    prev: Dict[str, Any] = {}
+    if rec is not None and int(rec.get("pid", -1)) != os.getpid():
+        pid = int(rec.get("pid", -1))
+        age = float(rec.get("heartbeat_age_s", 0.0))
+        if pid_alive(pid) and age <= LEASE_TAKEOVER_S:
+            return False
+        prev = {"took_over_pid": pid,
+                "took_over_cmdline": rec.get("cmdline", "?"),
+                "took_over_heartbeat_age_s": round(age, 1)}
+    new = {"pid": os.getpid(), "cmdline": _my_cmdline(),
+           "stage": stage, "created": time.time()}
+    new.update(prev)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(new, f)
+        os.replace(tmp, path)
+        return True
+    except OSError as e:
+        log.debug("lease takeover of %s failed: %s", path, e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
 
 
 def read_lease_sidecar(path: Optional[str] = None

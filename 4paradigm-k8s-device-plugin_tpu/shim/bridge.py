@@ -382,8 +382,10 @@ class Bridge:
                     # server-side: the session drains its own
                     # executes before processing a PUT.
                     _, fid, arr = item
-                    nparts = (int(np.asarray(arr).nbytes)
-                              // max(self._chunk_bytes(), 1)) + 1
+                    # Reply frames this upload will cost: always 1 on
+                    # the zero-copy raw framing (docs/PERF.md), one per
+                    # chunk on the legacy framing.
+                    nparts = self.client.put_parts(arr)
                     if nparts > self.client.MAX_PIPELINED_PUT_PARTS:
                         # Huge transient upload: the pipelined path
                         # would deadlock on its own unread acks —
@@ -417,11 +419,6 @@ class Bridge:
     def sync(self) -> None:
         with self._mu:
             self._drain_locked()
-
-    @staticmethod
-    def _chunk_bytes() -> int:
-        from ..runtime import protocol as P
-        return P.CHUNK_BYTES
 
     def epoch(self):
         return self.client.epoch

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
+import time
 from typing import List, Optional, Sequence
 
 from ..utils.envspec import MAX_DEVICES_PER_NODE
@@ -166,8 +168,45 @@ def load() -> ctypes.CDLL:
     lib.vtpu_region_active_procs.restype = ctypes.c_int
     lib.vtpu_region_active_procs.argtypes = [ctypes.c_void_p]
     lib.vtpu_core_version.restype = ctypes.c_char_p
+    lib._vtpu_fast = _load_fast()
     _lib = lib
     return lib
+
+
+def _load_fast() -> Optional[ctypes.PyDLL]:
+    """GIL-holding twin of the hot region atomics (docs/PERF.md).
+
+    A CDLL call releases the GIL and must re-acquire it on return; for
+    the sub-µs accounting atomics the broker issues several times per
+    execute, that round trip — measured at tens of µs under thread
+    contention, pure gil_drop_request latency — dwarfs the native work.
+    PyDLL skips the release.  The functions bound here only ever take
+    the region's ROBUST mutex for nanosecond-scale critical sections
+    (EOWNERDEAD-safe, so a crashed holder cannot wedge a waiter);
+    anything that sleeps (rate_block) or does syscalls stays on the
+    GIL-releasing CDLL.  ``VTPU_NOGIL_ATOMICS=0`` opts out."""
+    if os.environ.get("VTPU_NOGIL_ATOMICS", "1") == "0":
+        return None
+    try:
+        fast = ctypes.PyDLL(_find_lib())
+    except OSError:
+        return None
+    fast.vtpu_mem_acquire.restype = ctypes.c_int
+    fast.vtpu_mem_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_uint64, ctypes.c_int]
+    fast.vtpu_mem_acquire_capped.restype = ctypes.c_int
+    fast.vtpu_mem_acquire_capped.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64]
+    fast.vtpu_mem_release.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_uint64]
+    fast.vtpu_rate_acquire.restype = ctypes.c_uint64
+    fast.vtpu_rate_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_uint64, ctypes.c_int]
+    fast.vtpu_rate_adjust.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                      ctypes.c_int64]
+    fast.vtpu_busy_add.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                   ctypes.c_uint64]
+    return fast
 
 
 class SharedRegion:
@@ -185,6 +224,16 @@ class SharedRegion:
         if not self.handle:
             raise OSError(f"vtpu_region_open({path!r}) failed")
         self.path = path
+        # Hot accounting atomics go through the GIL-holding PyDLL twin
+        # when available (docs/PERF.md; see _load_fast) — pre-bound
+        # here so the per-call cost is one attribute lookup.
+        fast = getattr(self.lib, "_vtpu_fast", None) or self.lib
+        self._c_mem_acquire = fast.vtpu_mem_acquire
+        self._c_mem_acquire_capped = fast.vtpu_mem_acquire_capped
+        self._c_mem_release = fast.vtpu_mem_release
+        self._c_rate_acquire = fast.vtpu_rate_acquire
+        self._c_rate_adjust = fast.vtpu_rate_adjust
+        self._c_busy_add = fast.vtpu_busy_add
 
     # -- lifecycle --
     def close(self) -> None:
@@ -214,18 +263,18 @@ class SharedRegion:
     # -- memory --
     def mem_acquire(self, dev: int, nbytes: int,
                     oversubscribe: bool = False) -> bool:
-        return self.lib.vtpu_mem_acquire(self.handle, dev, nbytes,
-                                         1 if oversubscribe else 0) == 0
+        return self._c_mem_acquire(self.handle, dev, nbytes,
+                                   1 if oversubscribe else 0) == 0
 
     def mem_acquire_capped(self, dev: int, nbytes: int,
                            cap_bytes: int) -> bool:
         """Admit past the limit up to cap_bytes total, atomically
         (broker overshoot residency)."""
-        return self.lib.vtpu_mem_acquire_capped(
+        return self._c_mem_acquire_capped(
             self.handle, dev, nbytes, int(cap_bytes)) == 0
 
     def mem_release(self, dev: int, nbytes: int) -> None:
-        self.lib.vtpu_mem_release(self.handle, dev, nbytes)
+        self._c_mem_release(self.handle, dev, nbytes)
 
     def mem_info(self, dev: int):
         free = ctypes.c_uint64()
@@ -254,14 +303,13 @@ class SharedRegion:
     # -- rate limiting --
     def rate_acquire(self, dev: int, cost_us: int, priority: int = 1) -> int:
         """0 = admitted; else nanoseconds to sleep before retry."""
-        return self.lib.vtpu_rate_acquire(self.handle, dev, cost_us,
-                                          priority)
+        return self._c_rate_acquire(self.handle, dev, cost_us, priority)
 
     def rate_block(self, dev: int, cost_us: int, priority: int = 1) -> None:
         self.lib.vtpu_rate_block(self.handle, dev, cost_us, priority)
 
     def rate_adjust(self, dev: int, delta_us: int) -> None:
-        self.lib.vtpu_rate_adjust(self.handle, dev, delta_us)
+        self._c_rate_adjust(self.handle, dev, delta_us)
 
     def set_core_limit(self, dev: int, pct: int) -> None:
         self.lib.vtpu_set_core_limit(self.handle, dev, pct)
@@ -282,7 +330,7 @@ class SharedRegion:
 
     def busy_add(self, dev: int, us: int) -> None:
         """Record completed device time (duty-cycle source)."""
-        self.lib.vtpu_busy_add(self.handle, dev, int(us))
+        self._c_busy_add(self.handle, dev, int(us))
 
     def rate_level(self, dev: int) -> int:
         """Current token-bucket level (us; negative = borrowed) — the
@@ -308,6 +356,92 @@ class SharedRegion:
     def active_procs(self) -> int:
         """Live registered processes (sweeps dead ones first)."""
         return self.lib.vtpu_region_active_procs(self.handle)
+
+
+class RateLease:
+    """Client-side rate lease over the shared region's token bucket
+    (docs/PERF.md): one ``rate_acquire`` pre-debits a µs quantum —
+    through the SAME native atomics every co-tenant reads, so fairness
+    stays region-owned — and subsequent admissions burn the local
+    balance with plain arithmetic instead of a native bucket round
+    trip per execute.  Re-syncs when the balance is exhausted, on
+    expiry (the unburned remainder refunds via ``rate_adjust`` so an
+    idling process cannot park device time), and on ``revoke``.
+
+    The internal lock is ``lease.mu`` in the broker's lock-order
+    ground truth: it may wrap region bucket calls (lease.mu >
+    region.lock) but the *blocking* fallback path always runs with the
+    lock released."""
+
+    def __init__(self, region: SharedRegion, dev: int = 0,
+                 quantum_us: Optional[int] = None,
+                 ttl_s: Optional[float] = None):
+        self.mu = threading.Lock()
+        self.region = region
+        self.dev = dev
+        if quantum_us is None:
+            quantum_us = int(os.environ.get("VTPU_RATE_LEASE_US",
+                                            "20000") or 0)
+        self.quantum_us = max(int(quantum_us), 0)
+        # A few quanta of wall time: long enough to amortize, short
+        # enough that a stalled process returns its pre-debit quickly.
+        self.ttl_s = (ttl_s if ttl_s is not None
+                      else max(4.0 * self.quantum_us / 1e6, 0.05))
+        self._us = 0.0
+        self._exp = 0.0
+        self.grants = 0
+        self.refunds = 0
+
+    def acquire(self, cost_us: float, priority: int = 1) -> None:
+        """Admit ``cost_us`` of device time, blocking in the native
+        bucket only when neither the local balance nor a fresh quantum
+        can fund it — the common case is one float decrement."""
+        cost = max(int(cost_us), 0)
+        if self.quantum_us <= 0:
+            self.region.rate_block(self.dev, cost, priority)
+            return
+        with self.mu:
+            now = time.monotonic()
+            if self._us > 0.0 and now >= self._exp:
+                self._refund_locked()
+            if self._us >= cost:
+                self._us -= cost
+                return
+            wait_ns = self.region.rate_acquire(
+                self.dev, cost + self.quantum_us, priority)
+            if wait_ns == 0:
+                self._us += self.quantum_us
+                self._exp = now + self.ttl_s
+                self.grants += 1
+                return
+            # Bucket can't fund a whole quantum: fall back to the
+            # exact ask (minus whatever balance remains) and BLOCK
+            # outside the lock — a throttled process must not hold
+            # the lease lock while it waits out its debt.
+            need = max(cost - int(self._us), 1)
+            self._us = 0.0
+        self.region.rate_block(self.dev, need, priority)
+
+    def remaining_us(self) -> float:
+        """Unexpired local balance (observability)."""
+        with self.mu:
+            if time.monotonic() >= self._exp:
+                return 0.0
+            return self._us
+
+    def revoke(self) -> None:
+        """Refund the unburned balance to the bucket immediately
+        (broker revoke flag, suspend, process teardown)."""
+        with self.mu:
+            self._refund_locked()
+
+    def _refund_locked(self) -> None:
+        left = int(self._us)
+        self._us = 0.0
+        self._exp = 0.0
+        if left > 0:
+            self.refunds += 1
+            self.region.rate_adjust(self.dev, -left)
 
 
 class TraceRing:

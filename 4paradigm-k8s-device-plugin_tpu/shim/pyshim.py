@@ -22,7 +22,7 @@ from __future__ import annotations
 import functools
 import os
 import time
-from typing import Dict, Optional
+from typing import Any, Dict, Optional
 
 from ..utils import envspec
 from ..utils import logging as log
@@ -137,6 +137,18 @@ class _PyEnforcer:
         # native interposer): sole tenant runs ungated.
         self._contention_at = 0.0
         self._contended = True
+        # Per-device rate leases (docs/PERF.md): gate() burns a
+        # pre-debited quantum through region atomics instead of a
+        # native bucket round trip per execute.  VTPU_RATE_LEASE_US=0
+        # restores per-item rate_block.
+        self._leases: Dict[int, Any] = {}
+
+    def _lease(self, dev: int):
+        lease = self._leases.get(dev)
+        if lease is None:
+            from .core import RateLease
+            lease = self._leases[dev] = RateLease(self.region, dev)
+        return lease
 
     def trace_ring(self):
         """The vtpu-trace per-process event ring (VTPU_TRACE=1), or
@@ -187,7 +199,7 @@ class _PyEnforcer:
         est = max(self._cost_ema.get(key, 5000.0), self.min_cost_us)
         if not self._gating_active():
             return -est
-        self.region.rate_block(dev, int(est), self.spec.task_priority)
+        self._lease(dev).acquire(est, self.spec.task_priority)
         return est
 
     def observe(self, key: int, est: float, actual_us: float,

@@ -7,7 +7,10 @@ forward-compat "skip unknown ops" clause turns a typo into data loss).
 This checker extracts:
 
   - **writers** — every ``{"op": "<literal>", ...}`` dict passed to a
-    journal ``append`` call anywhere in the runtime package;
+    journal ``append`` call anywhere in the runtime package, including
+    records accumulated into a local list that later feeds a journal
+    ``append_many`` batch (the metering loop's wake-batched EMA
+    samples);
   - **handlers** — every ``op == "<literal>"`` comparison inside
     ``_apply_record``;
 
@@ -72,16 +75,35 @@ def written_ops(src: str, rel: str) -> Dict[str, Tuple[str, int]]:
             op = dict_op(node.value)
             if op is not None:
                 named[node.targets[0].id] = op
+
+    def is_journal_call(node: ast.AST, attr: str) -> bool:
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute) or \
+                node.func.attr != attr:
+            return False
+        base_parts = [p.rstrip("[]()") for p in
+                      _chain(node.func.value).split(".")]
+        return any(b in JOURNAL_BASES for b in base_parts) or \
+            "pending_journal" in base_parts
+
+    # Lists whose contents feed a batched `journal.append_many(lst)`:
+    # every `lst.append({"op": ...})` is then a writer too.
+    many_lists: Set[str] = set()
+    for node in ast.walk(tree):
+        if is_journal_call(node, "append_many"):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    many_lists.add(arg.id)
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or \
                 not isinstance(node.func, ast.Attribute) or \
                 node.func.attr != "append":
             continue
-        base_parts = [p.rstrip("[]()") for p in
-                      _chain(node.func.value).split(".")]
-        if not any(b in JOURNAL_BASES for b in base_parts) and \
-                "pending_journal" not in base_parts:
-            continue
+        if not is_journal_call(node, "append"):
+            base = node.func.value
+            if not (isinstance(base, ast.Name) and
+                    base.id in many_lists):
+                continue
         for arg in node.args:
             op = dict_op(arg)
             if op is None and isinstance(arg, ast.Name):

@@ -62,6 +62,8 @@ CLASS_LOCKS: Dict[Tuple[str, str], str] = {
     ("FlightRecorder", "mu"): "flight.mu",
     ("Bridge", "_mu"): "bridge.mu",
     ("BridgedFunction", "_mu"): "bridge.fn_mu",
+    ("_BatchReply", "mu"): "batch.mu",
+    ("RateLease", "mu"): "lease.mu",
 }
 
 # Bare-name locks (module-level objects).
@@ -96,13 +98,15 @@ REGION_METHODS = {
 BLOCKING_ATTRS = {
     "sendall", "recv", "recv_into", "connect", "accept", "fsync",
     "sleep", "send_msg", "recv_msg", "check_call", "check_output",
-    "run", "Popen", "communicate",
+    "run", "Popen", "communicate", "send_frames", "recv_raw_into",
+    "recv_exact_into", "sendmsg", "rate_block",
 }
 # Journal write methods: file I/O under journal.mu — blocking AND an
 # arc to journal.mu.  Matched only when the receiver chain mentions the
 # journal (``self.journal.append`` / ``jr.append`` / ``journal.append``)
 # so list.append etc. never false-positive.
-JOURNAL_WRITE_ATTRS = {"append", "put_blob", "write_snapshot"}
+JOURNAL_WRITE_ATTRS = {"append", "append_many", "put_blob",
+                       "write_snapshot"}
 JOURNAL_BASES = ("journal", "jr")
 
 _COMMON_METHODS = {

@@ -159,6 +159,7 @@ ENV_FLAGS = {
     "VTPU_FAKE_CHIPS": ("daemon", False),
     "VTPU_FAKE_GENERATION": ("daemon", False),
     "VTPU_FAKE_FAULT_DIR": ("daemon", False),
+    "VTPU_INOTIFY": ("daemon", False),
     # Broker (runtime/server.py, journal.py, protocol.py).
     "VTPU_JOURNAL_DIR": ("broker", True),
     "VTPU_JOURNAL_FSYNC": ("broker", True),
@@ -171,9 +172,17 @@ ENV_FLAGS = {
     "VTPU_SPILL_RESIDENT_OVERSHOOT": ("broker", True),
     "VTPU_CLAIM_WATCHDOG_S": ("broker", True),
     "VTPU_COMPILE_CACHE_DIR": ("broker", True),
+    # Broker hot path (docs/PERF.md).
+    "VTPU_RATE_LEASE_US": ("broker", True),
+    "VTPU_RECV_POOL_MB": ("broker", True),
+    "VTPU_WAKE_BATCH": ("broker", False),
     # In-container shim / client / bridge / native interposer.
     "VTPU_TENANT": ("shim", False),
     "VTPU_RECONNECT_TIMEOUT_S": ("shim", False),
+    # Broker hot path, client side (docs/PERF.md).
+    "VTPU_EXEC_BATCH": ("shim", True),
+    "VTPU_RAW_FRAMES": ("shim", False),
+    "VTPU_NOGIL_ATOMICS": ("shim", False),
     "VTPU_BRIDGE": ("shim", False),
     "VTPU_BRIDGE_CONNECT_TIMEOUT": ("shim", False),
     "VTPU_EXTRA_PYTHONPATH": ("shim", False),
@@ -199,6 +208,7 @@ ENV_FLAGS = {
     "VTPU_BENCH_CHAIN": ("bench", False),
     "VTPU_BENCH_RESNET_CHAIN": ("bench", False),
     "VTPU_BENCH_CHIP_WAIT_S": ("bench", False),
+    "VTPU_BENCH_SETTLE_S": ("bench", False),
 }
 
 # Per-ordinal derived forms: VTPU_DEVICE_HBM_LIMIT_<i>.

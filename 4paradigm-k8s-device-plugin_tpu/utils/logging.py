@@ -26,11 +26,23 @@ _NAMES = {LEVEL_ERROR: "ERROR", LEVEL_WARN: "Warn",
           LEVEL_INFO: "Info", LEVEL_DEBUG: "Debug"}
 
 
-def current_level() -> int:
+# Cached level: the env read was measurably hot on the broker's
+# per-item paths (every filtered-out log.debug re-read the environ).
+# Tests that flip VTPU_LOG_LEVEL mid-process call refresh_level().
+_cached_level: int = -1
+
+
+def refresh_level() -> int:
+    global _cached_level
     try:
-        return int(os.environ.get(ENV_LOG_LEVEL, "1"))
+        _cached_level = int(os.environ.get(ENV_LOG_LEVEL, "1"))
     except ValueError:
-        return 1
+        _cached_level = 1
+    return _cached_level
+
+
+def current_level() -> int:
+    return _cached_level if _cached_level >= 0 else refresh_level()
 
 
 def log(level: int, msg: str, *args) -> None:

@@ -1,0 +1,475 @@
+"""Broker hot-path tests (ISSUE 5): EXEC_BATCH ordering + per-item
+error isolation, zero-copy raw PUT/GET byte-exactness (including
+> CHUNK_BYTES streaming), receive-pool reuse via STATS, rate-lease
+grant/burn/revoke/expiry + journal-replay reclamation, fairness under
+a leased noisy neighbor, and wire-level backward compat (old-protocol
+clients against the new broker)."""
+
+import socket as sk
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vtpu.runtime import protocol as P
+from vtpu.runtime.client import RuntimeClient
+from vtpu.runtime.server import make_server
+
+MB = 10**6
+
+
+def _spawn(tmp_path, name, **kw):
+    sock = str(tmp_path / f"{name}.sock")
+    kw.setdefault("hbm_limit", 64 * MB)
+    kw.setdefault("core_limit", 0)
+    srv = make_server(sock, region_path=str(tmp_path / f"{name}.shr"),
+                      **kw)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, sock
+
+
+@pytest.fixture()
+def broker(tmp_path):
+    srv, sock = _spawn(tmp_path, "fp")
+    yield srv, sock
+    srv.shutdown()
+    srv.server_close()
+
+
+def _admin(sock, msg):
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.settimeout(10.0)
+    try:
+        s.connect(sock + ".admin")
+        P.send_msg(s, msg)
+        return P.recv_msg(s)
+    finally:
+        s.close()
+
+
+def _bindfree_stats(sock):
+    """Raw bind-free STATS — the full reply incl. the pool counters."""
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.settimeout(10.0)
+    try:
+        s.connect(sock)
+        P.send_msg(s, {"kind": P.STATS})
+        return P.recv_msg(s)
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# EXEC_BATCH: coalescing, positional ordering, error isolation
+# ---------------------------------------------------------------------------
+
+def test_exec_batch_coalesces_and_keeps_order(broker, monkeypatch):
+    monkeypatch.setenv("VTPU_EXEC_BATCH", "8")
+    _, sock = broker
+    c = RuntimeClient(sock, tenant="batch")
+    assert c._batch_max == 8
+    exe = c.compile(lambda a: a + 1.0, [np.ones(16, np.float32)])
+    h = c.put(np.zeros(16, np.float32))
+    n = 20
+    for i in range(n):
+        c.execute_send_ids(exe.id, [h.id], [f"o{i}"])
+    # 20 items at batch_max=8: two full frames shipped, 4 still
+    # buffered client-side — nothing has been read off the wire yet.
+    assert len(c._pending_batch) == 4
+    assert c._wire_out == 16
+    for i in range(n):
+        outs = c.execute_recv()
+        # Positional reply order == send order, across batch frames.
+        assert outs[0].id == f"o{i}"
+    assert c._wire_out == 0 and not c._pending_batch
+    np.testing.assert_array_equal(c.get("o7"), np.ones(16, np.float32))
+    c.close()
+
+
+def test_exec_batch_sync_request_flushes_and_absorbs(broker,
+                                                     monkeypatch):
+    """A synchronous verb issued mid-batch must flush the buffered
+    items first (frame order == call order) and absorb their replies,
+    so the sync reply is never misattributed."""
+    monkeypatch.setenv("VTPU_EXEC_BATCH", "16")
+    _, sock = broker
+    c = RuntimeClient(sock, tenant="sync")
+    exe = c.compile(lambda a: a * 2.0, [np.ones(8, np.float32)])
+    h = c.put(np.full(8, 3.0, np.float32))
+    for i in range(5):
+        c.execute_send_ids(exe.id, [h.id], [f"s{i}"])
+    # stats() is synchronous: buffered executes flush, replies absorb.
+    st = c.stats()
+    assert st["sync"]["used_bytes"] > 0
+    # The absorbed results are still served, in order.
+    for i in range(5):
+        assert c.execute_recv()[0].id == f"s{i}"
+    np.testing.assert_array_equal(c.get("s4"),
+                                  np.full(8, 6.0, np.float32))
+    c.close()
+
+
+def test_exec_batch_error_isolation(broker, monkeypatch):
+    """A failed item (unknown executable) fails ITS positional slot
+    only — batch-mates before and after it run normally."""
+    monkeypatch.setenv("VTPU_EXEC_BATCH", "8")
+    _, sock = broker
+    c = RuntimeClient(sock, tenant="iso")
+    exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+    h = c.put(np.zeros(4, np.float32))
+    c.execute_send_ids(exe.id, [h.id], ["g0"])
+    c.execute_send_ids("no-such-exe", [h.id], ["bad"])
+    c.execute_send_ids(exe.id, [h.id], ["g1"])
+    assert c.execute_recv()[0].id == "g0"
+    with pytest.raises(RuntimeError) as ei:
+        c.execute_recv()
+    assert "NOT_FOUND" in str(ei.value)
+    assert c.execute_recv()[0].id == "g1"
+    np.testing.assert_array_equal(c.get("g1"), np.ones(4, np.float32))
+    # The failed slot registered no output.
+    with pytest.raises(RuntimeError):
+        c.get("bad")
+    c.close()
+
+
+def test_batch_of_one_stays_legacy_execute(broker, monkeypatch):
+    """A single buffered item ships as the legacy EXECUTE verb —
+    protocol-identical to a pre-batching client on the wire."""
+    monkeypatch.setenv("VTPU_EXEC_BATCH", "8")
+    _, sock = broker
+    c = RuntimeClient(sock, tenant="one")
+    exe = c.compile(lambda a: a - 1.0, [np.ones(4, np.float32)])
+    h = c.put(np.ones(4, np.float32))
+    c.execute_send_ids(exe.id, [h.id], ["only"])
+    assert c.execute_recv()[0].id == "only"
+    np.testing.assert_array_equal(c.get("only"),
+                                  np.zeros(4, np.float32))
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy raw framing: byte-exactness, chunked streaming, pool
+# ---------------------------------------------------------------------------
+
+def test_raw_put_get_byte_exact(broker):
+    _, sock = broker
+    c = RuntimeClient(sock, tenant="raw")
+    assert c._raw  # shipped default
+    rng = np.random.default_rng(7)
+    cases = [
+        rng.random(1, dtype=np.float32).reshape(()),      # 0-d
+        rng.integers(-128, 127, 1001).astype(np.int8),    # odd bytes
+        rng.integers(0, 2**31 - 1, (37, 53)).astype(np.int32),
+        (rng.random((64, 32)).astype(np.float32)).T,      # non-contig
+    ]
+    for i, x in enumerate(cases):
+        h = c.put(x, f"r{i}")
+        got = c.get(f"r{i}")
+        assert got.dtype == x.dtype and got.shape == x.shape
+        np.testing.assert_array_equal(got, np.asarray(x))
+        h.delete()
+    c.close()
+
+
+def test_raw_put_get_streams_over_chunk_bytes(broker, monkeypatch):
+    """Payloads larger than CHUNK_BYTES split into multiple raw frames
+    on both directions and still round-trip bit-for-bit."""
+    monkeypatch.setattr(P, "CHUNK_BYTES", 64 * 1024)
+    _, sock = broker
+    c = RuntimeClient(sock, tenant="big")
+    x = np.random.default_rng(11).random(300_000).astype(np.float32)
+    assert x.nbytes > 10 * P.CHUNK_BYTES
+    assert P.raw_part_count(x.nbytes) == -(-x.nbytes // P.CHUNK_BYTES)
+    c.put(x, "big")
+    np.testing.assert_array_equal(c.get("big"), x)
+    c.close()
+
+
+def test_recv_pool_reuse_via_stats(broker):
+    """Steady-state raw PUTs reuse the pooled receive buffer; the
+    counters ride the bind-free STATS reply."""
+    _, sock = broker
+    c = RuntimeClient(sock, tenant="pool")
+    x = np.ones(2 * MB // 4, np.float32)
+    for i in range(4):
+        c.put(x, "buf")  # replacement PUTs, same size
+    pool = _bindfree_stats(sock)["pool"]
+    assert pool["misses"] >= 1
+    assert pool["hits"] >= 2, pool
+    assert pool["bytes_reused"] >= 2 * x.nbytes
+    c.close()
+
+
+def test_legacy_framing_toggle_still_works(broker, monkeypatch):
+    """VTPU_RAW_FRAMES=0 restores the msgpack-bin framing end to end
+    (the A/B switch the bench baseline mode uses)."""
+    monkeypatch.setenv("VTPU_RAW_FRAMES", "0")
+    monkeypatch.setattr(P, "CHUNK_BYTES", 64 * 1024)
+    _, sock = broker
+    c = RuntimeClient(sock, tenant="legacy")
+    assert not c._raw
+    x = np.random.default_rng(3).random(100_000).astype(np.float32)
+    c.put(x, "leg")  # > CHUNK_BYTES: exercises PUT_PART staging
+    np.testing.assert_array_equal(c.get("leg"), x)  # chunked GET parts
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# Rate leases: grant / burn / revoke / expiry / replay reclamation
+# ---------------------------------------------------------------------------
+
+def _metered(tmp_path, name, **kw):
+    kw.setdefault("core_limit", 50)
+    kw.setdefault("min_exec_cost_us", 1000)
+    return _spawn(tmp_path, name, **kw)
+
+
+def test_lease_grant_piggyback_and_local_burn(tmp_path):
+    srv, sock = _metered(tmp_path, "lease")
+    try:
+        assert srv.state.rate_lease_us > 0  # shipped default
+        c = RuntimeClient(sock, tenant="lt")
+        exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+        h = c.put(np.ones(4, np.float32))
+        for _ in range(30):
+            exe(h)
+        t = srv.state.tenants["lt"]
+        assert t.lease_grants >= 1
+        # The grant piggybacked on a reply and mirrors client-side.
+        assert c.lease_remaining_us() > 0
+        before = c.lease_remaining_us()
+        assert c.burn_lease(before / 2)
+        assert c.lease_remaining_us() < before
+        # Server STATS exposes the lease fields.
+        st = c.stats()["lt"]
+        assert st["lease_grants"] >= 1 and "lease_us" in st
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_lease_revoked_on_suspend(tmp_path):
+    """SUSPEND reclaims the unburned lease broker-side and flags the
+    revoke on the next reply, zeroing the client mirror."""
+    srv, sock = _metered(tmp_path, "revoke")
+    try:
+        c = RuntimeClient(sock, tenant="rv")
+        exe = c.compile(lambda a: a * 2.0, [np.ones(4, np.float32)])
+        h = c.put(np.ones(4, np.float32))
+        for _ in range(20):
+            exe(h)
+        t = srv.state.tenants["rv"]
+        assert t.lease_grants >= 1
+        assert _admin(sock, {"kind": P.SUSPEND, "tenant": "rv"})["ok"]
+        assert t.lease_us == 0.0 and t.lease_revoked
+        assert _admin(sock, {"kind": P.RESUME, "tenant": "rv"})["ok"]
+        # A reply that goes out WITHOUT a fresh dispatch re-grant still
+        # carries the one-shot revoke flag (an all-prefail batch is
+        # answered straight from the session thread); a dispatched
+        # execute would supersede the revoke with its new grant — also
+        # correct, but it is the flag path under test here.
+        c.execute_send_ids("nope-a", [h.id], ["xa"])
+        c.execute_send_ids("nope-b", [h.id], ["xb"])
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                c.execute_recv()
+        assert c.lease_revocations >= 1
+        assert c.lease_remaining_us() == 0.0
+        exe(h)  # and the next real execute re-grants
+        assert c.lease_remaining_us() > 0
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_lease_expiry_refunds_and_regrants(tmp_path):
+    srv, sock = _metered(tmp_path, "expire")
+    try:
+        srv.state.rate_lease_ttl_s = 0.05
+        c = RuntimeClient(sock, tenant="ex")
+        exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+        h = c.put(np.ones(4, np.float32))
+        for _ in range(10):
+            exe(h)
+        t = srv.state.tenants["ex"]
+        g1 = t.lease_grants
+        assert g1 >= 1
+        time.sleep(0.2)  # past TTL: the next admit refunds + regrants
+        for _ in range(10):
+            exe(h)
+        assert t.lease_grants > g1
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_lease_reclaimed_on_tenant_release(tmp_path):
+    srv, sock = _metered(tmp_path, "release")
+    try:
+        c = RuntimeClient(sock, tenant="rl")
+        exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+        h = c.put(np.ones(4, np.float32))
+        for _ in range(20):
+            exe(h)
+        assert srv.state.tenants["rl"].lease_grants >= 1
+        c.close()
+        deadline = time.monotonic() + 5.0
+        while "rl" in srv.state.tenants and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert "rl" not in srv.state.tenants
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_lease_not_restored_by_journal_replay(tmp_path):
+    """A recovered tenant starts with ZERO lease: the pre-crash lease's
+    debit died with the old broker's bucket, so replaying it would hand
+    the tenant un-debited device time."""
+    jdir = str(tmp_path / "journal")
+    srv, sock = _metered(tmp_path, "jr", hbm_limit=8 * MB,
+                         journal_dir=jdir)
+    c = RuntimeClient(sock, tenant="crashy")
+    exe = c.compile(lambda a: a + 1.0, [np.ones(4, np.float32)])
+    h = c.put(np.ones(4, np.float32))
+    for _ in range(20):
+        exe(h)
+    t = srv.state.tenants["crashy"]
+    assert t.lease_grants >= 1
+    # In-process 'kill -9': stop serving and detach the journal BEFORE
+    # close, so graceful teardown cannot write the close records.
+    srv.shutdown()
+    srv.server_close()
+    if srv.state.journal is not None:
+        srv.state.journal.close()
+        srv.state.journal = None
+    c.close()
+
+    srv2, _ = _metered(tmp_path, "jr2", hbm_limit=8 * MB,
+                       journal_dir=jdir)
+    try:
+        assert "crashy" in srv2.state.recovered, \
+            "journal replay lost the tenant"
+        t2, _deadline = srv2.state.recovered["crashy"]
+        assert t2.lease_us == 0.0 and t2.lease_exp == 0.0
+        assert not t2.lease_revoked
+    finally:
+        srv2.shutdown()
+        srv2.server_close()
+
+
+def test_leased_noisy_neighbor_still_throttled(tmp_path, monkeypatch):
+    """Fairness invariant: leases amortize round trips but are debited
+    from the same token bucket — a noisy neighbor pipelining batched
+    executes under a 25% grant still pays full price, and a co-tenant
+    is not starved."""
+    monkeypatch.setenv("VTPU_EXEC_BATCH", "16")
+    srv, sock = _spawn(tmp_path, "fair", hbm_limit=0, core_limit=25,
+                       min_exec_cost_us=10_000, work_conserving=False)
+    try:
+        noisy = RuntimeClient(sock, tenant="noisy")
+        quiet = RuntimeClient(sock, tenant="quiet")
+        exe_n = noisy.compile(lambda a: a + 1.0,
+                              [np.ones(4, np.float32)])
+        exe_q = quiet.compile(lambda a: a * 2.0,
+                              [np.ones(4, np.float32)])
+        hn = noisy.put(np.ones(4, np.float32))
+        hq = quiet.put(np.ones(4, np.float32))
+        for _ in range(50):   # drain the 400 ms burst at 10 ms/charge
+            exe_n(hn)
+        # 40 batched executes x 10 ms at 25% -> >= ~1.2 s of bucket
+        # time even though every item rides a lease.
+        t0 = time.monotonic()
+        for i in range(40):
+            noisy.execute_send_ids(exe_n.id, [hn.id], [f"n{i}"])
+        done = threading.Event()
+
+        def drain_noisy():
+            for _ in range(40):
+                noisy.execute_recv()
+            done.set()
+
+        th = threading.Thread(target=drain_noisy, daemon=True)
+        th.start()
+        # The quiet tenant keeps making progress while the noisy one
+        # is bucket-bound.
+        for _ in range(5):
+            exe_q(hq)
+        assert not done.is_set(), \
+            "noisy neighbor finished 400ms of charged work instantly"
+        th.join(timeout=30)
+        assert done.is_set()
+        elapsed = time.monotonic() - t0
+        assert elapsed > 0.8, f"lease bypassed the bucket: {elapsed:.3f}"
+        noisy.close()
+        quiet.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Backward compat: old-protocol clients against the new broker
+# ---------------------------------------------------------------------------
+
+def test_flags_off_client_full_surface(broker, monkeypatch):
+    """A client pinned to the pre-overhaul protocol (no EXEC_BATCH, no
+    raw frames — what an old shim speaks) exercises the whole tenant
+    surface against the new broker."""
+    monkeypatch.setenv("VTPU_EXEC_BATCH", "1")
+    monkeypatch.setenv("VTPU_RAW_FRAMES", "0")
+    _, sock = broker
+    c = RuntimeClient(sock, tenant="old")
+    assert c._batch_max <= 1 and not c._raw
+    x = np.random.default_rng(5).random((32, 8)).astype(np.float32)
+    h = c.put(x)
+    np.testing.assert_array_equal(h.fetch(), x)
+    f = c.remote_jit(lambda a: a.sum(axis=1))
+    np.testing.assert_allclose(f(x), x.sum(axis=1), rtol=1e-6)
+    # Pipelined legacy executes still answer frame-per-item.
+    exe = c.compile(lambda a: a + 1.0, [x])
+    for i in range(4):
+        c.execute_send_ids(exe.id, [h.id], [f"p{i}"])
+    for i in range(4):
+        assert c.execute_recv()[0].id == f"p{i}"
+    assert c.stats()["old"]["used_bytes"] > 0
+    h.delete()
+    c.close()
+
+
+def test_old_wire_protocol_raw_socket(broker):
+    """Wire-level pin: a hand-rolled legacy session (msgpack bin PUT,
+    field-free GET) must keep working byte-for-byte — no new fields
+    required, no raw frames injected into its stream."""
+    _, sock = broker
+    s = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    s.settimeout(10.0)
+    try:
+        s.connect(sock)
+        P.send_msg(s, {"kind": P.HELLO, "tenant": "wire",
+                       "priority": 1})
+        r = P.recv_msg(s)
+        assert r["ok"], r
+        x = np.arange(24, dtype=np.float32)
+        P.send_msg(s, {"kind": P.PUT, "id": "w0",
+                       "shape": list(x.shape), "dtype": "float32",
+                       "data": x.tobytes()})
+        r = P.recv_msg(s)
+        assert r["ok"] and r["nbytes"] == x.nbytes, r
+        P.send_msg(s, {"kind": P.GET, "id": "w0"})
+        r = P.recv_msg(s)
+        assert r["ok"] and "data" in r, \
+            f"legacy GET must answer inline bin, got {sorted(r)}"
+        got = np.frombuffer(r["data"], np.float32).reshape(r["shape"])
+        np.testing.assert_array_equal(got, x)
+        P.send_msg(s, {"kind": P.DELETE, "id": "w0"})
+        assert P.recv_msg(s)["ok"]
+        P.send_msg(s, {"kind": P.STATS})
+        r = P.recv_msg(s)
+        assert r["ok"] and "wire" in r["tenants"]
+    finally:
+        s.close()

@@ -194,3 +194,64 @@ def test_entrypoint_no_preload_lib_no_list(tmp_path):
         env=env, capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
     assert not (host / "ld.so.preload").exists()
+
+
+# -- FsWatcher: inotify fast path + poll fallback (ISSUE 5 satellite) --
+
+
+def _watch_roundtrip(tmp_path, name):
+    from vtpu.plugin.watchers import FsWatcher
+    p = str(tmp_path / f"{name}.sock")
+    w = FsWatcher(p, interval=0.2).start()
+    try:
+        open(p, "w").close()
+        assert w.events.get(timeout=3).op == "create"
+        os.unlink(p)
+        assert w.events.get(timeout=3).op == "delete"
+        # unlink+recreate (the kubelet-restart shape) must surface a
+        # create again — whether or not the delete was also seen.
+        open(p, "w").close()
+        deadline = time.monotonic() + 3
+        ops = []
+        while time.monotonic() < deadline:
+            try:
+                ops.append(w.events.get(timeout=0.3).op)
+            except Exception:  # noqa: BLE001 - queue.Empty
+                pass
+            if "create" in ops:
+                break
+        assert "create" in ops, ops
+    finally:
+        w.stop()
+    return w
+
+
+def test_fswatcher_inotify_backend(tmp_path):
+    w = _watch_roundtrip(tmp_path, "ino")
+    assert w.backend == "inotify", \
+        "Linux CI must exercise the inotify fast path"
+
+
+def test_fswatcher_poll_fallback(tmp_path, monkeypatch):
+    monkeypatch.setenv("VTPU_INOTIFY", "0")
+    w = _watch_roundtrip(tmp_path, "poll")
+    assert w.backend == "poll"
+
+
+def test_fswatcher_inotify_latency_beats_poll_interval(tmp_path):
+    """The point of the satellite: re-register latency is no longer
+    bounded below by the 1 s poll interval."""
+    from vtpu.plugin.watchers import FsWatcher
+    p = str(tmp_path / "fast.sock")
+    w = FsWatcher(p, interval=5.0).start()  # poll would take ~5 s
+    try:
+        if w.backend != "inotify":
+            pytest.skip("no inotify on this host")
+        t0 = time.monotonic()
+        open(p, "w").close()
+        ev = w.events.get(timeout=2.0)
+        lat = time.monotonic() - t0
+        assert ev.op == "create"
+        assert lat < 1.0, f"inotify latency {lat:.3f}s"
+    finally:
+        w.stop()

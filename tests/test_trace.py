@@ -445,26 +445,77 @@ def test_bench_gate_fails_fast_naming_live_holder(tmp_path, monkeypatch):
     assert "wedged co-claimer" in msg
 
 
-def test_bench_gate_keeps_waiting_on_stale_holder(tmp_path, monkeypatch):
-    """A DEAD holder's lease can still settle: the gate keeps probing
-    (bounded by max_wait_s) and the final error carries the
-    diagnosis."""
+def test_bench_gate_takes_over_stale_lease(tmp_path, monkeypatch):
+    """A DEAD holder's sidecar is taken over (ISSUE 5 satellite: the
+    BENCH_r06 fix) and the settle wait is bounded by
+    VTPU_BENCH_SETTLE_S — not the full 900 s budget.  The takeover
+    record names both this process and the corpse."""
     import sys
     sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
     import bench
     path = str(tmp_path / "lease.json")
     monkeypatch.setenv("VTPU_LEASE_SIDECAR", path)
+    monkeypatch.setenv("VTPU_BENCH_SETTLE_S", "0")
     tracing.write_lease_sidecar("dead claimer")
     rec = json.load(open(path))
-    rec["pid"] = 2 ** 22 + 54321
+    dead_pid = 2 ** 22 + 54321
+    rec["pid"] = dead_pid
     json.dump(rec, open(path, "w"))
     monkeypatch.setattr(bench, "_CHIP_PROBE",
                         "raise SystemExit('claim blocked')")
     monkeypatch.setattr(time, "sleep", lambda s: None)
+    t0 = time.monotonic()
     with pytest.raises(RuntimeError) as ei:
-        bench.wait_chip_claimable(max_wait_s=0.0)
-    msg = str(ei.value)
-    assert "DEAD" in msg and "dead claimer" in msg
+        bench.wait_chip_claimable(max_wait_s=900.0)
+    assert time.monotonic() - t0 < 60, "takeover must not burn budget"
+    assert "settle" in str(ei.value)
+    # The sidecar now names this process, corpse on the audit trail.
+    rec = json.load(open(path))
+    assert rec["pid"] == os.getpid()
+    assert rec["took_over_pid"] == dead_pid
+    assert rec["stage"] == "bench stale-lease takeover"
+
+
+def test_bench_gate_proceeds_after_takeover_settles(tmp_path,
+                                                    monkeypatch):
+    """The success path: once the dead holder's lease settles, the
+    gate RETURNS (the run proceeds) instead of raising."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+    import bench
+    path = str(tmp_path / "lease.json")
+    marker = str(tmp_path / "second_try")
+    monkeypatch.setenv("VTPU_LEASE_SIDECAR", path)
+    tracing.write_lease_sidecar("dead claimer")
+    rec = json.load(open(path))
+    rec["pid"] = 2 ** 22 + 54321
+    json.dump(rec, open(path, "w"))
+    # First probe fails (lease not yet settled), later probes succeed.
+    monkeypatch.setattr(bench, "_CHIP_PROBE", (
+        "import os, sys\n"
+        f"m = {marker!r}\n"
+        "if os.path.exists(m):\n"
+        "    print('CHIP_CLAIMABLE')\n"
+        "else:\n"
+        "    open(m, 'w').close()\n"
+        "    raise SystemExit('claim blocked')\n"))
+    monkeypatch.setattr(time, "sleep", lambda s: None)
+    bench.wait_chip_claimable(max_wait_s=900.0)  # must not raise
+    rec = json.load(open(path))
+    assert rec["pid"] == os.getpid()  # takeover happened on the way
+
+
+def test_takeover_refuses_live_fresh_holder(tmp_path, monkeypatch):
+    """takeover_lease_sidecar never touches a live holder inside the
+    heartbeat window."""
+    path = str(tmp_path / "lease.json")
+    monkeypatch.setenv("VTPU_LEASE_SIDECAR", path)
+    tracing.write_lease_sidecar("live co-claimer")
+    rec = json.load(open(path))
+    rec["pid"] = 1  # alive, fresh heartbeat (just written)
+    json.dump(rec, open(path, "w"))
+    assert tracing.takeover_lease_sidecar(path) is False
+    assert json.load(open(path))["pid"] == 1
 
 
 # -- claim watchdog journal record ---------------------------------------
