@@ -30,6 +30,15 @@ bad line anywhere else, a CRC mismatch, or an unreadable snapshot raises
 a fresh epoch (fail closed: no guessed quota state), which clients see
 as today's typed ``VtpuStateLost``.
 
+This contract is machine-checked, not example-tested: the vtpu-mc
+crash-cut engine (``python -m vtpu.tools.mc --engine crash``;
+docs/ANALYSIS.md "Model checking") truncates a recorded session's log
+at EVERY record boundary and mid-record, replays recovery through the
+real paths, and asserts replay determinism, independent-interpreter
+ground truth, resume consistency, re-resume idempotence, torn-tail
+drop and fail-closed corruption — with seeded-violation tests proving
+each checker bites (tests/test_mc.py).
+
 Durability note: ``flush()`` survives process death (the page cache
 holds the bytes); it does NOT survive machine death.  Set
 ``VTPU_JOURNAL_FSYNC=1`` to fsync every append when the journal dir is

@@ -161,6 +161,65 @@ ADMIN_VERBS = (STATS, TRACE, SUSPEND, RESUME, SHUTDOWN, DRAIN, HANDOVER)
 # so a read-only probe can never wedge a chip claim (ADVICE r5 #2).
 BIND_FREE_VERBS = (STATS, TRACE)
 
+# ---------------------------------------------------------------------------
+# Wire-field registry — the machine-checked request-HEADER contract.
+#
+# For every verb: ``required`` fields (a missing one is a malformed
+# frame, so a serving-side subscript read is correct) and ``optional``
+# fields added after the verb first shipped.  Old clients never send
+# the optional ones, so the serving side MUST read them with a
+# legacy-default branch (``msg.get(...)``) — a subscript read of an
+# optional field crashes every pre-upgrade client's session, silently,
+# on the first frame.  `vtpu-smi analyze`
+# (vtpu.tools.analyze.wirefields) proves both directions: every field
+# the broker reads is registered with the matching style, and every
+# registered field is actually read.  Adding an optional header field
+# without registering it here (and .get-reading it there) fails CI.
+#
+# EXECUTE item bodies (the per-item dict of EXEC_BATCH ``items`` and
+# the EXECUTE frame itself) share one shape, registered under EXECUTE.
+# ---------------------------------------------------------------------------
+
+WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
+    HELLO: {
+        "required": ("tenant",),
+        "optional": ("priority", "device", "devices", "hbm_limit",
+                     "hbm_limits", "core_limit", "oversubscribe",
+                     "spill_overshoot", "pid", "pidns", "resume_epoch",
+                     "trace"),
+    },
+    PUT_PART: {"required": ("id", "data"), "optional": ("trace",)},
+    PUT: {
+        # ``data`` is required by the LEGACY framing (its branch may
+        # subscript); ``nbytes`` is required whenever ``raw_parts``
+        # announced raw frames.
+        "required": ("id", "shape", "dtype", "data", "nbytes"),
+        "optional": ("staged", "raw_parts", "trace"),
+    },
+    GET: {"required": ("id",), "optional": ("raw", "trace")},
+    DELETE: {"required": ("id",), "optional": ("ids", "trace")},
+    COMPILE: {"required": ("id", "exported"), "optional": ("trace",)},
+    EXECUTE: {
+        "required": ("exe", "args"),
+        "optional": ("outs", "repeats", "carry", "free", "trace"),
+    },
+    EXEC_BATCH: {"required": (), "optional": ("items", "trace")},
+    STATS: {"required": (), "optional": ("trace",)},
+    TRACE: {"required": (), "optional": ("tenant", "limit", "trace")},
+    SUSPEND: {"required": ("tenant",), "optional": ()},
+    RESUME: {"required": ("tenant",), "optional": ()},
+    SHUTDOWN: {"required": (), "optional": ()},
+    DRAIN: {"required": (), "optional": ("timeout",)},
+    HANDOVER: {"required": (), "optional": ("timeout",)},
+}
+
+# Optional REPLY fields newer brokers piggyback on existing replies
+# (the client side of the same contract): each must be absorbed with a
+# legacy-default ``.get`` in runtime/client.py — an old broker's reply
+# simply lacks them.  ``lease``: the client-side rate-lease grant/
+# revoke rider on execute/EXEC_BATCH replies (docs/PERF.md).
+REPLY_OPTIONAL_FIELDS = ("lease",)
+
 
 class ProtocolError(RuntimeError):
     pass

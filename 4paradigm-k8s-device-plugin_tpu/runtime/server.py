@@ -1699,6 +1699,13 @@ class RuntimeState:
                 log.info("journal: dropping tenant %r (client pid %s "
                          "is dead)", name, pid)
                 continue
+            # Ledger bytes re-applied so far for THIS tenant: a replay
+            # failure below must hand them back before dropping the
+            # tenant, or the slot leaks quota until the next broker
+            # restart (reset_slot recycles the bucket, never the HBM
+            # ledger) — found by vtpu-analyze excsafety + the mc crash
+            # engine's resume-consistency invariant.
+            applied: List[Tuple[ChipState, int, int]] = []
             try:
                 devices = [int(d) for d in rec.get("devices") or [0]]
                 slots = [int(s) for s in rec.get("slots") or []]
@@ -1738,6 +1745,7 @@ class RuntimeState:
                     for pos, nb in charges:
                         chips[pos].region.mem_acquire(slots[pos], nb,
                                                       True)
+                        applied.append((chips[pos], slots[pos], nb))
                     t.charges[aid] = charges
                     t.nbytes[aid] = (0 if am.get("spilled")
                                      else int(am.get("nbytes", 0)))
@@ -1745,6 +1753,11 @@ class RuntimeState:
             except Exception as e:  # noqa: BLE001 - skip, don't refuse boot
                 log.warn("journal: cannot recover tenant %r (%s); "
                          "dropping it", name, e)
+                # Release the partially re-applied ledger: the dropped
+                # tenant's books die here, so every byte it charged
+                # must come back or the slot leaks quota.
+                for chip, slot, nb in applied:
+                    chip.region.mem_release(slot, nb)
                 self.recovery["tenants_dropped_dead"] += 1
                 continue
             self.recovered[name] = (t, now + self.resume_grace)

@@ -19,6 +19,13 @@ has grown hand-maintained contracts that generic linters cannot see:
   - **journal** — every record type the broker writes must have a
     replay handler in ``runtime/journal.py`` recovery (and vice versa:
     no dead replay arms).
+  - **excsafety** — every region/ledger/bucket acquire in ``runtime/``
+    and ``shim/`` must settle on all exception paths: released in the
+    handler/finally, or durably owned before any risky call.
+  - **wirefields** — every OPTIONAL wire field a newer client may send
+    is registered in ``protocol.py``'s ``WIRE_FIELDS`` and read with a
+    legacy-default ``.get`` on the serving side; an unregistered
+    optional read (or a subscript read of a registered one) fails CI.
 
 Run as ``python -m vtpu.tools.analyze`` or ``vtpu-smi analyze``; CI runs
 it in the ``analyze`` job and fails on any finding.  There is NO
@@ -48,7 +55,7 @@ PKG_NAME = os.path.basename(PKG_DIR)
 
 @dataclass(frozen=True)
 class Finding:
-    checker: str   # locks | verbs | envflags | journal
+    checker: str   # locks | verbs | envflags | journal | excsafety | wirefields
     path: str      # repo-relative
     line: int
     message: str
@@ -69,10 +76,12 @@ def read_text(root: str, relpath: str) -> Optional[str]:
 
 
 def run_all(root: Optional[str] = None) -> List[Finding]:
-    from . import envflags, journal_schema, locks, verbs
+    from . import (envflags, excsafety, journal_schema, locks, verbs,
+                   wirefields)
     root = root or REPO_ROOT
     out: List[Finding] = []
-    for mod in (locks, verbs, envflags, journal_schema):
+    for mod in (locks, verbs, envflags, journal_schema, excsafety,
+                wirefields):
         out.extend(mod.check(root))
     return out
 

@@ -1,0 +1,386 @@
+"""Exception-safety checker for region/ledger/bucket acquires.
+
+Every acquire against the shared accounting region — HBM ledger
+(``mem_acquire``/``mem_acquire_capped``/``charge_array``) or token
+bucket (``rate_acquire``/``rate_acquire_all``) — creates a debt that
+MUST be settled on every exception path: either released
+(``mem_release``/``rate_adjust``/``release_array``/...), or durably
+recorded against an owner whose teardown releases it (an ownership
+store into the tenant's ledger books: ``t.arrays[...] = ``,
+``t.charges[...] = ``, ...).  A path that raises between the acquire
+and either settlement leaks quota forever — the bug class behind
+"released tenant still holds HBM" incidents, and exactly what the mc
+interleaving engine's hbm-ledger/token-conservation invariants detect
+dynamically.  This checker proves it statically, on all paths:
+
+  - **swallowed-handler rule**: an acquire inside a ``try`` whose
+    handler catches-and-continues (no ``raise`` in the handler body)
+    must be released in that handler (or ``finally``) — directly or
+    via a call to a function that releases (one summary fixpoint).
+    An ownership store reached from the acquire through only-safe
+    statements also settles it, UNLESS the handler ``continue``s (the
+    owner is being discarded — its books die with it, the release
+    duty stays with the handler).
+  - **unprotected-risk rule**: an acquire NOT inside any ``try``,
+    followed in the same function by a risky call (device transfer,
+    journal/file/socket I/O, compile) before any release or ownership
+    store, is a finding — the risky call's exception unwinds past the
+    un-settled debt.
+
+Failure branches guarded by the acquire's own result (``admitted =
+region.mem_acquire(...); if not admitted: raise``) are exempt: a
+refused acquire charges nothing.
+
+Like every vtpu-analyze checker this is TUNED to the repo's idioms
+(the tables below are part of the contract): new acquire/release
+spellings must be added here, and an unclassifiable pattern is a
+finding, not a pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, read_text, PKG_NAME
+
+ANALYZED = [
+    f"{PKG_NAME}/runtime/server.py",
+    f"{PKG_NAME}/runtime/client.py",
+    f"{PKG_NAME}/runtime/journal.py",
+    f"{PKG_NAME}/runtime/trace.py",
+    f"{PKG_NAME}/shim/bridge.py",
+    f"{PKG_NAME}/shim/core.py",
+    f"{PKG_NAME}/shim/pyshim.py",
+    f"{PKG_NAME}/shim/sitecustomize.py",
+]
+
+# Acquire family: calls that create region/ledger/bucket debt.  The
+# native ctypes trampolines (_c_*) count as their Python spellings.
+ACQUIRES = ("mem_acquire", "mem_acquire_capped", "rate_acquire",
+            "rate_acquire_all", "charge_array")
+# Release family: calls that settle it.
+RELEASES = ("mem_release", "release_array", "rate_adjust",
+            "rate_adjust_all", "lease_release", "drop_staged",
+            "evict_staged_for", "busy_add")
+# Ownership stores: subscript assignment into a ledger book whose
+# owner's teardown path releases the debt.
+OWNER_BOOKS = ("arrays", "charges", "staged", "host_arrays",
+               "staged_bytes", "nbytes", "blob_meta")
+# Risky calls: operations that raise in practice (device transfer,
+# (de)serialization, journal/file/socket I/O, XLA compile).
+RISKY_ATTRS = ("device_put", "block_until_ready", "put_blob",
+               "append_many", "write_snapshot", "frombuffer", "asarray",
+               "ascontiguousarray", "chain_fn", "tenant_program",
+               "cached_blob", "send_msg", "send_frames", "sendall",
+               "sendmsg", "recv", "recv_into", "recv_raw_into",
+               "fsync", "deserialize", "compile", "lower")
+# Calls considered incapable of raising in these code paths — the
+# safe-walk between an acquire and its ownership store may cross them.
+SAFE_ATTRS = ("get", "pop", "items", "values", "keys", "append",
+              "add", "discard", "update", "setdefault", "move_to_end",
+              "hexdigest", "sha256", "put_cache_get", "device_stats",
+              "mem_info", "rate_level", "debug", "info", "warn",
+              "error", "monotonic", "time", "acquire", "release",
+              "notify", "notify_all", "clear", "copy", "encode",
+              "decode", "join", "startswith", "endswith", "reshape",
+              "toreadonly", "cast")
+SAFE_NAMES = ("int", "str", "float", "bool", "len", "list", "dict",
+              "tuple", "set", "max", "min", "abs", "isinstance",
+              "memoryview", "bytes", "bytearray", "sorted", "zip",
+              "range", "enumerate", "id", "repr", "getattr", "hasattr",
+              "print")
+
+
+def _attr_of(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        a = call.func.attr
+        return a[3:] if a.startswith("_c_") else a
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _is_acquire(call: ast.Call) -> bool:
+    return _attr_of(call) in ACQUIRES
+
+
+def _is_release(call: ast.Call) -> bool:
+    return _attr_of(call) in RELEASES
+
+
+def _is_journal_append(call: ast.Call) -> bool:
+    """``jr.append(...)`` / ``journal.append(...)`` is file I/O (the
+    generic list ``.append`` is safe)."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "append"):
+        return False
+    base = call.func.value
+    parts: List[str] = []
+    while isinstance(base, ast.Attribute):
+        parts.append(base.attr)
+        base = base.value
+    if isinstance(base, ast.Name):
+        parts.append(base.id)
+    return any(p in ("journal", "jr") for p in parts)
+
+
+def _is_risky(call: ast.Call) -> bool:
+    return _attr_of(call) in RISKY_ATTRS or _is_journal_append(call)
+
+
+def _release_summaries(tree: ast.Module) -> Set[str]:
+    """Function names that (transitively, one fixpoint) perform a
+    release-family call — a handler calling one of these settles the
+    debt."""
+    bodies: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bodies[node.name] = node
+    releasing: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in bodies.items():
+            if name in releasing:
+                continue
+            for call in (n for n in ast.walk(fn)
+                         if isinstance(n, ast.Call)):
+                callee = _attr_of(call)
+                if _is_release(call) or callee in releasing:
+                    releasing.add(name)
+                    changed = True
+                    break
+    return releasing
+
+
+def _body_releases(stmts: List[ast.stmt], releasing_fns: Set[str]
+                   ) -> bool:
+    for node in (n for s in stmts for n in ast.walk(s)):
+        if isinstance(node, ast.Call) and (
+                _is_release(node) or _attr_of(node) in releasing_fns):
+            return True
+    return False
+
+
+def _body_raises(stmts: List[ast.stmt]) -> bool:
+    return any(isinstance(n, ast.Raise)
+               for s in stmts for n in ast.walk(s))
+
+
+def _body_continues(stmts: List[ast.stmt]) -> bool:
+    return any(isinstance(n, ast.Continue)
+               for s in stmts for n in ast.walk(s))
+
+
+def _acquire_result_name(stmt: ast.stmt, call: ast.Call
+                         ) -> Optional[str]:
+    """``admitted = ...mem_acquire(...)`` -> "admitted"."""
+    if isinstance(stmt, ast.Assign) and stmt.value is call and \
+            len(stmt.targets) == 1 and \
+            isinstance(stmt.targets[0], ast.Name):
+        return stmt.targets[0].id
+    return None
+
+
+def _guarded_by(node: ast.stmt, name: Optional[str]) -> bool:
+    """Is ``node`` an ``if`` whose test references the acquire's
+    result name (the refused-acquire failure branch)?"""
+    if name is None or not isinstance(node, ast.If):
+        return False
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node.test))
+
+
+def _ownership_settles(stmts: List[ast.stmt], after_line: int,
+                       result_name: Optional[str]) -> bool:
+    """Walk the statements after the acquire: True when an ownership
+    store is reached through only-safe operations (a failure branch
+    guarded by the acquire result is skipped).  Compound statements
+    are expanded to their LEAVES — only a leaf's own expressions are
+    judged, so a ``with``/``if`` container is not condemned for an
+    unsafe call deep inside a branch that starts with the ownership
+    store."""
+    flat: List[ast.stmt] = []
+
+    def _expand(seq: List[ast.stmt]) -> None:
+        for s in seq:
+            if _guarded_by(s, result_name):
+                continue
+            sub = [x for attr in ("body", "orelse", "finalbody")
+                   for x in (getattr(s, attr, []) or [])]
+            if sub and not isinstance(s, ast.Try):
+                _expand(sub)
+            else:
+                flat.append(s)
+
+    _expand(stmts)
+    for s in sorted(flat, key=lambda x: x.lineno):
+        if s.lineno <= after_line:
+            continue
+        if _stores_ownership(s):
+            return True
+        if not _stmt_safe(s):
+            return False
+    return False
+
+
+def _stores_ownership(stmt: ast.stmt) -> bool:
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, ast.AugAssign):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, ast.Subscript) and \
+                isinstance(t.value, ast.Attribute) and \
+                t.value.attr in OWNER_BOOKS:
+            return True
+    return False
+
+
+def _stmt_safe(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Raise, ast.Try)):
+        return False
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call):
+            a = _attr_of(node)
+            if _is_journal_append(node):
+                return False
+            if a in ACQUIRES or a in RELEASES:
+                continue
+            if a in SAFE_ATTRS or a in SAFE_NAMES:
+                continue
+            return False
+    return True
+
+
+class _FnScan(ast.NodeVisitor):
+    """Per-function scan: acquire sites with their enclosing-try
+    stacks, and every risky call site."""
+
+    def __init__(self) -> None:
+        self.try_stack: List[ast.Try] = []
+        # (stmt, call, [tries innermost-first], arm stmts)
+        self.acquires: List[Tuple[ast.stmt, ast.Call, List[ast.Try]]] = []
+        self.riskies: List[Tuple[ast.Call, List[ast.Try]]] = []
+        self._stmt: Optional[ast.stmt] = None
+
+    def visit_Try(self, node: ast.Try) -> None:
+        self.try_stack.append(node)
+        for s in node.body + node.orelse:
+            self.visit(s)
+        self.try_stack.pop()
+        for h in node.handlers:
+            for s in h.body:
+                self.visit(s)
+        for s in node.finalbody:
+            self.visit(s)
+
+    def generic_visit(self, node: ast.AST) -> None:
+        if isinstance(node, ast.stmt):
+            self._stmt = node
+        if isinstance(node, ast.Call):
+            if _is_acquire(node):
+                self.acquires.append(
+                    (self._stmt, node, list(reversed(self.try_stack))))
+            elif _is_risky(node):
+                self.riskies.append(
+                    (node, list(reversed(self.try_stack))))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not self._root:
+            return  # nested defs scanned separately
+        super().generic_visit(node)
+
+    def scan(self, fn: ast.AST) -> "_FnScan":
+        self._root = fn
+        for s in fn.body:
+            self.visit(s)
+        return self
+
+
+def check_texts(sources: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, src in sorted(sources.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(Finding("excsafety", rel, e.lineno or 1,
+                                    f"unparseable: {e.msg}"))
+            continue
+        releasing = _release_summaries(tree)
+        for fn in (n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))):
+            scan = _FnScan().scan(fn)
+            for stmt, call, tries in scan.acquires:
+                findings.extend(_check_site(
+                    rel, fn, stmt, call, tries, scan, releasing))
+    return findings
+
+
+def _check_site(rel: str, fn: ast.AST, stmt: ast.stmt, call: ast.Call,
+                tries: List[ast.Try], scan: _FnScan,
+                releasing: Set[str]) -> List[Finding]:
+    what = _attr_of(call)
+    result_name = _acquire_result_name(stmt, call)
+    # -- swallowed-handler rule ----------------------------------------
+    for t in tries:
+        settled = (_body_releases(
+            [s for h in t.handlers for s in h.body], releasing)
+            or _body_releases(t.finalbody, releasing))
+        if settled:
+            return []
+        swallowing = [h for h in t.handlers if not _body_raises(h.body)]
+        if not swallowing:
+            continue  # every handler re-raises: walk outward
+        handler_continues = any(_body_continues(h.body)
+                                for h in swallowing)
+        if not handler_continues and _ownership_settles(
+                t.body, call.lineno, result_name):
+            return []
+        return [Finding(
+            "excsafety", rel, call.lineno,
+            f"{what}() inside a try whose handler catches-and-"
+            f"continues (line {swallowing[0].lineno}) without "
+            f"releasing: an exception after the acquire leaks the "
+            f"charge — release in the handler/finally"
+            + (" (the handler 'continue's past the owner, so the "
+               "ownership store does not settle it)"
+               if handler_continues else ""))]
+    # -- unprotected-risk rule -----------------------------------------
+    if _ownership_settles(
+            getattr(fn, "body", []), call.lineno, result_name):
+        return []
+    for risky, rtries in scan.riskies:
+        if risky.lineno <= call.lineno:
+            continue
+        protected = any(
+            _body_releases([s for h in t.handlers for s in h.body],
+                           releasing)
+            or _body_releases(t.finalbody, releasing)
+            for t in rtries)
+        if protected:
+            continue
+        # A release-family call between acquire and risk settles it.
+        if any(_is_release(n) or _attr_of(n) in releasing
+               for n in ast.walk(fn)
+               if isinstance(n, ast.Call)
+               and call.lineno < n.lineno <= risky.lineno):
+            break
+        return [Finding(
+            "excsafety", rel, call.lineno,
+            f"{what}() is followed by {_attr_of(risky)}() (line "
+            f"{risky.lineno}) with no try releasing on failure and no "
+            f"ownership store in between: an exception there leaks "
+            f"the charge")]
+    return []
+
+
+def check(root: str) -> List[Finding]:
+    sources: Dict[str, str] = {}
+    for rel in ANALYZED:
+        text = read_text(root, rel)
+        if text is not None:
+            sources[rel] = text
+    return check_texts(sources)

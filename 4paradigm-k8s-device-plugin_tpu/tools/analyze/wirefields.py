@@ -1,0 +1,235 @@
+"""Wire-field exhaustiveness checker (the PR-5 protocol stragglers).
+
+The hot-path overhaul grew the wire protocol by OPTIONAL header fields
+(EXEC_BATCH ``items``, raw framing ``raw_parts``/``nbytes``, the
+``lease`` reply rider).  Old clients never send them — so the serving
+side must read each with a legacy-default branch (``msg.get``), and
+every such field must be REGISTERED in ``protocol.py``'s
+``WIRE_FIELDS`` so the contract is reviewable in one place.  This
+checker proves, both directions:
+
+  - every ``msg[...]`` / ``msg.get(...)`` / ``spec[...]`` /
+    ``spec.get(...)`` field the broker reads is registered;
+  - a field registered as optional-only is NEVER subscript-read (a
+    subscript read of a field an old client omits kills that client's
+    session on its first frame);
+  - every registered field is actually read somewhere (no dead
+    registry entries masking a renamed reader);
+  - every verb in ``TENANT_VERBS``/``ADMIN_VERBS`` has a
+    ``WIRE_FIELDS`` entry (a new verb ships with its header contract);
+  - every optional REPLY rider (``REPLY_OPTIONAL_FIELDS``) is absorbed
+    in ``runtime/client.py`` with ``.get`` and never subscripted.
+
+Stdlib-only: the registries are AST-extracted from ``protocol.py``,
+never imported (protocol imports msgpack; the analyze CI job installs
+nothing).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, read_text, PKG_NAME
+
+PROTOCOL = f"{PKG_NAME}/runtime/protocol.py"
+SERVER = f"{PKG_NAME}/runtime/server.py"
+CLIENT = f"{PKG_NAME}/runtime/client.py"
+
+# Request-dict variable names in the serving code.
+MSG_NAMES = ("msg", "spec")
+# The dispatch discriminator every frame carries — implicitly
+# registered.
+IMPLICIT = ("kind",)
+
+
+def _const_str(node: ast.AST, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def load_registry(protocol_src: str) -> Optional[Tuple[
+        Dict[str, Dict[str, Tuple[str, ...]]], Tuple[str, ...],
+        Set[str]]]:
+    """(WIRE_FIELDS, REPLY_OPTIONAL_FIELDS, verbs-in-verb-registries)
+    extracted from protocol.py source."""
+    try:
+        tree = ast.parse(protocol_src)
+    except SyntaxError:
+        return None
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+    wire: Dict[str, Dict[str, Tuple[str, ...]]] = {}
+    reply: Tuple[str, ...] = ()
+    verbs: Set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+            continue
+        name = targets[0].id
+        value = node.value
+        if name == "WIRE_FIELDS" and isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                verb = _const_str(k, consts)
+                if verb is None or not isinstance(v, ast.Dict):
+                    continue
+                entry: Dict[str, Tuple[str, ...]] = {"required": (),
+                                                     "optional": ()}
+                for kk, vv in zip(v.keys, v.values):
+                    kind = _const_str(kk, consts)
+                    if kind in ("required", "optional") and \
+                            isinstance(vv, (ast.Tuple, ast.List)):
+                        entry[kind] = tuple(
+                            f for f in (_const_str(e, consts)
+                                        for e in vv.elts)
+                            if f is not None)
+                wire[verb] = entry
+        elif name == "REPLY_OPTIONAL_FIELDS" and \
+                isinstance(value, (ast.Tuple, ast.List)):
+            reply = tuple(f for f in (_const_str(e, consts)
+                                      for e in value.elts)
+                          if f is not None)
+        elif name in ("TENANT_VERBS", "ADMIN_VERBS") and \
+                isinstance(value, (ast.Tuple, ast.List)):
+            verbs.update(v for v in (_const_str(e, consts)
+                                     for e in value.elts)
+                         if v is not None)
+    if not wire:
+        return None
+    return wire, reply, verbs
+
+
+def field_reads(src: str, names: Tuple[str, ...] = MSG_NAMES
+                ) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """({field: line} subscript reads, {field: line} .get reads) of the
+    request-dict variables in ``src``."""
+    subs: Dict[str, int] = {}
+    gets: Dict[str, int] = {}
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return subs, gets
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in names and \
+                isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str):
+            subs.setdefault(node.slice.value, node.lineno)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in names and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            gets.setdefault(node.args[0].value, node.lineno)
+    return subs, gets
+
+
+def check_texts(sources: Dict[str, str]) -> List[Finding]:
+    protocol_src = sources.get(PROTOCOL)
+    server_src = sources.get(SERVER)
+    if protocol_src is None or server_src is None:
+        return [Finding("wirefields", PROTOCOL, 1,
+                        "protocol.py/server.py missing — cannot check "
+                        "wire-field contract")]
+    loaded = load_registry(protocol_src)
+    if loaded is None:
+        return [Finding("wirefields", PROTOCOL, 1,
+                        "cannot locate the WIRE_FIELDS registry in "
+                        "protocol.py")]
+    wire, reply_fields, verbs = loaded
+    findings: List[Finding] = []
+    required_any: Set[str] = set(IMPLICIT)
+    optional_any: Set[str] = set()
+    for entry in wire.values():
+        required_any.update(entry["required"])
+        optional_any.update(entry["optional"])
+    optional_only = optional_any - required_any
+    registered = required_any | optional_any
+
+    # Every verb the verb registries serve has a header contract.
+    for verb in sorted(verbs - set(wire)):
+        findings.append(Finding(
+            "wirefields", PROTOCOL, 1,
+            f'verb "{verb}" is in the verb registries but has no '
+            f"WIRE_FIELDS entry — new verbs ship with their header "
+            f"contract"))
+
+    subs, gets = field_reads(server_src)
+    for field in sorted(set(subs) - registered):
+        findings.append(Finding(
+            "wirefields", SERVER, subs[field],
+            f'request field "{field}" is subscript-read but not in '
+            f"WIRE_FIELDS — register it (required, or optional + "
+            f".get)"))
+    for field in sorted(set(gets) - registered):
+        findings.append(Finding(
+            "wirefields", SERVER, gets[field],
+            f'request field "{field}" is read but not in WIRE_FIELDS '
+            f"— register it"))
+    for field in sorted(optional_only & set(subs)):
+        findings.append(Finding(
+            "wirefields", SERVER, subs[field],
+            f'OPTIONAL wire field "{field}" is read by subscript — an '
+            f"old client that omits it dies with KeyError; use "
+            f".get with the legacy default"))
+    for field in sorted(optional_any - set(gets) - set(subs)):
+        findings.append(Finding(
+            "wirefields", PROTOCOL, 1,
+            f'optional wire field "{field}" is registered but never '
+            f"read in server.py (dead entry / renamed reader)"))
+    for field in sorted((required_any - set(IMPLICIT))
+                        - set(subs) - set(gets)):
+        findings.append(Finding(
+            "wirefields", PROTOCOL, 1,
+            f'required wire field "{field}" is registered but never '
+            f"read in server.py (dead entry / renamed reader)"))
+
+    # Reply riders: client must absorb each with .get, never subscript.
+    client_src = sources.get(CLIENT)
+    if reply_fields:
+        if client_src is None:
+            findings.append(Finding(
+                "wirefields", CLIENT, 1,
+                "client.py missing — cannot check reply riders"))
+        else:
+            csubs, cgets = field_reads(
+                client_src, names=("resp", "reply", "lease", "msg"))
+            for field in reply_fields:
+                if field in csubs:
+                    findings.append(Finding(
+                        "wirefields", CLIENT, csubs[field],
+                        f'optional reply rider "{field}" is '
+                        f"subscript-read in client.py — an old "
+                        f"broker's replies omit it; use .get"))
+                elif field not in cgets:
+                    findings.append(Finding(
+                        "wirefields", CLIENT, 1,
+                        f'optional reply rider "{field}" is registered '
+                        f"but never absorbed in client.py"))
+    return findings
+
+
+def check(root: str) -> List[Finding]:
+    sources: Dict[str, str] = {}
+    for rel in (PROTOCOL, SERVER, CLIENT):
+        text = read_text(root, rel)
+        if text is not None:
+            sources[rel] = text
+    if PROTOCOL not in sources:
+        return []
+    return check_texts(sources)

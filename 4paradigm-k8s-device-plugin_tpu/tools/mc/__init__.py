@@ -1,0 +1,35 @@
+"""vtpu-mc — deterministic model checking of the broker's quota, lease
+and crash-recovery invariants (docs/ANALYSIS.md "Model checking").
+
+Two engines, both driving the REAL broker code (``runtime/server.py``,
+``runtime/journal.py``) — never a re-implementation:
+
+  - **interleave** (interleave.py + sched.py + scenarios.py): the
+    broker's lock/queue/wake primitives are rebound to cooperative
+    shims whose every operation is a yield point; a DFS with DPOR-style
+    sleep sets and a CHESS-style bounded-preemption budget explores the
+    schedules of small multi-tenant scenarios, and the invariant
+    registry (invariants.py) is checked at every step and at every
+    quiescent terminal state.
+  - **crash** (crashcut.py): a canned multi-tenant session is recorded
+    through the real session/journal paths, then the journal is cut at
+    EVERY record boundary (and mid-record, for CRC-torn tails) and the
+    real recovery replays each prefix — twice for determinism, against
+    an independent record interpreter for ground truth, and re-resumed
+    for idempotence.
+
+Run as ``python -m vtpu.tools.mc`` or ``vtpu-smi mc``; CI runs the
+``mc`` job under a bounded schedule budget with the explored-state
+count floor-gated.  ``--selfcheck`` proves every invariant's checker
+still catches its seeded violation.  There is NO suppression mechanism
+on purpose: a real violation is fixed in broker source, never waived.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from . import cli
+    return cli.main(argv)

@@ -1,0 +1,6 @@
+"""``python -m vtpu.tools.mc`` — see package docstring."""
+
+from . import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

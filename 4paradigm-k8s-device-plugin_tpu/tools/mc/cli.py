@@ -1,0 +1,175 @@
+"""vtpu-mc command line — both engines, budgets, floor gates, selfcheck.
+
+Exploration is fully deterministic (DFS over scheduling decisions; no
+randomness anywhere), so CI needs no seed pinning: the same tree + the
+same budget flags explore the same schedules.  The CI ``mc`` job prints
+the explored-state counts and floor-gates them (``--min-schedules``):
+a refactor that silently shrinks the explored space — fewer yield
+points, a scenario that stopped spawning a task — fails loudly instead
+of shipping a weaker checker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from ...utils import logging as log
+
+
+def _run_interleave(ns: argparse.Namespace) -> Dict[str, Any]:
+    from . import interleave, scenarios
+    wanted = ([scenarios.get(ns.scenario)] if ns.scenario
+              else list(scenarios.SCENARIOS))
+    out: Dict[str, Any] = {"scenarios": {}, "schedules": 0,
+                           "decisions": 0, "violations": []}
+    for sc in wanted:
+        stats = interleave.explore_scenario(
+            sc, max_schedules=ns.max_schedules,
+            preemption_bound=ns.preemption_bound)
+        out["scenarios"][sc.name] = {
+            "schedules": stats.schedules,
+            "decisions": stats.decisions,
+            "truncated": stats.truncated,
+            "violations": stats.violations,
+            "witness": stats.witness,
+        }
+        out["schedules"] += stats.schedules
+        out["decisions"] += stats.decisions
+        out["violations"].extend(
+            f"{sc.name}: {v}" for v in stats.violations)
+    return out
+
+
+def _run_crash(ns: argparse.Namespace) -> Dict[str, Any]:
+    from . import crashcut
+    stats = crashcut.explore()
+    return {
+        "records": stats.records,
+        "boundary_cuts": stats.boundary_cuts,
+        "torn_cuts": stats.torn_cuts,
+        "corrupt_checks": stats.corrupt_checks,
+        "violations": stats.violations,
+    }
+
+
+def _run_selfcheck(ns: argparse.Namespace) -> int:
+    from . import selfcheck
+    results = selfcheck.run_all(max_schedules=ns.max_schedules)
+    missed = [s.name for s, caught, _n in results if not caught]
+    for seed, caught, n in results:
+        mark = "caught" if caught else "MISSED"
+        print(f"  seed {seed.name:28s} [{seed.engine:10s}] -> "
+              f"{seed.invariant:24s} {mark} ({n} violation(s))")
+    if missed:
+        print(f"vtpu-mc selfcheck: {len(missed)} seed(s) NOT caught: "
+              f"{missed}")
+        return 1
+    print(f"vtpu-mc selfcheck: all {len(results)} seeded violations "
+          f"caught")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="vtpu-mc",
+        description="deterministic model checking of broker quota/"
+                    "lease/crash-recovery invariants "
+                    "(docs/ANALYSIS.md)")
+    ap.add_argument("--engine", choices=("interleave", "crash", "all"),
+                    default="all")
+    ap.add_argument("--scenario", default=None,
+                    help="run one interleaving scenario by name")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and invariants, then exit")
+    ap.add_argument("--max-schedules", type=int, default=1500,
+                    help="schedule budget PER scenario (deterministic "
+                         "DFS; default 1500)")
+    ap.add_argument("--preemption-bound", type=int, default=2,
+                    help="CHESS-style preemption budget per schedule "
+                         "(default 2)")
+    ap.add_argument("--min-schedules", type=int, default=0,
+                    help="fail unless the interleaving engine explored "
+                         "at least this many schedules in total (CI "
+                         "floor gate)")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="run the seeded-violation matrix instead: "
+                         "every invariant's checker must catch its "
+                         "deliberately broken broker variant")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny budget (a few schedules per scenario + "
+                         "the crash engine): the analyze-job wiring "
+                         "check, not the real exploration")
+    ap.add_argument("--json", action="store_true")
+    ns = ap.parse_args(argv)
+
+    # The explorers replay torn/corrupt journals on purpose — silence
+    # the broker's expected warnings so real violations stand out.
+    import os
+    os.environ.setdefault("VTPU_LOG_LEVEL", "0")
+    log.refresh_level()
+
+    if ns.list:
+        from . import invariants, scenarios
+        print("scenarios:")
+        for sc in scenarios.SCENARIOS:
+            print(f"  {sc.name:18s} {sc.description}")
+        print("invariants:")
+        for inv in invariants.INVARIANTS:
+            print(f"  [{inv.engine:10s}/{inv.phase:8s}] "
+                  f"{inv.name:24s} {inv.description}")
+        return 0
+
+    if ns.selfcheck:
+        return _run_selfcheck(ns)
+
+    if ns.smoke:
+        ns.max_schedules = 5
+
+    report: Dict[str, Any] = {}
+    violations: List[str] = []
+    if ns.engine in ("interleave", "all"):
+        report["interleave"] = _run_interleave(ns)
+        violations.extend(report["interleave"]["violations"])
+    if ns.engine in ("crash", "all"):
+        report["crash"] = _run_crash(ns)
+        violations.extend(report["crash"]["violations"])
+
+    if ns.json:
+        print(json.dumps(report, indent=2))
+    else:
+        il = report.get("interleave")
+        if il:
+            for name, s in il["scenarios"].items():
+                print(f"  interleave {name:18s} schedules={s['schedules']:6d} "
+                      f"decisions={s['decisions']:8d}"
+                      + (f" truncated={s['truncated']}"
+                         if s["truncated"] else ""))
+            print(f"  interleave TOTAL: {il['schedules']} schedules, "
+                  f"{il['decisions']} decisions")
+        cr = report.get("crash")
+        if cr:
+            print(f"  crash: {cr['records']} records, "
+                  f"{cr['boundary_cuts']} boundary cuts, "
+                  f"{cr['torn_cuts']} torn cuts, "
+                  f"{cr['corrupt_checks']} corruption checks")
+        for v in violations:
+            print(f"VIOLATION: {v}")
+        print(f"vtpu-mc: {len(violations)} violation(s)")
+
+    if ns.min_schedules and ns.engine in ("interleave", "all"):
+        got = report["interleave"]["schedules"]
+        if got < ns.min_schedules:
+            print(f"vtpu-mc: explored-state FLOOR MISSED: "
+                  f"{got} < --min-schedules {ns.min_schedules} — "
+                  f"the explored space silently shrank", file=sys.stderr)
+            return 1
+    if ns.engine in ("crash", "all") and report["crash"]["records"] \
+            and report["crash"]["boundary_cuts"] \
+            != report["crash"]["records"] + 1:
+        print("vtpu-mc: crash engine did not cover every record "
+              "boundary", file=sys.stderr)
+        return 1
+    return 1 if violations else 0

@@ -1,0 +1,580 @@
+"""vtpu-mc crash-cut engine: journal truncation at every record
+boundary + recovery replay through the REAL broker code.
+
+A canned multi-tenant session is first RECORDED: a driver task replays
+scripted wire frames through the genuine ``TenantSession`` loop (HELLO
+/ PUT incl. an oversubscribed spill / COMPILE / EXECUTE with zero-RT
+free / DELETE / teardown-close) against the MC harness, so the journal
+on disk is byte-for-byte what a real broker under that workload would
+have written — bind, put, del, compile, ema, close, epoch, chip and
+wedge records all present, one tenant closed and one (multi-chip) left
+live.
+
+The journal is then CUT:
+
+  - at EVERY record boundary (the crash-anywhere property), and
+  - MID-record at every boundary + a torn fragment (the kill -9
+    artifact a CRC'd tail must drop), and
+  - with a flipped byte in a NON-tail record (must fail closed), and
+  - with a corrupted snapshot after compaction (must fail closed).
+
+Each prefix is recovered through the real ``Journal.load_state`` +
+``RuntimeState._recover_from_journal`` + ``try_resume`` — twice, for
+replay determinism; against an INDEPENDENT record interpreter
+(``_predict``), for ground truth (a skipped or wrong replay arm in
+``_apply_record`` diverges from the independent reading); and then
+crashed AGAIN immediately after the recovery boot-sequence writes
+(epoch record + boot snapshot) and recovered a third time, for
+re-resume idempotence.  Violations surface through the invariant
+registry (invariants.py, engine="crash").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import invariants as inv_registry
+from . import sched as mcsched
+from .harness import Harness, ScriptSock, fake_blob
+
+CANNED_CHIPS = 2
+
+
+# ---------------------------------------------------------------------------
+# Recording: the canned session
+# ---------------------------------------------------------------------------
+
+def _canned_frames_a() -> List[bytes]:
+    import numpy as np
+    from ...runtime import protocol as P
+    a1 = np.arange(8, dtype=np.float32)          # 32 B — fits the quota
+    big = np.zeros(128, dtype=np.float32)        # 512 B — spills (256 B cap)
+    return [
+        P.frame_header({"kind": P.HELLO, "tenant": "A", "priority": 1,
+                        "hbm_limit": 256, "core_limit": 50,
+                        "oversubscribe": True, "pid": os.getpid()}),
+        P.frame_header({"kind": P.PUT, "id": "w1", "shape": [8],
+                        "dtype": "float32", "data": a1.tobytes()}),
+        P.frame_header({"kind": P.PUT, "id": "big", "shape": [128],
+                        "dtype": "float32", "data": big.tobytes()}),
+        P.frame_header({"kind": P.COMPILE, "id": "p",
+                        "exported": fake_blob(1, 64)}),
+        P.frame_header({"kind": P.EXECUTE, "exe": "p", "args": ["w1"],
+                        "outs": ["o1"]}),
+        P.frame_header({"kind": P.STATS}),
+        P.frame_header({"kind": P.EXECUTE, "exe": "p", "args": ["o1"],
+                        "outs": ["o2"], "free": ["w1"]}),
+        P.frame_header({"kind": P.STATS}),
+        P.frame_header({"kind": P.DELETE, "id": "big"}),
+    ]
+
+
+def _canned_frames_b() -> List[bytes]:
+    import numpy as np
+    from ...runtime import protocol as P
+    wb = np.ones(16, dtype=np.float32)           # 64 B
+    return [
+        P.frame_header({"kind": P.HELLO, "tenant": "B", "priority": 1,
+                        "devices": [0, 1], "hbm_limit": 4096,
+                        "core_limit": 30, "pid": os.getpid()}),
+        P.frame_header({"kind": P.PUT, "id": "wb", "shape": [16],
+                        "dtype": "float32", "data": wb.tobytes()}),
+        P.frame_header({"kind": P.COMPILE, "id": "q",
+                        "exported": fake_blob(1, 32)}),
+        P.frame_header({"kind": P.EXECUTE, "exe": "q", "args": ["wb"],
+                        "outs": ["y1"]}),
+        P.frame_header({"kind": P.STATS}),
+        P.frame_header({"kind": P.EXECUTE, "exe": "q", "args": ["y1"],
+                        "outs": ["y2"]}),
+        P.frame_header({"kind": P.STATS}),
+    ]
+
+
+def _setup_canned(h: Harness, sched: mcsched.Scheduler) -> None:
+    """One sequential driver task: session A runs its full life through
+    the REAL handle() loop (incl. the teardown close record), then
+    session B binds a two-chip grant and is left LIVE — so every cut
+    prefix recovers a mix of closed and open tenants."""
+    def driver() -> None:
+        jr = h.state.journal
+        # The two boot-sequence writes RuntimeState.__init__ performs
+        # (the harness builds the state piecewise, so the driver issues
+        # them — same record shapes, same order).
+        jr.append({"op": "epoch", "epoch": h.state.epoch})
+        jr.append({"op": "chip", "index": 0, "lat_us": 111.0})
+        sess_a = h.session(ScriptSock(_canned_frames_a()))
+        sess_a.handle()
+        sock_b = ScriptSock(_canned_frames_b())
+        sess_b = h.session(sock_b)
+        box: List[Any] = [None]
+        sess_b._serve(sock_b, box)      # no teardown: B stays live
+        # A claim-watchdog wedge record (runtime/server.py
+        # wedge_report's dying words) closes the log.
+        jr.append({"op": "wedge", "stage": "mc-canned",
+                   "ts": h.clock.time(), "diagnosis": "seeded wedge"})
+
+    sched.spawn(driver, "driver")
+
+
+def record_session(jdir: str) -> List[str]:
+    """Record the canned session's journal into ``jdir``; returns the
+    scheduler/harness violations (must be empty for a usable
+    recording)."""
+    sched = mcsched.Scheduler()
+    with mcsched.patched_modules(sched):
+        from ...runtime.journal import Journal
+        journal = Journal(jdir, snapshot_every=100_000, fsync=False)
+        h = Harness(sched, journal=journal, n_chips=CANNED_CHIPS)
+        _setup_canned(h, sched)
+
+        def choose(step: int, enabled: List[mcsched.MCTask]
+                   ) -> mcsched.MCTask:
+            # Deterministic default policy: stay on the current task,
+            # else lowest id — the same rule the explorer's replay
+            # uses, so the recording is reproducible byte-for-byte.
+            prev = getattr(choose, "prev", None)
+            by_id = {t.tid: t for t in enabled}
+            pick = prev if prev in by_id else min(by_id)
+            choose.prev = pick
+            return by_id[pick]
+
+        sched.run(choose)
+        violations = list(sched.violations)
+        if not violations:
+            violations.extend(
+                inv_registry.run_checks("interleave", "terminal", h))
+        journal.close()
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Record framing (independent of runtime/journal.py on purpose)
+# ---------------------------------------------------------------------------
+
+def split_records(data: bytes) -> List[Tuple[int, int, Dict[str, Any]]]:
+    """[(start, end, record)] for every complete CRC-framed line —
+    parsed HERE, independently, so the cut points and the ground-truth
+    interpreter share no code with the implementation under test."""
+    out: List[Tuple[int, int, Dict[str, Any]]] = []
+    off = 0
+    while off < len(data):
+        nl = data.find(b"\n", off)
+        if nl < 0:
+            break
+        line = data[off:nl]
+        crc_hex, _, payload = line.partition(b" ")
+        if int(crc_hex, 16) != zlib.crc32(payload):
+            raise ValueError(f"recording has a bad CRC at offset {off}")
+        out.append((off, nl + 1, json.loads(payload)))
+        off = nl + 1
+    return out
+
+
+def _predict(records: List[Dict[str, Any]],
+             default_hbm: int, default_core: int) -> Dict[str, Any]:
+    """Independent interpretation of a record prefix: what a correct
+    recovery MUST reconstruct.  Deliberately re-implemented from the
+    docs/BROKER_RECOVERY.md contract, not from ``_apply_record`` — a
+    skipped or wrong replay arm shows up as a divergence."""
+    epoch: Optional[str] = None
+    tenants: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        op = rec.get("op")
+        if op == "epoch":
+            epoch = rec.get("epoch")
+        elif op == "bind":
+            t = tenants.setdefault(rec["name"], {
+                "arrays": {}, "exes": {}, "ema": {}, "execs": 0})
+            t.update({k: rec.get(k) for k in
+                      ("devices", "slots", "priority", "over", "hbm",
+                       "core", "spill", "pid", "pidns")})
+        elif op == "close":
+            tenants.pop(rec.get("name"), None)
+        elif op == "put" and rec.get("name") in tenants:
+            tenants[rec["name"]]["arrays"][rec["id"]] = {
+                "charges": [tuple(c) for c in rec.get("charges") or []],
+                "nbytes": 0 if rec.get("spilled")
+                else int(rec.get("nbytes", 0)),
+            }
+        elif op == "del" and rec.get("name") in tenants:
+            tenants[rec["name"]]["arrays"].pop(rec.get("id"), None)
+        elif op == "compile" and rec.get("name") in tenants:
+            tenants[rec["name"]]["exes"][rec["id"]] = rec.get("sha")
+        elif op == "ema" and rec.get("name") in tenants:
+            tenants[rec["name"]]["ema"][rec["key"]] = rec.get("ema")
+            if rec.get("execs") is not None:
+                tenants[rec["name"]]["execs"] = rec["execs"]
+    out: Dict[str, Any] = {}
+    for name, t in tenants.items():
+        hbm = t.get("hbm") or []
+        ndev = len(t.get("devices") or [0])
+        out[name] = {
+            "devices": [int(d) for d in t.get("devices") or [0]],
+            "slots": [int(s) for s in t.get("slots") or []],
+            "priority": int(t.get("priority", 1)),
+            "over": bool(t.get("over", False)),
+            "grant": {
+                "hbm": [int(hbm[k]) if k < len(hbm) and hbm[k] is not None
+                        else default_hbm for k in range(ndev)],
+                "core": int(t["core"]) if t.get("core") is not None
+                else default_core,
+            },
+            "charges": {aid: sorted(tuple(c) for c in am["charges"])
+                        for aid, am in t["arrays"].items()},
+            "nbytes": {aid: am["nbytes"]
+                       for aid, am in t["arrays"].items()},
+            "exes": dict(t["exes"]),
+            "ema": {k: float(v) for k, v in t["ema"].items()},
+            "execs": int(t["execs"]),
+            "lease_us": 0.0,
+        }
+    return {"epoch": epoch, "tenants": out}
+
+
+# ---------------------------------------------------------------------------
+# Recovery of one cut
+# ---------------------------------------------------------------------------
+
+class _Recovered:
+    """One recovery of one cut directory: the harness + journal it ran
+    on, kept open so the re-resume step can write through it."""
+
+    def __init__(self, h: Harness, journal: Any) -> None:
+        self.h = h
+        self.journal = journal
+
+    def digest(self) -> Dict[str, Any]:
+        st = self.h.state
+        tenants: Dict[str, Any] = {}
+        for name, (t, _dl) in st.recovered.items():
+            grant = t.grant or {}
+            tenants[name] = {
+                "devices": [c.index for c in t.chips],
+                "slots": list(t.slots),
+                "priority": t.priority,
+                "over": t.oversubscribe,
+                "grant": {
+                    "hbm": [int(x) for x in grant.get("hbm") or []],
+                    "core": int(grant.get("core"))
+                    if grant.get("core") is not None else None,
+                },
+                "charges": {aid: sorted(tuple(c) for c in charges)
+                            for aid, charges in t.charges.items()},
+                "nbytes": dict(t.nbytes),
+                "exes": dict(t.exe_shas),
+                "ema": {k: float(v) for k, v in t.cost_ema.items()},
+                "execs": t.executions,
+                "lease_us": float(t.lease_us),
+            }
+        return {"epoch": st.prev_epoch, "tenants": tenants}
+
+    def close(self) -> None:
+        self.journal.close()
+
+
+def recover_cut(cutdir: str, n_chips: int = CANNED_CHIPS) -> _Recovered:
+    """Drive the REAL recovery path over one cut journal: load_state +
+    _recover_from_journal on a fresh broker stub (inert shims — no
+    threads, no schedule exploration; recovery is sequential code).
+    Raises JournalCorrupt exactly when the real broker would
+    quarantine."""
+    inert = mcsched.InertScheduler()
+    with mcsched.patched_modules(inert):
+        from ...runtime.journal import Journal
+        journal = Journal(cutdir, snapshot_every=100_000, fsync=False)
+        try:
+            state = journal.load_state()
+        except Exception:
+            journal.close()
+            raise
+        h = Harness(inert, journal=journal, n_chips=n_chips)
+        st = h.state
+        st._journal_state = state
+        if state is not None:
+            st.prev_epoch = state.get("epoch")
+            st._recover_from_journal()
+        return _Recovered(h, journal)
+
+
+def _resume_checks(rec: _Recovered) -> List[str]:
+    """Resume safety of one recovered state: region limits re-seeded to
+    the journaled grant, ledgers equal to the re-applied charge books,
+    buckets re-seeded (journal-replay lease reclamation), and the
+    resume HELLO path (try_resume) restores arrays/programs
+    consistently."""
+    out: List[str] = []
+    st = rec.h.state
+    for name, (t, _dl) in list(st.recovered.items()):
+        grant = t.grant or {}
+        hbm = grant.get("hbm") or []
+        for k, (chip, slot) in enumerate(zip(t.chips, t.slots)):
+            r = chip.region
+            want_hbm = (int(hbm[k]) if k < len(hbm) and hbm[k] is not None
+                        else st.default_hbm)
+            if r.limit[slot] != want_hbm:
+                out.append(
+                    f"tenant {name!r} chip{chip.index}/{slot}: region "
+                    f"limit {r.limit[slot]} != journaled grant "
+                    f"{want_hbm}")
+            want_core = (int(grant["core"])
+                         if grant.get("core") is not None
+                         else st.default_core)
+            if r.core[slot] != want_core:
+                out.append(
+                    f"tenant {name!r} chip{chip.index}/{slot}: core "
+                    f"limit {r.core[slot]} != journaled {want_core}")
+            want_used = sum(nb for charges in t.charges.values()
+                            for pos, nb in charges
+                            if t.chips[pos] is chip
+                            and t.slots[pos] == slot)
+            if r.used[slot] != want_used:
+                out.append(
+                    f"tenant {name!r} chip{chip.index}/{slot}: region "
+                    f"ledger {r.used[slot]}B != recovered charge book "
+                    f"{want_used}B")
+            if abs(r.level[slot] - r.cap_us) > 1e-6:
+                out.append(
+                    f"tenant {name!r} chip{chip.index}/{slot}: bucket "
+                    f"not re-seeded at recovery (level "
+                    f"{r.level[slot]:.0f} != cap {r.cap_us:.0f})")
+        if t.lease_us != 0.0:
+            out.append(f"tenant {name!r}: recovered with a nonzero "
+                       f"rate lease ({t.lease_us}us) — the replay "
+                       f"reclamation must start leases at zero")
+    # Resume HELLO adoption: every parked tenant must restore its
+    # journaled arrays (or release the unrestorable ones) + programs.
+    for name in list(st.recovered):
+        t = st.recovered[name][0]
+        want_arrays = dict(t.blob_meta)
+        adopted = st.try_resume(name, st.prev_epoch)
+        if adopted is None:
+            out.append(f"tenant {name!r}: try_resume refused its own "
+                       f"prev-epoch resume")
+            continue
+        for aid, am in want_arrays.items():
+            spilled = bool(am.get("spilled"))
+            with adopted.mu:
+                present = (aid in adopted.host_arrays if spilled
+                           else aid in adopted.arrays)
+            if not present and aid in adopted.charges:
+                out.append(
+                    f"tenant {name!r}: array {aid!r} neither restored "
+                    f"nor released at resume (ledger still charged)")
+        for eid in t.exe_shas:
+            if eid not in adopted.executables:
+                out.append(f"tenant {name!r}: program {eid!r} not "
+                           f"restored at resume")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CutContext:
+    """What the invariant registry's crash checks read for one cut."""
+    label: str
+    state_a: Dict[str, Any]
+    state_b: Dict[str, Any]
+    expected: Optional[Dict[str, Any]] = None
+    resume_violations: List[str] = field(default_factory=list)
+    reresume_violations: List[str] = field(default_factory=list)
+    torn_violations: List[str] = field(default_factory=list)
+    corrupt_violations: List[str] = field(default_factory=list)
+
+    @staticmethod
+    def tenant_digest(state: Dict[str, Any]) -> Dict[str, Any]:
+        return state.get("tenants", {})
+
+
+@dataclass
+class CrashStats:
+    records: int = 0
+    boundary_cuts: int = 0
+    torn_cuts: int = 0
+    corrupt_checks: int = 0
+    violations: List[str] = field(default_factory=list)
+
+
+def _make_cut(src_dir: str, dst_dir: str, log_bytes: bytes) -> None:
+    shutil.copytree(src_dir, dst_dir)
+    from ...runtime.journal import LOG_NAME
+    with open(os.path.join(dst_dir, LOG_NAME), "wb") as f:
+        f.write(log_bytes)
+
+
+def explore(record_dir: Optional[str] = None,
+            workdir: Optional[str] = None) -> CrashStats:
+    """Run the full crash-cut exploration.  ``record_dir``: reuse an
+    existing recording (tests; seeded-violation runs) instead of
+    recording fresh."""
+    from ...runtime.journal import LOG_NAME, JournalCorrupt
+    stats = CrashStats()
+    tmp = workdir or tempfile.mkdtemp(prefix="vtpu-mc-crash-")
+    own_tmp = workdir is None
+    try:
+        jdir = record_dir or os.path.join(tmp, "recording")
+        if record_dir is None:
+            os.makedirs(jdir, exist_ok=True)
+            rec_violations = record_session(jdir)
+            if rec_violations:
+                stats.violations.extend(
+                    f"[recording] {v}" for v in rec_violations)
+                return stats
+        with open(os.path.join(jdir, LOG_NAME), "rb") as f:
+            log = f.read()
+        records = split_records(log)
+        stats.records = len(records)
+        boundaries = [0] + [end for _s, end, _r in records]
+
+        def _labels(i: int) -> str:
+            if i == 0:
+                return "boundary[0]=<empty>"
+            _s, _e, r = records[i - 1]
+            what = r.get("name") or r.get("id") or r.get("index", "")
+            return f"boundary[{i}]=after-{r.get('op')}:{what}"
+
+        # -- every record boundary ------------------------------------
+        for i, off in enumerate(boundaries):
+            label = _labels(i)
+            cut = os.path.join(tmp, f"cut{i}")
+            _make_cut(jdir, cut, log[:off])
+            ctx = CutContext(label=label, state_a={}, state_b={})
+            rec_a = recover_cut(cut)
+            ctx.state_a = rec_a.digest()
+            rec_b = recover_cut(cut)
+            ctx.state_b = rec_b.digest()
+            rec_b.close()
+            ctx.expected = _predict(
+                [r for _s, _e, r in records[:i]],
+                rec_a.h.state.default_hbm,
+                rec_a.h.state.default_core)["tenants"]
+            # Resume-safety checks mutate rec_a (try_resume) — digest
+            # was taken first.
+            ctx.resume_violations = _resume_checks(rec_a)
+            # Re-resume: crash again right after the recovery
+            # boot-sequence writes (epoch record + boot snapshot — the
+            # exact order RuntimeState.__init__ commits them), recover
+            # a third time: the parked/live tenants must round-trip.
+            st = rec_a.h.state
+            rec_a.journal.append({"op": "epoch", "epoch": st.epoch})
+            rec_a.journal.write_snapshot(st._snapshot_dict)
+            rec_a.close()
+            rec_c = recover_cut(cut)
+            got = CutContext.tenant_digest(rec_c.digest())
+            rec_c.close()
+            want = dict(ctx.state_a["tenants"])
+            # try_resume moved parked tenants into st.tenants; the
+            # boot snapshot carries BOTH parked and live tenants, so
+            # the third recovery must still see every one of them.
+            if got != want:
+                ctx.reresume_violations.append(
+                    f"cut {label}: second crash after recovery lost "
+                    f"state: {sorted(want)} -> {sorted(got)}")
+            stats.violations.extend(
+                inv_registry.run_checks("crash", "cut", ctx))
+            stats.boundary_cuts += 1
+            shutil.rmtree(cut, ignore_errors=True)
+
+        # -- torn tails: a cut MID-record must recover exactly the
+        # previous boundary's state (judged against the INDEPENDENT
+        # interpreter, so a parser that over- or under-drops cannot
+        # vouch for itself) ----------------------------------------
+        for i, (start, end, r) in enumerate(records):
+            frag = start + max((end - start) // 2, 1)
+            label = f"torn[{i}]=mid-{r.get('op')}"
+            cut = os.path.join(tmp, f"torn{i}")
+            _make_cut(jdir, cut, log[:frag])
+            ctx = CutContext(label=label, state_a={}, state_b={})
+            try:
+                rec_t = recover_cut(cut)
+                ctx.state_a = ctx.state_b = rec_t.digest()
+                want = _predict([x for _s, _e, x in records[:i]],
+                                rec_t.h.state.default_hbm,
+                                rec_t.h.state.default_core)["tenants"]
+                rec_t.close()
+                if CutContext.tenant_digest(ctx.state_a) != want:
+                    ctx.torn_violations.append(
+                        f"cut {label}: torn tail was not dropped "
+                        f"cleanly — recovered state differs from the "
+                        f"last complete boundary[{i}]")
+            except JournalCorrupt as e:
+                ctx.torn_violations.append(
+                    f"cut {label}: torn FINAL record must be dropped, "
+                    f"not treated as corruption ({e})")
+            stats.violations.extend(
+                inv_registry.run_checks("crash", "cut", ctx))
+            stats.torn_cuts += 1
+            shutil.rmtree(cut, ignore_errors=True)
+
+        # -- non-tail damage must fail closed -------------------------
+        for case, mutate in (
+            ("flip-mid-log", lambda b: _flip_byte(b, records)),
+            ("truncate-first-line", lambda b: b[3:]),
+        ):
+            cut = os.path.join(tmp, f"corrupt-{case}")
+            _make_cut(jdir, cut, mutate(log))
+            ctx = CutContext(label=f"corrupt[{case}]", state_a={},
+                             state_b={})
+            try:
+                rec_x = recover_cut(cut)
+                rec_x.close()
+                ctx.corrupt_violations.append(
+                    f"corrupt[{case}]: recovery proceeded on non-tail "
+                    f"journal damage instead of raising JournalCorrupt")
+            except JournalCorrupt:
+                pass
+            stats.violations.extend(
+                inv_registry.run_checks("crash", "cut", ctx))
+            stats.corrupt_checks += 1
+            shutil.rmtree(cut, ignore_errors=True)
+
+        # Corrupt SNAPSHOT: recover the full log, commit the boot
+        # snapshot, damage it, recover again — must fail closed.
+        cut = os.path.join(tmp, "corrupt-snapshot")
+        _make_cut(jdir, cut, log)
+        rec_s = recover_cut(cut)
+        st = rec_s.h.state
+        rec_s.journal.append({"op": "epoch", "epoch": st.epoch})
+        rec_s.journal.write_snapshot(st._snapshot_dict)
+        rec_s.close()
+        from ...runtime.journal import SNAP_NAME
+        snap_path = os.path.join(cut, SNAP_NAME)
+        with open(snap_path, "r+b") as f:
+            f.seek(2)
+            f.write(b"\x00")
+        ctx = CutContext(label="corrupt[snapshot]", state_a={},
+                         state_b={})
+        try:
+            rec_y = recover_cut(cut)
+            rec_y.close()
+            ctx.corrupt_violations.append(
+                "corrupt[snapshot]: recovery proceeded on an "
+                "unreadable snapshot instead of raising JournalCorrupt")
+        except JournalCorrupt:
+            pass
+        stats.violations.extend(
+            inv_registry.run_checks("crash", "cut", ctx))
+        stats.corrupt_checks += 1
+        shutil.rmtree(cut, ignore_errors=True)
+    finally:
+        if own_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return stats
+
+
+def _flip_byte(log: bytes, records: List[Tuple[int, int, dict]]) -> bytes:
+    """Flip one payload byte of a NON-final record (mid-log damage —
+    the case that must never be silently dropped)."""
+    if len(records) < 2:
+        raise ValueError("recording too short to corrupt mid-log")
+    start, end, _r = records[len(records) // 2]
+    pos = start + (end - start) // 2
+    return log[:pos] + bytes([log[pos] ^ 0x5A]) + log[pos + 1:]

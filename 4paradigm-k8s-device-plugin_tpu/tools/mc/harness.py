@@ -1,0 +1,566 @@
+"""vtpu-mc broker-under-test harness.
+
+Builds the REAL broker objects — ``RuntimeState``, ``Tenant``,
+``DeviceScheduler``, ``TenantSession``, ``Journal`` — on top of the
+cooperative scheduler's shims (sched.py), with exactly two stand-ins:
+
+  - **ModelRegion** replaces the native mmap'd accounting region.  The
+    native region is lock-free C (its own TSan job proves it); what the
+    model checker explores is the PYTHON broker logic around it, so the
+    model keeps the same API and — crucially — double-entry counters
+    (net bucket debit, busy billed, ledger bounds) that the invariant
+    registry checks against the broker's own state.
+  - **FakeJax / fake programs** replace device execution: a dispatch
+    "runs" by returning fake output arrays with static shapes, which is
+    all the broker's accounting paths ever look at.
+
+Everything else — scheduling, lease grant/burn/refund, queue/retire
+bookkeeping, journal deferral and replay — is the genuine code from
+``runtime/server.py`` / ``runtime/journal.py``.  The stubs are built
+with ``__new__`` + explicit field seeding (mirroring
+``RuntimeState.__init__`` minus the jax/chip-claim machinery) so no
+socket, no device and no wall clock is ever involved.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import sched as mcsched
+
+MAX_SLOTS = 16
+
+
+class RegionStats:
+    __slots__ = ("used_bytes", "limit_bytes", "peak_bytes",
+                 "core_limit_pct", "n_procs")
+
+    def __init__(self, used: int, limit: int, peak: int, core: int,
+                 n_procs: int = 0) -> None:
+        self.used_bytes = used
+        self.limit_bytes = limit
+        self.peak_bytes = peak
+        self.core_limit_pct = core
+        self.n_procs = n_procs
+
+
+class ModelRegion:
+    """Deterministic in-process model of the native shared region's
+    accounting semantics, instrumented for conservation checking.
+
+    ``refill=False`` (the conservation configuration) freezes the token
+    bucket at its seed level: every debit/credit is then exactly
+    auditable — ``net_debit`` must equal metered busy time plus
+    outstanding leases at any quiescent point, and the level may never
+    exceed the seed (a refund that does is a double credit).
+    ``refill=True`` models the real work-accruing bucket for
+    throttling scenarios (credits clamp at capacity, like the native
+    bucket)."""
+
+    def __init__(self, clock: mcsched.MCClock, nslots: int = MAX_SLOTS,
+                 cap_us: float = 10**9, refill: bool = False) -> None:
+        self.clock = clock
+        self.nslots = nslots
+        self.cap_us = float(cap_us)
+        self.refill = refill
+        self.limit = [0] * nslots
+        self.used = [0] * nslots
+        self.peak = [0] * nslots
+        self.core = [0] * nslots
+        self.level = [float(cap_us)] * nslots
+        self.busy = [0] * nslots
+        self.busy_base = [0] * nslots
+        self.net_debit = [0.0] * nslots
+        self.last_refill = [clock.now()] * nslots
+        self.violations: List[str] = []
+
+    # -- token bucket ------------------------------------------------------
+
+    def _tick(self, d: int) -> None:
+        now = self.clock.now()
+        if self.refill and self.core[d] > 0:
+            dt = max(now - self.last_refill[d], 0.0)
+            rate = self.core[d] / 100.0 * 1e6  # us of budget per s
+            self.level[d] = min(self.level[d] + dt * rate, self.cap_us)
+        self.last_refill[d] = now
+
+    def rate_acquire(self, d: int, cost_us: int,
+                     priority: int = 1) -> int:
+        self._tick(d)
+        if priority == 0 or self.level[d] >= cost_us:
+            self.level[d] -= cost_us
+            self.net_debit[d] += cost_us
+            return 0
+        short = cost_us - self.level[d]
+        rate = max(self.core[d], 1) / 100.0 * 1e6
+        return int(short / rate * 1e9) + 1  # ns until refilled enough
+
+    def rate_adjust(self, d: int, delta_us: int) -> None:
+        self._tick(d)
+        self.level[d] -= delta_us
+        self.net_debit[d] += delta_us
+        if not self.refill and self.level[d] > self.cap_us + 1e-6:
+            self.violations.append(
+                f"bucket over-credited on slot {d}: level "
+                f"{self.level[d]:.0f}us exceeds seed {self.cap_us:.0f}us "
+                f"(double refund)")
+        if self.refill:
+            self.level[d] = min(self.level[d], self.cap_us)
+
+    def rate_level(self, d: int) -> int:
+        self._tick(d)
+        return int(self.level[d])
+
+    def busy_add(self, d: int, us: int) -> None:
+        self.busy[d] += int(us)
+
+    # -- HBM ledger --------------------------------------------------------
+
+    def mem_acquire(self, d: int, nbytes: int,
+                    oversubscribe: bool = False) -> bool:
+        if not oversubscribe and self.limit[d] and \
+                self.used[d] + nbytes > self.limit[d]:
+            return False
+        self.used[d] += nbytes
+        self.peak[d] = max(self.peak[d], self.used[d])
+        return True
+
+    def mem_acquire_capped(self, d: int, nbytes: int,
+                           cap_bytes: int) -> bool:
+        if self.used[d] + nbytes > cap_bytes:
+            return False
+        self.used[d] += nbytes
+        self.peak[d] = max(self.peak[d], self.used[d])
+        return True
+
+    def mem_release(self, d: int, nbytes: int) -> None:
+        self.used[d] -= nbytes
+        if self.used[d] < 0:
+            self.violations.append(
+                f"HBM ledger negative on slot {d}: {self.used[d]} "
+                f"after releasing {nbytes} (double release)")
+
+    def mem_info(self, d: int) -> Tuple[int, int]:
+        free = max(self.limit[d] - self.used[d], 0) \
+            if self.limit[d] else 0
+        return free, self.limit[d]
+
+    # -- slot admin --------------------------------------------------------
+
+    def device_stats(self, d: int) -> RegionStats:
+        return RegionStats(self.used[d], self.limit[d], self.peak[d],
+                           self.core[d])
+
+    def set_mem_limit(self, d: int, limit_bytes: int) -> None:
+        self.limit[d] = int(limit_bytes)
+
+    def set_core_limit(self, d: int, pct: int) -> None:
+        self.core[d] = int(pct)
+
+    def reset_slot(self, d: int) -> None:
+        # Slot recycle: bucket re-seeds; busy is a monotonic counter
+        # the real region keeps — conservation rebases on it.
+        self.level[d] = self.cap_us
+        self.net_debit[d] = 0.0
+        self.busy_base[d] = self.busy[d]
+        self.last_refill[d] = self.clock.now()
+
+    def busy_since_reset(self, d: int) -> int:
+        return self.busy[d] - self.busy_base[d]
+
+    def set_work_conserving(self, on: bool) -> None:
+        pass
+
+    def register(self, host_pid: int = 0) -> int:
+        return 0
+
+    def close(self) -> None:
+        pass
+
+
+class FakeDevice:
+    def __init__(self, index: int) -> None:
+        self.id = index
+        self.platform = "mc"
+        self.coords = (index,)
+
+
+class FakeArray:
+    """Static-shape output array: everything the broker's accounting
+    reads off a dispatched program's result."""
+
+    def __init__(self, nbytes: int = 64, shape: Tuple[int, ...] = (16,),
+                 dtype: str = "float32") -> None:
+        self.nbytes = nbytes
+        self.shape = shape
+        self.dtype = dtype
+
+    def block_until_ready(self) -> "FakeArray":
+        return self
+
+
+class _FakeJit:
+    """jit(fn) stand-in with the .lower(...).compile() AOT surface the
+    COMPILE arm drives."""
+
+    def __init__(self, fn: Any) -> None:
+        self.fn = fn
+
+    def lower(self, *avals: Any) -> "_FakeJit":
+        return self
+
+    def compile(self) -> "_FakeJit":
+        return self
+
+    def __call__(self, *args: Any) -> Any:
+        return self.fn(*args)
+
+
+class _FakeExported:
+    """jax.export.Exported stand-in, decoded from an mc program blob
+    (``fake_blob``): carries exactly the attrs ``cached_blob`` reads."""
+
+    def __init__(self, n_outs: int, out_nbytes: int) -> None:
+        self.in_avals = ()
+        self.out_avals = [None] * n_outs
+        self.nr_devices = 1
+        self._n_outs = n_outs
+        self._out_nbytes = out_nbytes
+
+    def call(self, *args: Any) -> List[FakeArray]:
+        return [FakeArray(nbytes=self._out_nbytes)
+                for _ in range(self._n_outs)]
+
+
+class _FakeExportNS:
+    @staticmethod
+    def deserialize(blob: Any) -> _FakeExported:
+        parts = bytes(blob).decode("ascii", "replace").split(":")
+        if len(parts) != 3 or parts[0] != "mc-prog":
+            raise ValueError(f"not an mc program blob: {parts[:1]}")
+        return _FakeExported(int(parts[1]), int(parts[2]))
+
+
+def fake_blob(n_outs: int = 1, out_nbytes: int = 64) -> bytes:
+    """A serialized-export stand-in the harness FakeJax can
+    'deserialize' — lets scenarios drive the REAL COMPILE arm
+    (``cached_blob`` + journal blob store) without real jax."""
+    return b"mc-prog:%d:%d" % (n_outs, out_nbytes)
+
+
+class FakeJax:
+    """The jax surface the dispatch/metering/compile paths touch."""
+
+    export = _FakeExportNS()
+
+    def block_until_ready(self, x: Any) -> Any:
+        return x
+
+    def device_put(self, arr: Any, dev: Any) -> FakeArray:
+        nb = int(getattr(arr, "nbytes", 64))
+        return FakeArray(nbytes=nb)
+
+    def jit(self, fn: Any, **kw: Any) -> _FakeJit:
+        return _FakeJit(fn)
+
+    @staticmethod
+    def ShapeDtypeStruct(shape: Any, dtype: Any) -> Tuple[Any, Any]:
+        return (shape, dtype)
+
+
+class ScriptSock:
+    """Scripted in-memory socket: the pre-encoded request frames of one
+    connection, replayed through the REAL protocol layer
+    (``P.recv_msg``) into the REAL ``TenantSession._serve`` /
+    ``AdminSession.handle`` loops.  recv() past the script returns
+    b'' — the peer-closed signal that drives the genuine teardown
+    path.  Replies land in ``sent`` (bytes) for inspection."""
+
+    def __init__(self, frames: Any = ()) -> None:
+        self._buf = b"".join(frames)
+        self._off = 0
+        self.sent: List[bytes] = []
+
+    def recv(self, n: int) -> bytes:
+        out = self._buf[self._off:self._off + n]
+        self._off += len(out)
+        return out
+
+    def sendall(self, data: Any) -> None:
+        self.sent.append(bytes(data))
+
+    def getsockopt(self, level: int, opt: int, buflen: int = 0) -> bytes:
+        import os
+        import struct
+        return struct.pack("3i", os.getpid(), os.getuid(), os.getgid())
+
+
+def fake_program(n_outs: int = 1, out_nbytes: int = 64):
+    """A real ``Program`` whose callable returns fake static-shape
+    outputs (what the metering/accounting paths consume)."""
+    from ...runtime.server import Program
+
+    def fn(*args: Any) -> List[FakeArray]:
+        return [FakeArray(nbytes=out_nbytes) for _ in range(n_outs)]
+
+    return Program(fn, avals=(), n_outs=n_outs)
+
+
+class FakeChip:
+    """ChipState stand-in: model region + the REAL DeviceScheduler
+    (whose dispatcher/completer threads become MC daemon tasks via the
+    patched ``threading.Thread``)."""
+
+    def __init__(self, state: Any, index: int, clock: mcsched.MCClock,
+                 cap_us: float, refill: bool) -> None:
+        self.index = index
+        self.device = FakeDevice(index)
+        self.region = ModelRegion(clock, cap_us=cap_us, refill=refill)
+        self._latency_us = 0.0
+        from ...runtime.server import DeviceScheduler
+        self.scheduler = DeviceScheduler(state, self)
+
+    def calibrate_latency_us(self) -> float:
+        return 0.0
+
+
+class Harness:
+    """One scenario's broker instance + the oracles the invariant
+    registry reads."""
+
+    def __init__(self, sched: mcsched.Scheduler, *,
+                 n_chips: int = 1, journal: Any = None,
+                 rate_lease_us: int = 20_000, cap_us: float = 10**9,
+                 refill: bool = False, min_exec_cost_us: int = 0,
+                 default_hbm: int = 1 << 20,
+                 default_core: int = 50) -> None:
+        self.sched = sched
+        self.clock = sched.clock
+        self.refill = refill
+        self.sent: List[Tuple[str, Dict[str, Any]]] = []
+        self.lost_wakes: List[str] = []
+        self.durability: List[str] = []
+        self._dur_seen: Dict[str, set] = {}
+        # Every tenant the scenario ever bound (incl. released ones):
+        # the terminal deferred-flush invariant scans them all.
+        self.all_tenants: List[Any] = []
+        self.state = self._build_state(
+            n_chips, journal, rate_lease_us, cap_us, refill,
+            min_exec_cost_us, default_hbm, default_core)
+        sched.on_timeout_wake = self._on_timeout_wake
+        sched.quiescent = self.quiescent
+        sched.step_check = self._step_check
+
+    # -- construction ------------------------------------------------------
+
+    def _build_state(self, n_chips: int, journal: Any,
+                     rate_lease_us: int, cap_us: float, refill: bool,
+                     min_exec_cost_us: int, default_hbm: int,
+                     default_core: int) -> Any:
+        from ...runtime import server as S
+        from ...runtime import trace as tracing
+        st = S.RuntimeState.__new__(S.RuntimeState)
+        st.jax = FakeJax()
+        st.journal = journal
+        st.prev_epoch = None
+        st.recovered = {}
+        st.resume_grace = 120.0
+        st.recovery = {k: 0 for k in (
+            "recoveries_total", "tenants_recovered", "tenants_readopted",
+            "tenants_dropped_dead", "tenants_dropped_expired",
+            "tenants_dropped_replaced", "arrays_dropped",
+            "corrupt_recoveries")}
+        st.chip_latency_hints = {}
+        st.draining = False
+        st._keeper_stop = mcsched.MCEvent(self.sched)
+        st.flight = tracing.FlightRecorder(enabled=False)
+        st.last_wedge = None
+        st._journal_state = None
+        st.work_conserving = False
+        st.spill_overshoot = 0.0
+        st.rate_lease_us = rate_lease_us
+        st.rate_lease_ttl_s = max(4.0 * rate_lease_us / 1e6, 0.05)
+        st.pool_stats = {}
+        st.devices = [FakeDevice(i) for i in range(n_chips)]
+        st.epoch = "mc-epoch"
+        st.region_path = "<mc>"
+        st.default_hbm = default_hbm
+        st.default_core = default_core
+        st.min_exec_cost_us = min_exec_cost_us
+        st.tenants = {}
+        st.suspended = set()
+        st.blob_cache = collections.OrderedDict()
+        st.chain_cache = collections.OrderedDict()
+        st.put_cache = {}
+        st.put_dedup = False
+        st.put_dedup_node = False
+        # Locks via the patched server-module namespace, exactly as
+        # RuntimeState.__init__ would create them.
+        st.put_cache_mu = S.threading.Lock()
+        st.mu = S.threading.Lock()
+        st.chips_mu = S.threading.Lock()
+        st.chips = {}
+        for i in range(n_chips):
+            st.chips[i] = FakeChip(st, i, self.clock, cap_us, refill)
+        return st
+
+    def session(self, sock: Optional[ScriptSock] = None) -> Any:
+        """A real TenantSession wired to the stub state with the socket
+        send replaced by a recorder (+ the reply-durability oracle).
+        With ``sock`` set, ``sess.request`` is wired so a scenario task
+        can run the REAL ``handle()`` loop over scripted frames."""
+        from ...runtime import protocol as P
+        from ...runtime import server as S
+        sess = S.TenantSession.__new__(S.TenantSession)
+        sess.state = self.state
+        if sock is not None:
+            sess.request = sock
+        sess.send_mu = S.threading.Lock()
+        sess.pending = 0
+        sess.pending_cond = S.threading.Condition()
+        sess._staging = {}
+        sess._staging_bytes = 0
+        sess._pool = P.RecvPool(stats=self.state.pool_stats)
+
+        def _send(msg: Dict[str, Any], _sess=sess) -> None:
+            # Durability contract: once the client sees a reply, the
+            # journal covers the change — every pre-reply path flushes
+            # the tenant's deferred records first.  A record may
+            # legitimately be in flight for ONE concurrent reply (a
+            # co-task deferred it after this reply's flush); one that
+            # is still deferred at the tenant's NEXT reply was never
+            # flushed at all (the lost-durability bug).
+            t = getattr(_sess, "_mc_tenant", None)
+            if self.state.journal is not None and t is not None:
+                pending = {id(r) for r in t.pending_journal}
+                stale = pending & self._dur_seen.get(t.name, set())
+                if stale:
+                    self.durability.append(
+                        f"reply sent while tenant {t.name!r} still "
+                        f"holds {len(stale)} deferred journal "
+                        f"record(s) from before its previous reply "
+                        f"(deferred append never flushed)")
+                self._dur_seen[t.name] = pending
+            self.sent.append(("send", msg))
+
+        sess._send = _send
+        return sess
+
+    def tenant(self, sess: Any, name: str, priority: int = 1,
+               core_limit: int = 50, hbm_limit: Optional[int] = None,
+               device: int = 0,
+               devices: Optional[List[int]] = None) -> Any:
+        t, _created = self.state.tenant(
+            name, priority, device=device, devices=devices,
+            hbm_limit=hbm_limit if hbm_limit is not None
+            else self.state.default_hbm,
+            core_limit=core_limit)
+        if self.state.journal is not None:
+            import os
+            sess._journal_bind(t, {"pid": os.getpid(), "pidns": 0})
+        sess._mc_tenant = t
+        if t not in self.all_tenants:
+            self.all_tenants.append(t)
+        return t
+
+    def admin(self, frames: Any) -> Any:
+        """A real AdminSession over a scripted socket: a scenario task
+        calls ``.handle()`` to drive the genuine admin verbs
+        (SUSPEND/RESUME/DRAIN/...) against the stub state."""
+        from ...runtime import server as S
+        adm = S.AdminSession.__new__(S.AdminSession)
+        adm.state = self.state
+        adm.request = ScriptSock(frames)
+        return adm
+
+    def seed_array(self, t: Any, aid: str, nbytes: int = 64) -> None:
+        """Stage an input array through the real charge path (and, with
+        a journal, the real PUT bookkeeping order: blob_meta under
+        t.mu, the put record appended after release — so a later drop
+        of this id defers a del record exactly like a journaled PUT
+        array's would)."""
+        rec = {"op": "put", "name": t.name, "id": aid,
+               "sha": f"mc-{aid}", "shape": [nbytes // 4],
+               "dtype": "float32", "nbytes": nbytes,
+               "charges": [[0, nbytes]], "spilled": False}
+        with t.mu:
+            t.arrays[aid] = FakeArray(nbytes=nbytes)
+            t.nbytes[aid] = nbytes
+            t.charge_array(aid, [(0, nbytes)], False)
+            if self.state.journal is not None:
+                t.blob_meta[aid] = {
+                    k: rec[k] for k in ("sha", "shape", "dtype",
+                                        "nbytes", "charges", "spilled")}
+        if self.state.journal is not None:
+            self.state.journal.append(rec)
+
+    def exec_spec(self, exe: str, args: List[str], outs: List[str],
+                  free: Tuple[str, ...] = ()) -> Dict[str, Any]:
+        return {"exe": exe, "args": args, "outs": outs,
+                "free": list(free)}
+
+    # -- oracles -----------------------------------------------------------
+
+    def _on_timeout_wake(self, task: mcsched.MCTask, obj: Any,
+                         timeout: float) -> None:
+        """Lost-wake oracle: the dispatcher idle-slept (its 0.5 s
+        default — used only when _pick_locked reported no time-gated
+        work) yet its scheduler holds dispatchable work.  A correct
+        broker's submit/retire/resume paths would have notified it."""
+        if not task.name.startswith("vtpu-rt-dispatch"):
+            return
+        if timeout < 0.49:  # soonest-bounded waits are time-gated work
+            return
+        from ...runtime import server as S
+        for chip in self.state.chips.values():
+            ds = chip.scheduler
+            if not isinstance(obj, mcsched.MCCondition) or \
+                    obj is not ds.mu:
+                continue
+            if ds.queued_est_us >= S.MAX_QUEUED_US:
+                continue
+            now = self.clock.now()
+            for name, q in ds.queues.items():
+                if not q or name in self.state.suspended:
+                    continue
+                if ds.inflight.get(name, 0) >= S.MAX_INFLIGHT:
+                    continue
+                if ds.not_ready_until.get(name, 0.0) > now:
+                    continue
+                self.lost_wakes.append(
+                    f"dispatcher chip{chip.index} idle-slept with "
+                    f"dispatchable work queued for tenant {name!r} "
+                    f"(lost wake)")
+
+    def quiescent(self) -> bool:
+        for chip in self.state.chips.values():
+            ds = chip.scheduler
+            if any(ds.inflight.values()):
+                return False
+            if ds._completion_q.items:  # MCQueue
+                return False
+            for name, q in ds.queues.items():
+                if q and name not in self.state.suspended:
+                    return False
+        return True
+
+    def _step_check(self) -> List[str]:
+        from . import invariants
+        return invariants.run_checks("interleave", "step", self)
+
+    def expected_hbm(self) -> Dict[Tuple[int, int], int]:
+        """chip,slot -> bytes the broker's OWN books say are charged
+        (tenant charges + resident staged spill copies)."""
+        out: Dict[Tuple[int, int], int] = {}
+        live = list(self.state.tenants.values()) \
+            + [e[0] for e in self.state.recovered.values()]
+        for t in live:
+            for charges in t.charges.values():
+                for pos, nb in charges:
+                    key = (t.chips[pos].index, t.slots[pos])
+                    out[key] = out.get(key, 0) + nb
+            for nb in t.staged_bytes.values():
+                key = (t.chip.index, t.index)
+                out[key] = out.get(key, 0) + nb
+        return out

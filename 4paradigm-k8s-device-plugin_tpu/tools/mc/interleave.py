@@ -1,0 +1,235 @@
+"""vtpu-mc interleaving engine: exhaustive schedule exploration of the
+real broker under the cooperative scheduler.
+
+DFS over scheduling decisions with two classic state-space prunings:
+
+  - **sleep sets** (DPOR-style): after exploring task ``t`` at a
+    decision node, ``t`` sleeps there; an alternative ``u`` only wakes
+    ``t`` in the subtree when their pending operations are DEPENDENT
+    (touch the same lock/condition/queue).  Commuting interleavings of
+    independent operations are explored once, not 2! times.
+  - **bounded preemption** (CHESS-style): switching away from a task
+    that is still enabled costs one unit of a small preemption budget;
+    schedules beyond the budget are not branched.  Most concurrency
+    bugs need very few preemptions, and the bound turns an intractable
+    space into a dense, high-yield one.
+
+Every schedule replays the scenario from scratch (fresh broker state,
+fresh journal dir) following the recorded decision prefix, then runs
+the default policy (stay on the current task; else lowest id) to a
+terminal state — where the registry's terminal invariants are checked.
+Replay is exact because the only nondeterminism IS the decision
+sequence; a divergence is reported as a harness bug, never ignored.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import invariants as inv_registry
+from . import sched as mcsched
+from .harness import Harness
+
+
+def _op_resource(op: Optional[Tuple]) -> Optional[int]:
+    if not op or len(op) < 2 or op[1] is None:
+        return None
+    return id(op[1])
+
+
+def _dependent(op_a: Optional[Tuple], op_b: Optional[Tuple]) -> bool:
+    """Two pending operations are dependent when they touch the same
+    synchronization object (lock, condition, queue).  Everything else
+    commutes at the decision granularity the scheduler exposes."""
+    ra, rb = _op_resource(op_a), _op_resource(op_b)
+    if ra is None or rb is None:
+        return True  # unknown resources: be conservative, stay sound
+    return ra == rb
+
+
+@dataclass
+class Node:
+    """One decision point along the current schedule."""
+    enabled: List[int]
+    ops: Dict[int, Tuple]
+    chosen: int
+    prev: Optional[int] = None   # task that ran the previous slice
+    used_before: int = 0         # preemptions consumed before here
+    tried: set = field(default_factory=set)
+    sleep: set = field(default_factory=set)
+
+    def cost(self, t: int) -> int:
+        """A choice is a preemption when the previous slice's task is
+        still enabled but a different one runs."""
+        return 1 if (self.prev is not None and self.prev in self.enabled
+                     and t != self.prev) else 0
+
+
+@dataclass
+class ScenarioStats:
+    name: str = ""
+    schedules: int = 0
+    decisions: int = 0
+    truncated: int = 0
+    violations: List[str] = field(default_factory=list)
+    # schedule (decision list) that produced the first violation
+    witness: Optional[List[int]] = None
+
+
+class Explorer:
+    def __init__(self, scenario: "Scenario", *,
+                 max_schedules: int = 2000,
+                 preemption_bound: int = 2,
+                 max_steps: int = mcsched.DEFAULT_MAX_STEPS) -> None:
+        self.scenario = scenario
+        self.max_schedules = max_schedules
+        self.preemption_bound = preemption_bound
+        self.max_steps = max_steps
+        self.stats = ScenarioStats(name=scenario.name)
+
+    # -- one schedule ------------------------------------------------------
+
+    def _run_once(self, script: List[int],
+                  nodes: List[Node]) -> List[str]:
+        """Execute the scenario following ``script``; extend ``nodes``
+        with the decision points actually taken (prefix nodes are
+        reused, fresh ones appended)."""
+        sched = mcsched.Scheduler(max_steps=self.max_steps)
+        violations: List[str] = []
+        with mcsched.patched_modules(sched):
+            tmp = None
+            journal = None
+            if self.scenario.with_journal:
+                tmp = tempfile.mkdtemp(prefix="vtpu-mc-")
+                from ...runtime.journal import Journal
+                journal = Journal(tmp, snapshot_every=10_000,
+                                  fsync=False)
+            try:
+                h = Harness(sched, journal=journal,
+                            **self.scenario.harness_kw)
+                self.scenario.setup(h, sched)
+
+                def choose(step: int,
+                           enabled: List[mcsched.MCTask]
+                           ) -> mcsched.MCTask:
+                    self.stats.decisions += 1
+                    by_id = {t.tid: t for t in enabled}
+                    ids = sorted(by_id)
+                    ops = {t.tid: t.pending for t in enabled}
+                    if step < len(nodes):
+                        node = nodes[step]
+                        if node.chosen not in by_id:
+                            raise mcsched.ReplayDivergence(
+                                f"{self.scenario.name}: step {step} "
+                                f"scripted task {node.chosen} not "
+                                f"enabled (enabled={ids})")
+                        node.enabled = ids
+                        node.ops = ops
+                        return by_id[node.chosen]
+                    # Past the script: default policy (run-to-
+                    # completion bias), recorded as a fresh node.
+                    parent = nodes[-1] if nodes else None
+                    prev = parent.chosen if parent else None
+                    used = (parent.used_before
+                            + parent.cost(parent.chosen)) \
+                        if parent else 0
+                    pick = prev if (prev is not None and prev in by_id) \
+                        else ids[0]
+                    sleep: set = set()
+                    if parent is not None:
+                        chosen_op = parent.ops.get(parent.chosen)
+                        sleep = {
+                            t for t in parent.sleep | (parent.tried
+                                                       - {parent.chosen})
+                            if t in ops and not _dependent(
+                                ops.get(t), chosen_op)}
+                    if pick in sleep:
+                        awake = [i for i in ids if i not in sleep]
+                        if awake:
+                            pick = awake[0]
+                    node = Node(enabled=ids, ops=ops, chosen=pick,
+                                prev=prev, used_before=used)
+                    node.tried.add(pick)
+                    node.sleep = sleep
+                    nodes.append(node)
+                    return by_id[pick]
+
+                sched.run(choose)
+                violations.extend(sched.violations)
+                if not violations and sched.steps <= self.max_steps:
+                    violations.extend(inv_registry.run_checks(
+                        "interleave", "terminal", h))
+                if sched.steps > self.max_steps:
+                    self.stats.truncated += 1
+            finally:
+                if journal is not None:
+                    journal.close()
+                if tmp is not None:
+                    shutil.rmtree(tmp, ignore_errors=True)
+        return violations
+
+    # -- DFS over schedules ------------------------------------------------
+
+    def explore(self) -> ScenarioStats:
+        nodes: List[Node] = []
+        script: List[int] = []
+        while True:
+            try:
+                violations = self._run_once(script, nodes)
+            except mcsched.ReplayDivergence as e:
+                self.stats.violations.append(f"[determinism] {e}")
+                self.stats.witness = list(script)
+                break
+            self.stats.schedules += 1
+            if violations:
+                self.stats.violations.extend(violations)
+                self.stats.witness = [n.chosen for n in nodes]
+                break
+            if self.stats.schedules >= self.max_schedules:
+                break
+            # Backtrack: deepest node with an unexplored, awake,
+            # budget-feasible alternative.
+            nxt = None
+            while nodes:
+                node = nodes[-1]
+                feasible = [
+                    t for t in node.enabled
+                    if t not in node.tried and t not in node.sleep
+                    and node.used_before + node.cost(t)
+                    <= self.preemption_bound]
+                if feasible:
+                    t = feasible[0]
+                    node.tried.add(t)
+                    new = Node(enabled=node.enabled, ops=node.ops,
+                               chosen=t, prev=node.prev,
+                               used_before=node.used_before)
+                    new.tried = node.tried  # shared explored set
+                    new.sleep = set(node.sleep)
+                    nodes[-1] = new
+                    nxt = [n.chosen for n in nodes]
+                    break
+                nodes.pop()
+            if nxt is None:
+                break  # space exhausted
+            script = nxt
+            nodes = nodes[:len(script)]
+            for n in nodes:
+                n.ops = dict(n.ops)
+        return self.stats
+
+
+@dataclass
+class Scenario:
+    name: str
+    description: str
+    setup: Callable[[Harness, mcsched.Scheduler], None]
+    harness_kw: Dict[str, Any] = field(default_factory=dict)
+    with_journal: bool = True
+
+
+def explore_scenario(scenario: Scenario, **kw: Any) -> ScenarioStats:
+    return Explorer(scenario, **kw).explore()

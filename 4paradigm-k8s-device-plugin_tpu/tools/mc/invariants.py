@@ -1,0 +1,285 @@
+"""vtpu-mc invariant registry — the single declaration point.
+
+Every property the model checker enforces is declared HERE, as one
+``Invariant`` with the engine(s) that check it and the phase it runs
+in.  New broker state transitions (cross-node federation, elastic
+burst credits — ROADMAP 3-4) land with new entries in this table, not
+with new hope; docs/ANALYSIS.md renders the same table for operators.
+
+Phases:
+
+  - ``step``     — checked at every scheduling decision of the
+                   interleaving engine (cheap safety: non-negativity,
+                   over-credit, lost wakes, deadlock hooks live in the
+                   scheduler/harness and surface through these).
+  - ``terminal`` — checked once per fully-quiescent explored schedule
+                   (conservation equations that only balance when no
+                   operation is mid-flight).
+  - ``cut``      — checked per journal truncation point by the
+                   crash-cut engine (recovery safety).
+
+A check returns a list of human-readable violation strings (empty =
+holds).  Its ``ctx`` is the interleaving ``Harness`` for step/terminal
+checks and a ``CutContext`` for cut checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Tuple
+
+EPS_US = 1.0
+
+
+@dataclass(frozen=True)
+class Invariant:
+    name: str
+    engine: str        # "interleave" | "crash"
+    phase: str         # "step" | "terminal" | "cut"
+    description: str
+    check: Callable[[Any], List[str]]
+
+
+# ---------------------------------------------------------------------------
+# Interleaving-engine checks (ctx = tools.mc.harness.Harness)
+# ---------------------------------------------------------------------------
+
+def _chk_token_conservation(h: Any) -> List[str]:
+    """At a quiescent terminal state with a frozen (refill=False)
+    bucket, every µs ever debited is accounted for: it was either
+    metered as device time (busy counter) or still sits in a live
+    tenant's unexpired rate lease.  A broken refund path (expiry,
+    suspend, release, drain) or a double debit breaks the balance —
+    quota leaked."""
+    if h.refill:
+        return []
+    out: List[str] = []
+    lease_by_slot: dict = {}
+    live = list(h.state.tenants.values()) \
+        + [e[0] for e in h.state.recovered.values()]
+    for t in live:
+        for chip, slot in zip(t.chips, t.slots):
+            key = (chip.index, slot)
+            lease_by_slot[key] = lease_by_slot.get(key, 0.0) \
+                + float(t.lease_us)
+    for chip in h.state.chips.values():
+        r = chip.region
+        for s in range(r.nslots):
+            if r.core[s] <= 0:
+                if abs(r.net_debit[s]) > EPS_US:
+                    out.append(
+                        f"unmetered slot chip{chip.index}/{s} has a "
+                        f"net bucket debit of {r.net_debit[s]:.0f}us")
+                continue
+            expect = r.busy_since_reset(s) \
+                + lease_by_slot.get((chip.index, s), 0.0)
+            if abs(r.net_debit[s] - expect) > EPS_US:
+                out.append(
+                    f"token conservation broken on chip{chip.index} "
+                    f"slot {s}: net debit {r.net_debit[s]:.0f}us != "
+                    f"busy {r.busy_since_reset(s)}us + outstanding "
+                    f"leases {lease_by_slot.get((chip.index, s), 0.0):.0f}"
+                    f"us (quota leak / double credit)")
+    return out
+
+
+def _chk_hbm_balance(h: Any) -> List[str]:
+    """Region HBM ledgers must equal the sum of the per-tenant charge
+    books at every quiescent terminal state — and a slot with no live
+    tenant must read zero (release leaks nothing)."""
+    out: List[str] = []
+    expected = h.expected_hbm()
+    for chip in h.state.chips.values():
+        r = chip.region
+        for s in range(r.nslots):
+            want = expected.get((chip.index, s), 0)
+            if r.used[s] != want:
+                out.append(
+                    f"HBM ledger imbalance on chip{chip.index} slot "
+                    f"{s}: region says {r.used[s]}B, tenant books say "
+                    f"{want}B")
+    return out
+
+
+def _chk_region_safety(h: Any) -> List[str]:
+    """Continuous region safety, surfaced by the ModelRegion itself at
+    each mutation: the bucket never exceeds its seed (a refund past it
+    is a double credit) and the HBM ledger never goes negative (a
+    release past zero is a double release)."""
+    out: List[str] = []
+    for chip in h.state.chips.values():
+        out.extend(chip.region.violations)
+        chip.region.violations = []
+    return out
+
+
+def _chk_lease_nonneg(h: Any) -> List[str]:
+    """A tenant's pre-debited lease balance can never be negative —
+    burning more than was granted means unmetered device time."""
+    out: List[str] = []
+    for t in list(h.state.tenants.values()):
+        if t.lease_us < -1e-9:
+            out.append(f"tenant {t.name!r} lease balance is negative: "
+                       f"{t.lease_us}")
+    return out
+
+
+def _chk_lost_wake(h: Any) -> List[str]:
+    out, h.lost_wakes = list(h.lost_wakes), []
+    return out
+
+
+def _chk_durability(h: Any) -> List[str]:
+    out, h.durability = list(h.durability), []
+    return out
+
+
+def _chk_deferred_flush(h: Any) -> List[str]:
+    """At quiescence every reply has been sent, so every deferred
+    journal record must have been flushed — a leftover means some path
+    acknowledged (or tore down) state the journal never got."""
+    if h.state.journal is None:
+        return []
+    out: List[str] = []
+    seen: set = set()
+    every = (list(h.state.tenants.values())
+             + [e[0] for e in h.state.recovered.values()]
+             + list(h.all_tenants))
+    for t in every:
+        if id(t) in seen:
+            continue
+        seen.add(id(t))
+        if t.pending_journal:
+            out.append(
+                f"tenant {t.name!r} ends the scenario with "
+                f"{len(t.pending_journal)} deferred journal record(s) "
+                f"never flushed (lost durability)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Crash-cut-engine checks (ctx = tools.mc.crashcut.CutContext)
+# ---------------------------------------------------------------------------
+
+def _chk_replay_deterministic(c: Any) -> List[str]:
+    if c.state_a != c.state_b:
+        return [f"cut {c.label}: two recoveries of the same journal "
+                f"prefix disagree (replay is nondeterministic)"]
+    return []
+
+
+def _chk_ground_truth(c: Any) -> List[str]:
+    """At a cut on a record boundary of the single-threaded phase, the
+    replayed tenant/array/charge state must equal the LIVE broker
+    state snapshotted when that record was appended — any skipped or
+    wrong replay arm shows up as a diff."""
+    if c.expected is None:
+        return []
+    got = c.tenant_digest(c.state_a)
+    if got != c.expected:
+        return [f"cut {c.label}: recovered state diverges from the "
+                f"live broker state at append time: got {got!r}, "
+                f"expected {c.expected!r}"]
+    return []
+
+
+def _chk_resume_consistent(c: Any) -> List[str]:
+    """Driving the REAL ``_recover_from_journal`` over the prefix must
+    leave every recovered tenant internally consistent: region limits
+    re-seeded to the journaled grant, region usage equal to the
+    re-applied ledger, and the rate lease starting at zero (the
+    journal-replay lease reclamation)."""
+    return c.resume_violations
+
+
+def _chk_reresume_idempotent(c: Any) -> List[str]:
+    """Crashing again immediately after recovery (epoch record +
+    boot snapshot written, nothing else) and recovering a second time
+    must yield the same tenants — resume is idempotent."""
+    return c.reresume_violations
+
+
+def _chk_torn_tail(c: Any) -> List[str]:
+    """A cut mid-record (the kill -9 artifact) must recover exactly
+    the previous record boundary's state — the torn tail is dropped,
+    never guessed at, and never poisons the rest of the log."""
+    return c.torn_violations
+
+
+def _chk_fail_closed(c: Any) -> List[str]:
+    """Non-tail corruption must raise JournalCorrupt (quarantine +
+    fresh epoch) — recovery never proceeds on a log it cannot trust."""
+    return c.corrupt_violations
+
+
+INVARIANTS: Tuple[Invariant, ...] = (
+    Invariant(
+        "token-conservation", "interleave", "terminal",
+        "net bucket debit == metered busy time + outstanding leases "
+        "(no quota leak through grant/burn/refund/expiry/suspend/"
+        "release/drain)", _chk_token_conservation),
+    Invariant(
+        "hbm-ledger-balance", "interleave", "terminal",
+        "region HBM ledgers == per-tenant charge books; released "
+        "slots read zero", _chk_hbm_balance),
+    Invariant(
+        "region-safety", "interleave", "step",
+        "bucket never over-credited past its seed (double refund); "
+        "HBM ledger never negative (double release)",
+        _chk_region_safety),
+    Invariant(
+        "lease-nonnegative", "interleave", "step",
+        "pre-debited lease balances never go negative",
+        _chk_lease_nonneg),
+    Invariant(
+        "no-lost-wake", "interleave", "step",
+        "the dispatcher never idle-sleeps while dispatchable work is "
+        "queued", _chk_lost_wake),
+    Invariant(
+        "reply-durability", "interleave", "step",
+        "deferred journal records are flushed before the reply that "
+        "acknowledges them", _chk_durability),
+    Invariant(
+        "deferred-flush", "interleave", "terminal",
+        "no deferred journal record survives to quiescence unflushed",
+        _chk_deferred_flush),
+    Invariant(
+        "replay-deterministic", "crash", "cut",
+        "recovering the same journal prefix twice yields identical "
+        "state", _chk_replay_deterministic),
+    Invariant(
+        "replay-ground-truth", "crash", "cut",
+        "replayed state at every record boundary equals the live "
+        "broker state when that record was appended",
+        _chk_ground_truth),
+    Invariant(
+        "resume-consistent", "crash", "cut",
+        "epoch resume from any prefix re-seeds grants/limits/ledgers "
+        "consistently and restarts leases at zero",
+        _chk_resume_consistent),
+    Invariant(
+        "reresume-idempotent", "crash", "cut",
+        "a second crash immediately after recovery recovers the same "
+        "tenants", _chk_reresume_idempotent),
+    Invariant(
+        "torn-tail-dropped", "crash", "cut",
+        "a mid-record cut recovers exactly the previous boundary's "
+        "state", _chk_torn_tail),
+    Invariant(
+        "corruption-fails-closed", "crash", "cut",
+        "non-tail journal damage raises JournalCorrupt (no guessed "
+        "quota state)", _chk_fail_closed),
+)
+
+
+def for_engine(engine: str, phase: str) -> List[Invariant]:
+    return [i for i in INVARIANTS
+            if i.engine == engine and i.phase == phase]
+
+
+def run_checks(engine: str, phase: str, ctx: Any) -> List[str]:
+    out: List[str] = []
+    for inv in for_engine(engine, phase):
+        for v in inv.check(ctx):
+            out.append(f"[{inv.name}] {v}")
+    return out

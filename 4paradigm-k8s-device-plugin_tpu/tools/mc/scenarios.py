@@ -1,0 +1,277 @@
+"""vtpu-mc scenario suite — the small multi-tenant workloads the
+interleaving engine explores exhaustively.
+
+Each scenario spawns a handful of MC tasks (tenant clients, an admin
+driver) that call the REAL broker entry points — ``TenantSession``
+methods, ``AdminSession.handle`` over a scripted socket,
+``RuntimeState.tenant/release_tenant`` — against the harness's stub
+state.  The dispatcher and metering loops of every chip run as MC
+daemon tasks (the patched ``threading.Thread``), so every schedule the
+explorer picks is a genuine interleaving of genuine broker code.
+
+Design rule: scenarios are SMALL on purpose.  State-space size is
+exponential in concurrent operations; the exhaustive value comes from
+covering every interleaving of a few representative transitions
+(submit_many + lease grant/burn/refund + expiry + suspend/resume +
+tenant crash + journal deferral), not from big workloads.  Add a new
+transition class (ROADMAP 3-4: federation, burst credits) as a new
+small scenario + a registry invariant, not by growing an existing one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from .harness import Harness, fake_program
+from .interleave import Scenario
+from . import sched as mcsched
+
+
+def _teardown(h: Harness, sess: Any, t: Any) -> None:
+    """The REAL connection-death path (``TenantSession.handle``'s
+    finally block): purge still-queued items, drain replies, release
+    the tenant, drop its arrays."""
+    t.chip.scheduler.purge_session(sess)
+    sess._drain()
+    if h.state.release_tenant(t):
+        sess._cleanup(t)
+
+
+def _admin_frames(*msgs: dict) -> List[bytes]:
+    from ...runtime import protocol as P
+    return [P.frame_header(m) for m in msgs]
+
+
+# ---------------------------------------------------------------------------
+# Scenario setups
+# ---------------------------------------------------------------------------
+
+def _setup_batch_pipeline(h: Harness, sched: mcsched.Scheduler) -> None:
+    """One metered tenant pipelines an EXEC_BATCH whose middle item
+    frees an input array at dispatch (deferred journal del), then tears
+    down cleanly.  Covers: submit_many, lease grant/burn, zero-RT free,
+    journal deferral + pre-reply flush, release refund."""
+    sess = h.session()
+
+    def client() -> None:
+        t = h.tenant(sess, "A", core_limit=50)
+        h.seed_array(t, "w", 64)
+        t.executables["p"] = fake_program()
+        sess._enqueue_batch(t, {"items": [
+            h.exec_spec("p", ["w"], ["o1"]),
+            h.exec_spec("p", ["o1"], ["o2"], free=("w",)),
+            h.exec_spec("p", ["o2"], ["o3"]),
+        ]})
+        sess._drain()
+        _teardown(h, sess, t)
+
+    sched.spawn(client, "clientA")
+
+
+def _setup_contention(h: Harness, sched: mcsched.Scheduler) -> None:
+    """Two metered tenants race batches through one chip's scheduler:
+    the lease-grant/burn paths of both interleave with dispatch and
+    retirement.  Covers: concurrent submit_many, round-robin pick,
+    per-tenant lease isolation."""
+    sA, sB = h.session(), h.session()
+
+    def client(sess: Any, name: str) -> None:
+        t = h.tenant(sess, name, core_limit=50)
+        t.executables["p"] = fake_program()
+        sess._enqueue_batch(t, {"items": [
+            h.exec_spec("p", [], ["x1"]),
+            h.exec_spec("p", ["x1"], ["x2"]),
+        ]})
+        sess._drain()
+        _teardown(h, sess, t)
+
+    sched.spawn(lambda: client(sA, "A"), "clientA")
+    sched.spawn(lambda: client(sB, "B"), "clientB")
+
+
+def _setup_lease_expiry(h: Harness, sched: mcsched.Scheduler) -> None:
+    """A tenant executes, idles past the lease TTL (logical-clock
+    jump), then executes again: the second admission must refund the
+    expired remainder before re-granting.  Covers: expiry refund,
+    re-grant, terminal lease accounting."""
+    sess = h.session()
+
+    def client() -> None:
+        t = h.tenant(sess, "A", core_limit=50)
+        t.executables["p"] = fake_program()
+        sess._enqueue_execute(t, h.exec_spec("p", [], ["o1"]))
+        sess._drain()
+        # Idle past the lease TTL: the logical clock is the scenario's
+        # to command (discrete-event style) — no task sleeps.
+        h.clock.sleep(4.0 * h.state.rate_lease_ttl_s)
+        sess._enqueue_execute(t, h.exec_spec("p", ["o1"], ["o2"]))
+        sess._drain()
+        _teardown(h, sess, t)
+
+    sched.spawn(client, "clientA")
+
+
+def _setup_suspend_resume(h: Harness, sched: mcsched.Scheduler) -> None:
+    """An admin connection SUSPENDs then RESUMEs tenant A (the REAL
+    AdminSession arm over a scripted socket) while A pipelines a batch.
+    Covers: suspend lease revoke+refund, queue hold, resume kick (a
+    dropped kick is a lost wake), suspend racing bind/dispatch."""
+    from ...runtime import protocol as P
+    sess = h.session()
+
+    def client() -> None:
+        t = h.tenant(sess, "A", core_limit=50)
+        t.executables["p"] = fake_program()
+        sess._enqueue_batch(t, {"items": [
+            h.exec_spec("p", [], ["o1"]),
+            h.exec_spec("p", ["o1"], ["o2"]),
+        ]})
+        sess._drain()
+        _teardown(h, sess, t)
+
+    def admin() -> None:
+        h.admin(_admin_frames(
+            {"kind": P.SUSPEND, "tenant": "A"},
+            {"kind": P.RESUME, "tenant": "A"},
+        )).handle()
+
+    sched.spawn(client, "clientA")
+    sched.spawn(admin, "admin")
+
+
+def _setup_tenant_crash(h: Harness, sched: mcsched.Scheduler) -> None:
+    """Tenant A's connection dies MID-PIPELINE (no drain before the
+    teardown path runs): still-queued items are purged and abandoned,
+    dispatched ones complete against the dead session, the slot and
+    every ledger byte must come back.  An unmetered co-tenant keeps the
+    chip busy throughout.  Covers: purge/abandon, batch-slot fill on
+    teardown, release refund, close-record ordering."""
+    sA, sB = h.session(), h.session()
+
+    def crasher() -> None:
+        t = h.tenant(sA, "A", core_limit=50)
+        h.seed_array(t, "w", 128)
+        t.executables["p"] = fake_program()
+        sA._enqueue_batch(t, {"items": [
+            h.exec_spec("p", ["w"], ["o1"]),
+            h.exec_spec("p", ["o1"], ["o2"], free=("w",)),
+            h.exec_spec("p", ["o2"], ["o3"]),
+        ]})
+        # No drain: the connection is gone — straight to teardown.
+        _teardown(h, sA, t)
+
+    def steady() -> None:
+        t = h.tenant(sB, "B", core_limit=0)  # unmetered co-tenant
+        h.seed_array(t, "wb", 64)
+        t.executables["q"] = fake_program()
+        # Two SEPARATE executes -> two replies, with a journal-deferred
+        # del (free of the journaled array) pending between them: the
+        # reply-durability oracle needs exactly this shape to observe a
+        # record that was never flushed.
+        sB._enqueue_execute(t, h.exec_spec("q", ["wb"], ["y1"],
+                                           free=("wb",)))
+        sB._drain()
+        sB._enqueue_execute(t, h.exec_spec("q", ["y1"], ["y2"]))
+        sB._drain()
+        _teardown(h, sB, t)
+
+    sched.spawn(crasher, "clientA")
+    sched.spawn(steady, "clientB")
+
+
+def _setup_multichip(h: Harness, sched: mcsched.Scheduler) -> None:
+    """A two-chip grant (HELLO devices=[0,1]) executes alongside a
+    single-chip tenant on the secondary chip: multi-chip rate debits,
+    per-chip ledgers and both chips' dispatchers interleave.  Covers:
+    rate_acquire_all partial-refund, per-chip slot accounting,
+    cross-chip release."""
+    sA, sB = h.session(), h.session()
+
+    def wide() -> None:
+        t = h.tenant(sA, "A", core_limit=50, devices=[0, 1])
+        t.executables["p"] = fake_program()
+        sA._enqueue_batch(t, {"items": [
+            h.exec_spec("p", [], ["o1"]),
+            h.exec_spec("p", ["o1"], ["o2"]),
+        ]})
+        sA._drain()
+        _teardown(h, sA, t)
+
+    def narrow() -> None:
+        t = h.tenant(sB, "B", core_limit=50, device=1)
+        t.executables["q"] = fake_program()
+        sB._enqueue_execute(t, h.exec_spec("q", [], ["y1"]))
+        sB._drain()
+        _teardown(h, sB, t)
+
+    sched.spawn(wide, "clientA")
+    sched.spawn(narrow, "clientB")
+
+
+def _setup_churn_rebind(h: Harness, sched: mcsched.Scheduler) -> None:
+    """A tenant name releases and immediately rebinds (slot recycle:
+    reset_slot must rebase the bucket, the fresh instance must not
+    inherit the old lease) while a co-tenant runs.  Covers: slot
+    recycle conservation, close/bind journal ordering, lease reclaim
+    before recycle."""
+    s1, s2, sB = h.session(), h.session(), h.session()
+
+    def churn() -> None:
+        t = h.tenant(s1, "A", core_limit=50)
+        t.executables["p"] = fake_program()
+        s1._enqueue_execute(t, h.exec_spec("p", [], ["o1"]))
+        s1._drain()
+        _teardown(h, s1, t)
+        t2 = h.tenant(s2, "A", core_limit=50)
+        t2.executables["p"] = fake_program()
+        s2._enqueue_execute(t2, h.exec_spec("p", [], ["o1"]))
+        s2._drain()
+        _teardown(h, s2, t2)
+
+    def steady() -> None:
+        t = h.tenant(sB, "B", core_limit=50)
+        t.executables["q"] = fake_program()
+        sB._enqueue_execute(t, h.exec_spec("q", [], ["y1"]))
+        sB._drain()
+        _teardown(h, sB, t)
+
+    sched.spawn(churn, "clientA")
+    sched.spawn(steady, "clientB")
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+SCENARIOS: List[Scenario] = [
+    Scenario("batch_pipeline",
+             "EXEC_BATCH pipeline with zero-RT free + journal deferral",
+             _setup_batch_pipeline, with_journal=True),
+    Scenario("contention",
+             "two metered tenants race one chip's scheduler",
+             _setup_contention, with_journal=False),
+    Scenario("lease_expiry",
+             "lease TTL expiry refund between executes",
+             _setup_lease_expiry, with_journal=False),
+    Scenario("suspend_resume",
+             "admin SUSPEND/RESUME races a pipelining tenant",
+             _setup_suspend_resume, with_journal=False),
+    Scenario("tenant_crash",
+             "connection death mid-pipeline; co-tenant unaffected",
+             _setup_tenant_crash, with_journal=True),
+    Scenario("multichip",
+             "two-chip grant vs single-chip co-tenant",
+             _setup_multichip,
+             harness_kw={"n_chips": 2}, with_journal=False),
+    Scenario("churn_rebind",
+             "release + rebind recycles the slot mid-traffic",
+             _setup_churn_rebind, with_journal=True),
+]
+
+
+def get(name: str) -> Scenario:
+    for s in SCENARIOS:
+        if s.name == name:
+            return s
+    raise KeyError(f"unknown scenario {name!r}; have "
+                   f"{[s.name for s in SCENARIOS]}")

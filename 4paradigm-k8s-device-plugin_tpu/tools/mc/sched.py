@@ -1,0 +1,719 @@
+"""Cooperative deterministic scheduler — the substrate vtpu-mc runs the
+REAL broker code on.
+
+The broker's concurrency surface (``runtime/server.py`` scheduling,
+lease grant/burn/refund, ``runtime/journal.py`` deferred appends) is
+written against three stdlib primitives: ``threading`` (Lock /
+Condition / Thread), ``queue.Queue`` and ``time``.  This module
+provides drop-in shims for all three whose every visible operation is a
+YIELD POINT: the operation is announced to a controller, the task
+parks, and the controller decides which parked task runs next.  Exactly
+one task runs at a time, so a run is fully determined by the sequence
+of controller decisions — the schedule — and the same decision sequence
+replays the same execution (loom/shuttle-style schedule control;
+FoundationDB-style determinism).
+
+The shims are injected by rebinding the MODULE-LEVEL names the broker
+modules imported (``vtpu.runtime.server.threading = <shim>`` etc.), so
+only the code under test is redirected — the controller itself, pytest,
+and any real broker in the same process keep the real primitives.
+
+Time is a logical clock: it only advances when the controller decides
+no task is runnable and jumps straight to the earliest deadline among
+timed waiters (discrete-event style), so lease TTL expiry, dispatcher
+idle sleeps and quiesce polls are all explorable schedule events
+instead of wall-clock behavior.
+
+Lost-wake oracle: the dispatcher's IDLE sleep (the 0.5 s default
+timeout it uses only when ``_pick_locked`` reported no time-gated
+work) ending by TIMEOUT while its scheduler holds dispatchable work is
+exactly a lost wake — a correct broker's submit/retire/kick paths
+would have notified it.  The controller reports every timeout wake to
+the harness (``on_timeout_wake``) which applies that judgment.
+"""
+
+from __future__ import annotations
+
+import queue as real_queue
+import threading as real_threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# Decision-step ceiling per schedule: a scenario exceeding it is a
+# livelock (or a runaway daemon) — surfaced as a violation, never an
+# endless run.
+DEFAULT_MAX_STEPS = 20000
+# Clock-advance ceiling per schedule (each advance jumps to the next
+# deadline; a correct scenario needs only a handful).
+DEFAULT_MAX_ADVANCES = 400
+
+
+class MCAbort(BaseException):
+    """Raised inside a task thread to unwind it when the controller
+    abandons a schedule.  BaseException on purpose: the broker's
+    ``except Exception`` arms must not swallow it."""
+
+
+class ReplayDivergence(RuntimeError):
+    """A scripted replay saw a different enabled set than the recording
+    run — the scenario is nondeterministic (harness bug)."""
+
+
+class DeadlockError(RuntimeError):
+    pass
+
+
+class MCClock:
+    """Logical monotonic+wall clock (ns)."""
+
+    def __init__(self) -> None:
+        self.ns = 1_000_000_000  # 1s, so timestamps are never 0/False
+
+    def now(self) -> float:
+        return self.ns / 1e9
+
+    # -- the `time` module surface the broker uses --
+    def monotonic(self) -> float:
+        return self.ns / 1e9
+
+    def time(self) -> float:
+        return self.ns / 1e9
+
+    def time_ns(self) -> int:
+        return self.ns
+
+    def sleep(self, s: float) -> None:  # pragma: no cover - unused path
+        self.ns += int(s * 1e9)
+
+    def advance_to(self, t: float) -> None:
+        self.ns = max(self.ns, int(t * 1e9))
+
+
+class MCTask:
+    """One logical thread of the scenario, backed by a real OS thread
+    that is parked on a semaphore except while the controller grants it
+    a slice."""
+
+    def __init__(self, sched: "Scheduler", tid: int, name: str,
+                 fn: Callable[[], Any], daemon: bool) -> None:
+        self.sched = sched
+        self.tid = tid
+        self.name = name
+        self.fn = fn
+        self.daemon = daemon
+        self.sem = real_threading.Semaphore(0)
+        self.state = "new"      # new|runnable|blocked|waiting|done
+        self.pending: Optional[Tuple] = None  # announced next op
+        self.wait_obj: Optional[Any] = None   # cond/queue parked on
+        self.deadline: Optional[float] = None
+        self.woke_by_timeout = False
+        self.wait_timeout: Optional[float] = None
+        self.error: Optional[BaseException] = None
+        self.thread = real_threading.Thread(
+            target=self._run, name=f"mc-{name}", daemon=True)
+
+    def _run(self) -> None:
+        self.sem.acquire()
+        if self.sched.aborting:
+            self.state = "done"
+            self.sched._ctrl.release()
+            return
+        try:
+            self.fn()
+        except MCAbort:
+            pass
+        except BaseException as e:  # noqa: BLE001 - surfaced as violation
+            self.error = e
+        self.state = "done"
+        self.sched._ctrl.release()
+
+    def start(self) -> None:
+        self.state = "runnable"
+        self.pending = ("start", None)
+        self.thread.start()
+
+
+class Scheduler:
+    """The controller: owns the task set, the logical clock, and the
+    decision loop.  ``choose(step, enabled)`` — supplied by the
+    explorer — picks which enabled task runs the next slice."""
+
+    def __init__(self, clock: Optional[MCClock] = None,
+                 max_steps: int = DEFAULT_MAX_STEPS,
+                 max_advances: int = DEFAULT_MAX_ADVANCES) -> None:
+        self.clock = clock or MCClock()
+        self.tasks: List[MCTask] = []
+        self._ctrl = real_threading.Semaphore(0)
+        self._current: Optional[MCTask] = None
+        self.aborting = False
+        self.max_steps = max_steps
+        self.max_advances = max_advances
+        self.steps = 0
+        self.advances = 0
+        self.violations: List[str] = []
+        # Hooks the harness installs.
+        self.on_timeout_wake: Optional[Callable[[MCTask, Any, float],
+                                               None]] = None
+        self.quiescent: Optional[Callable[[], bool]] = None
+        self.on_quiescent: Optional[Callable[[], None]] = None
+        self.step_check: Optional[Callable[[], List[str]]] = None
+
+    # -- task-side API (runs on task threads) -----------------------------
+
+    def current(self) -> MCTask:
+        t = self._current
+        assert t is not None, "MC primitive used outside a task slice"
+        return t
+
+    def _park(self, task: MCTask) -> None:
+        """Hand control back and wait to be granted the announced op."""
+        self._ctrl.release()
+        task.sem.acquire()
+        if self.aborting:
+            raise MCAbort()
+
+    def announce(self, op: Tuple) -> None:
+        """Yield point: announce the op the task is ABOUT to perform
+        (it executes at the top of the task's next slice)."""
+        task = self.current()
+        task.pending = op
+        task.state = "runnable"
+        self._park(task)
+
+    def block_on(self, op: Tuple, obj: Any,
+                 deadline: Optional[float],
+                 timeout: Optional[float] = None) -> bool:
+        """Park as waiting on ``obj`` (condition or queue) until woken
+        by a notifier or — when ``deadline`` is set — by a clock
+        advance.  Returns True when the wake was a timeout."""
+        task = self.current()
+        task.pending = op
+        task.state = "waiting"
+        task.wait_obj = obj
+        task.deadline = deadline
+        task.wait_timeout = timeout
+        task.woke_by_timeout = False
+        self._park(task)
+        task.wait_obj = None
+        task.deadline = None
+        return task.woke_by_timeout
+
+    # -- controller-side --------------------------------------------------
+
+    def spawn(self, fn: Callable[[], Any], name: str,
+              daemon: bool = False) -> MCTask:
+        task = MCTask(self, len(self.tasks), name, fn, daemon)
+        self.tasks.append(task)
+        task.start()
+        return task
+
+    def _enabled(self) -> List[MCTask]:
+        out = []
+        for t in self.tasks:
+            if t.state != "runnable":
+                continue
+            op = t.pending or ("start", None)
+            if op[0] == "acq" and op[1].owner is not None:
+                continue
+            if op[0] == "qget" and not op[1].items:
+                # announced get on an empty queue: converts to waiting
+                # (handled in MCQueue.get) — treat as not enabled here
+                continue
+            out.append(t)
+        return out
+
+    def _wake(self, task: MCTask, timeout: bool) -> None:
+        task.woke_by_timeout = timeout
+        task.state = "runnable"
+
+    def _advance_clock(self) -> bool:
+        """Jump to the earliest deadline among timed waiters and wake
+        them.  Returns False when nobody is waiting on time."""
+        waiters = [t for t in self.tasks
+                   if t.state == "waiting" and t.deadline is not None]
+        if not waiters:
+            return False
+        self.advances += 1
+        if self.advances > self.max_advances:
+            self.violations.append(
+                "livelock: clock advanced %d times without reaching a "
+                "terminal state" % self.advances)
+            return False
+        dl = min(t.deadline for t in waiters)
+        self.clock.advance_to(dl)
+        for t in waiters:
+            if t.deadline is not None and t.deadline <= dl + 1e-12:
+                if self.on_timeout_wake is not None:
+                    self.on_timeout_wake(t, t.wait_obj,
+                                         t.wait_timeout or 0.0)
+                self._wake(t, timeout=True)
+        return True
+
+    def _step(self, task: MCTask) -> None:
+        self.steps += 1
+        self._current = task
+        task.sem.release()
+        self._ctrl.acquire()
+        self._current = None
+
+    def run(self, choose: Callable[[int, List[MCTask]], MCTask]
+            ) -> None:
+        """Drive the schedule to a terminal state: all non-daemon tasks
+        done and the harness-declared quiescence reached; then stop the
+        daemons cleanly.  Violations (deadlock, livelock, task crash,
+        step-hook findings) accumulate in ``self.violations``."""
+        step = 0
+        while True:
+            if self.steps > self.max_steps:
+                self.violations.append(
+                    "livelock: schedule exceeded %d decision steps"
+                    % self.max_steps)
+                break
+            if self.step_check is not None:
+                v = self.step_check()
+                if v:
+                    self.violations.extend(v)
+                    break
+            enabled = self._enabled()
+            if enabled:
+                task = choose(step, enabled)
+                step += 1
+                self._step(task)
+                continue
+            # Nothing runnable: terminal, clock advance, or deadlock.
+            live = [t for t in self.tasks
+                    if not t.daemon and t.state != "done"]
+            if not live and (self.quiescent is None or self.quiescent()):
+                break
+            if self._advance_clock():
+                if self.advances > self.max_advances:
+                    break
+                continue
+            self.violations.append(
+                "deadlock: tasks stuck with no timed waiter: "
+                + ", ".join(f"{t.name}({t.state} on {t.pending})"
+                            for t in self.tasks if t.state != "done"))
+            break
+        if self.on_quiescent is not None and not self.violations:
+            self.on_quiescent()
+        for t in self.tasks:
+            if t.error is not None:
+                self.violations.append(
+                    f"task {t.name} crashed: "
+                    f"{type(t.error).__name__}: {t.error}")
+        self._shutdown()
+
+    def _shutdown(self) -> None:
+        """Unwind every unfinished task thread (abort at its next yield
+        point) so schedules never leak OS threads."""
+        self.aborting = True
+        for _ in range(len(self.tasks) * 4 + 16):
+            live = [t for t in self.tasks if t.state != "done"]
+            if not live:
+                break
+            self._step(live[0])
+        for t in self.tasks:
+            t.thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# The primitive shims the broker modules are rebound to.
+# ---------------------------------------------------------------------------
+
+class MCLock:
+    """Cooperative lock: acquisition is a yield point; ownership is a
+    plain field only the single running task mutates."""
+
+    _ids = 0
+
+    def __init__(self, sched: Scheduler, name: str = "") -> None:
+        MCLock._ids += 1
+        self.sched = sched
+        self.lid = MCLock._ids
+        self.name = name or f"lock{self.lid}"
+        self.owner: Optional[MCTask] = None
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        if self.sched.aborting:
+            # Post-run controller-side use (journal.close during
+            # schedule teardown): no parking, no ownership games.
+            return True
+        self.sched.announce(("acq", self))
+        me = self.sched.current()
+        assert self.owner is None, \
+            f"MC granted held lock {self.name} to {me.name}"
+        self.owner = me
+        return True
+
+    def release(self) -> None:
+        if self.sched.aborting:
+            # MCAbort unwind: `with` __exit__ paths release whatever
+            # the task held; no assertions, no parking.
+            self.owner = None
+            return
+        assert self.owner is self.sched.current()
+        self.owner = None
+        self.sched.announce(("rel", self))
+
+    def __enter__(self) -> "MCLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self.owner is not None
+
+
+class MCCondition:
+    """Cooperative condition variable over an MCLock."""
+
+    def __init__(self, sched: Scheduler,
+                 lock: Optional[MCLock] = None) -> None:
+        self.sched = sched
+        self.lock = lock or MCLock(sched)
+        self.waiters: List[MCTask] = []
+
+    # Lock surface (``with cond:`` / cond.acquire()).
+    def acquire(self, *a: Any, **kw: Any) -> bool:
+        return self.lock.acquire()
+
+    def release(self) -> None:
+        self.lock.release()
+
+    def __enter__(self) -> "MCCondition":
+        self.lock.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.lock.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        me = self.sched.current()
+        assert self.lock.owner is me, "wait() without holding the lock"
+        self.lock.owner = None  # atomically release with the park
+        self.waiters.append(me)
+        deadline = (self.sched.clock.now() + timeout
+                    if timeout is not None else None)
+        timed_out = self.sched.block_on(("cwait", self), self, deadline,
+                                        timeout)
+        if me in self.waiters:
+            self.waiters.remove(me)
+        # Re-acquire before returning, like the real primitive.
+        self.sched.announce(("acq", self.lock))
+        assert self.lock.owner is None
+        self.lock.owner = me
+        return not timed_out
+
+    def _notify(self, n: Optional[int]) -> None:
+        woken = self.waiters if n is None else self.waiters[:n]
+        for t in list(woken):
+            self.waiters.remove(t)
+            self.sched._wake(t, timeout=False)
+
+    def notify(self, n: int = 1) -> None:
+        me = self.sched.current()
+        assert self.lock.owner is me, "notify() without holding the lock"
+        self._notify(n)
+
+    def notify_all(self) -> None:
+        me = self.sched.current()
+        assert self.lock.owner is me, \
+            "notify_all() without holding the lock"
+        self._notify(None)
+
+
+class MCEvent:
+    """Cooperative Event (broker uses it only for keeper shutdown)."""
+
+    def __init__(self, sched: Scheduler) -> None:
+        self.sched = sched
+        self._set = False
+
+    def set(self) -> None:
+        self._set = True
+
+    def is_set(self) -> bool:
+        return self._set
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self._set:
+            return True
+        deadline = (self.sched.clock.now() + timeout
+                    if timeout is not None else None)
+        self.sched.block_on(("ewait", self), self, deadline, timeout)
+        return self._set
+
+
+class MCQueue:
+    """Cooperative queue.Queue subset (put / get / get_nowait)."""
+
+    def __init__(self, sched: Scheduler, maxsize: int = 0) -> None:
+        self.sched = sched
+        self.items: List[Any] = []
+        self.waiters: List[MCTask] = []
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        self.sched.announce(("qput", self))
+        self.items.append(item)
+        for t in list(self.waiters):
+            self.waiters.remove(t)
+            self.sched._wake(t, timeout=False)
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            return self.get_nowait()
+        me = self.sched.current()
+        while True:
+            if self.items:
+                self.sched.announce(("qget", self))
+                # Another task may have raced the announce; re-check.
+                if self.items:
+                    return self.items.pop(0)
+                continue
+            self.waiters.append(me)
+            deadline = (self.sched.clock.now() + timeout
+                        if timeout is not None else None)
+            timed_out = self.sched.block_on(("qwait", self), self,
+                                            deadline, timeout)
+            if me in self.waiters:
+                self.waiters.remove(me)
+            if self.items:
+                return self.items.pop(0)
+            if timed_out:
+                raise real_queue.Empty()
+
+    def get_nowait(self) -> Any:
+        # Distinct op tag: a non-blocking get on an EMPTY queue must
+        # still be schedulable (it proceeds by raising Empty — the
+        # completion loop's drain-cap probe depends on it), while a
+        # blocking get's announce is only enabled when items exist.
+        self.sched.announce(("qget_nb", self))
+        if not self.items:
+            raise real_queue.Empty()
+        return self.items.pop(0)
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def empty(self) -> bool:
+        return not self.items
+
+
+class MCThread:
+    """threading.Thread stand-in: ``start`` registers the target as an
+    MC DAEMON task (the broker only spawns daemon service loops —
+    dispatcher, completer, keepers)."""
+
+    def __init__(self, sched: Scheduler, target: Callable[..., Any],
+                 args: Tuple = (), daemon: bool = True,
+                 name: str = "thread") -> None:
+        self.sched = sched
+        self.target = target
+        self.args = args
+        self.name = name
+        self.daemon = daemon
+        self.task: Optional[MCTask] = None
+
+    def start(self) -> None:
+        self.task = self.sched.spawn(
+            lambda: self.target(*self.args), self.name, daemon=True)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        pass  # controller owns lifecycle
+
+
+class _ShimModule:
+    """Attribute bag standing in for a stdlib module inside the broker
+    modules' namespaces."""
+
+    def __init__(self, **attrs: Any) -> None:
+        self.__dict__.update(attrs)
+
+
+def make_shims(sched: Scheduler) -> Dict[str, Any]:
+    """The three module shims, bound to one scheduler."""
+    def Lock() -> MCLock:
+        return MCLock(sched)
+
+    def Condition(lock: Optional[MCLock] = None) -> MCCondition:
+        return MCCondition(sched, lock)
+
+    def Event() -> MCEvent:
+        return MCEvent(sched)
+
+    def Thread(target: Callable[..., Any] = None, args: Tuple = (),
+               daemon: bool = True, name: str = "thread") -> MCThread:
+        return MCThread(sched, target, args, daemon, name)
+
+    def Queue(maxsize: int = 0) -> MCQueue:
+        return MCQueue(sched, maxsize)
+
+    threading_shim = _ShimModule(
+        Lock=Lock, RLock=Lock, Condition=Condition, Event=Event,
+        Thread=Thread, get_ident=real_threading.get_ident,
+        current_thread=real_threading.current_thread)
+    queue_shim = _ShimModule(Queue=Queue, Empty=real_queue.Empty,
+                             Full=real_queue.Full)
+    time_shim = _ShimModule(
+        monotonic=sched.clock.monotonic, time=sched.clock.time,
+        time_ns=sched.clock.time_ns, sleep=sched.clock.sleep,
+        perf_counter=sched.clock.monotonic)
+    return {"threading": threading_shim, "queue": queue_shim,
+            "time": time_shim}
+
+
+# ---------------------------------------------------------------------------
+# Inert shims: single-threaded stand-ins for the crash-cut engine.
+#
+# Journal recovery (``RuntimeState._recover_from_journal`` + resume) is
+# sequential code — it needs no schedule exploration, but building the
+# broker stub must not spawn real dispatcher/completer threads per cut
+# (hundreds of cuts would leak hundreds of parked OS threads).  These
+# shims make every lock a no-op, every Thread.start a no-op, and time a
+# plain logical clock.
+# ---------------------------------------------------------------------------
+
+class InertLock:
+    def __init__(self, *a: Any, **kw: Any) -> None:
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._depth += 1
+        return True
+
+    def release(self) -> None:
+        self._depth -= 1
+
+    def __enter__(self) -> "InertLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._depth > 0
+
+
+class InertCondition(InertLock):
+    def __init__(self, lock: Optional[InertLock] = None,
+                 clock: Optional[MCClock] = None) -> None:
+        super().__init__()
+        self._clock = clock
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        # Nothing can notify a single-threaded waiter: advance the
+        # clock so deadline'd loops (quiesce) terminate.
+        if self._clock is not None and timeout is not None:
+            self._clock.advance_to(self._clock.now() + timeout)
+        return False
+
+    def notify(self, n: int = 1) -> None:
+        pass
+
+    def notify_all(self) -> None:
+        pass
+
+
+class InertEvent:
+    def __init__(self) -> None:
+        self._set = False
+
+    def set(self) -> None:
+        self._set = True
+
+    def is_set(self) -> bool:
+        return self._set
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._set
+
+
+class InertThread:
+    """Thread whose start() is a no-op: service loops simply never run
+    (recovery touches none of them)."""
+
+    def __init__(self, target: Callable[..., Any] = None, args: Tuple = (),
+                 daemon: bool = True, name: str = "thread") -> None:
+        self.name = name
+
+    def start(self) -> None:
+        pass
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        pass
+
+
+class InertScheduler:
+    """Duck-typed stand-in for ``Scheduler`` that the crash-cut harness
+    passes to ``Harness``: carries the logical clock and accepts (and
+    ignores) the oracle hooks the harness installs."""
+
+    def __init__(self, clock: Optional[MCClock] = None) -> None:
+        self.clock = clock or MCClock()
+        self.on_timeout_wake: Optional[Callable] = None
+        self.quiescent: Optional[Callable[[], bool]] = None
+        self.step_check: Optional[Callable[[], List[str]]] = None
+        self.on_quiescent: Optional[Callable[[], None]] = None
+        self.aborting = False
+
+    def block_on(self, *a: Any, **kw: Any) -> bool:  # MCEvent compat
+        return False
+
+
+def make_inert_shims(clock: MCClock) -> Dict[str, Any]:
+    def Condition(lock: Optional[InertLock] = None) -> InertCondition:
+        return InertCondition(lock, clock=clock)
+
+    threading_shim = _ShimModule(
+        Lock=InertLock, RLock=InertLock, Condition=Condition,
+        Event=InertEvent, Thread=InertThread,
+        get_ident=real_threading.get_ident,
+        current_thread=real_threading.current_thread)
+    queue_shim = _ShimModule(Queue=real_queue.Queue,
+                             Empty=real_queue.Empty, Full=real_queue.Full)
+    time_shim = _ShimModule(
+        monotonic=clock.monotonic, time=clock.time, time_ns=clock.time_ns,
+        sleep=clock.sleep, perf_counter=clock.monotonic)
+    return {"threading": threading_shim, "queue": queue_shim,
+            "time": time_shim}
+
+
+class patched_modules:
+    """Context manager rebinding the stdlib names inside the broker
+    modules to this scheduler's shims (and restoring them on exit).
+
+    Only name BINDINGS in the listed modules change — the real stdlib
+    modules are untouched, so the controller, pytest and any live
+    broker in the same process keep real primitives."""
+
+    # module object -> names to rebind
+    TARGETS = {
+        "vtpu.runtime.server": ("threading", "time", "queue"),
+        "vtpu.runtime.journal": ("threading", "time"),
+    }
+
+    def __init__(self, sched: "Scheduler | InertScheduler") -> None:
+        if isinstance(sched, InertScheduler):
+            self.shims = make_inert_shims(sched.clock)
+        else:
+            self.shims = make_shims(sched)
+        self.saved: List[Tuple[Any, str, Any]] = []
+
+    def __enter__(self) -> "patched_modules":
+        import importlib
+        for modname, names in self.TARGETS.items():
+            mod = importlib.import_module(modname)
+            for name in names:
+                self.saved.append((mod, name, getattr(mod, name)))
+                setattr(mod, name, self.shims[name])
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        for mod, name, val in reversed(self.saved):
+            setattr(mod, name, val)
+        self.saved.clear()

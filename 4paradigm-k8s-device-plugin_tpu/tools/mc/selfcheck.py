@@ -1,0 +1,337 @@
+"""Seeded-violation selfcheck: prove every invariant's checker still
+catches the bug class it exists for.
+
+A model checker that reports "0 violations" is only trustworthy if a
+DELIBERATELY broken broker makes it scream.  Each seed below patches
+one real broker/journal code path into a known-bad variant (a refund
+that doesn't refund, a notify that doesn't notify, a replay arm that
+skips records, ...), runs the matching engine, and requires the named
+invariant to fire.  ``python -m vtpu.tools.mc --selfcheck`` runs the
+whole matrix (CI does); tests/test_mc.py drives the same seeds
+individually.
+
+The patches live HERE, never in the broker: broker source stays
+correct, and a seed that stops firing means the CHECKER regressed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+from . import crashcut, interleave, scenarios
+
+
+@dataclass(frozen=True)
+class Seed:
+    name: str
+    engine: str            # "interleave" | "crash"
+    invariant: str         # registry invariant expected to fire
+    scenario: str          # interleave scenario (ignored for crash)
+    patch: Callable[[], Any]  # contextmanager applying the broken code
+
+
+# ---------------------------------------------------------------------------
+# Interleave-engine seeds
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _seed_broken_refund() -> Iterator[None]:
+    """lease_release forgets the bucket refund: quota leaks on
+    expiry/suspend/teardown."""
+    from ...runtime import server as S
+    orig = S.Tenant.lease_release
+
+    def broken(self: Any) -> None:
+        self.lease_us = 0.0
+        self.lease_exp = 0.0   # the refund never happens
+
+    S.Tenant.lease_release = broken
+    try:
+        yield
+    finally:
+        S.Tenant.lease_release = orig
+
+
+@contextlib.contextmanager
+def _seed_dropped_wake() -> Iterator[None]:
+    """submit/retire notify is dropped: the dispatcher only ever wakes
+    by timeout."""
+    from ...runtime import server as S
+    orig = S.DeviceScheduler._notify_locked
+    S.DeviceScheduler._notify_locked = lambda self: None
+    try:
+        yield
+    finally:
+        S.DeviceScheduler._notify_locked = orig
+
+
+@contextlib.contextmanager
+def _seed_double_release() -> Iterator[None]:
+    """release_array releases the ledger twice (the double-free class
+    the region's negative-ledger guard exists for)."""
+    from ...runtime import server as S
+    orig = S.Tenant.release_array
+
+    def double(self: Any, aid: str, default_nbytes: int = 0) -> None:
+        charges = self.charges.get(aid)
+        orig(self, aid, default_nbytes)
+        if charges:
+            for pos, nb in charges:
+                self.chips[pos].region.mem_release(self.slots[pos], nb)
+
+    S.Tenant.release_array = double
+    try:
+        yield
+    finally:
+        S.Tenant.release_array = orig
+
+
+@contextlib.contextmanager
+def _seed_cleanup_leak() -> Iterator[None]:
+    """Teardown skips the array drops: HBM stays charged after the
+    tenant is gone."""
+    from ...runtime import server as S
+    orig = S.TenantSession._cleanup
+    S.TenantSession._cleanup = lambda self, t: None
+    try:
+        yield
+    finally:
+        S.TenantSession._cleanup = orig
+
+
+@contextlib.contextmanager
+def _seed_lease_overburn() -> Iterator[None]:
+    """Lease admission burns without checking the balance: the
+    pre-debited budget goes negative (unmetered device time)."""
+    from ...runtime import server as S
+    orig = S.DeviceScheduler._lease_admit_locked
+
+    def overburn(self: Any, t: Any, est: float, now: float) -> int:
+        q = float(self.state.rate_lease_us)
+        if q <= 0:
+            return orig(self, t, est, now)
+        if t.lease_us <= 0.0:
+            return orig(self, t, est, now)
+        t.lease_us -= 5.0 * est   # burns 5x the grant, never re-syncs
+        return 0
+
+    S.DeviceScheduler._lease_admit_locked = overburn
+    try:
+        yield
+    finally:
+        S.DeviceScheduler._lease_admit_locked = orig
+
+
+@contextlib.contextmanager
+def _seed_unflushed_journal() -> Iterator[None]:
+    """Deferred journal records are never flushed: a reply acknowledges
+    state the journal does not yet carry."""
+    from ...runtime import server as S
+    orig = S.flush_tenant_journal
+    S.flush_tenant_journal = lambda state, t: None
+    try:
+        yield
+    finally:
+        S.flush_tenant_journal = orig
+
+
+# ---------------------------------------------------------------------------
+# Crash-engine seeds
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _seed_skipped_replay_arm() -> Iterator[None]:
+    """_apply_record loses its 'del' arm: recovery resurrects deleted
+    arrays (and their ledger bytes)."""
+    from ...runtime import journal as J
+    orig = J._apply_record
+
+    def skip_del(state: Any, rec: Any) -> None:
+        if rec.get("op") == "del":
+            return
+        orig(state, rec)
+
+    J._apply_record = skip_del
+    try:
+        yield
+    finally:
+        J._apply_record = orig
+
+
+@contextlib.contextmanager
+def _seed_nondeterministic_replay() -> Iterator[None]:
+    """Replay applies EMA records only on every second recovery: two
+    recoveries of one prefix disagree."""
+    from ...runtime import journal as J
+    orig_apply = J._apply_record
+    orig_load = J.Journal.load_state
+    flip = {"n": 0}
+
+    def flaky_apply(state: Any, rec: Any) -> None:
+        if rec.get("op") == "ema" and flip["n"] % 2 == 1:
+            return
+        orig_apply(state, rec)
+
+    def counting_load(self: Any) -> Any:
+        out = orig_load(self)
+        flip["n"] += 1
+        return out
+
+    J._apply_record = flaky_apply
+    J.Journal.load_state = counting_load
+    try:
+        yield
+    finally:
+        J._apply_record = orig_apply
+        J.Journal.load_state = orig_load
+
+
+@contextlib.contextmanager
+def _seed_grant_not_reseeded() -> Iterator[None]:
+    """Recovery forgets to re-seed the region limits from the journaled
+    grant: quotas silently revert to broker defaults."""
+    from . import harness as H
+    orig = H.ModelRegion.set_mem_limit
+    H.ModelRegion.set_mem_limit = lambda self, d, limit_bytes: None
+    try:
+        yield
+    finally:
+        H.ModelRegion.set_mem_limit = orig
+
+
+@contextlib.contextmanager
+def _seed_lossy_snapshot() -> Iterator[None]:
+    """The boot snapshot drops a tenant: the SECOND crash after a
+    recovery loses state the first recovery still had."""
+    from ...runtime import server as S
+    orig = S.RuntimeState._snapshot_dict
+
+    def lossy(self: Any) -> dict:
+        out = orig(self)
+        if out.get("tenants"):
+            out["tenants"].pop(sorted(out["tenants"])[0])
+        return out
+
+    S.RuntimeState._snapshot_dict = lossy
+    try:
+        yield
+    finally:
+        S.RuntimeState._snapshot_dict = orig
+
+
+@contextlib.contextmanager
+def _seed_overdropped_tail() -> Iterator[None]:
+    """Tail handling drops one record too many: a torn-tail recovery
+    loses a COMMITTED record."""
+    from ...runtime import journal as J
+    orig = J.Journal._parse_lines
+
+    def overdrop(data: bytes, tail_tolerant: bool) -> list:
+        out = orig(data, tail_tolerant)
+        if tail_tolerant and out:
+            out = out[:-1]
+        return out
+
+    J.Journal._parse_lines = staticmethod(overdrop)
+    try:
+        yield
+    finally:
+        J.Journal._parse_lines = staticmethod(orig)
+
+
+@contextlib.contextmanager
+def _seed_corruption_swallowed() -> Iterator[None]:
+    """Mid-log damage is silently skipped instead of failing closed:
+    recovery proceeds on a log it cannot trust."""
+    from ...runtime import journal as J
+    orig = J.Journal._parse_lines
+
+    def swallow(data: bytes, tail_tolerant: bool) -> list:
+        try:
+            return orig(data, tail_tolerant)
+        except J.JournalCorrupt:
+            # "Best effort": parse what still frames — the exact
+            # guessed-quota-state behavior the contract bans.
+            out = []
+            for line in data.split(b"\n"):
+                try:
+                    recs = orig(line + b"\n", True)
+                except (J.JournalCorrupt, ValueError):
+                    continue
+                out.extend(recs)
+            return out
+
+    J.Journal._parse_lines = staticmethod(swallow)
+    try:
+        yield
+    finally:
+        J.Journal._parse_lines = staticmethod(orig)
+
+
+SEEDS: Tuple[Seed, ...] = (
+    Seed("broken-lease-refund", "interleave", "token-conservation",
+         "batch_pipeline", _seed_broken_refund),
+    Seed("dropped-wake", "interleave", "no-lost-wake",
+         "batch_pipeline", _seed_dropped_wake),
+    Seed("double-ledger-release", "interleave", "region-safety",
+         "batch_pipeline", _seed_double_release),
+    Seed("teardown-hbm-leak", "interleave", "hbm-ledger-balance",
+         "batch_pipeline", _seed_cleanup_leak),
+    Seed("lease-overburn", "interleave", "lease-nonnegative",
+         "contention", _seed_lease_overburn),
+    Seed("unflushed-deferred-journal", "interleave", "reply-durability",
+         "tenant_crash", _seed_unflushed_journal),
+    Seed("terminal-deferred-leftover", "interleave", "deferred-flush",
+         "batch_pipeline", _seed_unflushed_journal),
+    Seed("skipped-replay-arm", "crash", "replay-ground-truth",
+         "", _seed_skipped_replay_arm),
+    Seed("nondeterministic-replay", "crash", "replay-deterministic",
+         "", _seed_nondeterministic_replay),
+    Seed("grant-not-reseeded", "crash", "resume-consistent",
+         "", _seed_grant_not_reseeded),
+    Seed("lossy-boot-snapshot", "crash", "reresume-idempotent",
+         "", _seed_lossy_snapshot),
+    Seed("overdropped-torn-tail", "crash", "torn-tail-dropped",
+         "", _seed_overdropped_tail),
+    Seed("corruption-swallowed", "crash", "corruption-fails-closed",
+         "", _seed_corruption_swallowed),
+)
+
+
+def run_seed(seed: Seed, record_dir: Optional[str] = None,
+             max_schedules: int = 300) -> Tuple[bool, List[str]]:
+    """Apply one seed and run its engine; returns (caught, violations).
+    ``caught`` is True when the expected invariant fired."""
+    with seed.patch():
+        if seed.engine == "interleave":
+            stats = interleave.explore_scenario(
+                scenarios.get(seed.scenario),
+                max_schedules=max_schedules)
+            violations = stats.violations
+        else:
+            stats = crashcut.explore(record_dir=record_dir)
+            violations = stats.violations
+    tag = f"[{seed.invariant}]"
+    return any(tag in v for v in violations), violations
+
+
+def run_all(max_schedules: int = 300) -> List[Tuple[Seed, bool, int]]:
+    """The full matrix.  The crash recording is made ONCE with the
+    pristine code (seeds patch recovery, not recording) and reused."""
+    results: List[Tuple[Seed, bool, int]] = []
+    with tempfile.TemporaryDirectory(prefix="vtpu-mc-selfcheck-") as tmp:
+        rec = os.path.join(tmp, "recording")
+        os.makedirs(rec)
+        rec_violations = crashcut.record_session(rec)
+        if rec_violations:
+            raise RuntimeError(
+                f"selfcheck recording not clean: {rec_violations}")
+        for seed in SEEDS:
+            caught, violations = run_seed(seed, record_dir=rec,
+                                          max_schedules=max_schedules)
+            results.append((seed, caught, len(violations)))
+    return results

@@ -327,14 +327,17 @@ def cmd_leases(ns) -> int:
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
     ap.add_argument("cmd", nargs="?", default=None,
-                    choices=("trace", "leases", "analyze", "metricsd"),
+                    choices=("trace", "leases", "analyze", "mc",
+                             "metricsd"),
                     help="trace: flight-recorder spans (needs "
                          "--broker; --dump FILE exports Chrome-trace "
                          "JSON); leases: chip-lease sidecar forensics; "
                          "analyze: cross-layer invariant linters "
-                         "(docs/ANALYSIS.md); metricsd: the quota-"
-                         "virtualized view stock tpu-info sees "
-                         "(docs/METRICSD.md)")
+                         "(docs/ANALYSIS.md); mc: deterministic model "
+                         "checking of quota/lease/crash-recovery "
+                         "invariants (--smoke for the quick wiring "
+                         "check); metricsd: the quota-virtualized "
+                         "view stock tpu-info sees (docs/METRICSD.md)")
     ap.add_argument("cmd_arg", nargs="?", default=None,
                     help="tenant name for `trace`; HOST:PORT for "
                          "`metricsd`")
@@ -352,6 +355,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--region", action="append", default=[],
                     help="explicit region file (repeatable)")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="with `mc`: tiny-budget wiring check (the "
+                         "analyze CI job's smoke)")
     ap.add_argument("--sweep-host", action="store_true",
                     help="reclaim slots of dead host pids (node mode only)")
     ap.add_argument("--broker", default=None, metavar="SOCKET",
@@ -389,6 +395,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         # exhaustiveness, env-flag contract, journal replay coverage.
         from .analyze import main as analyze_main
         return analyze_main(["--json"] if ns.json else [])
+    if ns.cmd == "mc":
+        # Model checker (tools/mc): interleaving + crash-cut engines
+        # over the invariant registry (docs/ANALYSIS.md).  --smoke is
+        # the cheap wiring check the analyze CI job runs; budgets and
+        # selfcheck live on `python -m vtpu.tools.mc` directly.
+        from .mc import main as mc_main
+        args = []
+        if ns.json:
+            args.append("--json")
+        if ns.smoke:
+            args.append("--smoke")
+        if ns.cmd_arg:
+            args.extend(["--scenario", ns.cmd_arg])
+        return mc_main(args)
 
     admin_verbs = (ns.suspend or ns.resume or ns.broker_stats
                    or ns.drain or ns.handover or ns.shutdown)

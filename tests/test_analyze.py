@@ -427,3 +427,221 @@ def test_console_entry_exits_zero(capsys):
 def test_vtpu_smi_analyze_subcommand():
     from vtpu.tools import vtpu_smi
     assert vtpu_smi.main(["analyze"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# excsafety (exception-safe region/ledger/bucket acquires)
+# ---------------------------------------------------------------------------
+
+from vtpu.tools.analyze import excsafety, wirefields  # noqa: E402
+
+
+def _exc_findings(body):
+    return excsafety.check_texts({excsafety.ANALYZED[0]: body})
+
+
+def test_excsafety_swallowing_handler_without_release_caught():
+    fs = _exc_findings('''
+class R:
+    def bad(self, region, jr):
+        try:
+            region.mem_acquire(0, 64, True)
+            jr.put_blob(b"x")
+        except Exception:
+            pass
+''')
+    assert any("catches-and-continues" in f.message for f in fs), fs
+
+
+def test_excsafety_handler_release_clean():
+    assert _exc_findings('''
+class R:
+    def good(self, region, jr):
+        try:
+            region.mem_acquire(0, 64, True)
+            jr.put_blob(b"x")
+        except Exception:
+            region.mem_release(0, 64)
+            raise
+''') == []
+
+
+def test_excsafety_handler_release_via_helper_clean():
+    # One-fixpoint call summary: the handler calls a function that
+    # releases.
+    assert _exc_findings('''
+class R:
+    def _undo(self, region):
+        region.mem_release(0, 64)
+
+    def good(self, region, jr):
+        try:
+            region.mem_acquire(0, 64, True)
+            jr.put_blob(b"x")
+        except Exception:
+            self._undo(region)
+''') == []
+
+
+def test_excsafety_continue_handler_voids_ownership():
+    # The recovery-loop bug class: ownership store present, but the
+    # handler `continue`s past the owner — the store settles nothing.
+    fs = _exc_findings('''
+class R:
+    def bad(self, region, recs):
+        for rec in recs:
+            try:
+                region.mem_acquire(0, 64, True)
+                self.charges[rec] = [(0, 64)]
+                self.nbytes[rec] = int(rec)
+            except Exception:
+                continue
+''')
+    assert any("'continue'" in f.message for f in fs), fs
+
+
+def test_excsafety_ownership_before_risk_clean():
+    assert _exc_findings('''
+class R:
+    def good(self, region, jr, t):
+        region.mem_acquire(0, 64, False)
+        t.arrays["a"] = object()
+        jr.put_blob(b"x")
+''') == []
+
+
+def test_excsafety_unprotected_risky_call_caught():
+    fs = _exc_findings('''
+class R:
+    def bad(self, region, jax, arr, dev):
+        region.mem_acquire(0, 64, False)
+        jax.device_put(arr, dev)
+''')
+    assert any("leaks the charge" in f.message for f in fs), fs
+
+
+def test_excsafety_failure_branch_guarded_by_result_clean():
+    # `admitted = acquire(); if not admitted: raise` — the refused
+    # acquire charged nothing; the raise is not a leak.
+    assert _exc_findings('''
+class R:
+    def good(self, region, t):
+        admitted = region.mem_acquire(0, 64, False)
+        if not admitted:
+            raise MemoryError("RESOURCE_EXHAUSTED")
+        t.charges["a"] = [(0, 64)]
+''') == []
+
+
+def test_excsafety_real_tree_clean():
+    assert excsafety.check(REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# wirefields (optional-header legacy-default contract)
+# ---------------------------------------------------------------------------
+
+_WF_PROTO = '''
+HELLO = "hello"
+PUT = "put"
+TENANT_VERBS = (HELLO, PUT)
+ADMIN_VERBS = ()
+WIRE_FIELDS = {
+    HELLO: {"required": ("tenant",), "optional": ("priority",)},
+    PUT: {"required": ("id",), "optional": ("raw_parts",)},
+}
+REPLY_OPTIONAL_FIELDS = ("lease",)
+'''
+
+_WF_CLIENT_OK = '''
+def absorb(resp):
+    lease = resp.get("lease")
+    return lease
+'''
+
+
+def _wf_findings(server_body, proto=_WF_PROTO, client=_WF_CLIENT_OK):
+    return wirefields.check_texts({
+        wirefields.PROTOCOL: proto,
+        wirefields.SERVER: server_body,
+        wirefields.CLIENT: client,
+    })
+
+
+_WF_SERVER_OK = '''
+def serve(msg):
+    kind = msg.get("kind")
+    t = msg["tenant"]
+    p = msg.get("priority", 1)
+    i = msg["id"]
+    raw = int(msg.get("raw_parts", 0) or 0)
+    return t, p, i, raw
+'''
+
+
+def test_wirefields_clean_fixture():
+    assert _wf_findings(_WF_SERVER_OK) == []
+
+
+def test_wirefields_optional_subscript_caught():
+    fs = _wf_findings('''
+def serve(msg):
+    t = msg["tenant"]
+    p = msg["priority"]
+    i = msg["id"]
+    raw = int(msg.get("raw_parts", 0) or 0)
+''')
+    assert any('OPTIONAL wire field "priority"' in f.message
+               for f in fs), fs
+
+
+def test_wirefields_unregistered_field_caught():
+    fs = _wf_findings(_WF_SERVER_OK.replace(
+        "return t, p, i, raw",
+        'extra = msg.get("brand_new_field")\n    return t, p, i, raw'))
+    assert any('"brand_new_field"' in f.message for f in fs), fs
+
+
+def test_wirefields_dead_registry_entry_caught():
+    fs = _wf_findings('''
+def serve(msg):
+    t = msg["tenant"]
+    p = msg.get("priority", 1)
+    i = msg["id"]
+''')
+    assert any('"raw_parts" is registered but never read' in f.message
+               for f in fs), fs
+
+
+def test_wirefields_verb_without_entry_caught():
+    proto = _WF_PROTO.replace(
+        'PUT: {"required": ("id",), "optional": ("raw_parts",)},\n', "")
+    fs = _wf_findings('''
+def serve(msg):
+    t = msg["tenant"]
+    p = msg.get("priority", 1)
+''', proto=proto)
+    assert any('verb "put" is in the verb registries but has no '
+               "WIRE_FIELDS entry" in f.message for f in fs), fs
+
+
+def test_wirefields_reply_rider_subscript_caught():
+    fs = _wf_findings(_WF_SERVER_OK, client='''
+def absorb(resp):
+    return resp["lease"]
+''')
+    assert any('reply rider "lease" is subscript-read' in f.message
+               for f in fs), fs
+
+
+def test_wirefields_reply_rider_missing_caught():
+    fs = _wf_findings(_WF_SERVER_OK, client='''
+def absorb(resp):
+    return resp.get("ok")
+''')
+    assert any('"lease" is registered but never absorbed' in f.message
+               for f in fs), fs
+
+
+def test_wirefields_real_tree_clean():
+    assert wirefields.check(REPO_ROOT) == []

@@ -1,0 +1,285 @@
+"""vtpu-mc tests (tools/mc, docs/ANALYSIS.md "Model checking").
+
+Four layers:
+
+  - engine sanity: every scenario explores clean under a bounded
+    budget, exploration is deterministic (same budget -> same tree),
+    the crash engine covers every record boundary of the canned
+    session;
+  - the PARAMETRIZED crash-cut sweep: one test case per record
+    boundary of the canned multi-tenant session (and one per torn
+    mid-record cut), each recovered through the real path and checked
+    against the independent record interpreter;
+  - seeded violations: one test per invariant, proving the checker
+    catches its deliberately broken broker variant (a model checker
+    that can't catch a seeded bug proves nothing with its green runs);
+  - the recovery exception-safety regression the checkers found
+    (partial journal replay must release re-applied ledger bytes).
+"""
+
+import atexit
+import os
+import shutil
+import sys
+import tempfile
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from vtpu.tools.mc import (  # noqa: E402
+    cli, crashcut, interleave, invariants, scenarios, selfcheck)
+from vtpu.tools.mc import sched as mcsched  # noqa: E402
+from vtpu.tools.mc.harness import Harness  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Canned-session recording, made once per test process (the crash-cut
+# parametrization needs the record count at collection time).
+# ---------------------------------------------------------------------------
+
+_REC_DIR = None
+
+
+def _recording():
+    global _REC_DIR
+    if _REC_DIR is None:
+        _REC_DIR = tempfile.mkdtemp(prefix="vtpu-mc-test-rec-")
+        atexit.register(shutil.rmtree, _REC_DIR, ignore_errors=True)
+        violations = crashcut.record_session(_REC_DIR)
+        assert violations == [], violations
+    return _REC_DIR
+
+
+def _records():
+    from vtpu.runtime.journal import LOG_NAME
+    with open(os.path.join(_recording(), LOG_NAME), "rb") as f:
+        log = f.read()
+    return log, crashcut.split_records(log)
+
+
+# ---------------------------------------------------------------------------
+# interleaving engine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", [s.name for s in scenarios.SCENARIOS])
+def test_interleave_scenario_green(name):
+    stats = interleave.explore_scenario(scenarios.get(name),
+                                        max_schedules=120)
+    assert stats.violations == [], stats.violations
+    assert stats.schedules > 1, "explorer never branched"
+    assert stats.truncated == 0
+
+
+def test_interleave_deterministic():
+    a = interleave.explore_scenario(scenarios.get("contention"),
+                                    max_schedules=60)
+    b = interleave.explore_scenario(scenarios.get("contention"),
+                                    max_schedules=60)
+    assert (a.schedules, a.decisions) == (b.schedules, b.decisions)
+
+
+def test_interleave_preemption_bound_grows_space():
+    tight = interleave.explore_scenario(
+        scenarios.get("batch_pipeline"), max_schedules=100_000,
+        preemption_bound=0)
+    loose = interleave.explore_scenario(
+        scenarios.get("batch_pipeline"), max_schedules=tight.schedules + 50,
+        preemption_bound=1)
+    assert loose.schedules > tight.schedules
+
+
+def test_registry_has_both_engines_and_all_phases():
+    engines = {(i.engine, i.phase) for i in invariants.INVARIANTS}
+    assert ("interleave", "step") in engines
+    assert ("interleave", "terminal") in engines
+    assert ("crash", "cut") in engines
+    # Every invariant name is unique (the seeded tests key on them).
+    names = [i.name for i in invariants.INVARIANTS]
+    assert len(names) == len(set(names))
+
+
+# ---------------------------------------------------------------------------
+# crash-cut engine: the full sweep + per-boundary parametrization
+# ---------------------------------------------------------------------------
+
+def test_crash_engine_full_green():
+    stats = crashcut.explore(record_dir=_recording())
+    assert stats.violations == [], stats.violations
+    assert stats.records > 10, "canned session suspiciously small"
+    assert stats.boundary_cuts == stats.records + 1
+    assert stats.torn_cuts == stats.records
+    assert stats.corrupt_checks >= 3
+
+
+def test_canned_session_covers_every_record_type():
+    _log, records = _records()
+    ops = {r.get("op") for _s, _e, r in records}
+    assert {"epoch", "chip", "bind", "put", "del", "compile", "ema",
+            "close", "wedge"} <= ops, ops
+
+
+def pytest_generate_tests(metafunc):
+    if "boundary_idx" in metafunc.fixturenames:
+        _log, records = _records()
+        metafunc.parametrize("boundary_idx",
+                             list(range(len(records) + 1)))
+    if "torn_idx" in metafunc.fixturenames:
+        _log, records = _records()
+        metafunc.parametrize("torn_idx", list(range(len(records))))
+
+
+def test_boundary_cut_recovers_ground_truth(boundary_idx, tmp_path):
+    """Crash at record boundary N: the real recovery must reconstruct
+    exactly what the independent interpreter says records[:N] imply."""
+    log, records = _records()
+    off = 0 if boundary_idx == 0 else records[boundary_idx - 1][1]
+    cut = str(tmp_path / "cut")
+    crashcut._make_cut(_recording(), cut, log[:off])
+    rec = crashcut.recover_cut(cut)
+    got = crashcut.CutContext.tenant_digest(rec.digest())
+    want = crashcut._predict(
+        [r for _s, _e, r in records[:boundary_idx]],
+        rec.h.state.default_hbm, rec.h.state.default_core)["tenants"]
+    rec.close()
+    assert got == want
+
+
+def test_torn_cut_drops_tail_exactly(torn_idx, tmp_path):
+    """Crash MID-record (the kill -9 torn tail): recovery must land on
+    the previous record boundary — never on a guessed partial state,
+    never on JournalCorrupt."""
+    log, records = _records()
+    start, end, _r = records[torn_idx]
+    frag = start + max((end - start) // 2, 1)
+    cut = str(tmp_path / "cut")
+    crashcut._make_cut(_recording(), cut, log[:frag])
+    rec = crashcut.recover_cut(cut)   # JournalCorrupt would fail here
+    got = crashcut.CutContext.tenant_digest(rec.digest())
+    want = crashcut._predict(
+        [r for _s, _e, r in records[:torn_idx]],
+        rec.h.state.default_hbm, rec.h.state.default_core)["tenants"]
+    rec.close()
+    assert got == want
+
+
+def test_nontail_corruption_fails_closed(tmp_path):
+    from vtpu.runtime.journal import JournalCorrupt
+    log, records = _records()
+    cut = str(tmp_path / "cut")
+    crashcut._make_cut(_recording(), cut,
+                       crashcut._flip_byte(log, records))
+    with pytest.raises(JournalCorrupt):
+        crashcut.recover_cut(cut)
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: every invariant's checker must catch its bug
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", selfcheck.SEEDS,
+                         ids=[s.name for s in selfcheck.SEEDS])
+def test_seeded_violation_caught(seed):
+    caught, violations = selfcheck.run_seed(
+        seed, record_dir=_recording(), max_schedules=250)
+    assert caught, (
+        f"seed {seed.name} did not trigger [{seed.invariant}]; "
+        f"violations: {violations[:5]}")
+
+
+def test_every_invariant_has_a_seed():
+    seeded = {s.invariant for s in selfcheck.SEEDS}
+    all_invs = {i.name for i in invariants.INVARIANTS}
+    assert seeded == all_invs, (
+        f"unseeded invariants: {sorted(all_invs - seeded)}; "
+        f"stale seeds: {sorted(seeded - all_invs)}")
+
+
+# ---------------------------------------------------------------------------
+# the recovery exception-safety fix (found by excsafety + mc)
+# ---------------------------------------------------------------------------
+
+def test_partial_recovery_releases_reapplied_ledger(tmp_path):
+    """A tenant whose journal replay fails MID-ledger-re-apply (here: a
+    charge position past the granted chip set) must be dropped with
+    every already-re-applied byte released — the pre-fix broker leaked
+    them on the slot until the next restart."""
+    import json
+    import zlib
+
+    def frame(rec):
+        payload = json.dumps(rec, separators=(",", ":"),
+                             sort_keys=True).encode()
+        return b"%08x %s\n" % (zlib.crc32(payload), payload)
+
+    jdir = tmp_path / "journal"
+    (jdir / "blobs").mkdir(parents=True)
+    pid = os.getpid()
+    with open(jdir / "journal.log", "wb") as f:
+        f.write(frame({"op": "epoch", "epoch": "e1"}))
+        f.write(frame({"op": "bind", "name": "L", "devices": [0],
+                       "slots": [0], "priority": 1, "over": False,
+                       "hbm": [4096], "core": 50, "pid": pid}))
+        f.write(frame({"op": "put", "name": "L", "id": "ok",
+                       "sha": "s1", "shape": [16], "dtype": "float32",
+                       "nbytes": 64, "charges": [[0, 64]],
+                       "spilled": False}))
+        # Poison pill: charge position 7 on a 1-chip grant -> replay
+        # raises AFTER "ok"'s 64 bytes were re-applied.
+        f.write(frame({"op": "put", "name": "L", "id": "bad",
+                       "sha": "s2", "shape": [16], "dtype": "float32",
+                       "nbytes": 64, "charges": [[7, 64]],
+                       "spilled": False}))
+    rec = crashcut.recover_cut(str(jdir), n_chips=1)
+    st = rec.h.state
+    assert "L" not in st.recovered, "poisoned tenant must be dropped"
+    assert st.recovery["tenants_dropped_dead"] == 1
+    region = st.chips[0].region
+    assert region.used[0] == 0, (
+        f"partial replay leaked {region.used[0]} bytes on the slot")
+    rec.close()
+
+
+def test_interleave_catches_the_unfixed_recovery_leak():
+    """The same bug class through the invariant registry: seed a
+    recovered tenant whose ledger was over-applied relative to its
+    books — the hbm-ledger-balance invariant must flag it."""
+    sched = mcsched.Scheduler()
+    with mcsched.patched_modules(sched):
+        h = Harness(sched, journal=None)
+        # Simulate the pre-fix leak: bytes applied to the region with
+        # no tenant book carrying them.
+        h.state.chips[0].region.mem_acquire(3, 128, True)
+        out = invariants.run_checks("interleave", "terminal", h)
+    assert any("hbm-ledger-balance" in v for v in out), out
+
+
+# ---------------------------------------------------------------------------
+# CLI + vtpu-smi wiring
+# ---------------------------------------------------------------------------
+
+def test_cli_smoke_green(capsys):
+    assert cli.main(["--smoke"]) == 0
+    out = capsys.readouterr().out
+    assert "0 violation(s)" in out
+    assert "boundary cuts" in out
+
+
+def test_cli_floor_gate_fires(capsys):
+    assert cli.main(["--engine", "interleave", "--scenario",
+                     "lease_expiry", "--max-schedules", "3",
+                     "--min-schedules", "10_000_000".replace("_", "")
+                     ]) == 1
+
+
+def test_cli_list(capsys):
+    assert cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "token-conservation" in out
+    assert "batch_pipeline" in out
+
+
+def test_vtpu_smi_mc_subcommand():
+    from vtpu.tools import vtpu_smi
+    assert vtpu_smi.main(["mc", "--smoke"]) == 0
