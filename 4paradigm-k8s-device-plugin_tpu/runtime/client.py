@@ -18,16 +18,39 @@ from __future__ import annotations
 import collections
 import itertools
 import os
+import random
 import socket
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..utils import envspec
 from ..utils.dtypes import np_dtype as _np_dtype
+from . import faults
 from . import protocol as P
 from . import trace as tracing
+
+
+def _env_float(name: str, default: float) -> float:
+    """Float env knob with a junk-tolerant default (a typo'd tuning
+    value must degrade to the default, never crash the tenant)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def full_jitter_delay(rng: random.Random, base_s: float, cap_s: float,
+                      attempt: int) -> float:
+    """Bounded exponential backoff with FULL jitter (docs/CHAOS.md):
+    uniform over [0, min(cap, base * 2^attempt)].  Full jitter is what
+    desynchronizes N tenants reconnecting after ONE broker crash — a
+    deterministic (or merely +/-jittered) schedule re-aligns every
+    client on the same retry ticks and the respawned broker eats N
+    simultaneous HELLOs per tick (the reconnect stampede)."""
+    cap = min(cap_s, base_s * (2 ** min(max(attempt, 0), 16)))
+    return rng.uniform(0.0, cap)
 
 
 class VtpuQuotaError(MemoryError):
@@ -52,6 +75,17 @@ class VtpuConnectionLost(RuntimeError_):
     executes in flight at the crash died unreplied."""
 
     resumed = False
+
+
+class VtpuBrokerUnavailable(RuntimeError_):
+    """The broker has been unreachable past ``VTPU_BROKER_GRACE_S`` and
+    the client is in DEGRADED mode (docs/CHAOS.md): operations fail
+    fast with this typed error instead of blocking, the LAST-GRANTED
+    quotas keep biting locally (an over-quota request raises
+    ``VtpuQuotaError`` even with the broker gone — fail closed), and
+    compiles queue for replay.  The client reattaches transparently on
+    the next operation once the broker answers again; a journal-resumed
+    reattach is invisible to the caller beyond this window."""
 
 
 class VtpuStateLost(RuntimeError_):
@@ -228,6 +262,40 @@ class RuntimeClient:
             except ValueError:
                 pass
         self._hello = hello
+        # -- vtpu-chaos hardening (docs/CHAOS.md) --
+        # Per-RPC deadline on EVERY socket op: no recv or connect in
+        # this client can block unboundedly — a wedged (not dead)
+        # broker surfaces through the same typed recovery path a
+        # SIGKILLed one does.  0 disables.
+        self._rpc_timeout = _env_float("VTPU_RPC_TIMEOUT_S", 120.0)
+        self._connect_timeout = _env_float("VTPU_CONNECT_TIMEOUT_S", 5.0)
+        # Reconnect backoff: bounded exponential with FULL jitter,
+        # seeded per tenant+pid so N tenants recovering from one broker
+        # crash never produce a synchronized HELLO burst.
+        self._backoff_base = max(
+            _env_float("VTPU_RECONNECT_BACKOFF_MS", 50.0) / 1e3, 1e-3)
+        self._backoff_cap = max(
+            _env_float("VTPU_RECONNECT_BACKOFF_CAP_MS", 2000.0) / 1e3,
+            self._backoff_base)
+        self._backoff_rng = random.Random(
+            f"{self.tenant}\x00{os.getpid()}")
+        # Fail-closed degraded mode: past this many seconds of broker
+        # unreachability the client stops blocking and enforces the
+        # last-granted quotas locally (runtime/degraded.py).  0 keeps
+        # the legacy behavior (hard error after the reconnect budget).
+        self._grace_s = _env_float("VTPU_BROKER_GRACE_S", 0.0)
+        self._degraded = False
+        self._deg_since = 0.0
+        self._deg_attempt = 0
+        self._deg_next_dial = 0.0
+        self._deg_enforcer: Optional[Any] = None
+        self._deg_q: List[Tuple[str, bytes]] = []
+        self._deg_qmax = int(_env_float("VTPU_DEGRADED_QUEUE", 32.0))
+        # Mirror of this tenant's broker-side PUT footprint (aid ->
+        # bytes): what the degraded-mode quota check charges against.
+        self._used_mirror: Dict[str, int] = {}
+        self._granted_hbm = int(hello.get("hbm_limit") or 0)
+        self._granted_core = int(hello.get("core_limit") or 0)
         self.epoch: Optional[str] = None
         self.epoch = self._connect()[0]
 
@@ -238,7 +306,14 @@ class RuntimeClient:
         was re-adopted with its state intact.  Used for both the first
         connection and crash-recovery rebinds."""
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        # Bounded dial + per-RPC deadline on everything after it: no
+        # socket op in this client is ever unbounded (docs/CHAOS.md).
+        if self._connect_timeout > 0:
+            self.sock.settimeout(self._connect_timeout)
+        faults.fire("connect")
         self.sock.connect(self._socket_path)
+        self.sock.settimeout(self._rpc_timeout
+                             if self._rpc_timeout > 0 else None)
         msg = dict(self._hello)
         if self.epoch:
             # Reconnect: offer our previous epoch — a journal-enabled
@@ -298,8 +373,20 @@ class RuntimeClient:
           execute."""
         if self._closed:
             raise RuntimeError_("client is closed")
+        if self._degraded:
+            # Already degraded: the caller's op re-enters through the
+            # degraded gate (reattach is paced there) — never block
+            # here a second time.
+            raise VtpuBrokerUnavailable(
+                f"broker still unreachable on {self._socket_path} "
+                f"(degraded mode since "
+                f"{time.monotonic() - self._deg_since:.0f}s)")
         old = self.epoch
-        deadline = time.monotonic() + self._reconnect_timeout
+        budget = self._reconnect_timeout
+        if self._grace_s > 0:
+            budget = max(budget, self._grace_s)
+        deadline = time.monotonic() + budget
+        attempt = 0
         last: Optional[BaseException] = None
         while time.monotonic() < deadline:
             try:
@@ -311,14 +398,16 @@ class RuntimeClient:
             except (ConnectionError, FileNotFoundError, OSError,
                     P.ProtocolError) as e:
                 last = e
-                time.sleep(0.25)
+                attempt += 1
+                self._backoff_sleep(attempt, deadline)
                 continue
             except RuntimeError_ as e:
                 # HELLO itself rejected (e.g. slots exhausted while the
                 # dead session's teardown drains, or a DRAINING broker
                 # mid-handover): retryable.
                 last = e
-                time.sleep(0.25)
+                attempt += 1
+                self._backoff_sleep(attempt, deadline)
                 continue
             if resumed:
                 self.epoch = new_epoch
@@ -331,6 +420,11 @@ class RuntimeClient:
                 raise err
             if new_epoch != old or created:
                 self.epoch = new_epoch
+                # Handles are gone: the degraded-mode usage mirror and
+                # any queued compiles must not survive into the fresh
+                # epoch's books.
+                self._used_mirror.clear()
+                self._deg_q.clear()
                 why = ("broker restarted" if new_epoch != old else
                        "broker alive but tenant state was torn down "
                        "before the rebind")
@@ -342,9 +436,125 @@ class RuntimeClient:
                 "CONNECTION_LOST: broker connection dropped and was "
                 "rebound (same epoch, state intact); in-flight requests "
                 "were lost")
+        if self._grace_s > 0:
+            # Fail-closed degraded mode (docs/CHAOS.md): stop blocking,
+            # enforce the last-granted quotas locally, reattach on the
+            # next op that finds the broker back.
+            self._enter_degraded()
+            raise VtpuBrokerUnavailable(
+                f"broker unreachable for {budget:.0f}s on "
+                f"{self._socket_path}; degraded mode: local enforcement "
+                f"at last-granted limits, reattach pending ({last})")
         raise RuntimeError_(
-            f"broker unreachable for {self._reconnect_timeout:.0f}s "
+            f"broker unreachable for {budget:.0f}s "
             f"on {self._socket_path}: {last}")
+
+    def _backoff_sleep(self, attempt: int, deadline: float) -> None:
+        """One jittered backoff pause, clipped to the reconnect
+        deadline (the last attempt must not oversleep its budget)."""
+        delay = full_jitter_delay(self._backoff_rng, self._backoff_base,
+                                  self._backoff_cap, attempt)
+        time.sleep(max(min(delay, deadline - time.monotonic()), 0.0))
+
+    # -- degraded mode (docs/CHAOS.md) --
+
+    def _enter_degraded(self) -> None:
+        self._degraded = True
+        self._deg_since = time.monotonic()
+        self._deg_attempt = 0
+        self._deg_next_dial = 0.0
+        if self._deg_enforcer is None:
+            from . import degraded
+            self._deg_enforcer = degraded.LocalEnforcer.from_env(
+                hbm_limit=self._granted_hbm,
+                core_pct=self._granted_core,
+                used_bytes=sum(self._used_mirror.values()))
+
+    def _try_reattach(self) -> bool:
+        """One paced reattach dial; True when the client is back on a
+        live broker with state intact (journal resume or the broker
+        never died).  A FRESH epoch raises VtpuStateLost — handles are
+        gone and the queued compiles died with them."""
+        now = time.monotonic()
+        if now < self._deg_next_dial:
+            return False
+        self._deg_attempt += 1
+        self._deg_next_dial = now + full_jitter_delay(
+            self._backoff_rng, self._backoff_base, self._backoff_cap,
+            self._deg_attempt)
+        old = self.epoch
+        try:
+            new_epoch, created, resumed = self._connect()
+        except (ConnectionError, FileNotFoundError, OSError,
+                P.ProtocolError, RuntimeError_):
+            return False
+        self._degraded = False
+        if self._deg_enforcer is not None:
+            self._deg_enforcer.close()
+            self._deg_enforcer = None
+        self.epoch = new_epoch
+        if resumed or (new_epoch == old and not created):
+            self._replay_degraded_queue()
+            return True
+        # Fresh epoch / fresh slot: device state is gone.  The typed
+        # contract is the same one _on_disconnect raises.
+        self._deg_q.clear()
+        self._used_mirror.clear()
+        raise VtpuStateLost(
+            f"broker restarted while degraded (epoch {old} -> "
+            f"{new_epoch}); arrays and executables are lost — "
+            f"re-put/re-compile on this client",
+            epoch_old=old, epoch_new=new_epoch)
+
+    def _replay_degraded_queue(self) -> None:
+        """Re-register the compiles queued while degraded, under their
+        reserved ids — the caller-visible handles become live."""
+        q, self._deg_q = self._deg_q, []
+        for eid, blob in q:
+            self._rpc({"kind": P.COMPILE, "id": eid, "exported": blob})
+
+    def _degraded_gate(self, nbytes: int = 0,
+                       est_us: float = 0.0) -> None:
+        """Degraded-mode chokepoint: every op first tries a transparent
+        reattach; while the broker stays gone the LAST-GRANTED quotas
+        still bite (fail closed — killing the broker is never a quota
+        escape) and everything else fails fast with the typed
+        VtpuBrokerUnavailable instead of hanging."""
+        if not self._degraded:
+            return
+        if self._try_reattach():
+            return
+        enf = self._deg_enforcer
+        if enf is not None and nbytes and not enf.admit_bytes(nbytes):
+            raise VtpuQuotaError(
+                f"RESOURCE_EXHAUSTED: degraded mode: {nbytes} bytes "
+                f"would exceed the last-granted HBM quota "
+                f"({self._granted_hbm or 'contract'} limit) — "
+                f"enforcement holds while the broker is down")
+        if enf is not None and est_us and not enf.admit_us(est_us):
+            raise VtpuQuotaError(
+                "RESOURCE_EXHAUSTED: degraded mode: device-time quota "
+                "exhausted at the last-granted rate — enforcement "
+                "holds while the broker is down")
+        raise VtpuBrokerUnavailable(
+            f"broker unreachable on {self._socket_path} (degraded "
+            f"since {time.monotonic() - self._deg_since:.0f}s); "
+            f"operation failed cleanly, will reattach when the broker "
+            f"returns")
+
+    def _degraded_compile(self, blob: bytes) -> "RemoteExecutable":
+        """Compiles QUEUE while degraded (bounded): the blob replays
+        under its reserved id at reattach, so the returned handle
+        becomes live transparently."""
+        if self._try_reattach():
+            return self.compile_blob(blob)
+        if len(self._deg_q) >= max(self._deg_qmax, 0):
+            raise VtpuBrokerUnavailable(
+                f"degraded compile queue full "
+                f"({self._deg_qmax} blobs); broker still unreachable")
+        eid = f"e{next(self._ids)}"
+        self._deg_q.append((eid, bytes(blob)))
+        return RemoteExecutable(self, eid)
 
     @staticmethod
     def _default_tenant() -> str:
@@ -383,11 +593,21 @@ class RuntimeClient:
         return cls(path, **kw)
 
     # Kinds an interrupted synchronous request may transparently retry
-    # after a resumed reconnect: all single-frame idempotent verbs.
-    # EXECUTE is excluded (non-idempotent), as are staged PUT flows
-    # (the per-connection staging died with the old socket).
-    _RESUME_RETRY_KINDS = frozenset({P.GET, P.DELETE, P.STATS,
-                                     P.TRACE, P.COMPILE, P.PUT})
+    # after a resumed reconnect — DERIVED from the protocol's machine-
+    # checked retry-safety registry (P.IDEMPOTENT_VERBS, enforced by
+    # vtpu-analyze), never a hand-maintained literal.  EXECUTE/
+    # EXEC_BATCH are non-idempotent by classification; staged PUT
+    # flows are additionally excluded at the retry site (the
+    # per-connection staging died with the old socket).
+    _RESUME_RETRY_KINDS = frozenset(P.IDEMPOTENT_VERBS) \
+        & frozenset(P.TENANT_VERBS)
+
+    def _recv(self) -> Dict[str, Any]:
+        """One reply frame off the socket, with the vtpu-chaos recv
+        hook in front (recv_trunc / mid-frame disconnect inject here)
+        and the per-RPC deadline applied by the socket timeout."""
+        faults.fire("recv")
+        return P.recv_msg(self.sock)
 
     def _maybe_stamp(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         """Attach the trace context when tracing is on; byte-identical
@@ -468,10 +688,12 @@ class RuntimeClient:
         queue, so the NEXT frame read belongs to the sync request.
         Callers that paired their sends and recvs (the documented
         pipelining contract) hit the zero-iteration fast path."""
+        if self._degraded:
+            self._degraded_gate()
         self._flush_batch()
         while self._wire_out > 0:
             try:
-                raw = P.recv_msg(self.sock)
+                raw = self._recv()
             except (ConnectionError, P.ProtocolError, OSError):
                 self._on_disconnect()
                 raise AssertionError("unreachable")
@@ -484,7 +706,7 @@ class RuntimeClient:
         self._sync_prelude()
         try:
             P.send_msg(self.sock, self._maybe_stamp(msg))
-            resp = P.recv_msg(self.sock)
+            resp = self._recv()
         except (ConnectionError, P.ProtocolError, OSError):
             try:
                 self._on_disconnect()
@@ -517,7 +739,7 @@ class RuntimeClient:
             for p in payloads:
                 bufs.extend(P.raw_frames(p))
             P.send_frames(self.sock, bufs)
-            resp = P.recv_msg(self.sock)
+            resp = self._recv()
         except (ConnectionError, P.ProtocolError, OSError):
             try:
                 self._on_disconnect()
@@ -536,6 +758,9 @@ class RuntimeClient:
 
     def close(self) -> None:
         self._closed = True
+        if self._deg_enforcer is not None:
+            self._deg_enforcer.close()
+            self._deg_enforcer = None
         try:
             self.sock.close()
         except OSError:
@@ -551,12 +776,18 @@ class RuntimeClient:
             arr = np.ascontiguousarray(arr)
         aid = aid or f"a{next(self._ids)}"
         arr = np.asarray(arr)
+        if self._degraded:
+            # Fail-closed gate BEFORE any transport attempt: the
+            # last-granted HBM quota still decides over-quota uploads
+            # even with the broker gone (docs/CHAOS.md).
+            self._degraded_gate(nbytes=int(arr.nbytes))
         if self._raw:
             # Zero-copy upload: header + payload segments leave in one
             # gather write straight from the numpy buffer, answered by
             # ONE ack regardless of size (docs/PERF.md).
             hdr, payload = self._put_raw_parts(arr, aid)
             self._rpc_frames(hdr, [payload])
+            self._track_put(aid, int(arr.nbytes))
             return RemoteArray(self, aid, arr.shape, arr.dtype)
         # Legacy framing (VTPU_RAW_FRAMES=0): one framing implementation
         # (_put_msgs) serves both the sync and pipelined paths; the sync
@@ -566,7 +797,13 @@ class RuntimeClient:
         # stops reading parts).
         for m in self._put_msgs(arr, aid):
             self._rpc(m)
+        self._track_put(aid, int(arr.nbytes))
         return RemoteArray(self, aid, arr.shape, arr.dtype)
+
+    def _track_put(self, aid: str, nbytes: int) -> None:
+        """Mirror the tenant's broker-side PUT footprint so a later
+        degraded window enforces against real usage (docs/CHAOS.md)."""
+        self._used_mirror[aid] = nbytes
 
     @staticmethod
     def _put_raw_parts(arr: np.ndarray, aid: str):
@@ -629,6 +866,8 @@ class RuntimeClient:
         without draining its in-flight executes.  Buffered executes
         flush first so frame order matches the caller's send order."""
         arr = np.asarray(arr)
+        if self._degraded:
+            self._degraded_gate(nbytes=int(arr.nbytes))
         self._flush_batch()
         sent = 0
         try:
@@ -658,9 +897,11 @@ class RuntimeClient:
         if self._ready:
             resp = self._ready.popleft()
         else:
+            if self._degraded:
+                self._degraded_gate()
             self._flush_batch()
             try:
-                raw = P.recv_msg(self.sock)
+                raw = self._recv()
             except (ConnectionError, P.ProtocolError, OSError):
                 self._on_disconnect()
                 raise AssertionError("unreachable")
@@ -692,7 +933,7 @@ class RuntimeClient:
             off = 0
             try:
                 for _ in range(int(r["parts"])):
-                    part = P.recv_msg(self.sock)["data"]
+                    part = self._recv()["data"]
                     buf[off:off + len(part)] = part
                     off += len(part)
             except (ConnectionError, P.ProtocolError, OSError):
@@ -712,7 +953,7 @@ class RuntimeClient:
         try:
             P.send_msg(self.sock, self._maybe_stamp(
                 {"kind": P.GET, "id": aid, "raw": True}))
-            r = P.recv_msg(self.sock)
+            r = self._recv()
             arr = None
             if r.get("ok"):
                 buf = bytearray(int(r["nbytes"]))
@@ -742,12 +983,15 @@ class RuntimeClient:
 
     def delete(self, aid: str) -> None:
         self._rpc({"kind": P.DELETE, "id": aid})
+        self._used_mirror.pop(aid, None)
 
     def delete_many(self, aids: Sequence[str]) -> None:
         """Batch delete: one round trip for any number of ids (the
         bridge's deferred-free flush)."""
         if aids:
             self._rpc({"kind": P.DELETE, "ids": list(aids)})
+            for aid in aids:
+                self._used_mirror.pop(aid, None)
 
     # -- compute --
     def compile(self, fn, example_args: Sequence[np.ndarray]) -> RemoteExecutable:
@@ -769,6 +1013,8 @@ class RuntimeClient:
 
     def compile_blob(self, blob: bytes) -> RemoteExecutable:
         """Register an already-serialized jax.export artifact."""
+        if self._degraded:
+            return self._degraded_compile(bytes(blob))
         eid = f"e{next(self._ids)}"
         self._rpc({"kind": P.COMPILE, "id": eid, "exported": bytes(blob)})
         return RemoteExecutable(self, eid)
@@ -827,6 +1073,12 @@ class RuntimeClient:
         other send (frame order == call order), and before any recv
         (the awaited reply must be in flight) — callers pairing sends
         with recv_reply/execute_recv observe identical semantics."""
+        if self._degraded:
+            # Rate bite in degraded mode: the last-granted device-time
+            # share still paces (and eventually refuses) execute
+            # attempts, so hammering a broker-less socket spends the
+            # tenant's own budget, not its neighbours' (docs/CHAOS.md).
+            self._degraded_gate(est_us=5000.0)
         item: Dict[str, Any] = {"exe": eid, "args": list(arg_ids),
                                 "outs": list(out_ids)}
         if repeats > 1:

@@ -56,6 +56,7 @@ import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils import logging as log
+from . import faults
 
 LOG_NAME = "journal.log"
 SNAP_NAME = "snapshot.json"
@@ -104,6 +105,17 @@ def _apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> None:
         t = tenants.get(rec.get("name"))
         if t is not None:
             t.setdefault("exes", {})[rec["id"]] = rec.get("sha")
+    elif op == "resize":
+        # Live quota resize (admin RESIZE): the post-resize grant is
+        # what recovery must re-seed — replayed onto the same keys the
+        # bind record established, so _recover_from_journal needs no
+        # special case.
+        t = tenants.get(rec.get("name"))
+        if t is not None:
+            if rec.get("hbm") is not None:
+                t["hbm"] = rec["hbm"]
+            if rec.get("core") is not None:
+                t["core"] = rec["core"]
     elif op == "ema":
         t = tenants.get(rec.get("name"))
         if t is not None:
@@ -147,6 +159,14 @@ class Journal:
         self._fh = open(self.log_path, "ab")
         self._records_since = 0
         self._appended_total = 0
+        # Write-failure hardening (docs/CHAOS.md): a failed append
+        # (EIO / ENOSPC / short write) truncates the log back to the
+        # last good record boundary so later appends can never land
+        # after a torn line (mid-log damage is the one artifact replay
+        # must refuse).  When even the truncate fails the journal is
+        # quarantined and disabled — fail closed, never guess.
+        self._write_errors = 0
+        self._broken = False
         self._last_snapshot_ts: Optional[float] = None
         try:
             st = os.stat(self.snap_path)
@@ -197,15 +217,7 @@ class Journal:
     def append(self, rec: Dict[str, Any]) -> None:
         frame = self._frame(rec)
         with self.mu:
-            self._fh.write(frame)
-            # flush() reaches the OS page cache: enough to survive the
-            # broker's own death (SIGKILL, os._exit).  fsync covers
-            # machine death, at a per-record syscall cost.
-            self._fh.flush()
-            if self.fsync:
-                os.fsync(self._fh.fileno())
-            self._records_since += 1
-            self._appended_total += 1
+            self._append_locked(frame, 1)
 
     def append_many(self, recs) -> None:
         """Append a run of records in ONE buffered write + flush (the
@@ -216,12 +228,59 @@ class Journal:
             return
         frames = b"".join(self._frame(r) for r in recs)
         with self.mu:
-            self._fh.write(frames)
+            self._append_locked(frames, len(recs))
+
+    def _append_locked(self, data: bytes, n: int) -> None:
+        """Write + flush one framed run under self.mu, with write-error
+        hardening: on any OSError (real EIO/ENOSPC or an injected one —
+        vtpu-chaos ``write_short@journal``/``enospc@journal``) the log
+        is truncated back to the pre-write boundary so the failure
+        leaves no torn MID-log line behind (which replay would — and
+        must — refuse as corruption).  The error still propagates: the
+        request that could not be journaled is failed, never silently
+        acked undurable."""
+        if self._broken:
+            raise OSError("journal is disabled after an unrecoverable "
+                          "write failure (quarantined)")
+        # flush() reaches the OS page cache: enough to survive the
+        # broker's own death (SIGKILL, os._exit).  fsync covers
+        # machine death, at a per-record syscall cost.
+        try:
+            off = self._fh.tell()
+        except OSError:
+            off = None
+        try:
+            faults.fire("journal", fh=self._fh, data=data)
+            self._fh.write(data)
             self._fh.flush()
             if self.fsync:
+                faults.fire("fsync")
                 os.fsync(self._fh.fileno())
-            self._records_since += len(recs)
-            self._appended_total += len(recs)
+        except OSError:
+            self._write_errors += 1
+            self._repair_locked(off)
+            raise
+        self._records_since += n
+        self._appended_total += n
+
+    def _repair_locked(self, off: Optional[int]) -> None:
+        """Truncate the log back to the last good boundary after a
+        failed write; quarantine + disable when the repair itself fails
+        (an unreadable log must never be trusted OR extended)."""
+        try:
+            if off is None:
+                raise OSError("pre-write offset unknown")
+            self._fh.seek(off)
+            self._fh.truncate()
+            self._fh.flush()
+        except OSError as e:
+            log.error("journal: cannot repair after failed append "
+                      "(%s); quarantining and disabling the journal", e)
+            self._broken = True
+            self._quarantine_locked()
+
+    def journal_broken(self) -> bool:
+        return self._broken
 
     def snapshot_due(self) -> bool:
         with self.mu:
@@ -367,19 +426,22 @@ class Journal:
     def quarantine(self) -> None:
         """Move the corrupt journal aside (``<name>.corrupt.<ts>``) so
         the fresh epoch starts from an empty, trustworthy directory."""
-        ts = int(time.time())
         with self.mu:
-            self._fh.close()
-            for name in (LOG_NAME, LOG_NAME + ".old", SNAP_NAME):
-                path = os.path.join(self.dir, name)
-                if os.path.exists(path):
-                    try:
-                        os.replace(path, f"{path}.corrupt.{ts}")
-                    except OSError as e:
-                        log.warn("journal: cannot quarantine %s: %s",
-                                 name, e)
-            self._fh = open(self.log_path, "ab")
-            self._records_since = 0
+            self._quarantine_locked()
+
+    def _quarantine_locked(self) -> None:
+        ts = int(time.time())
+        self._fh.close()
+        for name in (LOG_NAME, LOG_NAME + ".old", SNAP_NAME):
+            path = os.path.join(self.dir, name)
+            if os.path.exists(path):
+                try:
+                    os.replace(path, f"{path}.corrupt.{ts}")
+                except OSError as e:
+                    log.warn("journal: cannot quarantine %s: %s",
+                             name, e)
+        self._fh = open(self.log_path, "ab")
+        self._records_since = 0
 
     # -- introspection -----------------------------------------------------
 
@@ -400,6 +462,10 @@ class Journal:
                 "records_appended": self._appended_total,
                 "last_snapshot_age_s": round(age, 1),
                 "fsync": self.fsync,
+                # Write-error hardening counters (docs/CHAOS.md):
+                # repaired append failures / quarantined-and-disabled.
+                "write_errors": self._write_errors,
+                "broken": self._broken,
             }
 
     def close(self) -> None:

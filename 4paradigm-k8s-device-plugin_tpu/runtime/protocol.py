@@ -139,6 +139,17 @@ SHUTDOWN = "shutdown"    # {} -> {ok}  then the broker exits gracefully
 # clients resume with state intact (docs/BROKER_RECOVERY.md).
 DRAIN = "drain"          # {timeout?} -> {ok, tenants, snapshotted}
 HANDOVER = "handover"    # {timeout?} -> {ok, tenants, snapshotted}
+# RESIZE (ROADMAP item 4): live per-tenant quota resize, no tenant
+# restart.  ``hbm_limit`` replicates across the grant, ``hbm_limits``
+# sets per-ordinal caps, ``core_limit`` re-seeds the device-time share.
+# Journaled (op "resize") with a replay arm, so the post-resize grant
+# survives a broker crash at ANY journal cut (the vtpu-mc crash engine
+# cuts through a canned resize).  Shrinks re-clamp immediately: the
+# rate lease is revoked (pre-debited budget priced at the old share
+# must not outlive it) and over-limit HBM books simply block new
+# admissions until freed.
+RESIZE = "resize"        # {tenant, hbm_limit?|hbm_limits?, core_limit?}
+                         # -> {ok, tenant, hbm, core}
 
 # ---------------------------------------------------------------------------
 # Verb registries — the machine-checked protocol contract.
@@ -156,10 +167,35 @@ HANDOVER = "handover"    # {timeout?} -> {ok, tenants, snapshotted}
 TENANT_VERBS = (HELLO, PUT_PART, PUT, GET, DELETE, COMPILE, EXECUTE,
                 EXEC_BATCH, STATS, TRACE)
 # Served on the host-side admin socket (<socket>.admin, never mounted).
-ADMIN_VERBS = (STATS, TRACE, SUSPEND, RESUME, SHUTDOWN, DRAIN, HANDOVER)
+ADMIN_VERBS = (STATS, TRACE, SUSPEND, RESUME, RESIZE, SHUTDOWN, DRAIN,
+               HANDOVER)
 # Answer WITHOUT a HELLO binding — no tenant slot, no lazy chip claim,
 # so a read-only probe can never wedge a chip claim (ADVICE r5 #2).
 BIND_FREE_VERBS = (STATS, TRACE)
+
+# ---------------------------------------------------------------------------
+# Retry-safety registry — the machine-checked idempotency contract
+# (docs/CHAOS.md).
+#
+# The client transparently re-runs an interrupted synchronous request
+# against a journal-resumed broker ONLY when its verb is classified
+# idempotent here (runtime/client.py derives its retry set from this
+# tuple — never from a hand-maintained literal).  Every verb served by
+# TENANT_VERBS/ADMIN_VERBS must appear in exactly one of the two
+# tuples, and the known-mutating verbs can never be marked idempotent:
+# EXECUTE/EXEC_BATCH re-run double-executes, a re-sent PUT_PART stages
+# its chunk twice, SHUTDOWN/HANDOVER are one-shot lifecycle.  `vtpu-smi
+# analyze` (vtpu.tools.analyze.verbs) enforces all of it.
+#
+# PUT is idempotent by its replacement semantics (same id, same bytes);
+# staged PUT flows are additionally excluded at the retry site (the
+# per-connection staging died with the old socket).  RESIZE/SUSPEND/
+# RESUME set absolute state; DRAIN re-requested is already draining.
+# ---------------------------------------------------------------------------
+IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, TRACE,
+                    SUSPEND, RESUME, RESIZE, DRAIN)
+NONIDEMPOTENT_VERBS = (PUT_PART, EXECUTE, EXEC_BATCH, SHUTDOWN,
+                       HANDOVER)
 
 # ---------------------------------------------------------------------------
 # Wire-field registry — the machine-checked request-HEADER contract.
@@ -208,6 +244,8 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
     TRACE: {"required": (), "optional": ("tenant", "limit", "trace")},
     SUSPEND: {"required": ("tenant",), "optional": ()},
     RESUME: {"required": ("tenant",), "optional": ()},
+    RESIZE: {"required": ("tenant",),
+             "optional": ("hbm_limit", "hbm_limits", "core_limit")},
     SHUTDOWN: {"required": (), "optional": ()},
     DRAIN: {"required": (), "optional": ("timeout",)},
     HANDOVER: {"required": (), "optional": ("timeout",)},

@@ -123,6 +123,7 @@ from ..shim.core import SharedRegion
 from ..utils.dtypes import np_dtype as _np_dtype
 from ..utils import envspec
 from ..utils import logging as log
+from . import faults
 from . import protocol as P
 from . import trace as tracing
 from .journal import Journal, JournalCorrupt
@@ -412,13 +413,25 @@ def flush_tenant_journal(state: "RuntimeState", t: "Tenant") -> None:
     discipline: journal writes never run under fast broker locks).
     Callers invoke this after releasing t.mu and BEFORE sending the
     reply that acknowledges the change, so the durability contract —
-    once the client sees ok, the journal has it — is unchanged."""
+    once the client sees ok, the journal has it — is unchanged.
+
+    A FAILING append (disk EIO/ENOSPC, vtpu-chaos injection) is
+    survived, not propagated: these records are array DROPS, and the
+    callers sit on the dispatcher/teardown paths where an escaped
+    OSError would kill the thread and wedge every tenant (availability
+    loss) to protect against at worst a resurrected-array restore
+    (bounded durability loss, books still balance).  The journal
+    itself already truncated back to a clean boundary."""
     jr = state.journal
     with t.mu:
         recs, t.pending_journal = t.pending_journal, []
     if jr is not None:
         for rec in recs:
-            jr.append(rec)
+            try:
+                jr.append(rec)
+            except OSError as e:
+                log.error("journal: dropping deferred %r record for "
+                          "%s (%s)", rec.get("op"), t.name, e)
 
 
 class Program:
@@ -834,6 +847,10 @@ class DeviceScheduler:
                 self._completion_q.put(done)
 
     def _dispatch_item(self, item: WorkItem):
+        # vtpu-chaos dispatch hook: `sigkill_broker@dispatch:after=N`
+        # is the VERDICT #8 scenario — kill -9 mid-EXEC_BATCH with
+        # live leases and replies in flight.  No lock is held here.
+        faults.fire("dispatch")
         jax = self.state.jax
         t = item.tenant
         t0 = time.monotonic()
@@ -1196,7 +1213,16 @@ class DeviceScheduler:
             self._record_span(item, t0, t_obs, busy_us,
                               solo=(len(batch) == 1))
         if ema_recs and self.state.journal is not None:
-            self.state.journal.append_many(ema_recs)
+            try:
+                self.state.journal.append_many(ema_recs)
+            except OSError as e:
+                # Cost-EMA samples are a cache of learned state: losing
+                # a batch degrades the successor's estimates by one
+                # sample — an escaped OSError here would kill the
+                # METERING thread and stall retirement for every
+                # tenant.  Availability wins.
+                log.warn("journal: dropping %d EMA sample(s) (%s)",
+                         len(ema_recs), e)
         self._retire_many([item for item, _, _ in batch])
 
     # -- vtpu-trace (runtime/trace.py) -------------------------------------
@@ -2026,7 +2052,15 @@ class RuntimeState:
                 self.tenants[name] = t
             t.connections += 1
         if deferred_close is not None and self.journal is not None:
-            self.journal.append(deferred_close)
+            try:
+                self.journal.append(deferred_close)
+            except OSError as e:
+                # The superseding bind record still follows; losing
+                # the close means replay re-creates then re-binds the
+                # name — idempotent.  Raising here instead would leak
+                # the just-incremented connection count.
+                log.error("journal: superseded-close record for %s "
+                          "lost (%s)", name, e)
         return t, created
 
     def release_tenant(self, t: Tenant) -> bool:
@@ -2068,9 +2102,15 @@ class RuntimeState:
         # The close record goes out AFTER state.mu is released (lock
         # discipline: journal file I/O never runs under fast locks) but
         # before this thread's _cleanup drops the arrays — replay order
-        # for this tenant is unchanged.
+        # for this tenant is unchanged.  An append failure must not
+        # abort the teardown half-done (the ledger release below it is
+        # what keeps the books at zero).
         if self.journal is not None:
-            self.journal.append({"op": "close", "name": t.name})
+            try:
+                self.journal.append({"op": "close", "name": t.name})
+            except OSError as e:
+                log.error("journal: close record for %s lost (%s)",
+                          t.name, e)
         return True
 
     def cached_blob(self, blob: bytes) -> "Program":
@@ -2237,6 +2277,10 @@ class TenantSession(socketserver.BaseRequestHandler):
         self._pool = P.RecvPool(stats=self.state.pool_stats)
 
     def _send(self, msg) -> None:
+        # vtpu-chaos reply-write hook: a sock_drop here models the
+        # kernel buffer dying under the reply (client sees a torn
+        # frame; server paths treat it as the connection dying).
+        faults.fire("reply")
         with self.send_mu:
             P.send_msg(self.request, msg)
 
@@ -2293,6 +2337,12 @@ class TenantSession(socketserver.BaseRequestHandler):
             except (ConnectionError, P.ProtocolError):
                 break
             kind = msg.get("kind")
+            # vtpu-chaos verb-site hook (docs/CHAOS.md): fired OUTSIDE
+            # the dispatch try so an injected ConnectionError takes the
+            # real peer-died path — the session loop exits and the
+            # teardown in handle() runs, exactly like a mid-request
+            # client death.
+            faults.fire(str(kind))
             try:
                 if kind == P.HELLO:
                     if tenant is not None:
@@ -2352,8 +2402,11 @@ class TenantSession(socketserver.BaseRequestHandler):
                         # First HELLO wins, like the hbm/core grant.
                         tenant.spill_overshoot = max(float(overshoot),
                                                      0.0)
-                    self._journal_bind(tenant, msg)
+                    # tenant_box FIRST: if the bind record's append
+                    # fails (journal EIO), teardown must still release
+                    # the connection count this HELLO took.
                     tenant_box[0] = tenant
+                    self._journal_bind(tenant, msg)
                     self._send({"ok": True, "tenant_index": tenant.index,
                                 "chip": tenant.chip.index,
                                 "chips": [c.index for c in tenant.chips],
@@ -3005,12 +3058,62 @@ def collect_stats(state: RuntimeState):
     return out
 
 
+def resize_tenant(state: RuntimeState, t: Tenant,
+                  hbm_limit: Optional[int] = None,
+                  hbm_limits: Optional[List[int]] = None,
+                  core_limit: Optional[int] = None) -> dict:
+    """Live per-tenant quota resize (admin RESIZE, ROADMAP item 4):
+    re-seed the tenant's region slot limits without a tenant restart.
+
+    HBM shrinks apply to NEW admissions immediately — books already
+    past the new cap stay until freed (the same bounded-overshoot
+    semantics spill residency uses), so nothing is evicted out from
+    under a running program.  A core-share change revokes the rate
+    lease: budget pre-debited at the old share must not outlive it
+    (the shrink re-clamp), and the revoke rider tells the client to
+    re-sync.  Returns the journal record; the CALLER appends it once
+    it holds no fast lock (lock discipline: journal writes are file
+    I/O)."""
+    new_hbm: List[int] = []
+    for k, (chip, slot) in enumerate(zip(t.chips, t.slots)):
+        h: Optional[int] = None
+        if hbm_limits is not None and k < len(hbm_limits):
+            h = int(hbm_limits[k])
+        elif hbm_limit is not None:
+            h = int(hbm_limit)
+        if h is None:
+            h = int(chip.region.device_stats(slot).limit_bytes)
+        else:
+            chip.region.set_mem_limit(slot, h)
+        if core_limit is not None:
+            chip.region.set_core_limit(slot, int(core_limit))
+        new_hbm.append(h)
+    new_core = (int(core_limit) if core_limit is not None
+                else int(t.chip.region.device_stats(t.index)
+                         .core_limit_pct))
+    t.grant = {"hbm": new_hbm, "core": new_core}
+    with t.chip.scheduler.mu:
+        if core_limit is not None:
+            # Re-clamp: refund the pre-debited lease and flag the
+            # revoke so the client's mirrored pacing re-syncs at the
+            # new share.
+            t.lease_release()
+            t.lease_revoked = True
+        # The dispatcher caches the metered? verdict ~0.5s; a resize
+        # that turns metering on/off must bite now, not half a second
+        # of dispatches later.
+        t._metered_cache = None
+    resize_rec = {"op": "resize", "name": t.name, "hbm": new_hbm,
+                  "core": new_core}
+    return resize_rec
+
+
 class AdminSession(socketserver.BaseRequestHandler):
     """Host-side admin surface (<socket>.admin — NOT mounted into
     tenant containers, which is what keeps a hostile tenant from
     suspending or killing its neighbours).  Verbs: SUSPEND / RESUME
-    (reference suspend_all/resume_all, SURVEY §2.9d), STATS,
-    SHUTDOWN."""
+    (reference suspend_all/resume_all, SURVEY §2.9d), RESIZE (live
+    quota resize, ROADMAP item 4), STATS, SHUTDOWN."""
 
     state: RuntimeState  # injected by make_server
 
@@ -3082,6 +3185,44 @@ class AdminSession(socketserver.BaseRequestHandler):
                              name, known)
                     P.send_msg(self.request,
                                {"ok": True, "known": known})
+                elif kind == P.RESIZE:
+                    name = str(msg["tenant"])
+                    hbm = msg.get("hbm_limit")
+                    hbms = msg.get("hbm_limits")
+                    core = msg.get("core_limit")
+                    with self.state.mu:
+                        t_obj = self.state.tenants.get(name)
+                        if t_obj is None and name in self.state.recovered:
+                            # A parked journal-recovered tenant resizes
+                            # too: the grant its resume HELLO adopts is
+                            # the post-resize one.
+                            t_obj = self.state.recovered[name][0]
+                    if t_obj is None:
+                        P.reply_err(self.request, "NOT_FOUND",
+                                    f"tenant {name!r} is not bound")
+                    else:
+                        resize_rec = resize_tenant(
+                            self.state, t_obj,
+                            hbm_limit=int(hbm) if hbm is not None
+                            else None,
+                            hbm_limits=[int(h) for h in hbms]
+                            if hbms else None,
+                            core_limit=int(core) if core is not None
+                            else None)
+                        # Journal BEFORE the ack (durability contract:
+                        # once the operator sees ok, the resized grant
+                        # survives a crash at any cut) — no fast lock
+                        # is held here.
+                        jr = self.state.journal
+                        if jr is not None:
+                            jr.append(resize_rec)
+                        log.info("admin: RESIZE tenant %r hbm=%s "
+                                 "core=%s", name, resize_rec["hbm"],
+                                 resize_rec["core"])
+                        P.send_msg(self.request,
+                                   {"ok": True, "tenant": name,
+                                    "hbm": resize_rec["hbm"],
+                                    "core": resize_rec["core"]})
                 elif kind == P.STATS:
                     with self.state.mu:
                         suspended = sorted(self.state.suspended)

@@ -146,6 +146,23 @@ def no_hello_line(fn: ast.FunctionDef) -> Optional[int]:
     return None
 
 
+def protocol_attr_refs(src: str) -> Set[str]:
+    """Every ``P.<attr>`` attribute reference in a module — used to
+    prove the client DERIVES its retry set from the protocol's
+    idempotency registry instead of hand-maintaining a literal."""
+    out: Set[str] = set()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "P":
+            out.add(node.attr)
+    return out
+
+
 def sender_bindings(src: str) -> Set[str]:
     """Verb constants sent by a module: dict literals carrying
     ``"kind": P.X``."""
@@ -224,6 +241,67 @@ def check_texts(protocol_src: str, server_src: str, client_src: str,
             findings.append(Finding(
                 "verbs", SMI, 1,
                 f"admin verb {name} has no vtpu-smi binding"))
+    findings.extend(_check_retry_safety(registries, client_src,
+                                        verbs))
+    return findings
+
+
+# Verbs that can NEVER be classified idempotent: re-running an EXECUTE/
+# EXEC_BATCH double-executes, a re-sent PUT_PART stages its chunk
+# twice, SHUTDOWN/HANDOVER are one-shot lifecycle transitions.  The
+# retry-safety checker holds the registry to this floor so a refactor
+# cannot quietly make the client re-run device work.
+MUTATING_VERBS = frozenset({"EXECUTE", "EXEC_BATCH", "PUT_PART",
+                            "SHUTDOWN", "HANDOVER"})
+
+
+def _check_retry_safety(registries: Dict[str, Set[str]],
+                        client_src: str,
+                        verbs: Dict[str, int]) -> List[Finding]:
+    """Idempotency-classification exhaustiveness (docs/CHAOS.md): every
+    served verb classified exactly once, mutating verbs never marked
+    idempotent, and the client's transparent-retry set derived from
+    the registry."""
+    findings: List[Finding] = []
+    idem = registries.get("IDEMPOTENT_VERBS")
+    nonidem = registries.get("NONIDEMPOTENT_VERBS")
+    if idem is None or nonidem is None:
+        for reg in ("IDEMPOTENT_VERBS", "NONIDEMPOTENT_VERBS"):
+            if registries.get(reg) is None:
+                findings.append(Finding(
+                    "verbs", PROTOCOL, 1,
+                    f"retry-safety registry {reg} is missing — every "
+                    f"verb must be classified for the client's "
+                    f"transparent-retry contract"))
+        return findings
+    served = registries.get("TENANT_VERBS", set()) \
+        | registries.get("ADMIN_VERBS", set())
+    for name in sorted(served - idem - nonidem):
+        findings.append(Finding(
+            "verbs", PROTOCOL, verbs.get(name, 1),
+            f"verb {name} is served but unclassified — add it to "
+            f"IDEMPOTENT_VERBS or NONIDEMPOTENT_VERBS"))
+    for name in sorted(idem & nonidem):
+        findings.append(Finding(
+            "verbs", PROTOCOL, verbs.get(name, 1),
+            f"verb {name} is classified BOTH idempotent and "
+            f"non-idempotent"))
+    for name in sorted((idem | nonidem) - served):
+        findings.append(Finding(
+            "verbs", PROTOCOL, verbs.get(name, 1),
+            f"verb {name} is retry-classified but served by neither "
+            f"socket (dead classification)"))
+    for name in sorted(MUTATING_VERBS & idem):
+        findings.append(Finding(
+            "verbs", PROTOCOL, verbs.get(name, 1),
+            f"mutating verb {name} is marked idempotent — a "
+            f"transparent retry would re-run device work"))
+    if "IDEMPOTENT_VERBS" not in protocol_attr_refs(client_src):
+        findings.append(Finding(
+            "verbs", CLIENT, 1,
+            "runtime/client.py does not reference "
+            "P.IDEMPOTENT_VERBS — the transparent-retry set must be "
+            "DERIVED from the registry, not hand-maintained"))
     return findings
 
 

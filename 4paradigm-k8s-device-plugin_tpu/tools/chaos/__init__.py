@@ -1,0 +1,28 @@
+"""vtpu-chaos — deterministic fault schedules + the kill -9 churn suite.
+
+PR 6's model checker (vtpu-mc) proves the quota/lease/crash-recovery
+invariants under *simulated* schedules and journal cuts; this package
+makes the same invariants hold under *real* injected faults on live
+processes (docs/CHAOS.md):
+
+  - ``python -m vtpu.tools.chaos`` runs seeded churn schedules: a real
+    broker subprocess + 4+ real tenant processes driving pipelined
+    EXEC_BATCH work with in-flight PUTs and live rate leases, the
+    broker SIGKILLed mid-flight and respawned, every tenant resuming
+    via HELLO epoch resume — then the live system is held to the PR 6
+    invariant registry (HBM ledger balance to ZERO bytes of leak,
+    lease non-negativity + quantum clamp, reply durability via a
+    probe-array round trip, throughput recovery >= 90% of pre-crash).
+  - schedules are DETERMINISTIC per seed (``--seeds 1,2,3,4,5`` in CI,
+    plus one randomized seed printed for repro); fault variety comes
+    from per-seed ``VTPU_FAULTS`` specs (runtime/faults.py).
+  - ``vtpu-smi chaos --smoke`` is the dependency-light wiring check
+    (fault grammar, seeded determinism, backoff jitter spread,
+    degraded-gate plumbing — no jax, no subprocesses) the analyze CI
+    job runs.
+"""
+
+from .cli import main  # noqa: F401
+
+# The fixed CI schedule (one churn run per seed, deterministic).
+DEFAULT_SEEDS = (1, 2, 3, 4, 5)

@@ -1,0 +1,175 @@
+"""vtpu-chaos CLI: the churn suite, its smoke check, and the tenant
+child entry point.
+
+  python -m vtpu.tools.chaos --quick --seeds 1,2,3,4,5 --random-extra
+  python -m vtpu.tools.chaos --smoke        # = vtpu-smi chaos --smoke
+
+The suite exits non-zero on ANY invariant violation in ANY schedule;
+every schedule's seed is printed so a failure replays exactly
+(docs/CHAOS.md)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+from typing import List, Optional
+
+
+def _smoke() -> List[str]:
+    """Dependency-light wiring check (no jax, no subprocesses): fault
+    grammar + seeded determinism, jittered-backoff spread, degraded
+    local enforcement, and the retry-set derivation.  Runs in the
+    analyze CI job."""
+    from ...runtime import faults as F
+    errs: List[str] = []
+
+    # Grammar: the documented examples must parse; junk must not.
+    for spec in ("sock_drop@EXEC_BATCH:p=0.01;"
+                 "sigkill_broker@dispatch:after=500",
+                 "fsync_eio@journal:nth=3;reply_delay@GET:ms=50"):
+        try:
+            F.FaultPlan(spec, seed=7)
+        except F.FaultSpecError as e:
+            errs.append(f"documented spec failed to parse: {e}")
+    for bad in ("nosite", "x@y:zap=1", "x@y:p=high"):
+        try:
+            F.FaultPlan(bad, seed=0)
+            errs.append(f"junk spec {bad!r} parsed")
+        except F.FaultSpecError:
+            pass
+
+    # Determinism: same spec + seed -> identical fire schedule.
+    def schedule(seed: int) -> List[bool]:
+        plan = F.FaultPlan("sock_drop@recv:p=0.1", seed=seed)
+        pt = plan.points[0]
+        return [pt.should_fire() for _ in range(500)]
+
+    if schedule(3) != schedule(3):
+        errs.append("same seed produced different fault schedules")
+    if schedule(3) == schedule(4):
+        errs.append("different seeds produced identical schedules "
+                    "(rng not seeded per plan)")
+    nth = F.FaultPlan("fsync_eio@journal:nth=3", seed=0).points[0]
+    fired = [nth.should_fire() for _ in range(5)]
+    if fired != [False, False, True, False, False]:
+        errs.append(f"nth trigger wrong: {fired}")
+
+    # Reconnect stampede: 16 tenants' jittered schedules must spread.
+    from ...runtime.client import full_jitter_delay
+    delays = []
+    for i in range(16):
+        rng = random.Random(f"tenant-{i}\x000")
+        delays.append(full_jitter_delay(rng, 0.05, 2.0, 4))
+    buckets = {int(d / 0.05) for d in delays}
+    if len(buckets) < 8:
+        errs.append(f"16 tenants' backoff delays landed in only "
+                    f"{len(buckets)} 50ms buckets (stampede risk)")
+    if max(delays) > 0.8 + 1e-9:
+        errs.append("full-jitter delay exceeded its cap")
+
+    # Degraded-mode local enforcement (mirror backend — no region).
+    from ...runtime.degraded import LocalEnforcer
+    enf = LocalEnforcer(hbm_limit=1000, core_pct=50, used_bytes=900)
+    if not enf.admit_bytes(100):
+        errs.append("degraded enforcer refused a within-quota PUT")
+    if enf.admit_bytes(101):
+        errs.append("degraded enforcer admitted an over-quota PUT "
+                    "(NOT fail-closed)")
+    drained = 0
+    while enf.admit_us(50_000) and drained < 100:
+        drained += 1
+    if drained >= 100:
+        errs.append("degraded rate bucket never exhausted (rate quota "
+                    "does not bite)")
+
+    # Retry-set derivation: the client's transparent-retry kinds come
+    # from the protocol registry and can never contain execute verbs.
+    from ...runtime import protocol as P
+    from ...runtime.client import RuntimeClient
+    kinds = RuntimeClient._RESUME_RETRY_KINDS
+    if not kinds or P.EXECUTE in kinds or P.EXEC_BATCH in kinds:
+        errs.append(f"retry-kind derivation broken: {sorted(kinds)}")
+    return errs
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="vtpu-chaos", description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="cheap wiring check (no jax, no processes)")
+    ap.add_argument("--seeds", default="1,2,3,4,5",
+                    help="comma-separated fixed schedule seeds")
+    ap.add_argument("--random-extra", action="store_true",
+                    help="append one randomized seed (printed for "
+                         "repro)")
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--quick", action="store_true",
+                    help="short windows (CI)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--out", default=None, metavar="FILE")
+    # tenant child plumbing (spawned by the driver)
+    ap.add_argument("--tenant-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--socket", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--name", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--progress", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--child-seed", type=int, default=0, dest="seed",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--hbm", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--core", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ns = ap.parse_args(argv)
+
+    if ns.tenant_child:
+        from .tenant import tenant_main
+        return tenant_main(ns)
+
+    if ns.smoke:
+        errs = _smoke()
+        out = {"smoke": "vtpu-chaos", "ok": not errs, "errors": errs}
+        print(json.dumps(out, indent=2 if not ns.json else None))
+        return 0 if not errs else 1
+
+    from .driver import run_schedule
+    seeds = [int(s) for s in ns.seeds.split(",") if s.strip()]
+    if ns.random_extra:
+        extra = random.SystemRandom().randrange(1, 10**6)
+        print(f"[chaos] randomized extra seed: {extra} "
+              f"(replay with --seeds {extra})", file=sys.stderr)
+        seeds.append(extra)
+    report = {"suite": "vtpu-chaos churn", "tenants": ns.tenants,
+              "quick": bool(ns.quick), "schedules": []}
+    ok = True
+    for seed in seeds:
+        t0 = time.monotonic()
+        print(f"[chaos] schedule seed={seed} ...", file=sys.stderr)
+        res = run_schedule(seed, tenants=ns.tenants, quick=ns.quick,
+                           log=lambda m: print(m, file=sys.stderr))
+        res["wall_s"] = round(time.monotonic() - t0, 1)
+        report["schedules"].append(res)
+        ok = ok and res["ok"]
+        print(f"[chaos]   seed={seed} ok={res['ok']} "
+              f"recovery_ms={res.get('recovery_ms')} "
+              f"ratio={res.get('recovery_ratio')} "
+              f"leak={res.get('region_leak_bytes')}B",
+              file=sys.stderr)
+        for v in res["violations"]:
+            print(f"[chaos]   VIOLATION {v}", file=sys.stderr)
+    report["ok"] = ok
+    text = json.dumps(report, indent=None if ns.json else 2)
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(text + "\n")
+    print(text if ns.json else
+          json.dumps({"suite": "vtpu-chaos churn", "ok": ok,
+                      "schedules": len(report["schedules"])}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

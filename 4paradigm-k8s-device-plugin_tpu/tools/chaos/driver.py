@@ -1,0 +1,426 @@
+"""Churn-schedule driver: real broker, real tenants, real kill -9.
+
+One schedule (= one seed) is the unified churn scenario VERDICT #8
+asked for:
+
+  1. spawn a journal-enabled broker SUBPROCESS (``python -m
+     vtpu.runtime.server``) and 4+ tenant SUBPROCESSES (tenant.py)
+     running pipelined EXEC_BATCH loops with in-flight PUTs and live
+     rate leases (core-metered broker, leases on by default);
+  2. measure steady pre-crash throughput, then ``SIGKILL`` the broker
+     mid-flight and respawn it — the successor replays the journal
+     and every tenant re-adopts its state via HELLO epoch resume;
+  3. measure recovery time + post-crash throughput, let the tenants
+     drain and exit, then hold the LIVE system to the PR 6 invariant
+     registry's churn rows:
+
+       hbm-ledger-balance   every region slot reads ZERO bytes after
+                            teardown (quota leak == 0)
+       lease-nonnegative    no STATS poll ever saw a negative lease
+       token-conservation   no lease ever exceeded the one-quantum
+                            clamp, and teardown refunded them all
+       reply-durability     each tenant's acked probe PUT read back
+                            bit-identical after the kill -9 resume
+       epoch-resume         every tenant resumed (no state loss)
+       throughput-recovery  post-crash >= RECOVERY_RATIO x pre-crash
+
+Determinism: the seed fixes the kill fraction, the per-seed
+``VTPU_FAULTS`` garnish (connection drops, a torn journal write) and
+every tenant's RNG; CI runs 5 fixed seeds plus one randomized seed
+whose value is PRINTED so any failure replays exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket as socketmod
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+PKG_DIR = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+REPO = os.path.dirname(PKG_DIR)
+
+# Acceptance floor: post-crash steady-state throughput vs pre-crash.
+RECOVERY_RATIO = 0.9
+# One scheduler quantum (µs) — the broker-side lease clamp the
+# token-conservation live check holds STATS to.
+LEASE_CLAMP_US = 100_000
+
+
+def _seed_faults(seed: int) -> Tuple[str, str]:
+    """(broker VTPU_FAULTS, tenant VTPU_FAULTS) for one schedule —
+    deterministic garnish on top of the SIGKILL every schedule gets.
+    Kept mild: the schedule must still reach steady state to measure
+    recovery against."""
+    broker = ""
+    tenant = ""
+    if seed % 3 == 1:
+        # One torn journal write mid-run: the append fails typed, the
+        # log self-repairs to the record boundary, recovery still
+        # resumes every tenant.
+        broker = "write_short@journal:nth=40"
+    elif seed % 3 == 2:
+        # Sporadic client-side connection drops: the reconnect path
+        # (full-jitter backoff, idempotent retry) runs during steady
+        # state, not just at the kill.
+        tenant = "sock_drop@recv:p=0.001"
+    return broker, tenant
+
+
+class Schedule:
+    """Everything one churn run needs, derived from its seed."""
+
+    def __init__(self, seed: int, tenants: int, quick: bool):
+        rng = random.Random(seed)
+        self.seed = seed
+        self.tenants = max(int(tenants), 4)
+        self.duration = 12.0 if quick else 18.0
+        # Kill lands mid-steady-state (after every child's jax import
+        # + compile ramp), varied per seed so the cut point sweeps the
+        # pipeline phases across the suite.
+        self.kill_at = (5.0 if quick else 6.5) + rng.random() * 1.0
+        self.broker_faults, self.tenant_faults = _seed_faults(seed)
+
+
+def _wait_socket(path: str, timeout: float) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            s = socketmod.socket(socketmod.AF_UNIX,
+                                 socketmod.SOCK_STREAM)
+            s.settimeout(1.0)
+            try:
+                s.connect(path)
+                return True
+            except OSError:
+                pass
+            finally:
+                s.close()
+        time.sleep(0.05)
+    return False
+
+
+def _admin_stats(sock: str) -> Optional[dict]:
+    from ...runtime import protocol as P
+    s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+    s.settimeout(2.0)
+    try:
+        s.connect(sock + ".admin")
+        P.send_msg(s, {"kind": P.STATS})
+        return P.recv_msg(s)
+    except OSError:
+        return None
+    finally:
+        s.close()
+
+
+class ChurnRun:
+    """One schedule's execution + live-invariant verdicts."""
+
+    def __init__(self, sched: Schedule, workdir: Optional[str] = None,
+                 log=print):
+        self.sched = sched
+        self.tmp = workdir or tempfile.mkdtemp(
+            prefix=f"vtpu-chaos-s{sched.seed}-")
+        self.sock = os.path.join(self.tmp, "chaos.sock")
+        self.jdir = os.path.join(self.tmp, "journal")
+        self.log = log
+        self.broker: Optional[subprocess.Popen] = None
+        self.broker_log = open(os.path.join(self.tmp, "broker.log"),
+                               "ab")
+        self.polls: List[dict] = []
+        self.violations: List[str] = []
+
+    # -- processes ---------------------------------------------------------
+
+    def _broker_env(self) -> dict:
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            "VTPU_JOURNAL_DIR": self.jdir,
+            "VTPU_LEASE_SIDECAR": os.path.join(self.tmp, "lease.json"),
+            "VTPU_LOG_LEVEL": "0",
+            "VTPU_TRACE": "0",
+        })
+        if self.sched.broker_faults:
+            env["VTPU_FAULTS"] = self.sched.broker_faults
+            env["VTPU_FAULTS_SEED"] = str(self.sched.seed)
+        else:
+            env.pop("VTPU_FAULTS", None)
+        return env
+
+    def spawn_broker(self) -> None:
+        cmd = [sys.executable, "-m", "vtpu.runtime.server",
+               "--socket", self.sock, "--hbm-limit", "64Mi",
+               "--core-limit", "50", "--journal-dir", self.jdir]
+        self.broker = subprocess.Popen(
+            cmd, cwd=REPO, env=self._broker_env(),
+            stdout=self.broker_log, stderr=self.broker_log)
+
+    def spawn_tenants(self) -> List[Tuple[subprocess.Popen, str]]:
+        procs = []
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            "VTPU_LOG_LEVEL": "0",
+            # The reconnect budget must cover a broker respawn (jax
+            # import + journal recovery), with margin.
+            "VTPU_RECONNECT_TIMEOUT_S": "30",
+        })
+        if self.sched.tenant_faults:
+            env["VTPU_FAULTS"] = self.sched.tenant_faults
+            env["VTPU_FAULTS_SEED"] = str(self.sched.seed)
+        else:
+            env.pop("VTPU_FAULTS", None)
+        for i in range(self.sched.tenants):
+            progress = os.path.join(self.tmp, f"t{i}.progress")
+            cmd = [sys.executable, "-m", "vtpu.tools.chaos",
+                   "--tenant-child", "--socket", self.sock,
+                   "--name", f"churn-{self.sched.seed}-{i}",
+                   "--progress", progress,
+                   "--duration", str(self.sched.duration),
+                   "--child-seed", str(self.sched.seed * 100 + i),
+                   "--hbm", str(8 << 20), "--core", "50"]
+            procs.append((subprocess.Popen(
+                cmd, cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True), progress))
+        return procs
+
+    # -- live polling ------------------------------------------------------
+
+    def _poll_once(self) -> None:
+        resp = _admin_stats(self.sock)
+        if not resp or not resp.get("ok"):
+            return
+        now = time.time()
+        for name, st in (resp.get("tenants") or {}).items():
+            lease = int(st.get("lease_us", 0))
+            if lease < 0:
+                self.violations.append(
+                    f"[lease-nonnegative] tenant {name} lease_us="
+                    f"{lease} at t={now:.2f}")
+            if lease > LEASE_CLAMP_US:
+                self.violations.append(
+                    f"[token-conservation] tenant {name} lease_us="
+                    f"{lease} exceeds the one-quantum clamp "
+                    f"({LEASE_CLAMP_US})")
+        self.polls.append({"t": now, "resp": resp})
+
+    # -- the schedule ------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        sched = self.sched
+        result: Dict[str, Any] = {
+            "seed": sched.seed, "tenants": sched.tenants,
+            "kill_at_s": round(sched.kill_at, 2),
+            "broker_faults": sched.broker_faults,
+            "tenant_faults": sched.tenant_faults,
+        }
+        self.spawn_broker()
+        if not _wait_socket(self.sock, 30.0):
+            raise RuntimeError("broker never bound its socket")
+        tenants = self.spawn_tenants()
+        t0 = time.time()
+        t_kill = t0 + sched.kill_at
+        killed = False
+        respawned_at = None
+        # Drive the schedule: poll STATS, kill on time, respawn.
+        while any(p.poll() is None for p, _ in tenants):
+            now = time.time()
+            if not killed and now >= t_kill:
+                # THE kill -9: mid-EXEC_BATCH, leases live, PUTs in
+                # flight.  SIGKILL — no handler runs, no snapshot is
+                # taken; recovery is the journal's problem.
+                self.broker.send_signal(signal.SIGKILL)
+                self.broker.wait(timeout=10)
+                killed = True
+                t_kill = now
+                self.log(f"[chaos s{sched.seed}] broker SIGKILLed at "
+                         f"+{now - t0:.2f}s")
+                self.spawn_broker()
+                if not _wait_socket(self.sock, 30.0):
+                    raise RuntimeError(
+                        "respawned broker never bound its socket")
+                respawned_at = time.time()
+            if killed or now < t_kill - 0.3:
+                # No STATS poll in the final pre-kill window: a probe
+                # quiesce there would drain the very in-flight state
+                # the kill is supposed to cut through.
+                self._poll_once()
+            time.sleep(0.25)
+        reports = []
+        for p, _prog in tenants:
+            out, _ = p.communicate(timeout=30)
+            rep = None
+            for line in (out or "").splitlines():
+                if line.startswith("TENANT_RESULT "):
+                    rep = json.loads(line[len("TENANT_RESULT "):])
+            if p.returncode != 0 or rep is None:
+                self.violations.append(
+                    f"[epoch-resume] tenant child rc={p.returncode} "
+                    f"without a result (crashed under churn)")
+                continue
+            reports.append(rep)
+        result["tenant_reports"] = reports
+        self._judge(result, tenants, t_kill, respawned_at)
+        self._teardown()
+        result["violations"] = self.violations
+        result["ok"] = not self.violations
+        return result
+
+    # -- verdicts ----------------------------------------------------------
+
+    @staticmethod
+    def _rate(samples: List[Tuple[float, int]], lo: float,
+              hi: float) -> float:
+        """Aggregate steps/s inside [lo, hi] from (ts, steps) rows."""
+        inside = [(t, s) for t, s in samples if lo <= t <= hi]
+        if len(inside) < 2:
+            return 0.0
+        (ta, sa), (tb, sb) = inside[0], inside[-1]
+        return (sb - sa) / max(tb - ta, 1e-6)
+
+    def _judge(self, result: Dict[str, Any], tenants, t_kill: float,
+               respawned_at: Optional[float]) -> None:
+        sched = self.sched
+        # Per-tenant progress curves.
+        curves: List[List[Tuple[float, int]]] = []
+        for _p, prog in tenants:
+            rows: List[Tuple[float, int]] = []
+            try:
+                with open(prog) as f:
+                    for line in f:
+                        parts = line.split()
+                        if len(parts) == 2:
+                            rows.append((float(parts[0]),
+                                         int(parts[1])))
+            except OSError:
+                pass
+            curves.append(rows)
+        # Recovery: first progress past the kill, per tenant; the
+        # SLOWEST tenant defines the system's recovery.
+        rec_ts = []
+        for rows in curves:
+            at_kill = max((s for t, s in rows if t <= t_kill),
+                          default=0)
+            after = [t for t, s in rows if t > t_kill and s > at_kill]
+            if after:
+                rec_ts.append(after[0])
+        if len(rec_ts) == len(curves) and rec_ts:
+            result["recovery_ms"] = round(
+                (max(rec_ts) - t_kill) * 1e3, 1)
+        else:
+            self.violations.append(
+                "[epoch-resume] some tenant never made progress after "
+                "the kill")
+            result["recovery_ms"] = None
+        # Throughput: aggregate across tenants, steady windows.
+        pre_lo, pre_hi = t_kill - 2.0, t_kill - 0.1
+        rec_edge = (max(rec_ts) if rec_ts else
+                    (respawned_at or t_kill)) + 1.0
+        end = min((rows[-1][0] for rows in curves if rows),
+                  default=rec_edge)
+        pre = sum(self._rate(rows, pre_lo, pre_hi) for rows in curves)
+        post = sum(self._rate(rows, rec_edge, end - 0.1)
+                   for rows in curves)
+        result["pre_crash_steps_per_s"] = round(pre, 1)
+        result["post_crash_steps_per_s"] = round(post, 1)
+        ratio = post / pre if pre > 0 else 0.0
+        result["recovery_ratio"] = round(ratio, 3)
+        if pre <= 0:
+            self.violations.append(
+                "[throughput-recovery] no pre-crash steady state "
+                "measured")
+        elif ratio < RECOVERY_RATIO:
+            self.violations.append(
+                f"[throughput-recovery] post-crash throughput "
+                f"{post:.0f} steps/s is {ratio:.2f}x pre-crash "
+                f"({pre:.0f}) — floor is {RECOVERY_RATIO}")
+        # Per-tenant verdicts from the children.
+        for rep in result.get("tenant_reports", []):
+            if rep.get("state_lost"):
+                self.violations.append(
+                    f"[epoch-resume] tenant {rep['tenant']} lost state "
+                    f"{rep['state_lost']}x (journal resume failed)")
+            if not rep.get("resumes"):
+                self.violations.append(
+                    f"[epoch-resume] tenant {rep['tenant']} never saw "
+                    f"a resumed reconnect")
+            if not rep.get("durability_ok", True):
+                self.violations.append(
+                    f"[reply-durability] tenant {rep['tenant']}'s "
+                    f"acked probe PUT did not survive the crash "
+                    f"bit-identical")
+        # Ledger balance: wait for the broker to tear every tenant
+        # down, then the region must read ZERO bytes on every slot.
+        deadline = time.monotonic() + 20.0
+        remaining = None
+        while time.monotonic() < deadline:
+            resp = _admin_stats(self.sock)
+            if resp and resp.get("ok") and not resp.get("tenants") \
+                    and not (resp.get("journal") or {}).get(
+                        "tenants_awaiting_resume"):
+                remaining = resp
+                break
+            time.sleep(0.2)
+        leak = self._region_leak_bytes()
+        result["region_leak_bytes"] = leak
+        if remaining is None:
+            self.violations.append(
+                "[hbm-ledger-balance] broker never finished tenant "
+                "teardown (cannot audit the ledger)")
+        elif leak != 0:
+            self.violations.append(
+                f"[hbm-ledger-balance] region ledgers hold {leak} "
+                f"bytes after every tenant closed (quota leak != 0)")
+        if remaining is not None:
+            jstats = remaining.get("journal") or {}
+            result["tenants_readopted"] = jstats.get(
+                "tenants_readopted")
+            if int(jstats.get("tenants_readopted", 0) or 0) \
+                    < sched.tenants:
+                self.violations.append(
+                    f"[epoch-resume] broker re-adopted only "
+                    f"{jstats.get('tenants_readopted')} of "
+                    f"{sched.tenants} tenants")
+
+    def _region_leak_bytes(self) -> int:
+        import glob as globmod
+
+        from ...shim.core import SharedRegion
+        total = 0
+        for path in [self.sock + ".shr"] + sorted(
+                globmod.glob(self.sock + ".shr.chip*")):
+            if not os.path.exists(path):
+                continue
+            r = SharedRegion(path)
+            try:
+                for d in range(r.ndevices):
+                    total += int(r.device_stats(d).used_bytes)
+            finally:
+                r.close()
+        return total
+
+    def _teardown(self) -> None:
+        if self.broker is not None and self.broker.poll() is None:
+            self.broker.terminate()
+            try:
+                self.broker.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.broker.kill()
+        self.broker_log.close()
+
+
+def run_schedule(seed: int, tenants: int = 4, quick: bool = False,
+                 log=print) -> Dict[str, Any]:
+    sched = Schedule(seed, tenants, quick)
+    return ChurnRun(sched, log=log).run()

@@ -102,6 +102,7 @@ def _setup_canned(h: Harness, sched: mcsched.Scheduler) -> None:
     session B binds a two-chip grant and is left LIVE — so every cut
     prefix recovers a mix of closed and open tenants."""
     def driver() -> None:
+        from ...runtime import protocol as P
         jr = h.state.journal
         # The two boot-sequence writes RuntimeState.__init__ performs
         # (the harness builds the state piecewise, so the driver issues
@@ -114,6 +115,15 @@ def _setup_canned(h: Harness, sched: mcsched.Scheduler) -> None:
         sess_b = h.session(sock_b)
         box: List[Any] = [None]
         sess_b._serve(sock_b, box)      # no teardown: B stays live
+        # Live quota resize of the still-open tenant, through the REAL
+        # AdminSession arm: the journaled `resize` record now sits
+        # between B's state records and the wedge — so EVERY cut from
+        # here on must recover B with the POST-resize grant (ISSUE 7
+        # satellite: resize survives every journal cut).
+        adm = h.admin([P.frame_header(
+            {"kind": P.RESIZE, "tenant": "B", "hbm_limit": 8192,
+             "core_limit": 20})])
+        adm.handle()
         # A claim-watchdog wedge record (runtime/server.py
         # wedge_report's dying words) closes the log.
         jr.append({"op": "wedge", "stage": "mc-canned",
@@ -206,6 +216,13 @@ def _predict(records: List[Dict[str, Any]],
             tenants[rec["name"]]["arrays"].pop(rec.get("id"), None)
         elif op == "compile" and rec.get("name") in tenants:
             tenants[rec["name"]]["exes"][rec["id"]] = rec.get("sha")
+        elif op == "resize" and rec.get("name") in tenants:
+            # Live resize: the post-resize grant is what recovery must
+            # re-seed (docs/BROKER_RECOVERY.md).
+            if rec.get("hbm") is not None:
+                tenants[rec["name"]]["hbm"] = rec["hbm"]
+            if rec.get("core") is not None:
+                tenants[rec["name"]]["core"] = rec["core"]
         elif op == "ema" and rec.get("name") in tenants:
             tenants[rec["name"]]["ema"][rec["key"]] = rec.get("ema")
             if rec.get("execs") is not None:

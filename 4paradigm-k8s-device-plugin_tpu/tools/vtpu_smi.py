@@ -328,7 +328,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
     ap.add_argument("cmd", nargs="?", default=None,
                     choices=("trace", "leases", "analyze", "mc",
-                             "metricsd"),
+                             "metricsd", "chaos"),
                     help="trace: flight-recorder spans (needs "
                          "--broker; --dump FILE exports Chrome-trace "
                          "JSON); leases: chip-lease sidecar forensics; "
@@ -356,8 +356,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="explicit region file (repeatable)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--smoke", action="store_true",
-                    help="with `mc`: tiny-budget wiring check (the "
-                         "analyze CI job's smoke)")
+                    help="with `mc`/`chaos`: tiny-budget wiring check "
+                         "(the analyze CI job's smokes)")
     ap.add_argument("--sweep-host", action="store_true",
                     help="reclaim slots of dead host pids (node mode only)")
     ap.add_argument("--broker", default=None, metavar="SOCKET",
@@ -367,6 +367,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="hold TENANT's queue (reference "
                          "suspend_all analogue)")
     ap.add_argument("--resume", default=None, metavar="TENANT")
+    ap.add_argument("--resize", default=None, metavar="TENANT",
+                    help="live-resize TENANT's quotas without a "
+                         "restart (RESIZE verb, journaled; combine "
+                         "with --hbm/--core — docs/CHAOS.md)")
+    ap.add_argument("--hbm", default=None, metavar="QTY",
+                    help="with --resize: new per-chip HBM quota "
+                         "(K8s quantity, replicated across the grant)")
+    ap.add_argument("--core", type=int, default=None, metavar="PCT",
+                    help="with --resize: new device-time share "
+                         "(0-100; 0 = unmetered)")
     ap.add_argument("--broker-stats", action="store_true",
                     help="per-tenant broker stats (quota, spill, "
                          "residency, suspension, journal/recovery)")
@@ -395,6 +405,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         # exhaustiveness, env-flag contract, journal replay coverage.
         from .analyze import main as analyze_main
         return analyze_main(["--json"] if ns.json else [])
+    if ns.cmd == "chaos":
+        # vtpu-chaos (docs/CHAOS.md): deterministic fault schedules +
+        # the kill -9 churn suite.  --smoke is the cheap wiring check
+        # the analyze CI job runs (no jax, no processes); full
+        # schedules live on `python -m vtpu.tools.chaos`.
+        from .chaos import main as chaos_main
+        args = []
+        if ns.json:
+            args.append("--json")
+        if ns.smoke:
+            args.append("--smoke")
+        return chaos_main(args)
     if ns.cmd == "mc":
         # Model checker (tools/mc): interleaving + crash-cut engines
         # over the invariant registry (docs/ANALYSIS.md).  --smoke is
@@ -410,11 +432,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.extend(["--scenario", ns.cmd_arg])
         return mc_main(args)
 
-    admin_verbs = (ns.suspend or ns.resume or ns.broker_stats
-                   or ns.drain or ns.handover or ns.shutdown)
+    admin_verbs = (ns.suspend or ns.resume or ns.resize
+                   or ns.broker_stats or ns.drain or ns.handover
+                   or ns.shutdown)
     if admin_verbs and not ns.broker:
-        ap.error("--suspend/--resume/--broker-stats/--drain/--handover/"
-                 "--shutdown need --broker <main socket>")
+        ap.error("--suspend/--resume/--resize/--broker-stats/--drain/"
+                 "--handover/--shutdown need --broker <main socket>")
     if ns.broker:
         from ..runtime import protocol as P
         if ns.suspend:
@@ -423,6 +446,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif ns.resume:
             resp = _admin_request(ns.broker, {"kind": P.RESUME,
                                               "tenant": ns.resume})
+        elif ns.resize:
+            msg = {"kind": P.RESIZE, "tenant": ns.resize}
+            if ns.hbm is not None:
+                msg["hbm_limit"] = envspec.parse_quantity(ns.hbm)
+            if ns.core is not None:
+                msg["core_limit"] = int(ns.core)
+            resp = _admin_request(ns.broker, msg)
         elif ns.broker_stats:
             resp = _admin_request(ns.broker, {"kind": P.STATS})
         elif ns.drain:
@@ -434,8 +464,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         elif ns.shutdown:
             resp = _admin_request(ns.broker, {"kind": P.SHUTDOWN})
         else:
-            ap.error("--broker needs --suspend/--resume/--broker-stats/"
-                     "--drain/--handover/--shutdown")
+            ap.error("--broker needs --suspend/--resume/--resize/"
+                     "--broker-stats/--drain/--handover/--shutdown")
         print(json.dumps(resp, indent=2))
         return 0 if resp.get("ok") else 1
 

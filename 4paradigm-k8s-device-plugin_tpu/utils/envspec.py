@@ -176,6 +176,16 @@ ENV_FLAGS = {
     "VTPU_RATE_LEASE_US": ("broker", True),
     "VTPU_RECV_POOL_MB": ("broker", True),
     "VTPU_WAKE_BATCH": ("broker", False),
+    # vtpu-chaos (docs/CHAOS.md): deterministic fault injection +
+    # client churn hardening + broker-loss degraded mode.
+    "VTPU_FAULTS": ("chaos", True),
+    "VTPU_FAULTS_SEED": ("chaos", True),
+    "VTPU_RPC_TIMEOUT_S": ("shim", True),
+    "VTPU_CONNECT_TIMEOUT_S": ("shim", True),
+    "VTPU_RECONNECT_BACKOFF_MS": ("shim", True),
+    "VTPU_RECONNECT_BACKOFF_CAP_MS": ("shim", True),
+    "VTPU_BROKER_GRACE_S": ("shim", True),
+    "VTPU_DEGRADED_QUEUE": ("shim", True),
     # In-container shim / client / bridge / native interposer.
     "VTPU_TENANT": ("shim", False),
     "VTPU_RECONNECT_TIMEOUT_S": ("shim", False),

@@ -119,6 +119,22 @@ def _unchained_loop(client, exe_id, x_id, duration_s, window):
     return steps, time.monotonic() - t0, rtts
 
 
+def _mock_programs(srv) -> None:
+    """In-process broker: stub each compiled program's body with a
+    canned real output ("mock PJRT") so the measured path is enqueue ->
+    dispatch -> reply fan-in, not XLA CPU time.  Output registration,
+    quota charging and metering still run for real."""
+    import numpy as np
+    mocked = set()
+    for t in srv.state.tenants.values():
+        for prog in t.executables.values():
+            if id(prog) in mocked:
+                continue
+            canned = prog.fn(np.zeros(256, np.float32))
+            prog.fn = (lambda out: (lambda *a: out))(canned)
+            mocked.add(id(prog))
+
+
 def run_scenario(tenants: int, quick: bool, mock: bool) -> dict:
     import numpy as np
 
@@ -145,19 +161,7 @@ def run_scenario(tenants: int, quick: bool, mock: bool) -> dict:
             exe = c.compile(lambda a: a * 1.0001 + 1.0, [x])
             clients.append((c, exe.id, h.id))
         if mock:
-            # In-process broker: reach in and stub each program's body
-            # with a canned real output ("mock PJRT") so the measured
-            # path is enqueue -> dispatch -> reply fan-in, not XLA CPU
-            # time.  Output registration, quota charging and metering
-            # still run for real.
-            mocked = set()
-            for t in srv.state.tenants.values():
-                for prog in t.executables.values():
-                    if id(prog) in mocked:
-                        continue
-                    canned = prog.fn(np.zeros(256, np.float32))
-                    prog.fn = (lambda out: (lambda *a: out))(canned)
-                    mocked.add(id(prog))
+            _mock_programs(srv)
 
         # Warmup (compile chains, seed EMAs, prime pools).
         for c, eid, xid in clients:
@@ -218,6 +222,230 @@ def run_scenario(tenants: int, quick: bool, mock: bool) -> dict:
         srv.shutdown()
 
 
+def run_priority_scenario(quick: bool) -> dict:
+    """Priority-under-pressure sub-metric (VERDICT next-round #4): a
+    HIGH-priority tenant's per-step latency, solo vs while a
+    low-priority co-tenant saturates the chip.  priority 0 borrows
+    from the token bucket instead of waiting (reference
+    CUDA_TASK_PRIORITY semantics), so the isolation story is queueing,
+    not throttling — exactly what the p50/p99 contrast measures."""
+    import numpy as np
+
+    from vtpu.runtime.client import RuntimeClient
+    from vtpu.runtime.server import make_server
+
+    tmp = tempfile.mkdtemp(prefix="broker-bench-prio-")
+    sock = os.path.join(tmp, "bench.sock")
+    srv = make_server(sock, hbm_limit=256 << 20, core_limit=50,
+                      region_path=os.path.join(tmp, "bench.shr"))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    duration = 1.5 if quick else 4.0
+    hi = lo = None
+    try:
+        x = np.random.rand(256).astype(np.float32)
+        hi = RuntimeClient(sock, tenant="prio-hi", priority=0)
+        hi.put(x, "x0")
+        hi_exe = hi.compile(lambda a: a * 1.0001 + 1.0, [x])
+        lo = RuntimeClient(sock, tenant="prio-lo", priority=1)
+        lo.put(x, "x0")
+        lo_exe = lo.compile(lambda a: a * 1.0001 + 1.0, [x])
+        _mock_programs(srv)
+
+        def hi_lat(dur: float) -> list:
+            """Synchronous cadence: one step in flight, per-step RTT —
+            the latency a serving tenant actually observes."""
+            rtts = []
+            t_end = time.monotonic() + dur
+            seq = 0
+            while time.monotonic() < t_end:
+                t0 = time.monotonic()
+                hi.execute_send_ids(hi_exe.id, ["x0"],
+                                    [f"h{seq & 63}"])
+                hi.recv_reply()
+                rtts.append((time.monotonic() - t0) * 1e6)
+                seq += 1
+            return rtts
+
+        hi_lat(0.2)  # warm
+        solo = hi_lat(duration)
+
+        lo_stats = {}
+
+        def saturate():
+            lo_stats["res"] = _unchained_loop(lo, lo_exe.id, "x0",
+                                              duration + 0.5, 64)
+
+        th = threading.Thread(target=saturate)
+        th.start()
+        time.sleep(0.2)  # let the co-tenant's pipeline fill
+        contended = hi_lat(duration)
+        th.join()
+        steps, wall, _ = lo_stats["res"]
+        p50s, p99s = (_percentile(solo, 0.50), _percentile(solo, 0.99))
+        p50c, p99c = (_percentile(contended, 0.50),
+                      _percentile(contended, 0.99))
+        return {
+            "hi_priority": 0, "lo_priority": 1,
+            "hi_solo_p50_us": round(p50s, 1),
+            "hi_solo_p99_us": round(p99s, 1),
+            "hi_contended_p50_us": round(p50c, 1),
+            "hi_contended_p99_us": round(p99c, 1),
+            "hi_contended_steps": len(contended),
+            "lo_steps_per_s": round(steps / max(wall, 1e-6), 1),
+            "p99_inflation": round(p99c / max(p99s, 1e-9), 2),
+        }
+    finally:
+        for c in (hi, lo):
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        srv.shutdown()
+
+
+def _wait_socket(path: str, timeout: float) -> bool:
+    import socket as socketmod
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            s = socketmod.socket(socketmod.AF_UNIX,
+                                 socketmod.SOCK_STREAM)
+            s.settimeout(1.0)
+            try:
+                s.connect(path)
+                return True
+            except OSError:
+                pass
+            finally:
+                s.close()
+        time.sleep(0.05)
+    return False
+
+
+def run_crash_scenario(quick: bool, frac: float) -> dict:
+    """``--inject-crash``: SIGKILL a journal-enabled broker SUBPROCESS
+    once at ``frac`` of the run, respawn it, and still report a valid
+    number (ROADMAP item 4) — ``recovery_ms`` (kill to first post-
+    resume step) and post-crash steps/s ride the JSON as first-class
+    fields.  Real execution (the broker is out of process), so the
+    absolute rates sit below the mocked cells; the pre/post RATIO and
+    the recovery time are the signal."""
+    import numpy as np
+
+    from vtpu.runtime.client import (RuntimeClient, RuntimeError_,
+                                     VtpuConnectionLost, VtpuStateLost)
+
+    tmp = tempfile.mkdtemp(prefix="broker-bench-crash-")
+    sock = os.path.join(tmp, "bench.sock")
+    jdir = os.path.join(tmp, "journal")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+        "VTPU_JOURNAL_DIR": jdir,
+        "VTPU_LEASE_SIDECAR": os.path.join(tmp, "lease.json"),
+        "VTPU_LOG_LEVEL": "0",
+    })
+    cmd = [sys.executable, "-m", "vtpu.runtime.server",
+           "--socket", sock, "--hbm-limit", "256Mi",
+           "--core-limit", "50", "--journal-dir", jdir]
+    logf = open(os.path.join(tmp, "broker.log"), "ab")
+
+    def spawn():
+        return subprocess.Popen(cmd, cwd=repo, env=env, stdout=logf,
+                                stderr=logf)
+
+    broker = spawn()
+    if not _wait_socket(sock, 30.0):
+        raise RuntimeError("crash-cell broker never bound its socket")
+    duration = 4.0 if quick else 10.0
+    client = RuntimeClient(sock, tenant="crash-bench",
+                           reconnect_timeout=30.0)
+    try:
+        x = np.random.rand(256).astype(np.float32)
+        client.put(x, "x0")
+        exe = client.compile(lambda a: a * 1.0001 + 1.0, [x])
+        window = 32
+        outstanding = 0
+        prev = None
+        seq = 0
+        steps = []  # (monotonic ts per completed step)
+        killed_at = None
+        reconnected = False  # saw the post-kill connection loss yet?
+        recovered_at = None
+        t0 = time.monotonic()
+        t_end = t0 + duration
+        kill_t = t0 + duration * max(min(frac, 0.9), 0.1)
+        while time.monotonic() < t_end:
+            if killed_at is None and time.monotonic() >= kill_t:
+                broker.kill()  # SIGKILL: no handler, no snapshot
+                broker.wait(timeout=10)
+                killed_at = time.monotonic()
+                broker = spawn()
+            try:
+                while outstanding < window:
+                    oid = f"y{seq & 255}"
+                    client.execute_send_ids(
+                        exe.id, ["x0"], [oid],
+                        free=(prev,) if prev else ())
+                    prev = oid
+                    seq += 1
+                    outstanding += 1
+                while outstanding > window // 2:
+                    client.recv_reply()
+                    outstanding -= 1
+                    now = time.monotonic()
+                    steps.append(now)
+                    # Recovery = first step SERVED BY THE RESPAWNED
+                    # broker (after the post-kill reconnect) — replies
+                    # the dead broker left in the kernel buffer must
+                    # not count.
+                    if reconnected and recovered_at is None:
+                        recovered_at = now
+            except (VtpuConnectionLost, VtpuStateLost):
+                if killed_at is not None:
+                    reconnected = True
+                outstanding = 0
+                prev = None
+            except RuntimeError_:
+                outstanding = 0
+                prev = None
+                time.sleep(0.02)
+        pre = [t for t in steps
+               if t0 + 0.3 <= t <= (killed_at or t_end)]
+        post = [t for t in steps
+                if recovered_at is not None and t >= recovered_at + 0.2]
+        pre_rate = (len(pre) - 1) / max(pre[-1] - pre[0], 1e-6) \
+            if len(pre) > 1 else 0.0
+        post_rate = (len(post) - 1) / max(post[-1] - post[0], 1e-6) \
+            if len(post) > 1 else 0.0
+        return {
+            "crash_at_frac": frac,
+            "steps_total": len(steps),
+            "pre_crash_steps_per_s": round(pre_rate, 1),
+            "post_crash_steps_per_s": round(post_rate, 1),
+            "recovery_ms": round((recovered_at - killed_at) * 1e3, 1)
+            if (killed_at is not None and recovered_at is not None)
+            else None,
+            "recovered_ratio": round(post_rate / pre_rate, 3)
+            if pre_rate > 0 else None,
+        }
+    finally:
+        try:
+            client.close()
+        except OSError:
+            pass
+        if broker.poll() is None:
+            broker.terminate()
+            try:
+                broker.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                broker.kill()
+        logf.close()
+
+
 # ---------------------------------------------------------------------------
 # Orchestrator
 # ---------------------------------------------------------------------------
@@ -233,12 +461,16 @@ def _cell_env(mode: str) -> dict:
 
 
 def run_cell(mode: str, tenants: int, quick: bool,
-             mock: bool = True, tree: str = None) -> dict:
+             mock: bool = True, tree: str = None,
+             kind: str = "steps", crash_at: float = 0.5) -> dict:
     """One (mode, tenants) measurement in a fresh subprocess.
 
     ``tree`` points the subprocess at a different source tree (the
     pre-PR git worktree); the scenario then imports THAT tree's
     broker/client while reusing this repo's prebuilt native lib.
+    ``kind`` selects the scenario body: the default unchained-steps
+    cell, the priority-under-pressure contrast, or the --inject-crash
+    kill -9 cell.
     """
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = os.path.abspath(__file__)
@@ -251,6 +483,9 @@ def run_cell(mode: str, tenants: int, quick: bool,
             env.setdefault("VTPU_CORE_LIB", core)
     cmd = [sys.executable, script, "--scenario",
            "--tenants", str(tenants)]
+    if kind != "steps":
+        cmd.extend(["--scenario-kind", kind,
+                    "--crash-at", str(crash_at)])
     if quick:
         cmd.append("--quick")
     if not mock:
@@ -307,10 +542,13 @@ class _PreprWorktree:
         return False
 
 
-def full_run(quick: bool, out_path: str, prepr_ref: str) -> int:
+def full_run(quick: bool, out_path: str, prepr_ref: str,
+             inject_crash: bool = False, crash_at: float = 0.5) -> int:
+    run_id = os.path.splitext(os.path.basename(out_path))[0]
+    run_id = run_id.replace("BENCH_BROKER_", "").lower() or "r01"
     report = {
         "bench": "broker_bench",
-        "run": "r01",
+        "run": run_id,
         "quick": bool(quick),
         "platform": "cpu",
         "baseline_modes": {
@@ -362,6 +600,34 @@ def full_run(quick: bool, out_path: str, prepr_ref: str) -> int:
           file=sys.stderr)
     report["scenarios"]["fast_real_exec"] = {
         "tenants_1": run_cell("fast", 1, quick, mock=False)}
+    # Priority-under-pressure sub-metric (VERDICT next-round #4): a
+    # priority-0 tenant's p50/p99 step latency, solo vs while a
+    # priority-1 co-tenant saturates.  Un-gated context.
+    print("[broker-bench] priority-under-pressure ...", file=sys.stderr)
+    prio = run_cell("fast", 1, quick, kind="priority")
+    report["scenarios"]["priority"] = prio
+    print(f"[broker-bench]   hi p99 {prio['hi_solo_p50_us']}/"
+          f"{prio['hi_solo_p99_us']}us solo -> "
+          f"{prio['hi_contended_p50_us']}/"
+          f"{prio['hi_contended_p99_us']}us under a saturating "
+          f"co-tenant ({prio['lo_steps_per_s']} lo steps/s)",
+          file=sys.stderr)
+    if inject_crash:
+        # --inject-crash (ROADMAP item 4): SIGKILL the broker once at
+        # the configured step fraction and STILL emit a valid JSON,
+        # with recovery_ms + post-crash steps/s as first-class fields.
+        print(f"[broker-bench] inject-crash (frac={crash_at}) ...",
+              file=sys.stderr)
+        crash = run_cell("fast", 1, quick, kind="crash",
+                         crash_at=crash_at)
+        report["scenarios"]["crash"] = crash
+        report["recovery_ms"] = crash.get("recovery_ms")
+        report["post_crash_steps_per_s"] = crash.get(
+            "post_crash_steps_per_s")
+        print(f"[broker-bench]   recovery {crash.get('recovery_ms')}ms,"
+              f" post-crash {crash.get('post_crash_steps_per_s')} "
+              f"steps/s ({crash.get('recovered_ratio')}x pre)",
+              file=sys.stderr)
 
     gate_base = ("prepr" if "prepr" in report["scenarios"]
                  else "baseline")
@@ -440,8 +706,19 @@ def main() -> int:
                          "against (default HEAD — correct while the "
                          "PR is uncommitted; pass the recorded "
                          "prepr_sha when re-recording later)")
+    ap.add_argument("--inject-crash", action="store_true",
+                    help="SIGKILL the broker once mid-run (a real "
+                         "subprocess broker with a journal) and report "
+                         "recovery_ms + post-crash steps/s — the JSON "
+                         "stays valid, rc stays 0 on a green gate")
+    ap.add_argument("--crash-at", type=float, default=0.5,
+                    help="with --inject-crash: fraction of the run at "
+                         "which the kill -9 lands (default 0.5)")
     ap.add_argument("--scenario", action="store_true",
                     help=argparse.SUPPRESS)  # subprocess entry
+    ap.add_argument("--scenario-kind", default="steps",
+                    choices=("steps", "priority", "crash"),
+                    help=argparse.SUPPRESS)
     ap.add_argument("--tenants", type=int, default=1,
                     help=argparse.SUPPRESS)
     ap.add_argument("--real-exec", action="store_true",
@@ -450,13 +727,20 @@ def main() -> int:
 
     if args.scenario:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        res = run_scenario(args.tenants, args.quick,
-                           mock=not args.real_exec)
+        if args.scenario_kind == "priority":
+            res = run_priority_scenario(args.quick)
+        elif args.scenario_kind == "crash":
+            res = run_crash_scenario(args.quick, args.crash_at)
+        else:
+            res = run_scenario(args.tenants, args.quick,
+                               mock=not args.real_exec)
         print("SCENARIO_RESULT " + json.dumps(res))
         return 0
     if args.check:
         return check_run(args.quick, args.check)
-    return full_run(args.quick, args.out, args.prepr_ref)
+    return full_run(args.quick, args.out, args.prepr_ref,
+                    inject_crash=args.inject_crash,
+                    crash_at=args.crash_at)
 
 
 if __name__ == "__main__":
