@@ -53,6 +53,15 @@ class Backend:
     def devices(self) -> List[DeviceView]:
         raise NotImplementedError
 
+    def slo_summary(self) -> Optional[Dict]:
+        """The tenant's OWN SLO view for the virtualized wire
+        (docs/OBSERVABILITY.md): ``{"attainment_pct", "p99_us",
+        "target_us", "burn_rate"}`` or None when no SLO source exists.
+        Like duty, the numbers are already tenant-relative ("of my
+        objective") — nothing about co-tenants or the raw chip leaks
+        through this surface."""
+        return None
+
     def close(self) -> None:
         pass
 
@@ -174,6 +183,39 @@ class RegionBackend(Backend):
         return out
 
 
+    def slo_summary(self) -> Optional[Dict]:
+        """Bind-free SLO read on the broker MAIN socket: the probe
+        names its own tenant explicitly (no HELLO, no slot, no chip
+        claim — the STATS rule) and gets exactly that row back."""
+        if not self.broker_socket or not self.tenant:
+            return None
+        from ..runtime import protocol as P
+        from ..tools.vtpu_smi import _main_request
+        try:
+            resp = _main_request(
+                self.broker_socket,
+                {"kind": P.SLO, "tenant": self.tenant}, timeout=2.0)
+        except (OSError, P.ProtocolError) as e:
+            log.warn("metricsd: broker %s SLO read failed: %s",
+                     self.broker_socket, e)
+            return None
+        if not resp.get("ok") or not resp.get("enabled"):
+            return None
+        row = (resp.get("tenants") or {}).get(self.tenant)
+        if not row:
+            return None
+        wins = row.get("windows") or {}
+        short = wins[min(wins, key=float)] if wins else {}
+        return {
+            "attainment_pct": float(short.get("attainment_pct", 100.0)),
+            "p99_us": float((row.get("phases") or {})
+                            .get("e2e", {}).get("p99_us", 0.0)),
+            "target_us": float((row.get("objective") or {})
+                               .get("target_us", 0.0)),
+            "burn_rate": float(short.get("burn_rate", 0.0)),
+        }
+
+
 class FakeBackend(Backend):
     """Deterministic synthetic tenant (CPU CI / --selftest).
 
@@ -225,3 +267,9 @@ class FakeBackend(Backend):
             )
             for i in range(self.n_devices)
         ]
+
+    def slo_summary(self) -> Optional[Dict]:
+        """Canonical synthetic SLO: 95% attainment against a 50ms
+        objective, e2e p99 at 42ms, burn 0.5 — the selftest numbers."""
+        return {"attainment_pct": 95.0, "p99_us": 42_000.0,
+                "target_us": 50_000.0, "burn_rate": 0.5}

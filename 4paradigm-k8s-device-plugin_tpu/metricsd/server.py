@@ -50,6 +50,12 @@ from .backend import Backend, DeviceView, FakeBackend, RegionBackend
 METRIC_HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
 METRIC_HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
 METRIC_DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
+# vtpu-slo (docs/OBSERVABILITY.md): the tenant's OWN SLO, served on
+# the stock wire so an in-container scrape sees its attainment the
+# same place it sees its (virtualized) HBM — rescaled like duty:
+# attainment is "of MY objective", never a co-tenant's number.
+METRIC_SLO_ATTAINMENT = "vtpu.slo.attainment.percent"
+METRIC_SLO_P99 = "vtpu.slo.e2e.p99.microseconds"
 # metricsd self-gauges, served on the same wire so node tooling
 # (tools/metrics_server.py --metricsd) can scrape them without a side
 # channel.
@@ -58,7 +64,8 @@ METRIC_SELF_PASSTHROUGH = "vtpu.metricsd.passthrough.total"
 METRIC_SELF_DENIED = "vtpu.metricsd.passthrough.denied.total"
 
 VIRTUALIZED_METRICS = (METRIC_HBM_TOTAL, METRIC_HBM_USAGE,
-                       METRIC_DUTY_CYCLE)
+                       METRIC_DUTY_CYCLE, METRIC_SLO_ATTAINMENT,
+                       METRIC_SLO_P99)
 SELF_METRICS = (METRIC_SELF_REQUESTS, METRIC_SELF_PASSTHROUGH,
                 METRIC_SELF_DENIED)
 
@@ -188,6 +195,26 @@ class MetricsdServicer:
                 name, self.backend.devices(),
                 lambda v: float(virtual_duty_pct(v.duty_cycle_pct,
                                                  v.core_limit_pct)))
+        if name in (METRIC_SLO_ATTAINMENT, METRIC_SLO_P99):
+            # Tenant-virtualized SLO (docs/OBSERVABILITY.md): the
+            # tenant's own attainment/p99 reported per granted ordinal
+            # (the grant's SLO is tenant-level; each granted device
+            # shows it, the way duty shows the rescaled share).  No
+            # SLO source -> empty metric, never an error.
+            slo = self.backend.slo_summary()
+            resp = self.mpb.MetricResponse()
+            resp.metric.name = name
+            if slo is not None:
+                val = (float(slo.get("attainment_pct", 100.0))
+                       if name == METRIC_SLO_ATTAINMENT
+                       else float(slo.get("p99_us", 0.0)))
+                for v in self.backend.devices():
+                    m = resp.metric.metrics.add()
+                    m.attribute.key = "device-id"
+                    m.attribute.value.int_attr = v.ordinal
+                    m.timestamp.GetCurrentTime()
+                    m.gauge.as_double = val
+            return resp
         if is_sensitive(name):
             # Never forwarded: a raw-capacity metric the virtualizer does
             # not model must not leak through the proxy either.

@@ -261,6 +261,20 @@ class RuntimeClient:
                 hello["spill_overshoot"] = float(ov)
             except ValueError:
                 pass
+        # SLO objective from the grant (docs/OBSERVABILITY.md): the
+        # Allocate env may declare a latency target and a throughput
+        # floor; they ride HELLO so the broker's always-on SLO plane
+        # judges attainment against the tenant's OWN objective instead
+        # of the quota-share default.
+        for env_name, field in (("VTPU_SLO_TARGET_US", "slo_target_us"),
+                                ("VTPU_SLO_FLOOR_STEPS",
+                                 "slo_floor_steps")):
+            raw = os.environ.get(env_name)
+            if raw:
+                try:
+                    hello[field] = float(raw)
+                except ValueError:
+                    pass
         self._hello = hello
         # -- vtpu-chaos hardening (docs/CHAOS.md) --
         # Per-RPC deadline on EVERY socket op: no recv or connect in
@@ -1043,6 +1057,16 @@ class RuntimeClient:
         r = self._rpc(msg)
         return {"enabled": r.get("enabled", False),
                 "tenants": r.get("tenants", {})}
+
+    def slo(self) -> Dict[str, Any]:
+        """This tenant's own SLO row from the broker's always-on plane
+        (runtime/slo.py): phase quantiles, burn rates, blame row,
+        fairness.  The broker scopes the reply to THIS bound tenant —
+        co-tenant rows and the blame matrix are admin-socket-only."""
+        r = self._rpc({"kind": P.SLO})
+        return {"enabled": r.get("enabled", False),
+                "tenants": r.get("tenants", {}),
+                "fairness": r.get("fairness")}
 
     # -- pipelined execution (throughput mode) --
     # Replies are FIFO per connection, so a caller may keep several

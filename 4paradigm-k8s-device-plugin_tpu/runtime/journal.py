@@ -122,6 +122,13 @@ def _apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> None:
             t.setdefault("ema", {})[rec["key"]] = rec.get("ema")
             if rec.get("execs") is not None:
                 t["execs"] = rec["execs"]
+    elif op == "slo":
+        # vtpu-slo plane state (runtime/slo.py export_state): the
+        # newest record wins whole — sketches are cumulative, so
+        # replaying an older one over a newer would rewind counters.
+        t = tenants.get(rec.get("name"))
+        if t is not None and rec.get("state") is not None:
+            t["slo"] = rec["state"]
     elif op == "wedge":
         # The claim watchdog's dying words (runtime/server.py
         # claim_watchdog): which claim stage hung and who held the chip

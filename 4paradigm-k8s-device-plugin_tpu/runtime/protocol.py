@@ -119,6 +119,19 @@ STATS = "stats"          # {} -> {ok, tenants: {...}, journal: {...}}
 # still answers (enabled=false, empty rings) so probes need no
 # env-coupling.
 TRACE = "trace"          # {tenant?, limit?} -> {ok, enabled, tenants}
+# SLO is the always-on telemetry plane's read verb (runtime/slo.py,
+# docs/OBSERVABILITY.md): per-tenant x per-phase quantile sketches,
+# burn rates, noisy-neighbor blame, fairness.  Bind-free (no tenant
+# slot, no chip claim) with SCOPED replies: a BOUND tenant connection
+# always gets exactly its own row (the requested ``tenant`` field is
+# ignored — a tenant cannot widen its view by naming a neighbour); an
+# unbound probe gets the row it names explicitly (the bind-free path
+# metricsd's virtualized scrape uses — same disclosure level as the
+# bind-free STATS matrix) or, with no name, just the enabled flag; the
+# admin socket gets every row plus the full blame matrix and the
+# fairness report.
+SLO = "slo"              # {tenant?} -> {ok, enabled, tenants,
+                         #  fairness?, matrix? (admin only)}
 
 # Admin verbs — served ONLY on the host-side admin socket
 # (<socket>.admin, never mounted into tenant containers: the tenant
@@ -165,13 +178,13 @@ RESIZE = "resize"        # {tenant, hbm_limit?|hbm_limits?, core_limit?}
 
 # Served on the tenant socket (mounted into containers).
 TENANT_VERBS = (HELLO, PUT_PART, PUT, GET, DELETE, COMPILE, EXECUTE,
-                EXEC_BATCH, STATS, TRACE)
+                EXEC_BATCH, STATS, TRACE, SLO)
 # Served on the host-side admin socket (<socket>.admin, never mounted).
-ADMIN_VERBS = (STATS, TRACE, SUSPEND, RESUME, RESIZE, SHUTDOWN, DRAIN,
-               HANDOVER)
+ADMIN_VERBS = (STATS, TRACE, SLO, SUSPEND, RESUME, RESIZE, SHUTDOWN,
+               DRAIN, HANDOVER)
 # Answer WITHOUT a HELLO binding — no tenant slot, no lazy chip claim,
 # so a read-only probe can never wedge a chip claim (ADVICE r5 #2).
-BIND_FREE_VERBS = (STATS, TRACE)
+BIND_FREE_VERBS = (STATS, TRACE, SLO)
 
 # ---------------------------------------------------------------------------
 # Retry-safety registry — the machine-checked idempotency contract
@@ -193,7 +206,7 @@ BIND_FREE_VERBS = (STATS, TRACE)
 # RESUME set absolute state; DRAIN re-requested is already draining.
 # ---------------------------------------------------------------------------
 IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, TRACE,
-                    SUSPEND, RESUME, RESIZE, DRAIN)
+                    SLO, SUSPEND, RESUME, RESIZE, DRAIN)
 NONIDEMPOTENT_VERBS = (PUT_PART, EXECUTE, EXEC_BATCH, SHUTDOWN,
                        HANDOVER)
 
@@ -222,7 +235,7 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
         "optional": ("priority", "device", "devices", "hbm_limit",
                      "hbm_limits", "core_limit", "oversubscribe",
                      "spill_overshoot", "pid", "pidns", "resume_epoch",
-                     "trace"),
+                     "slo_target_us", "slo_floor_steps", "trace"),
     },
     PUT_PART: {"required": ("id", "data"), "optional": ("trace",)},
     PUT: {
@@ -242,6 +255,9 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
     EXEC_BATCH: {"required": (), "optional": ("items", "trace")},
     STATS: {"required": (), "optional": ("trace",)},
     TRACE: {"required": (), "optional": ("tenant", "limit", "trace")},
+    # ``tenant`` scopes an UNBOUND probe's reply (metricsd's bind-free
+    # scrape); a bound connection's own identity always wins over it.
+    SLO: {"required": (), "optional": ("tenant", "trace")},
     SUSPEND: {"required": ("tenant",), "optional": ()},
     RESUME: {"required": ("tenant",), "optional": ()},
     RESIZE: {"required": ("tenant",),

@@ -86,9 +86,10 @@ lock-order ground truth (vtpu-analyze):
         order: bridge.fn_mu > bridge.mu
         leaf: region.lock, journal.mu, flight.mu, put_cache_mu
         leaf: session.send_mu, session.pending_cond, bridge.mu
-        leaf: batch.mu
+        leaf: batch.mu, slo.mu
         no-blocking-under: state.mu, tenant.mu, scheduler.mu
         no-blocking-under: put_cache_mu, flight.mu, batch.mu
+        no-blocking-under: slo.mu
 
     New in the hot-path overhaul (docs/PERF.md): ``batch.mu`` guards
     one EXEC_BATCH reply's result slots — strictly leaf, and the
@@ -96,6 +97,9 @@ lock-order ground truth (vtpu-analyze):
     releasing it;
     ``lease.mu`` is the shim-side RateLease's internal lock
     (shim/core.py), which wraps the region's token-bucket calls.
+    ``slo.mu`` guards the always-on SLO plane (runtime/slo.py):
+    strictly leaf — ``SloPlane.record`` is called from the metering /
+    retire paths holding NO broker lock and never calls back out.
 
     Deliberate NON-edges the checker enforces by omission:
     scheduler.mu and tenant.mu are unordered siblings — the dispatcher
@@ -125,6 +129,7 @@ from ..utils import envspec
 from ..utils import logging as log
 from . import faults
 from . import protocol as P
+from . import slo as slo_mod
 from . import trace as tracing
 from .journal import Journal, JournalCorrupt
 
@@ -486,7 +491,7 @@ class WorkItem:
                  "steps", "carry", "metered", "est_us", "first_run",
                  "free_ids", "t_enq", "t_enq_wall", "t_bucket0",
                  "bucket_wait_us", "trace_id", "trace_ts", "batch",
-                 "batch_idx")
+                 "batch_idx", "slo_busy0")
 
     def __init__(self, tenant, session, exe, key, arg_ids, out_ids,
                  steps=1, carry=(), free_ids=()):
@@ -524,6 +529,11 @@ class WorkItem:
         # None for a plain EXECUTE — its reply is a frame of its own.
         self.batch: "Optional[_BatchReply]" = None
         self.batch_idx = 0
+        # vtpu-slo noisy-neighbor blame (runtime/slo.py): snapshot of
+        # the chip's per-slot cumulative device time at enqueue — the
+        # blame denominators are the co-tenant deltas between this and
+        # retire.  None with the plane off (zero hot-path touch).
+        self.slo_busy0: Optional[tuple] = None
 
 
 class _ItemError(Exception):
@@ -580,6 +590,14 @@ class DeviceScheduler:
         # Estimated device time of dispatched-but-unretired items (the
         # chip's queue depth in time units); guarded by self.mu.
         self.queued_est_us = 0.0
+        # vtpu-slo blame substrate (runtime/slo.py): cumulative metered
+        # device time per tenant SLOT of this chip, plus the slot->name
+        # map.  Written ONLY by the metering thread (plain float adds);
+        # read unlocked by submit_many (enqueue snapshot) and
+        # _record_span — a torn read skews one request's blame split by
+        # a few µs, never enforcement state.
+        self.slo_busy = [0.0] * MAX_TENANTS
+        self.slo_names: List[Optional[str]] = [None] * MAX_TENANTS
         # Threads parked in a self.mu.wait (dispatcher + quiesce
         # callers); guarded by self.mu.  Producers skip the notify when
         # nobody is waiting — on a hot queue every submit/retire used
@@ -605,10 +623,15 @@ class DeviceScheduler:
         sub-ms step sizes."""
         now_m = time.monotonic()
         now_w = time.time()
+        # One busy-vector snapshot per submit batch (not per item):
+        # the blame window opens at enqueue, and batch-mates enqueued
+        # in the same lock acquisition share it exactly.
+        snap = tuple(self.slo_busy) if self.state.slo.enabled else None
         with self.mu:
             for item in items:
                 item.t_enq = now_m
                 item.t_enq_wall = now_w
+                item.slo_busy0 = snap
                 name = item.tenant.name
                 if name not in self.queues:
                     self.queues[name] = collections.deque()
@@ -1122,6 +1145,16 @@ class DeviceScheduler:
             learn_scale = sparse_batch_learn_scale(batch_est, disp_us,
                                                    len(batch))
         ema_recs: List[dict] = []
+        # vtpu-slo staging: retired items' RAW timestamps collect here
+        # (4 floats per item, flat) and the whole batch parks with ONE
+        # stage_batch call below — the phase math runs vectorized at
+        # ingest, never per item (the <3% always-on budget).  Loop
+        # locals hoisted: the per-item cost is a dict get + one extend.
+        slo_stage: Dict[str, list] = {}
+        slo_on = self.state.slo.enabled
+        slo_fast = slo_on and not self.state.flight.enabled
+        slo_busy = self.slo_busy
+        slo_names = self.slo_names
         for item, t0, outs in batch:
             t = item.tenant
             prev_ema = t.cost_ema.get(item.key, 5000.0)
@@ -1169,6 +1202,11 @@ class DeviceScheduler:
                 if learn_scale is not None:
                     per_step = item.est_us * learn_scale / item.steps
             t.busy_add_all(int(busy_us))
+            if slo_on:
+                # vtpu-slo blame substrate: this thread is the only
+                # writer of the per-slot busy vector.
+                slo_busy[t.index] += busy_us
+                slo_names[t.index] = t.name
             charged = max(busy_us,
                           float(self.state.min_exec_cost_us)
                           * item.steps)
@@ -1210,8 +1248,42 @@ class DeviceScheduler:
                 "batch=%d obs_gap=%.0fus disp_gap=%.0fus",
                 t.name, item.est_us, busy_us, self._pool_us,
                 len(batch), obs_us, disp_us)
-            self._record_span(item, t0, t_obs, busy_us,
-                              solo=(len(batch) == 1))
+            if slo_fast and item.trace_id is None:
+                # HOT PATH: one flat extend of dt-relative stamps — no
+                # phase math, no function call, no lock.
+                rows = slo_stage.get(t.name)
+                if rows is None:
+                    rows = slo_stage[t.name] = []
+                rows.extend((t_obs - item.t_enq, item.bucket_wait_us,
+                             t_obs - t0, item.steps))
+            else:
+                self._record_span(item, t0, t_obs, busy_us,
+                                  solo=(len(batch) == 1),
+                                  slo_stage=slo_stage)
+        if slo_stage:
+            # Batch-window blame denominators, computed ONCE per batch:
+            # co-tenant device-time deltas from the batch head's
+            # enqueue snapshot to now (each victim's own entry is
+            # excluded at ingest, runtime/slo.py).
+            weights: Optional[Dict[str, float]] = None
+            base = batch[0][0].slo_busy0
+            if base is not None:
+                cur = self.slo_busy
+                names = self.slo_names
+                for i in range(MAX_TENANTS):
+                    n = names[i]
+                    if n is None:
+                        continue
+                    d = cur[i] - (base[i] if i < len(base) else 0.0)
+                    if d > 0.0:
+                        if weights is None:
+                            weights = {}
+                        weights[n] = d
+            n_staged = 0
+            for rows in slo_stage.values():
+                n_staged += len(rows)
+            self.state.slo.stage_batch(slo_stage, weights,
+                                       n_staged // 4)
         if ema_recs and self.state.journal is not None:
             try:
                 self.state.journal.append_many(ema_recs)
@@ -1229,20 +1301,63 @@ class DeviceScheduler:
 
     def _record_span(self, item: WorkItem, t_disp: float, t_obs: float,
                      busy_us: float, error: Optional[str] = None,
-                     solo: bool = True) -> None:
-        """Fold one retired item's timestamps into a flight-recorder
-        span.  Phases are WALL-clock deltas that partition the item's
-        broker residency exactly (queue + bucket + device == total by
-        construction); the metered ``busy_us`` rides along as the
-        billing view."""
+                     solo: bool = True,
+                     slo_stage: Optional[Dict[str, list]] = None
+                     ) -> None:
+        """Fold one retired item's timestamps into the always-on SLO
+        plane (runtime/slo.py) and — when tracing is on — a
+        flight-recorder span.  Phases are WALL-clock deltas that
+        partition the item's broker residency exactly (queue + bucket +
+        device == total by construction); the metered ``busy_us`` rides
+        along as the billing view."""
         fl = self.state.flight
-        if not fl.enabled:
+        plane = self.state.slo
+        if not fl.enabled and not plane.enabled:
             return
         t = item.tenant
         total_us = max(t_obs - item.t_enq, 0.0) * 1e6
         bucket_us = min(item.bucket_wait_us, total_us)
         queue_us = max((t_disp - item.t_enq) * 1e6 - bucket_us, 0.0)
         device_us = max(t_obs - t_disp, 0.0) * 1e6
+        if plane.enabled:
+            if slo_stage is not None and item.trace_id is None \
+                    and error is None:
+                # Staged path (the metering loop, flight recorder on):
+                # raw timestamps parked flat; the whole batch folds in
+                # bulk (runtime/slo.py; the <3% always-on budget).
+                rows = slo_stage.get(t.name)
+                if rows is None:
+                    rows = slo_stage[t.name] = []
+                rows.extend((t_obs - item.t_enq, item.bucket_wait_us,
+                             t_obs - t_disp, item.steps))
+            else:
+                # Exact per-item path: traced items (their id becomes
+                # a histogram exemplar) and error retires.  Blame
+                # denominators: each co-tenant's metered device time
+                # between this item's enqueue snapshot and now —
+                # unlocked reads of the metering thread's own vector.
+                weights: Optional[Dict[str, float]] = None
+                base = item.slo_busy0
+                if base is not None:
+                    cur = self.slo_busy
+                    names = self.slo_names
+                    for i in range(MAX_TENANTS):
+                        n = names[i]
+                        if n is None or n == t.name:
+                            continue
+                        d = cur[i] - (base[i] if i < len(base) else 0.0)
+                        if d > 0.0:
+                            if weights is None:
+                                weights = {}
+                            weights[n] = d
+                plane.record(t.name, queue_us=queue_us,
+                             bucket_us=bucket_us, device_us=device_us,
+                             total_us=total_us, steps=item.steps,
+                             ok=error is None, wait_weights=weights,
+                             trace_id=item.trace_id,
+                             wall_ts=item.t_enq_wall)
+        if not fl.enabled:
+            return
         span: Dict[str, Any] = {
             "ts": item.t_enq_wall,
             "tenant": t.name, "chip": self.chip.index,
@@ -1481,6 +1596,12 @@ class RuntimeState:
         # VTPU_TRACE=1; a disabled recorder records nothing and the
         # protocol carries zero extra fields.
         self.flight = tracing.FlightRecorder()
+        # vtpu-slo plane (runtime/slo.py): ALWAYS-ON per-tenant SLO /
+        # fairness / noisy-neighbor accounting — unlike the opt-in
+        # flight recorder it runs in production by default (VTPU_SLO=0
+        # removes every hot-path touch; the bench A/B gate proves the
+        # on-cost < 3%).
+        self.slo = slo_mod.SloPlane()
         # The previous instance's claim-watchdog wedge record, if its
         # journal carries one: surfaced at recovery so an os._exit(3)
         # restart is attributable (ISSUE 2 satellite).
@@ -1786,6 +1907,12 @@ class RuntimeState:
                     chip.region.mem_release(slot, nb)
                 self.recovery["tenants_dropped_dead"] += 1
                 continue
+            # SLO attainment history resumes with the tenant: sketches
+            # journaled by the previous instance (periodic "slo"
+            # records + snapshot) re-seed the plane, so a kill -9 never
+            # zeroes a tenant's burn/attainment record.
+            if rec.get("slo"):
+                self.slo.restore(name, rec["slo"])
             self.recovered[name] = (t, now + self.resume_grace)
             self.recovery["tenants_recovered"] += 1
         log.info("journal: recovered %d tenant(s) from epoch %s "
@@ -1893,6 +2020,30 @@ class RuntimeState:
             rec = self._release_recovered(t, "tenants_dropped_expired")
             if rec is not None and self.journal is not None:
                 self.journal.append(rec)
+        if self.journal is not None and self.slo.journal_due():
+            # Periodic SLO-state records (docs/OBSERVABILITY.md): a
+            # crashed broker's successor resumes each tenant's
+            # attainment history within one period of pre-crash.
+            # In-flight requests at the kill are in NEITHER the
+            # journaled sketch nor the successor's (they retire — and
+            # record — only after the append), so resume can never
+            # double-count; the chaos driver asserts this live.
+            with self.mu:
+                names = set(self.tenants) | set(self.recovered)
+            recs: List[dict] = []
+            for name in names:
+                st = self.slo.export_state(name)
+                if st is not None:
+                    recs.append({"op": "slo", "name": name,
+                                 "state": st})
+            if recs:
+                try:
+                    self.journal.append_many(recs)
+                except OSError as e:
+                    # Telemetry history: losing a period degrades the
+                    # successor's attainment view, never enforcement.
+                    log.warn("journal: dropping %d slo record(s) (%s)",
+                             len(recs), e)
         if self.journal is not None and self.journal.snapshot_due():
             self.journal.write_snapshot(self._snapshot_dict)
 
@@ -1921,6 +2072,12 @@ class RuntimeState:
                 "ema": {k: float(v) for k, v in t.cost_ema.items()},
                 "execs": t.executions,
             }
+            # SLO plane state rides the snapshot too (slo.mu is leaf;
+            # no other lock is held here), so compaction never ages
+            # attainment history out of the journal.
+            slo_state = self.slo.export_state(name)
+            if slo_state is not None:
+                tenants[name]["slo"] = slo_state
         with self.chips_mu:
             chips = {str(i): c._latency_us  # noqa: SLF001 - own class
                      for i, c in self.chips.items() if c._latency_us}
@@ -1949,6 +2106,23 @@ class RuntimeState:
         if self.journal is not None:
             out.update(self.journal.stats())
         return out
+
+    def slo_report(self, tenant: Optional[str] = None,
+                   admin: bool = False) -> Dict[str, Any]:
+        """SLO-verb reply body: the plane's report plus the live quota
+        shares the fairness index compares attainment against.  Region
+        reads happen with no broker lock held (region.lock is leaf)."""
+        quota: Dict[str, int] = {}
+        with self.mu:
+            tenants = list(self.tenants.items())
+        for name, t in tenants:
+            try:
+                quota[name] = int(t.chip.region.device_stats(
+                    t.index).core_limit_pct)
+            except Exception:  # noqa: BLE001 - advisory read
+                quota[name] = 0
+        return self.slo.report(tenant=tenant, admin=admin,
+                               quota_pcts=quota)
 
     def drain(self, timeout: float = 30.0) -> int:
         """Prepare a zero-downtime handover: refuse new HELLOs
@@ -2090,6 +2264,11 @@ class RuntimeState:
             # Flight-recorder rings die with the tenant: a reused name
             # is a NEW tenant whose histograms must start at zero.
             self.flight.forget(t.name)
+            # ... and so does its SLO row (sketches, blame, burn
+            # windows): attainment history never resurrects across a
+            # true teardown (journal resume is the one sanctioned
+            # survival path).
+            self.slo.forget(t.name)
             # Suspension dies with the tenant instance: a redeployed pod
             # reusing the name must not start silently frozen (the only
             # clue would be the admin-side STATS list).
@@ -2402,6 +2581,17 @@ class TenantSession(socketserver.BaseRequestHandler):
                         # First HELLO wins, like the hbm/core grant.
                         tenant.spill_overshoot = max(float(overshoot),
                                                      0.0)
+                    # vtpu-slo objective seeding (docs/OBSERVABILITY.md):
+                    # the grant may declare a latency target and a
+                    # throughput floor (Allocate env VTPU_SLO_TARGET_US
+                    # / VTPU_SLO_FLOOR_STEPS, relayed by the client);
+                    # absent, the target defaults from the quota share.
+                    self.state.slo.ensure_tenant(
+                        tenant.name,
+                        quota_pct=int(tenant.chip.region.device_stats(
+                            tenant.index).core_limit_pct),
+                        target_us=msg.get("slo_target_us"),
+                        floor_steps_s=msg.get("slo_floor_steps"))
                     # tenant_box FIRST: if the bind record's append
                     # fails (journal EIO), teardown must still release
                     # the connection count this HELLO took.
@@ -2437,6 +2627,25 @@ class TenantSession(socketserver.BaseRequestHandler):
                         "tenants": self.state.flight.snapshot(
                             tenant=str(t_arg) if t_arg else None,
                             limit=int(msg.get("limit", 0) or 0))})
+                    continue
+                if kind == P.SLO:
+                    # BIND-FREE like STATS/TRACE, with SCOPED replies
+                    # (docs/OBSERVABILITY.md): a bound connection gets
+                    # exactly ITS OWN row — the requested tenant field
+                    # is ignored, so a tenant can never widen its view
+                    # by naming a neighbour; an unbound probe gets the
+                    # row it names (metricsd's bind-free scrape) or
+                    # just the enabled flag.  The matrix is admin-only.
+                    if tenant is not None:
+                        self._drain()
+                        scope = tenant.name
+                    else:
+                        t_arg = msg.get("tenant")
+                        scope = str(t_arg) if t_arg else None
+                    rep = self.state.slo_report(tenant=scope,
+                                                admin=False)
+                    rep["ok"] = True
+                    self._send(rep)
                     continue
 
                 if tenant is None:
@@ -3103,6 +3312,9 @@ def resize_tenant(state: RuntimeState, t: Tenant,
         # that turns metering on/off must bite now, not half a second
         # of dispatches later.
         t._metered_cache = None
+    # The SLO plane's quota-derived default objective tracks the new
+    # share (an operator-declared explicit target stays).
+    state.slo.set_quota_pct(t.name, new_core)
     resize_rec = {"op": "resize", "name": t.name, "hbm": new_hbm,
                   "core": new_core}
     return resize_rec
@@ -3242,6 +3454,13 @@ class AdminSession(socketserver.BaseRequestHandler):
                         "tenants": self.state.flight.snapshot(
                             tenant=str(t_arg) if t_arg else None,
                             limit=int(msg.get("limit", 0) or 0))})
+                elif kind == P.SLO:
+                    # The ADMIN view of the SLO plane: every tenant's
+                    # row, the full noisy-neighbor blame matrix and the
+                    # fairness report (vtpu-smi top, metrics_server).
+                    rep = self.state.slo_report(admin=True)
+                    rep["ok"] = True
+                    P.send_msg(self.request, rep)
                 elif kind in (P.DRAIN, P.HANDOVER):
                     # Zero-downtime upgrade: quiesce + final snapshot;
                     # HANDOVER then exits so the supervisor's successor

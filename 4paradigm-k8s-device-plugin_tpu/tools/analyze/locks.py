@@ -41,6 +41,7 @@ ANALYZED = [
     f"{PKG_NAME}/runtime/server.py",
     f"{PKG_NAME}/runtime/client.py",
     f"{PKG_NAME}/runtime/journal.py",
+    f"{PKG_NAME}/runtime/slo.py",
     f"{PKG_NAME}/runtime/trace.py",
     f"{PKG_NAME}/shim/bridge.py",
     f"{PKG_NAME}/shim/core.py",
@@ -60,6 +61,7 @@ CLASS_LOCKS: Dict[Tuple[str, str], str] = {
     ("TenantSession", "pending_cond"): "session.pending_cond",
     ("Journal", "mu"): "journal.mu",
     ("FlightRecorder", "mu"): "flight.mu",
+    ("SloPlane", "mu"): "slo.mu",
     ("Bridge", "_mu"): "bridge.mu",
     ("BridgedFunction", "_mu"): "bridge.fn_mu",
     ("_BatchReply", "mu"): "batch.mu",

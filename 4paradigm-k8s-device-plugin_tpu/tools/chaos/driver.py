@@ -121,6 +121,22 @@ def _admin_stats(sock: str) -> Optional[dict]:
         s.close()
 
 
+def _admin_slo(sock: str) -> Optional[dict]:
+    """One SLO-plane read over the admin socket (docs/OBSERVABILITY.md)
+    — the churn suite's attainment/sketch timeline source."""
+    from ...runtime import protocol as P
+    s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+    s.settimeout(2.0)
+    try:
+        s.connect(sock + ".admin")
+        P.send_msg(s, {"kind": P.SLO})
+        return P.recv_msg(s)
+    except OSError:
+        return None
+    finally:
+        s.close()
+
+
 class ChurnRun:
     """One schedule's execution + live-invariant verdicts."""
 
@@ -136,6 +152,9 @@ class ChurnRun:
         self.broker_log = open(os.path.join(self.tmp, "broker.log"),
                                "ab")
         self.polls: List[dict] = []
+        # SLO-impact timeline: (wall ts, {tenant: {count, attainment}})
+        # samples across the churn — before / during / after the kill.
+        self.slo_polls: List[dict] = []
         self.violations: List[str] = []
 
     # -- processes ---------------------------------------------------------
@@ -149,6 +168,11 @@ class ChurnRun:
             "VTPU_LEASE_SIDECAR": os.path.join(self.tmp, "lease.json"),
             "VTPU_LOG_LEVEL": "0",
             "VTPU_TRACE": "0",
+            # Frequent SLO sketch journaling (docs/OBSERVABILITY.md):
+            # the churn verdict asserts attainment history SURVIVES the
+            # kill -9 resume without double-counting in-flight work, so
+            # the journaled state must lag the kill by ~a keeper tick.
+            "VTPU_SLO_JOURNAL_S": "0.5",
         })
         if self.sched.broker_faults:
             env["VTPU_FAULTS"] = self.sched.broker_faults
@@ -214,6 +238,19 @@ class ChurnRun:
                     f"{lease} exceeds the one-quantum clamp "
                     f"({LEASE_CLAMP_US})")
         self.polls.append({"t": now, "resp": resp})
+        slo = _admin_slo(self.sock)
+        if slo and slo.get("ok") and slo.get("enabled"):
+            rows = {}
+            for name, row in (slo.get("tenants") or {}).items():
+                wins = row.get("windows") or {}
+                short = wins[min(wins, key=float)] if wins else {}
+                rows[name] = {
+                    "count": int((row.get("phases") or {})
+                                 .get("e2e", {}).get("count", 0)),
+                    "attainment_pct": short.get("attainment_pct"),
+                    "burn_rate": short.get("burn_rate"),
+                }
+            self.slo_polls.append({"t": now, "rows": rows})
 
     # -- the schedule ------------------------------------------------------
 
@@ -392,6 +429,79 @@ class ChurnRun:
                     f"[epoch-resume] broker re-adopted only "
                     f"{jstats.get('tenants_readopted')} of "
                     f"{sched.tenants} tenants")
+        self._judge_slo(result, curves, t_kill, respawned_at)
+
+    def _judge_slo(self, result: Dict[str, Any], curves,
+                   t_kill: float,
+                   respawned_at: Optional[float]) -> None:
+        """SLO-plane churn verdicts (docs/OBSERVABILITY.md): the
+        attainment timeline spans the kill, and the sketches SURVIVE
+        the epoch resume without double-counting in-flight requests.
+
+        Survival/double-count discriminators per tenant (e2e sketch
+        count C, client step curves S):
+
+          C_end >= S_post + C_pre/2   sketches restored — without the
+                                      journal restore C_end would be
+                                      only the post-crash traffic
+          C_end <= C_pre + S_post + slack   no double count — a replay
+                                      that re-ingested live history
+                                      would land near 2*C_pre + S_post
+        """
+        pre = [p for p in self.slo_polls if p["t"] < t_kill]
+        post_edge = respawned_at or t_kill
+        post = [p for p in self.slo_polls if p["t"] > post_edge]
+        result["slo_timeline"] = {
+            "samples": len(self.slo_polls),
+            "pre": pre[-1]["rows"] if pre else None,
+            "post": post[-1]["rows"] if post else None,
+        }
+        if not pre or not post:
+            self.violations.append(
+                f"[slo-timeline] no SLO samples on both sides of the "
+                f"kill (pre={len(pre)} post={len(post)}) — the "
+                f"always-on plane must answer across the churn")
+            return
+        c_pre = pre[-1]["rows"]
+        for i, rows in enumerate(curves):
+            # Tenant names follow the spawn order: churn-<seed>-<i>.
+            name = f"churn-{self.sched.seed}-{i}"
+            pre_n = int((c_pre.get(name) or {}).get("count", 0))
+            if pre_n == 0:
+                continue  # tenant bound after the last pre-kill poll
+            # The PEAK post-respawn sample: the final polls may land
+            # after the tenant's clean teardown already dropped its row
+            # (a reused name must start at zero) — the peak is the
+            # resume evidence.
+            end_n = 0
+            t_end = post_edge
+            for p in post:
+                n = int((p["rows"].get(name) or {}).get("count", 0))
+                if n >= end_n:
+                    end_n = n
+                    t_end = p["t"]
+            # Client steps completed between the respawn and that
+            # sample — the post-crash traffic the sketch holds IN
+            # ADDITION to the restored history.
+            s_at_respawn = max(
+                (s for t, s in rows if t <= post_edge), default=0)
+            s_at_last = max(
+                (s for t, s in rows if t <= t_end),
+                default=s_at_respawn)
+            s_post = max(s_at_last - s_at_respawn, 0)
+            if end_n < s_post + pre_n // 2:
+                self.violations.append(
+                    f"[slo-survival] tenant {name} e2e sketch count "
+                    f"{end_n} after resume < post-crash steps "
+                    f"{s_post} + half its pre-crash count {pre_n} — "
+                    f"attainment history did not survive the kill -9")
+            slack = 512 + (pre_n + s_post) // 4
+            if end_n > pre_n + s_post + slack:
+                self.violations.append(
+                    f"[slo-double-count] tenant {name} e2e sketch "
+                    f"count {end_n} exceeds pre-crash {pre_n} + "
+                    f"post-crash {s_post} + slack {slack} — resume "
+                    f"double-counted in-flight requests")
 
     def _region_leak_bytes(self) -> int:
         import glob as globmod

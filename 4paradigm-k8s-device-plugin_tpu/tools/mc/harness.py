@@ -376,6 +376,12 @@ class Harness:
         st._keeper_stop = mcsched.MCEvent(self.sched)
         st.flight = tracing.FlightRecorder(enabled=False)
         st.last_wedge = None
+        # SLO plane disabled under MC: its internal clock reads are
+        # wall-time (not the model clock), and the invariants under
+        # test are quota/lease/crash ones — the plane's own properties
+        # have their own suite (tests/test_slo.py).
+        from ...runtime import slo as slo_mod
+        st.slo = slo_mod.SloPlane(enabled=False)
         st._journal_state = None
         st.work_conserving = False
         st.spill_overshoot = 0.0

@@ -71,10 +71,22 @@ class MetricsState:
                 return None
             if not resp.get("ok"):
                 return None
+            # The always-on SLO plane rides the same admin socket
+            # (docs/OBSERVABILITY.md): per-tenant sketches, burn rates,
+            # blame matrix, fairness.  Best-effort like everything
+            # else on this path.
+            slo = None
+            try:
+                s = _admin_request(sock, {"kind": P.SLO}, timeout=2.0)
+                if s.get("ok"):
+                    slo = s
+            except (OSError, P.ProtocolError) as e:
+                log.warn("broker %s SLO scrape failed: %s", sock, e)
             return {"broker": sock,
                     "tenants": resp.get("tenants", {}),
                     "suspended": resp.get("suspended", []),
-                    "journal": resp.get("journal") or {}}
+                    "journal": resp.get("journal") or {},
+                    "slo": slo}
 
         if not self.brokers:
             return []
@@ -238,13 +250,44 @@ def broker_prometheus(brokers: List[Dict]) -> str:
         "# TYPE vtpu_tenant_suspended gauge",
         "# HELP vtpu_tenant_executions_total Steps executed per tenant.",
         "# TYPE vtpu_tenant_executions_total counter",
-        # vtpu-trace flight-recorder rollups (docs/TRACING.md): where a
-        # tenant's request time goes — queue vs token bucket vs device —
-        # plus the end-to-end latency histogram.  Only present when the
-        # broker runs with VTPU_TRACE=1.
+        # vtpu-slo (docs/OBSERVABILITY.md): the end-to-end latency
+        # histogram is ALWAYS emitted for every known tenant, with
+        # buckets DERIVED from the broker's own quantile sketch (a
+        # stable ~2x log grid — not a hardcoded list) and trace-id
+        # exemplars linking into the flight recorder when tracing is
+        # on.
         "# HELP vtpu_tenant_latency_us End-to-end broker residency per "
-        "execute (enqueue to device-ready), microseconds.",
+        "execute (enqueue to device-ready), microseconds; buckets "
+        "derived from the vtpu-slo sketch.",
         "# TYPE vtpu_tenant_latency_us histogram",
+        "# HELP vtpu_tenant_slo_phase_us Phase latency quantiles "
+        "(queue/bucket/device/e2e) from the always-on SLO sketches.",
+        "# TYPE vtpu_tenant_slo_phase_us gauge",
+        "# HELP vtpu_tenant_slo_attainment_ratio Fraction of requests "
+        "inside the tenant's latency objective, per burn window.",
+        "# TYPE vtpu_tenant_slo_attainment_ratio gauge",
+        "# HELP vtpu_tenant_slo_burn_rate SLO burn rate (violation "
+        "rate over error budget), per burn window.",
+        "# TYPE vtpu_tenant_slo_burn_rate gauge",
+        "# HELP vtpu_tenant_slo_burn_alert 1 when the short-window "
+        "burn rate crossed the alert threshold.",
+        "# TYPE vtpu_tenant_slo_burn_alert gauge",
+        "# HELP vtpu_tenant_slo_target_us The tenant's end-to-end "
+        "latency objective (explicit grant or quota-share default).",
+        "# TYPE vtpu_tenant_slo_target_us gauge",
+        "# HELP vtpu_tenant_blame_us_total Noisy-neighbor blame: "
+        "cumulative queue+bucket wait of `tenant` attributed to "
+        "`culprit` (rows sum to the tenant's measured wait).",
+        "# TYPE vtpu_tenant_blame_us_total counter",
+        "# HELP vtpu_tenant_fairness_ratio Attained device-time share "
+        "over quota share (1.0 = exactly proportional).",
+        "# TYPE vtpu_tenant_fairness_ratio gauge",
+        "# HELP vtpu_broker_fairness_jain Jain fairness index over "
+        "per-tenant attainment ratios (1.0 = perfectly fair).",
+        "# TYPE vtpu_broker_fairness_jain gauge",
+        # vtpu-trace flight-recorder rollups (docs/TRACING.md): where a
+        # tenant's request time goes — queue vs token bucket vs device.
+        # Only present when the broker runs with VTPU_TRACE=1.
         "# HELP vtpu_tenant_queue_wait_us_total Cumulative scheduler-"
         "queue wait per tenant (microseconds).",
         "# TYPE vtpu_tenant_queue_wait_us_total counter",
@@ -322,21 +365,16 @@ def broker_prometheus(brokers: List[Dict]) -> str:
                          f'{1 if t.get("suspended") else 0}')
             lines.append(f'vtpu_tenant_executions_total{labels} '
                          f'{t["executions"]}')
+            # vtpu-slo: ALWAYS emit the latency histogram per known
+            # tenant — a tenant with no SLO row yet gets a zero-count
+            # series, so dashboards never gap (the PR-2 histogram was
+            # only present "when present" and its buckets were
+            # hardcoded).
+            slo_rows = ((b.get("slo") or {}).get("tenants") or {})
+            _emit_tenant_slo(lines, labels, name,
+                             slo_rows.get(name))
             tr = t.get("trace")
             if tr:
-                base = labels[1:-1]  # strip braces; le rides alongside
-                cum = 0
-                bounds = tr.get("latency_bounds_us", [])
-                buckets = tr.get("latency_buckets", [])
-                for le, cnt in zip(list(bounds) + ["+Inf"], buckets):
-                    cum += int(cnt)
-                    lines.append(
-                        f'vtpu_tenant_latency_us_bucket{{{base},'
-                        f'le="{le}"}} {cum}')
-                lines.append(f'vtpu_tenant_latency_us_sum{labels} '
-                             f'{tr.get("latency_sum_us", 0)}')
-                lines.append(f'vtpu_tenant_latency_us_count{labels} '
-                             f'{tr.get("latency_count", 0)}')
                 lines.append(
                     f'vtpu_tenant_queue_wait_us_total{labels} '
                     f'{tr.get("queue_wait_us_total", 0)}')
@@ -349,7 +387,76 @@ def broker_prometheus(brokers: List[Dict]) -> str:
                 lines.append(
                     f'vtpu_tenant_slow_op_captures{labels} '
                     f'{tr.get("slow_captures", 0)}')
+        fair = ((b.get("slo") or {}).get("fairness") or {})
+        for name, row in sorted((fair.get("tenants") or {}).items()):
+            lines.append(
+                f'vtpu_tenant_fairness_ratio{{broker="{broker}",'
+                f'tenant="{_esc(name)}"}} {row.get("ratio", 0.0)}')
+        if fair:
+            lines.append(f'vtpu_broker_fairness_jain'
+                         f'{{broker="{broker}"}} '
+                         f'{fair.get("jain", 1.0)}')
     return "\n".join(lines) + "\n" if brokers else ""
+
+
+def _emit_tenant_slo(lines: List[str], labels: str, name: str,
+                     row: Optional[Dict]) -> None:
+    """One tenant's SLO series (docs/OBSERVABILITY.md): the
+    sketch-derived e2e histogram with trace-id exemplars, phase
+    quantile gauges, per-window attainment/burn, the objective, and
+    the noisy-neighbor blame counters."""
+    base = labels[1:-1]  # strip braces; le/extra labels ride alongside
+    buckets = (row or {}).get("e2e_buckets") or []
+    count = ((row or {}).get("phases") or {}).get("e2e", {}) \
+        .get("count", 0)
+    sum_us = ((row or {}).get("phases") or {}).get("e2e", {}) \
+        .get("sum_us", 0.0)
+    # Exemplars: OpenMetrics syntax, attached to the first bucket that
+    # covers the exemplar value — scrapers that predate exemplars
+    # ignore everything after ' # '.
+    exemplars = sorted(
+        (v for v in ((row or {}).get("exemplars") or {}).values()
+         if isinstance(v, (list, tuple)) and len(v) >= 3),
+        key=lambda e: e[0])
+    prev_le = 0.0
+    for le, cum in buckets:
+        line = (f'vtpu_tenant_latency_us_bucket{{{base},'
+                f'le="{le}"}} {cum}')
+        ex = next((e for e in exemplars
+                   if prev_le < float(e[0]) <= float(le)), None)
+        if ex is not None:
+            line += (f' # {{trace_id="{_esc(ex[1])}"}} '
+                     f'{ex[0]} {ex[2]}')
+        lines.append(line)
+        prev_le = float(le)
+    lines.append(f'vtpu_tenant_latency_us_bucket{{{base},'
+                 f'le="+Inf"}} {count}')
+    lines.append(f'vtpu_tenant_latency_us_sum{{{base}}} {sum_us}')
+    lines.append(f'vtpu_tenant_latency_us_count{{{base}}} {count}')
+    if row is None:
+        return
+    for phase, ph in sorted((row.get("phases") or {}).items()):
+        for q in ("p50_us", "p99_us"):
+            lines.append(
+                f'vtpu_tenant_slo_phase_us{{{base},phase="{phase}",'
+                f'quantile="{q[:3]}"}} {ph.get(q, 0.0)}')
+    for w, win in sorted((row.get("windows") or {}).items()):
+        lines.append(
+            f'vtpu_tenant_slo_attainment_ratio{{{base},'
+            f'window_s="{w}"}} '
+            f'{round(win.get("attainment_pct", 100.0) / 100.0, 4)}')
+        lines.append(
+            f'vtpu_tenant_slo_burn_rate{{{base},window_s="{w}"}} '
+            f'{win.get("burn_rate", 0.0)}')
+    obj = row.get("objective") or {}
+    lines.append(f'vtpu_tenant_slo_target_us{{{base}}} '
+                 f'{obj.get("target_us", 0.0)}')
+    lines.append(f'vtpu_tenant_slo_burn_alert{{{base}}} '
+                 f'{1 if row.get("burn_alert") else 0}')
+    for culprit, us in sorted((row.get("blame") or {}).items()):
+        lines.append(
+            f'vtpu_tenant_blame_us_total{{{base},'
+            f'culprit="{_esc(culprit)}"}} {us}')
 
 
 def metricsd_prometheus(items: List[Dict]) -> str:

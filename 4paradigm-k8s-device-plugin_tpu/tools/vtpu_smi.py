@@ -303,6 +303,123 @@ def cmd_metricsd(ns) -> int:
     return 0
 
 
+def _top_rows(slo_resp: dict, stats_resp: dict) -> List[Dict]:
+    """Join one SLO reply with one STATS reply into renderable rows."""
+    rows = []
+    stats = (stats_resp or {}).get("tenants") or {}
+    for name, body in sorted((slo_resp.get("tenants") or {}).items()):
+        ph = body.get("phases", {})
+        wins = body.get("windows", {})
+        short = wins[min(wins, key=float)] if wins else {}
+        fair = ((slo_resp.get("fairness") or {}).get("tenants")
+                or {}).get(name, {})
+        st = stats.get(name, {})
+        rows.append({
+            "tenant": name,
+            "steps_per_s": short.get("steps_per_s", 0.0),
+            "p50_queue_us": ph.get("queue", {}).get("p50_us", 0.0),
+            "p99_queue_us": ph.get("queue", {}).get("p99_us", 0.0),
+            "p50_e2e_us": ph.get("e2e", {}).get("p50_us", 0.0),
+            "p99_e2e_us": ph.get("e2e", {}).get("p99_us", 0.0),
+            "p99_device_us": ph.get("device", {}).get("p99_us", 0.0),
+            "attainment_pct": short.get("attainment_pct", 100.0),
+            "burn_rate": short.get("burn_rate", 0.0),
+            "burn_alert": body.get("burn_alert", False),
+            "fair_ratio": fair.get("ratio"),
+            "top_blamer": body.get("top_blamer"),
+            "hbm_used": st.get("used_bytes", 0),
+            "suspended": st.get("suspended", False),
+        })
+    rows.sort(key=lambda r: -r["steps_per_s"])
+    return rows
+
+
+def render_top(rows: List[Dict], enabled: bool = True,
+               jain: Optional[float] = None) -> str:
+    """The htop-style per-tenant SLO table (docs/OBSERVABILITY.md)."""
+    hdr = (f"{'TENANT':<18} {'STEPS/S':>8} {'P50 E2E':>9} "
+           f"{'P99 E2E':>9} {'P99 QUE':>9} {'P99 DEV':>9} "
+           f"{'ATTAIN%':>8} {'BURN':>6} {'FAIR':>5} {'TOP BLAMER':<16}")
+    lines = ["vtpu-smi top — per-tenant SLO / fairness / blame"
+             + (f"  (jain={jain})" if jain is not None else "")
+             + ("" if enabled else "  [SLO PLANE DISABLED: VTPU_SLO=0]"),
+             hdr, "-" * len(hdr)]
+    for r in rows:
+        flag = "!" if r["burn_alert"] else (
+            "s" if r["suspended"] else " ")
+        fair = (f"{r['fair_ratio']:.2f}" if r["fair_ratio"] is not None
+                else "-")
+        lines.append(
+            f"{r['tenant'][:17]:<17}{flag} {r['steps_per_s']:>8.1f} "
+            f"{r['p50_e2e_us']:>9.0f} {r['p99_e2e_us']:>9.0f} "
+            f"{r['p99_queue_us']:>9.0f} {r['p99_device_us']:>9.0f} "
+            f"{r['attainment_pct']:>8.2f} {r['burn_rate']:>6.1f} "
+            f"{fair:>5} {(r['top_blamer'] or '-')[:16]:<16}")
+    if not rows:
+        lines.append("(no tenants with SLO history)")
+    return "\n".join(lines)
+
+
+def cmd_top(ns) -> int:
+    """``vtpu-smi top``: live htop-style per-tenant table — steps/s,
+    p50/p99 by phase, SLO attainment, burn rate, top noisy-neighbor
+    blamer — from the broker's always-on SLO plane over the host-side
+    admin socket.  ``--once`` prints a single snapshot; ``--fake``
+    renders a synthetic plane (CI wiring check, no broker needed)."""
+    import time as timemod
+
+    from ..runtime import protocol as P
+    from ..runtime import slo as slo_lib
+    if ns.fake:
+        rep = slo_lib.fairness_smoke(n_tenants=8, seed=3)
+        plane_rep = None
+        # Re-run the smoke's plane for a renderable report.
+        smoke_plane = slo_lib.SloPlane(enabled=True, windows=(30.0,),
+                                       budget=0.01)
+        for i in range(8):
+            name = f"fake-{i}"
+            smoke_plane.ensure_tenant(name, quota_pct=50)
+            for k in range(64):
+                smoke_plane.record(name, queue_us=100.0 * (i + 1),
+                                   bucket_us=10.0, device_us=500.0,
+                                   total_us=110.0 * (i + 1) + 500.0,
+                                   wait_weights={f"fake-{(i+1) % 8}":
+                                                 1.0})
+        plane_rep = smoke_plane.report(
+            admin=True, quota_pcts={f"fake-{i}": 50 for i in range(8)})
+        rows = _top_rows(plane_rep, {})
+        if ns.json:
+            print(json.dumps({"smoke": rep, "rows": rows}, indent=2))
+        else:
+            print(render_top(rows,
+                             jain=plane_rep["fairness"]["jain"]))
+        return 0 if rep["ok"] else 1
+    if not ns.broker:
+        print("top needs --broker <main socket> (or --fake)",
+              file=sys.stderr)
+        return 2
+    while True:
+        slo_resp = _admin_request(ns.broker, {"kind": P.SLO})
+        if not slo_resp.get("ok"):
+            print(json.dumps(slo_resp, indent=2))
+            return 1
+        stats_resp = _admin_request(ns.broker, {"kind": P.STATS})
+        rows = _top_rows(slo_resp, stats_resp)
+        if ns.json:
+            print(json.dumps({"rows": rows,
+                              "fairness": slo_resp.get("fairness")},
+                             indent=2))
+        else:
+            if not ns.once:
+                print("\033[2J\033[H", end="")
+            print(render_top(
+                rows, enabled=slo_resp.get("enabled", False),
+                jain=(slo_resp.get("fairness") or {}).get("jain")))
+        if ns.once:
+            return 0
+        timemod.sleep(max(ns.interval, 0.2))
+
+
 def cmd_leases(ns) -> int:
     """`vtpu-smi leases`: chip-lease sidecar forensics — who holds (or
     last held) each chip lease, liveness, heartbeat age."""
@@ -328,7 +445,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
     ap.add_argument("cmd", nargs="?", default=None,
                     choices=("trace", "leases", "analyze", "mc",
-                             "metricsd", "chaos"),
+                             "metricsd", "chaos", "top"),
                     help="trace: flight-recorder spans (needs "
                          "--broker; --dump FILE exports Chrome-trace "
                          "JSON); leases: chip-lease sidecar forensics; "
@@ -337,7 +454,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "checking of quota/lease/crash-recovery "
                          "invariants (--smoke for the quick wiring "
                          "check); metricsd: the quota-virtualized "
-                         "view stock tpu-info sees (docs/METRICSD.md)")
+                         "view stock tpu-info sees (docs/METRICSD.md); "
+                         "top: live htop-style per-tenant SLO / "
+                         "fairness / blame table (needs --broker; "
+                         "--once for one snapshot, --fake for the CI "
+                         "wiring check — docs/OBSERVABILITY.md)")
     ap.add_argument("cmd_arg", nargs="?", default=None,
                     help="tenant name for `trace`; HOST:PORT for "
                          "`metricsd`")
@@ -355,6 +476,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--region", action="append", default=[],
                     help="explicit region file (repeatable)")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--once", action="store_true",
+                    help="with `top`: print one snapshot and exit")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="with `top`: refresh period, seconds")
+    ap.add_argument("--fake", action="store_true",
+                    help="with `top`: render a synthetic SLO plane "
+                         "(no broker; the analyze CI job's wiring "
+                         "check)")
     ap.add_argument("--smoke", action="store_true",
                     help="with `mc`/`chaos`: tiny-budget wiring check "
                          "(the analyze CI job's smokes)")
@@ -394,6 +523,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "--handover for zero-downtime upgrades)")
     ns = ap.parse_args(argv)
 
+    if ns.cmd == "top":
+        return cmd_top(ns)
     if ns.cmd == "leases":
         return cmd_leases(ns)
     if ns.cmd == "metricsd":

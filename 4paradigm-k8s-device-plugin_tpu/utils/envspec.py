@@ -207,6 +207,18 @@ ENV_FLAGS = {
     "VTPU_HOST_PID": ("native", False),
     "VTPU_WC_WINDOW_US": ("native", False),
     "VTPU_FOREIGN_LIVE_WINDOW_US": ("native", False),
+    # vtpu-slo (docs/OBSERVABILITY.md): the always-on per-tenant SLO /
+    # fairness / noisy-neighbor plane.
+    "VTPU_SLO": ("broker", True),
+    "VTPU_SLO_ALPHA": ("broker", True),
+    "VTPU_SLO_BUCKETS": ("broker", False),
+    "VTPU_SLO_WINDOWS": ("broker", False),
+    "VTPU_SLO_BUDGET": ("broker", True),
+    "VTPU_SLO_BURN_ALERT": ("broker", True),
+    "VTPU_SLO_JOURNAL_S": ("broker", False),
+    # Grant-declared objectives (Allocate env, relayed in HELLO).
+    "VTPU_SLO_TARGET_US": ("contract", True),
+    "VTPU_SLO_FLOOR_STEPS": ("contract", True),
     # vtpu-trace (docs/TRACING.md).
     "VTPU_TRACE": ("trace", True),
     "VTPU_TRACE_RING": ("trace", True),

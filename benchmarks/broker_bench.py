@@ -64,33 +64,66 @@ BASELINE_ENV = {
     "VTPU_RAW_FRAMES": "0",
     "VTPU_RATE_LEASE_US": "0",
     "VTPU_WAKE_BATCH": "1",
+    "VTPU_SLO": "0",
 }
 FAST_ENV = {
     "VTPU_EXEC_BATCH": "64",
     "VTPU_RAW_FRAMES": "1",
     "VTPU_RATE_LEASE_US": "20000",
     "VTPU_WAKE_BATCH": "32",
+    # The SLO plane ships ON (docs/OBSERVABILITY.md); the slo_overhead
+    # A/B cell isolates its cost and gates it < 3%.
+    "VTPU_SLO": "1",
 }
+# Always-on accounting budget: the SLO plane may cost at most this
+# fraction of unchained steps/s (acceptance criterion; gated by the
+# slo_overhead A/B pair in full_run).
+SLO_OVERHEAD_PCT_MAX = 3.0
 
 
 # ---------------------------------------------------------------------------
 # Scenario body (runs inside the per-cell subprocess)
 # ---------------------------------------------------------------------------
 
-def _percentile(xs, p):
-    if not xs:
-        return 0.0
-    xs = sorted(xs)
-    k = min(int(len(xs) * p), len(xs) - 1)
-    return xs[k]
+# The SHARED sketch implementation (runtime/slo.py): bench RTTs feed
+# the same mergeable DDSketch-style sketches the broker's SLO plane
+# uses, so bench and production report the same numbers.  The pre-PR
+# worktree cell predates the module — a minimal list-backed stand-in
+# with the same surface keeps the old-tree subprocess runnable.
+try:
+    from vtpu.runtime.slo import QuantileSketch
+except ImportError:  # pre-PR tree
+    class QuantileSketch:  # type: ignore[no-redef]
+        def __init__(self, alpha=0.02, max_buckets=None):
+            self.xs = []
+            self.count = 0
+
+        def add(self, v):
+            self.xs.append(float(v))
+            self.count += 1
+
+        def merge(self, other):
+            self.xs.extend(other.xs)
+            self.count += other.count
+            return self
+
+        def quantile(self, q):
+            if not self.xs:
+                return 0.0
+            xs = sorted(self.xs)
+            return xs[min(int(len(xs) * q), len(xs) - 1)]
+
+
+def _rtt_sketch():
+    return QuantileSketch(alpha=0.02, max_buckets=512)
 
 
 def _unchained_loop(client, exe_id, x_id, duration_s, window):
     """Pipelined per-step (repeats=1) executes: send up to ``window``
     outstanding, recv to stay level.  Returns (steps, elapsed_s,
-    rtt_us list).  The previous step's output rides the next step's
+    rtt_sketch).  The previous step's output rides the next step's
     ``free`` list — zero-round-trip GC, the serving-loop shape."""
-    rtts = []
+    rtts = _rtt_sketch()
     send_ts = {}
     seq = 0
     outstanding = []
@@ -109,14 +142,51 @@ def _unchained_loop(client, exe_id, x_id, duration_s, window):
         while len(outstanding) >= window:
             s = outstanding.pop(0)
             client.execute_recv()
-            rtts.append((time.monotonic() - send_ts.pop(s)) * 1e6)
+            rtts.add((time.monotonic() - send_ts.pop(s)) * 1e6)
             steps += 1
     while outstanding:
         s = outstanding.pop(0)
         client.execute_recv()
-        rtts.append((time.monotonic() - send_ts.pop(s)) * 1e6)
+        rtts.add((time.monotonic() - send_ts.pop(s)) * 1e6)
         steps += 1
     return steps, time.monotonic() - t0, rtts
+
+
+def _fairness_block(srv) -> dict:
+    """Per-tenant SLO attainment vs quota share, read from the BROKER'S
+    OWN sketches (runtime/slo.py) — the same plane production scrapes —
+    plus the blame-conservation audit the CI gate validates.  Returns
+    {"enabled": False} on a pre-SLO tree or with VTPU_SLO=0."""
+    state = getattr(srv, "state", None)
+    if state is None or not hasattr(state, "slo_report"):
+        return {"enabled": False}
+    rep = state.slo_report(admin=True)
+    if not rep.get("enabled"):
+        return {"enabled": False}
+    fair = rep.get("fairness") or {}
+    rows = {}
+    conservation_ok = True
+    for name, row in (rep.get("tenants") or {}).items():
+        blamed = sum(row.get("blame", {}).values())
+        wait = row.get("wait_us_total", 0.0)
+        if wait > 0 and abs(blamed - wait) > max(0.5, 1e-5 * wait):
+            conservation_ok = False
+        wins = row.get("windows") or {}
+        short = wins[min(wins, key=float)] if wins else {}
+        frow = (fair.get("tenants") or {}).get(name, {})
+        rows[name] = {
+            "attainment_pct": short.get("attainment_pct", 100.0),
+            "burn_rate": short.get("burn_rate", 0.0),
+            "e2e_p50_us": row["phases"]["e2e"]["p50_us"],
+            "e2e_p99_us": row["phases"]["e2e"]["p99_us"],
+            "quota_share": frow.get("quota_share"),
+            "attained_share": frow.get("attained_share"),
+            "ratio": frow.get("ratio"),
+            "top_blamer": row.get("top_blamer"),
+        }
+    return {"enabled": True, "tenants": rows,
+            "jain": fair.get("jain"),
+            "blame_conservation_ok": conservation_ok}
 
 
 def _mock_programs(srv) -> None:
@@ -183,7 +253,11 @@ def run_scenario(tenants: int, quick: bool, mock: bool) -> dict:
         wall = time.monotonic() - t0
 
         total_steps = sum(r[0] for r in results)
-        all_rtts = [v for r in results for v in r[2]]
+        # Mergeable sketches: per-tenant RTT sketches fold into one
+        # node view — the same merge the broker-side plane supports.
+        all_rtts = _rtt_sketch()
+        for r in results:
+            all_rtts.merge(r[2])
         steps_per_s = total_steps / wall
 
         # -- PUT/GET bandwidth (tenant 0, replacement semantics) --
@@ -202,17 +276,21 @@ def run_scenario(tenants: int, quick: bool, mock: bool) -> dict:
         get_s = time.monotonic() - t0
         gb = reps * nbytes / 1e9
 
-        return {
+        cell = {
             "tenants": tenants,
             "mock_pjrt": bool(mock),
             "duration_s": round(wall, 3),
             "steps": total_steps,
             "unchained_steps_per_s": round(steps_per_s, 1),
-            "rtt_p50_us": round(_percentile(all_rtts, 0.50), 1),
-            "rtt_p99_us": round(_percentile(all_rtts, 0.99), 1),
+            "rtt_p50_us": round(all_rtts.quantile(0.50), 1),
+            "rtt_p99_us": round(all_rtts.quantile(0.99), 1),
             "put_gbps": round(gb / put_s, 3),
             "get_gbps": round(gb / get_s, 3),
         }
+        fairness = _fairness_block(srv)
+        if fairness is not None:
+            cell["fairness"] = fairness
+        return cell
     finally:
         for c, _, _ in clients:
             try:
@@ -251,10 +329,11 @@ def run_priority_scenario(quick: bool) -> dict:
         lo_exe = lo.compile(lambda a: a * 1.0001 + 1.0, [x])
         _mock_programs(srv)
 
-        def hi_lat(dur: float) -> list:
+        def hi_lat(dur: float):
             """Synchronous cadence: one step in flight, per-step RTT —
-            the latency a serving tenant actually observes."""
-            rtts = []
+            the latency a serving tenant actually observes.  Collected
+            into the shared sketch (runtime/slo.py)."""
+            rtts = _rtt_sketch()
             t_end = time.monotonic() + dur
             seq = 0
             while time.monotonic() < t_end:
@@ -262,7 +341,7 @@ def run_priority_scenario(quick: bool) -> dict:
                 hi.execute_send_ids(hi_exe.id, ["x0"],
                                     [f"h{seq & 63}"])
                 hi.recv_reply()
-                rtts.append((time.monotonic() - t0) * 1e6)
+                rtts.add((time.monotonic() - t0) * 1e6)
                 seq += 1
             return rtts
 
@@ -281,19 +360,37 @@ def run_priority_scenario(quick: bool) -> dict:
         contended = hi_lat(duration)
         th.join()
         steps, wall, _ = lo_stats["res"]
-        p50s, p99s = (_percentile(solo, 0.50), _percentile(solo, 0.99))
-        p50c, p99c = (_percentile(contended, 0.50),
-                      _percentile(contended, 0.99))
-        return {
+        p50s, p99s = (solo.quantile(0.50), solo.quantile(0.99))
+        p50c, p99c = (contended.quantile(0.50),
+                      contended.quantile(0.99))
+        out = {
             "hi_priority": 0, "lo_priority": 1,
             "hi_solo_p50_us": round(p50s, 1),
             "hi_solo_p99_us": round(p99s, 1),
             "hi_contended_p50_us": round(p50c, 1),
             "hi_contended_p99_us": round(p99c, 1),
-            "hi_contended_steps": len(contended),
+            "hi_contended_steps": contended.count,
             "lo_steps_per_s": round(steps / max(wall, 1e-6), 1),
             "p99_inflation": round(p99c / max(p99s, 1e-9), 2),
         }
+        # The BROKER'S OWN sketches (runtime/slo.py): production and
+        # bench report the same numbers from the same plane — the
+        # broker-side view also splits phases, naming WHERE the
+        # contended latency went (queue vs device).
+        state = getattr(srv, "state", None)
+        if state is not None and hasattr(state, "slo_report"):
+            rep = state.slo_report(admin=True)
+            hi_row = (rep.get("tenants") or {}).get("prio-hi")
+            if rep.get("enabled") and hi_row:
+                ph = hi_row["phases"]
+                out["broker_slo"] = {
+                    "hi_e2e_p50_us": ph["e2e"]["p50_us"],
+                    "hi_e2e_p99_us": ph["e2e"]["p99_us"],
+                    "hi_queue_p99_us": ph["queue"]["p99_us"],
+                    "hi_device_p99_us": ph["device"]["p99_us"],
+                    "hi_top_blamer": hi_row.get("top_blamer"),
+                }
+        return out
     finally:
         for c in (hi, lo):
             if c is not None:
@@ -462,7 +559,8 @@ def _cell_env(mode: str) -> dict:
 
 def run_cell(mode: str, tenants: int, quick: bool,
              mock: bool = True, tree: str = None,
-             kind: str = "steps", crash_at: float = 0.5) -> dict:
+             kind: str = "steps", crash_at: float = 0.5,
+             extra_env: dict = None) -> dict:
     """One (mode, tenants) measurement in a fresh subprocess.
 
     ``tree`` points the subprocess at a different source tree (the
@@ -475,6 +573,8 @@ def run_cell(mode: str, tenants: int, quick: bool,
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = os.path.abspath(__file__)
     env = _cell_env(mode)
+    if extra_env:
+        env.update(extra_env)
     if tree is not None:
         script = os.path.join(tree, "benchmarks",
                               os.path.basename(__file__))
@@ -595,6 +695,37 @@ def full_run(quick: bool, out_path: str, prepr_ref: str,
             print(f"[broker-bench] {mode} {tenants}t ...",
                   file=sys.stderr)
             _record(mode, tenants, run_cell(mode, tenants, quick))
+    # SLO-plane overhead A/B (docs/OBSERVABILITY.md acceptance): the
+    # always-on accounting may cost < SLO_OVERHEAD_PCT_MAX of unchained
+    # steps/s.  Median of 3 INTERLEAVED cell pairs: single quick cells
+    # on a shared runner swing by more than the budget itself, so a
+    # one-shot A/B would gate machine noise, not the plane.
+    print("[broker-bench] slo overhead A/B (fast 1t, VTPU_SLO=0 vs 1, "
+          "median of 3 interleaved pairs) ...", file=sys.stderr)
+    off_sps_all, on_sps_all = [], []
+    for _ in range(3):
+        off_sps_all.append(run_cell(
+            "fast", 1, quick,
+            extra_env={"VTPU_SLO": "0"})["unchained_steps_per_s"])
+        on_sps_all.append(run_cell(
+            "fast", 1, quick,
+            extra_env={"VTPU_SLO": "1"})["unchained_steps_per_s"])
+    off_med = sorted(off_sps_all)[1]
+    on_med = sorted(on_sps_all)[1]
+    overhead_pct = max((off_med - on_med) / max(off_med, 1e-9) * 100.0,
+                       0.0)
+    report["slo_overhead"] = {
+        "off_steps_per_s": off_sps_all,
+        "on_steps_per_s": on_sps_all,
+        "off_median": off_med,
+        "on_median": on_med,
+        "overhead_pct": round(overhead_pct, 2),
+        "required_max_pct": SLO_OVERHEAD_PCT_MAX,
+        "pass": overhead_pct <= SLO_OVERHEAD_PCT_MAX,
+    }
+    print(f"[broker-bench]   slo overhead {overhead_pct:.2f}% "
+          f"(median off {off_med} vs on {on_med} steps/s; gate "
+          f"<= {SLO_OVERHEAD_PCT_MAX}%)", file=sys.stderr)
     # Context: real-execution (no mock) fast cell, un-gated.
     print("[broker-bench] fast 1t (real exec, context) ...",
           file=sys.stderr)
@@ -658,6 +789,8 @@ def full_run(quick: bool, out_path: str, prepr_ref: str,
         "observed_ratio": worst,
         "pass": worst >= GATE_FRESH_RATIO,
     }
+    ok = report["gate"]["pass"] and report["slo_overhead"]["pass"] \
+        and _fairness_gate(report["scenarios"]["fast"]["tenants_4"])
     with open(out_path, "w") as fh:
         json.dump(report, fh, indent=2)
         fh.write("\n")
@@ -665,8 +798,43 @@ def full_run(quick: bool, out_path: str, prepr_ref: str,
                       "value": worst, "unit": "ratio",
                       "baseline": gate_base,
                       "pass": report["gate"]["pass"],
+                      "slo_overhead_pct":
+                          report["slo_overhead"]["overhead_pct"],
                       "out": out_path}))
-    return 0 if report["gate"]["pass"] else 1
+    return 0 if ok else 1
+
+
+def _fairness_gate(cell: dict, log=print) -> bool:
+    """Regression-gate a cell's fairness block: the broker's own SLO
+    plane must be on, blame must conserve, and every share/ratio must
+    be well-formed.  (The CI --check runs this on a fresh 4-tenant
+    cell so a broken plane fails the bench job, not just dashboards.)"""
+    fair = cell.get("fairness")
+    if not fair or not fair.get("enabled"):
+        log("[broker-bench] fairness gate: SLO plane disabled or "
+            "block missing", file=sys.stderr)
+        return False
+    if not fair.get("blame_conservation_ok"):
+        log("[broker-bench] fairness gate: blame does not sum to "
+            "measured wait", file=sys.stderr)
+        return False
+    jain = fair.get("jain")
+    if jain is None or not (0.0 < jain <= 1.0 + 1e-9):
+        log(f"[broker-bench] fairness gate: bad jain {jain}",
+            file=sys.stderr)
+        return False
+    for name, row in fair.get("tenants", {}).items():
+        att = row.get("attainment_pct")
+        share = row.get("attained_share")
+        if att is None or not (0.0 <= att <= 100.0):
+            log(f"[broker-bench] fairness gate: {name} attainment "
+                f"{att} out of range", file=sys.stderr)
+            return False
+        if share is None or not (0.0 <= share <= 1.0 + 1e-9):
+            log(f"[broker-bench] fairness gate: {name} attained share "
+                f"{share} out of range", file=sys.stderr)
+            return False
+    return True
 
 
 def check_run(quick: bool, committed_path: str) -> int:
@@ -683,6 +851,11 @@ def check_run(quick: bool, committed_path: str) -> int:
     now = cell["unchained_steps_per_s"]
     ratio = now / max(base, 1e-9)
     ok = ratio >= GATE_CHECK_RATIO
+    # Fairness-block regression gate (docs/OBSERVABILITY.md): a fresh
+    # 4-tenant cell must produce a well-formed fairness report from
+    # the broker's OWN sketches — conservation, shares, Jain.
+    fcell = run_cell("fast", 4, quick)
+    fair_ok = _fairness_gate(fcell)
     print(json.dumps({
         "metric": "broker_bench_check", "unit": "ratio",
         "committed_baseline_mode": base_mode,
@@ -690,8 +863,10 @@ def check_run(quick: bool, committed_path: str) -> int:
         "current_fast_steps_per_s": now,
         "value": round(ratio, 2),
         "required": GATE_CHECK_RATIO, "pass": ok,
+        "fairness_gate_pass": fair_ok,
+        "fairness": fcell.get("fairness"),
     }))
-    return 0 if ok else 1
+    return 0 if (ok and fair_ok) else 1
 
 
 def main() -> int:

@@ -541,9 +541,10 @@ def test_analyze_retry_safety_clean_tree():
 def test_analyze_catches_unclassified_verb():
     src = _read("runtime/protocol.py").replace(
         "IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, "
-        "TRACE,\n                    SUSPEND, RESUME, RESIZE, DRAIN)",
+        "TRACE,\n                    SLO, SUSPEND, RESUME, RESIZE, "
+        "DRAIN)",
         "IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, "
-        "TRACE,\n                    SUSPEND, RESUME, DRAIN)")
+        "TRACE,\n                    SLO, SUSPEND, RESUME, DRAIN)")
     assert any("RESIZE is served but unclassified" in str(f)
                for f in _verb_findings(src))
 

@@ -1278,7 +1278,11 @@ def test_admin_socket_hardened(broker):
 
     # Foreign-uid peer (simulated by shrinking the allowlist): refused
     # before any verb is processed.
-    orig = server_mod.AdminSession._allowed_uids
+    # __dict__ access keeps the staticmethod WRAPPER: restoring via
+    # plain attribute access would reinstall the bare function, and
+    # every later admin call in this process would explode with
+    # "takes 0 positional arguments but 1 was given".
+    orig = server_mod.AdminSession.__dict__["_allowed_uids"]
     server_mod.AdminSession._allowed_uids = staticmethod(
         lambda: {2**31 - 5})
     try:

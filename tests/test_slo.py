@@ -1,0 +1,701 @@
+"""vtpu-slo — the always-on SLO / fairness / noisy-neighbor plane
+(runtime/slo.py, docs/OBSERVABILITY.md).
+
+Coverage per the acceptance list: sketch accuracy vs exact percentiles
+(rank error bound), merge associativity, bucket-cap collapse,
+serialization, staged-vs-direct ingestion equivalence, blame-matrix
+conservation (blamed wait sums to measured wait), burn rates and
+throughput floors, the 64-tenant heterogeneous fairness smoke, SLO-verb
+tenant/admin scoping on a real broker, metricsd's virtualized-SLO
+scrape, `vtpu-smi top --once`, journal resume without double-counting,
+and seeded-violation tests proving the verbs/wirefields analyzers police
+the new verb."""
+
+import json
+import os
+import random
+import socket as socketmod
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from vtpu.runtime import protocol as P  # noqa: E402
+from vtpu.runtime import slo  # noqa: E402
+from vtpu.runtime.client import RuntimeClient  # noqa: E402
+from vtpu.runtime.server import make_server  # noqa: E402
+
+MB = 2**20
+
+
+# ---------------------------------------------------------------------------
+# QuantileSketch
+# ---------------------------------------------------------------------------
+
+def test_sketch_rank_error_bound():
+    """DDSketch contract: any reported quantile is within relative
+    error alpha of the exact value (no collapse pressure)."""
+    rng = random.Random(11)
+    xs = [rng.lognormvariate(7.0, 1.2) for _ in range(20_000)]
+    sk = slo.QuantileSketch(alpha=0.02, max_buckets=4096)
+    for v in xs:
+        sk.add(v)
+    xs.sort()
+    for q in (0.5, 0.9, 0.99, 0.999):
+        exact = xs[min(int(q * (len(xs) - 1)), len(xs) - 1)]
+        got = sk.quantile(q)
+        assert abs(got - exact) / exact <= 0.025, (q, got, exact)
+    assert sk.count == len(xs)
+    assert abs(sk.sum - sum(xs)) < 1e-6 * sum(xs)
+
+
+def test_sketch_merge_associativity():
+    rng = random.Random(5)
+    sks = []
+    for seed in range(3):
+        sk = slo.QuantileSketch(alpha=0.02, max_buckets=512)
+        for _ in range(3000):
+            sk.add(rng.lognormvariate(6.0, 1.0))
+        sks.append(sk)
+
+    def clone(s):
+        return slo.QuantileSketch.from_dict(s.to_dict(),
+                                            max_buckets=512)
+
+    left = clone(sks[0]).merge(clone(sks[1])).merge(clone(sks[2]))
+    right = clone(sks[0]).merge(clone(sks[1]).merge(clone(sks[2])))
+    assert left.buckets == right.buckets
+    assert left.count == right.count == sum(s.count for s in sks)
+    assert abs(left.sum - right.sum) < 1e-6
+    for q in (0.5, 0.99):
+        assert left.quantile(q) == right.quantile(q)
+
+
+def test_sketch_bucket_cap_collapses_low_end():
+    """Hard memory cap: past max_buckets the LOWEST buckets fold —
+    counts stay exact and the tail quantile keeps its accuracy."""
+    sk = slo.QuantileSketch(alpha=0.02, max_buckets=32)
+    rng = random.Random(3)
+    vals = [10.0 ** rng.uniform(0, 7) for _ in range(5000)]
+    for v in vals:
+        sk.add(v)
+    assert len(sk.buckets) <= 32
+    assert sk.count == 5000
+    vals.sort()
+    exact99 = vals[int(0.99 * (len(vals) - 1))]
+    assert abs(sk.quantile(0.99) - exact99) / exact99 <= 0.05
+    # Quantiles stay monotone even with collapsed low buckets.
+    qs = [sk.quantile(q) for q in (0.1, 0.5, 0.9, 0.99)]
+    assert qs == sorted(qs)
+
+
+def test_sketch_serialization_roundtrip_json_safe():
+    sk = slo.QuantileSketch(alpha=0.02, max_buckets=128)
+    for v in (0.0, 1.5, 1000.0, 2.5e6):
+        sk.add(v)
+    d = json.loads(json.dumps(sk.to_dict()))  # must be JSON-safe
+    back = slo.QuantileSketch.from_dict(d)
+    assert back.count == sk.count
+    assert back.zero == sk.zero
+    assert back.buckets == sk.buckets
+    assert back.quantile(0.5) == sk.quantile(0.5)
+
+
+# ---------------------------------------------------------------------------
+# SloPlane: blame conservation, burn rates, floors, staged ingestion
+# ---------------------------------------------------------------------------
+
+def _plane(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("windows", (30.0, 300.0))
+    kw.setdefault("budget", 0.01)
+    kw.setdefault("burn_alert", 10.0)
+    return slo.SloPlane(**kw)
+
+
+def test_blame_conservation_and_matrix():
+    plane = _plane()
+    plane.ensure_tenant("victim", quota_pct=50)
+    fed_wait = 0.0
+    for i in range(200):
+        q, b = 500.0 + i, 50.0
+        fed_wait += q + b
+        plane.record("victim", queue_us=q, bucket_us=b,
+                     device_us=100.0, total_us=q + b + 100.0,
+                     wait_weights={"heavy": 3.0, "light": 1.0},
+                     now=1000.0 + i * 0.01)
+    rep = plane.report(admin=True, quota_pcts={"victim": 50})
+    row = rep["tenants"]["victim"]
+    blamed = sum(row["blame"].values())
+    assert abs(blamed - row["wait_us_total"]) <= 1e-6 * blamed
+    assert abs(row["wait_us_total"] - fed_wait) <= 1e-6 * fed_wait
+    # 3:1 split by the weights.
+    assert row["blame"]["heavy"] == pytest.approx(
+        3 * row["blame"]["light"], rel=1e-9)
+    assert row["top_blamer"] == "heavy"
+    assert rep["matrix"]["victim"]["heavy"] == row["blame"]["heavy"]
+
+
+def test_blame_self_when_no_co_tenant_activity():
+    plane = _plane()
+    plane.record("solo", queue_us=100.0, bucket_us=0.0,
+                 device_us=10.0, total_us=110.0, now=1000.0)
+    rep = plane.report(admin=True, quota_pcts={})
+    assert rep["tenants"]["solo"]["blame"] == {slo.SELF_BLAME: 100.0}
+
+
+def test_staged_ingestion_matches_direct_record():
+    """The metering thread's bulk path (stage_batch -> ingest) must be
+    count/sum/quantile-equivalent to per-item record calls."""
+    direct = _plane()
+    staged = _plane()
+    t_obs = 5000.0
+    flat = []
+    for i in range(64):
+        dt_enq = 0.010 + i * 1e-4   # enqueue 10ms+ before observation
+        bucket_us = 20.0
+        dt_disp = 0.002 + i * 1e-5  # dispatched 2ms+ before observation
+        flat.extend((dt_enq, bucket_us, dt_disp, 1))
+        total = dt_enq * 1e6
+        dev = dt_disp * 1e6
+        queue = (dt_enq - dt_disp) * 1e6 - bucket_us
+        direct.record("t", queue_us=queue, bucket_us=bucket_us,
+                      device_us=dev, total_us=total, now=t_obs)
+    staged.stage_batch({"t": flat}, None, 64)
+    rep_d = direct.report(admin=True, quota_pcts={})["tenants"]["t"]
+    rep_s = staged.report(admin=True, quota_pcts={})["tenants"]["t"]
+    for phase in slo.PHASES:
+        assert rep_s["phases"][phase]["count"] == 64
+        assert rep_s["phases"][phase]["sum_us"] == pytest.approx(
+            rep_d["phases"][phase]["sum_us"], rel=1e-6)
+        assert rep_s["phases"][phase]["p99_us"] == pytest.approx(
+            rep_d["phases"][phase]["p99_us"], rel=1e-9)
+    assert rep_s["wait_us_total"] == pytest.approx(
+        rep_d["wait_us_total"], rel=1e-6)
+    blamed = sum(rep_s["blame"].values())
+    assert abs(blamed - rep_s["wait_us_total"]) <= 1e-6 * blamed
+
+
+def test_staged_ingestion_is_lazy_but_read_consistent():
+    plane = _plane()
+    plane.stage_batch({"t": [0.01, 0.0, 0.001, 1]}, None, 1)
+    # Nothing ingested yet...
+    assert plane._pending_n == 1
+    # ...but any read folds the pending batches first.
+    rep = plane.report(admin=True, quota_pcts={})
+    assert rep["tenants"]["t"]["phases"]["e2e"]["count"] == 1
+    assert plane._pending_n == 0
+
+
+def test_burn_rate_fires_for_starved_tenant():
+    plane = _plane(budget=0.01, burn_alert=10.0)
+    plane.ensure_tenant("starved", quota_pct=10, target_us=1000.0)
+    for i in range(100):
+        plane.record("starved", queue_us=50_000.0, bucket_us=0.0,
+                     device_us=10.0, total_us=50_010.0,
+                     now=1000.0 + i * 0.1)
+    rep = plane.report(admin=True, quota_pcts={"starved": 10},
+                       now=1011.0)
+    row = rep["tenants"]["starved"]
+    assert row["burn_alert"] is True
+    short = row["windows"]["30"]
+    assert short["burn_rate"] >= 10.0
+    assert short["attainment_pct"] == 0.0
+
+
+def test_throughput_floor_violation_flagged():
+    plane = _plane()
+    plane.ensure_tenant("slowpoke", quota_pct=50,
+                        floor_steps_s=100.0)
+    for i in range(30):  # 30 steps over 30 s << 100 steps/s floor
+        plane.record("slowpoke", queue_us=1.0, bucket_us=0.0,
+                     device_us=10.0, total_us=11.0, steps=1,
+                     now=1000.0 + i)
+    rep = plane.report(admin=True, quota_pcts={}, now=1030.0)
+    assert rep["tenants"]["slowpoke"]["windows"]["30"]["floor_ok"] \
+        is False
+
+
+def test_explicit_objective_wins_and_resize_refreshes_default():
+    plane = _plane()
+    plane.ensure_tenant("a", quota_pct=50, target_us=123.0)
+    plane.ensure_tenant("b", quota_pct=50)
+    assert plane._tenants["a"].target_us == 123.0
+    b_default = plane._tenants["b"].target_us
+    assert b_default == slo.default_target_us(50)
+    plane.set_quota_pct("a", 25)
+    plane.set_quota_pct("b", 25)
+    assert plane._tenants["a"].target_us == 123.0  # explicit stays
+    assert plane._tenants["b"].target_us == slo.default_target_us(25)
+
+
+def test_disabled_plane_is_inert():
+    plane = slo.SloPlane(enabled=False)
+    plane.ensure_tenant("x", quota_pct=50)
+    plane.record("x", queue_us=1.0, bucket_us=1.0, device_us=1.0,
+                 total_us=3.0)
+    plane.stage_batch({"x": [0.1, 0.0, 0.05, 1]}, None, 1)
+    rep = plane.report(admin=True, quota_pcts={})
+    assert rep["enabled"] is False
+    assert rep["tenants"] == {}
+    assert plane.export_state("x") is None
+    assert plane.journal_due() is False
+
+
+def test_fairness_smoke_64_tenants():
+    """The acceptance scenario: 64 heterogeneous tenants, blamed wait
+    sums to measured wait everywhere, the deliberately-starved tenant's
+    burn rate fires, Jain index well-formed."""
+    rep = slo.fairness_smoke(n_tenants=64, seed=7)
+    assert rep["ok"], rep["failures"]
+    assert rep["starved_burn_alert"] is True
+    assert 0.0 < rep["jain"] <= 1.0
+    assert rep["starved_ratio"] < 0.5
+
+
+def test_plane_restore_roundtrip():
+    plane = _plane()
+    for i in range(50):
+        plane.record("t", queue_us=100.0, bucket_us=10.0,
+                     device_us=50.0, total_us=160.0,
+                     wait_weights={"n": 1.0}, now=1000.0 + i * 0.01)
+    state = json.loads(json.dumps(plane.export_state("t")))
+    other = _plane()
+    other.restore("t", state)
+    a = plane.report(admin=True, quota_pcts={})["tenants"]["t"]
+    b = other.report(admin=True, quota_pcts={})["tenants"]["t"]
+    assert b["phases"]["e2e"]["count"] == 50
+    assert b["phases"] == a["phases"]
+    assert b["blame"] == a["blame"]
+    assert b["wait_us_total"] == a["wait_us_total"]
+
+
+# ---------------------------------------------------------------------------
+# Live broker: verb scoping, always-on accounting, journal resume
+# ---------------------------------------------------------------------------
+
+def _broker(tmp_path, name="slo", journal_dir=None, core_limit=50):
+    sock = str(tmp_path / f"{name}.sock")
+    srv = make_server(sock, hbm_limit=32 * MB, core_limit=core_limit,
+                      region_path=str(tmp_path / f"{name}.shr"),
+                      journal_dir=journal_dir)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, sock
+
+
+def _drive(client, steps=40):
+    x = np.random.rand(128).astype(np.float32)
+    client.put(x, "x0")
+    exe = client.compile(lambda a: a * 1.0001 + 1.0, [x])
+    for i in range(steps):
+        client.execute_send_ids(exe.id, ["x0"], [f"y{i % 8}"])
+    for _ in range(steps):
+        client.recv_reply()
+    client.stats()  # quiesce: every dispatched item retires
+    return exe
+
+
+def test_slo_verb_scoping_tenant_vs_admin(tmp_path):
+    from vtpu.tools.vtpu_smi import _admin_request
+    srv, sock = _broker(tmp_path)
+    c1 = c2 = None
+    try:
+        c1 = RuntimeClient(sock, tenant="alice")
+        c2 = RuntimeClient(sock, tenant="bob")
+        _drive(c1)
+        _drive(c2)
+        # Bound tenant: exactly its own row, never the matrix.
+        rep = c1.slo()
+        assert rep["enabled"] is True
+        assert set(rep["tenants"]) == {"alice"}
+        row = rep["tenants"]["alice"]
+        assert row["phases"]["e2e"]["count"] == 40
+        assert row["phases"]["e2e"]["p50_us"] > 0
+        # Conservation on the live broker.
+        blamed = sum(row["blame"].values())
+        assert blamed == pytest.approx(row["wait_us_total"],
+                                       rel=1e-4, abs=1.0)
+        # A bound connection cannot widen its view by naming a
+        # neighbour: the tenant field is ignored.
+        r = c1._rpc({"kind": P.SLO, "tenant": "bob"})
+        assert set(r.get("tenants", {})) == {"alice"}
+        assert "matrix" not in r
+        # Admin: every row + blame matrix + fairness.
+        arep = _admin_request(sock, {"kind": P.SLO})
+        assert arep["ok"]
+        assert set(arep["tenants"]) == {"alice", "bob"}
+        assert set(arep["matrix"]) == {"alice", "bob"}
+        assert 0.0 < arep["fairness"]["jain"] <= 1.0
+    finally:
+        for c in (c1, c2):
+            if c is not None:
+                c.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_slo_verb_bind_free_probe(tmp_path):
+    """SLO answers without HELLO (no slot, no chip claim): a bare probe
+    sees only the enabled flag; naming a tenant returns that row (the
+    metricsd scrape path) but never the matrix."""
+    srv, sock = _broker(tmp_path)
+    c = None
+    try:
+        c = RuntimeClient(sock, tenant="carol")
+        _drive(c, steps=20)
+        s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        s.connect(sock)
+        P.send_msg(s, {"kind": P.SLO})
+        r = P.recv_msg(s)
+        assert r["ok"] and r["enabled"] and r["tenants"] == {}
+        P.send_msg(s, {"kind": P.SLO, "tenant": "carol"})
+        r = P.recv_msg(s)
+        assert set(r["tenants"]) == {"carol"}
+        assert r["tenants"]["carol"]["phases"]["e2e"]["count"] == 20
+        assert "matrix" not in r
+        s.close()
+        # The probe claimed no slot: the broker still has one tenant.
+        assert r["ok"]
+    finally:
+        if c is not None:
+            c.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_slo_disabled_broker_answers_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("VTPU_SLO", "0")
+    srv, sock = _broker(tmp_path, name="off")
+    c = None
+    try:
+        c = RuntimeClient(sock, tenant="dora")
+        _drive(c, steps=10)
+        rep = c.slo()
+        assert rep["enabled"] is False
+        assert rep["tenants"] == {}
+    finally:
+        if c is not None:
+            c.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_metrics_server_always_emits_slo_histogram(tmp_path):
+    """The satellite fix: vtpu_tenant_latency_us is emitted for every
+    known tenant with sketch-derived buckets even with VTPU_TRACE off,
+    plus fairness/burn/blame gauges."""
+    import urllib.request
+
+    from vtpu.tools import metrics_server
+    srv, sock = _broker(tmp_path)
+    c = None
+    msrv = None
+    try:
+        c = RuntimeClient(sock, tenant="scraped")
+        _drive(c)
+        msrv = metrics_server.make_server(0, brokers=[sock])
+        port = msrv.server_address[1]
+        threading.Thread(target=msrv.serve_forever,
+                         daemon=True).start()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            text = resp.read().decode()
+        assert 'vtpu_tenant_latency_us_bucket{broker=' in text
+        assert 'le="+Inf"} 40' in text
+        assert "vtpu_tenant_latency_us_count" in text
+        assert "vtpu_tenant_slo_phase_us" in text
+        assert "vtpu_tenant_slo_burn_rate" in text
+        assert "vtpu_tenant_slo_target_us" in text
+        assert "vtpu_tenant_blame_us_total" in text
+        assert "vtpu_tenant_fairness_ratio" in text
+        assert "vtpu_broker_fairness_jain" in text
+    finally:
+        if msrv is not None:
+            msrv.shutdown()
+            msrv.server_close()
+        if c is not None:
+            c.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_metrics_server_exemplars_with_trace(tmp_path, monkeypatch):
+    """With tracing on, histogram buckets carry trace-id exemplars
+    linking into the flight recorder."""
+    import urllib.request
+
+    from vtpu.tools import metrics_server
+    monkeypatch.setenv("VTPU_TRACE", "1")
+    srv, sock = _broker(tmp_path, name="tr")
+    c = None
+    msrv = None
+    try:
+        c = RuntimeClient(sock, tenant="traced", trace=True)
+        _drive(c, steps=30)
+        msrv = metrics_server.make_server(0, brokers=[sock])
+        port = msrv.server_address[1]
+        threading.Thread(target=msrv.serve_forever,
+                         daemon=True).start()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            text = resp.read().decode()
+        ex_lines = [ln for ln in text.splitlines()
+                    if "latency_us_bucket" in ln and "trace_id=" in ln]
+        assert ex_lines, "no exemplar lines in scrape"
+        assert ' # {trace_id="' in ex_lines[0]
+    finally:
+        if msrv is not None:
+            msrv.shutdown()
+            msrv.server_close()
+        if c is not None:
+            c.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_metricsd_virtualized_slo_scrape():
+    """A stock-protocol scrape of metricsd sees the tenant's OWN SLO
+    (attainment of its objective, e2e p99) per granted ordinal."""
+    grpc = pytest.importorskip("grpc")
+    from vtpu.metricsd import server as msrv_mod
+    from vtpu.metricsd.backend import FakeBackend
+    from vtpu.proto import tpu_metrics_grpc as mrpc
+    from vtpu.proto import tpu_metrics_pb2 as mpb
+    backend = FakeBackend()
+    server, _, port = msrv_mod.make_server(0, backend)
+    try:
+        ch = grpc.insecure_channel(f"localhost:{port}")
+        stub = mrpc.RuntimeMetricServiceStub(ch)
+        att = stub.GetRuntimeMetric(mpb.MetricRequest(
+            metric_name=msrv_mod.METRIC_SLO_ATTAINMENT), timeout=5)
+        p99 = stub.GetRuntimeMetric(mpb.MetricRequest(
+            metric_name=msrv_mod.METRIC_SLO_P99), timeout=5)
+        listed = stub.ListSupportedMetrics(
+            mpb.ListSupportedMetricsRequest(), timeout=5)
+        ch.close()
+        assert len(att.metric.metrics) == backend.n_devices
+        assert all(m.gauge.as_double == pytest.approx(95.0)
+                   for m in att.metric.metrics)
+        assert all(m.gauge.as_double == pytest.approx(42_000.0)
+                   for m in p99.metric.metrics)
+        names = {sm.metric_name for sm in listed.supported_metric}
+        assert msrv_mod.METRIC_SLO_ATTAINMENT in names
+    finally:
+        server.stop(grace=0.5)
+
+
+def test_metricsd_region_backend_slo_reads_broker(tmp_path):
+    """RegionBackend's bind-free SLO read: names its tenant on the MAIN
+    socket, no HELLO, gets its row back as a summary."""
+    from vtpu.metricsd.backend import RegionBackend
+    srv, sock = _broker(tmp_path, name="mb")
+    c = None
+    try:
+        c = RuntimeClient(sock, tenant="podtenant")
+        _drive(c, steps=25)
+        be = RegionBackend(region_path=str(tmp_path / "absent"),
+                           broker_socket=sock, tenant="podtenant")
+        s = be.slo_summary()
+        assert s is not None
+        assert 0.0 <= s["attainment_pct"] <= 100.0
+        assert s["p99_us"] > 0.0
+        assert s["target_us"] > 0.0
+    finally:
+        if c is not None:
+            c.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_vtpu_smi_top_once_fake(capsys):
+    from vtpu.tools import vtpu_smi
+    rc = vtpu_smi.main(["top", "--once", "--fake"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "vtpu-smi top" in out
+    assert "TENANT" in out and "ATTAIN%" in out and "TOP BLAMER" in out
+    assert "fake-0" in out
+
+
+def test_vtpu_smi_top_once_live_broker(tmp_path, capsys):
+    from vtpu.tools import vtpu_smi
+    srv, sock = _broker(tmp_path, name="top")
+    c = None
+    try:
+        c = RuntimeClient(sock, tenant="topt")
+        _drive(c, steps=15)
+        rc = vtpu_smi.main(["top", "--once", "--broker", sock])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "topt" in out
+    finally:
+        if c is not None:
+            c.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_slo_sketches_survive_resume_without_double_count(tmp_path):
+    """Kill-style restart: the successor restores the journaled
+    sketches; in-flight-at-crash requests are in NEITHER epoch's
+    counts (no double count), and post-resume traffic adds on top."""
+    os.environ["VTPU_SLO_JOURNAL_S"] = "0.01"
+    try:
+        jdir = str(tmp_path / "journal")
+        sock1 = str(tmp_path / "b1.sock")
+        srv1 = make_server(sock1, hbm_limit=32 * MB, core_limit=50,
+                           region_path=str(tmp_path / "b1.shr"),
+                           journal_dir=jdir)
+        threading.Thread(target=srv1.serve_forever,
+                         daemon=True).start()
+        c = RuntimeClient(sock1, tenant="phoenix")
+        ep1 = c.epoch
+        _drive(c, steps=30)
+        srv1.state.journal_tick()  # slo records + any due compaction
+        pre = srv1.state.slo_report(admin=True)
+        pre_n = pre["tenants"]["phoenix"]["phases"]["e2e"]["count"]
+        assert pre_n == 30
+        # In-process 'kill -9' (test_journal.py pattern): stop serving
+        # and detach the journal so no graceful close records land.
+        srv1.shutdown()
+        srv1.server_close()
+        srv1.state.journal.close()
+        srv1.state.journal = None
+        c.close()
+
+        sock2 = str(tmp_path / "b2.sock")
+        srv2 = make_server(sock2, hbm_limit=32 * MB, core_limit=50,
+                           region_path=str(tmp_path / "b2.shr"),
+                           journal_dir=jdir)
+        threading.Thread(target=srv2.serve_forever,
+                         daemon=True).start()
+        try:
+            # Restored BEFORE resume: the parked tenant's history is
+            # already back (recovery-time restore).
+            rep = srv2.state.slo_report(admin=True)
+            assert rep["tenants"]["phoenix"]["phases"]["e2e"][
+                "count"] == pre_n
+            # Resume + new traffic adds on top, exactly once.
+            s = socketmod.socket(socketmod.AF_UNIX,
+                                 socketmod.SOCK_STREAM)
+            s.connect(sock2)
+            P.send_msg(s, {"kind": P.HELLO, "tenant": "phoenix",
+                           "resume_epoch": ep1})
+            r = P.recv_msg(s)
+            assert r["ok"] and r["resumed"] is True, r
+            P.send_msg(s, {"kind": P.SLO})
+            r = P.recv_msg(s)
+            assert r["tenants"]["phoenix"]["phases"]["e2e"][
+                "count"] == pre_n  # nothing double-counted by resume
+            s.close()
+        finally:
+            srv2.shutdown()
+            srv2.server_close()
+    finally:
+        os.environ.pop("VTPU_SLO_JOURNAL_S", None)
+
+
+# ---------------------------------------------------------------------------
+# Analyzer coverage for the SLO verb (seeded violations + clean tree)
+# ---------------------------------------------------------------------------
+
+def _tree_sources():
+    from vtpu.tools.analyze import verbs as verbs_mod
+    root = os.path.join(REPO_ROOT)
+    out = {}
+    for rel in (verbs_mod.PROTOCOL, verbs_mod.SERVER,
+                verbs_mod.CLIENT, verbs_mod.SMI):
+        with open(os.path.join(root, rel)) as f:
+            out[rel] = f.read()
+    return out
+
+
+def test_verbs_analyzer_polices_slo_registration():
+    """Seeded violation: dropping SLO from the verb registries makes
+    the checker fire (bind-free verbs must sit in BOTH registries)."""
+    from vtpu.tools.analyze import verbs as verbs_mod
+    src = _tree_sources()
+    proto = src[verbs_mod.PROTOCOL]
+    broken = proto.replace(
+        "EXEC_BATCH, STATS, TRACE, SLO)",
+        "EXEC_BATCH, STATS, TRACE)").replace(
+        "ADMIN_VERBS = (STATS, TRACE, SLO, SUSPEND",
+        "ADMIN_VERBS = (STATS, TRACE, SUSPEND")
+    assert broken != proto
+    msgs = [f.message for f in verbs_mod.check_texts(
+        broken, src[verbs_mod.SERVER], src[verbs_mod.CLIENT],
+        src[verbs_mod.SMI])]
+    assert any("SLO" in m and "bind-free" in m for m in msgs), msgs
+
+
+def test_verbs_analyzer_requires_slo_client_binding():
+    from vtpu.tools.analyze import verbs as verbs_mod
+    src = _tree_sources()
+    client = src[verbs_mod.CLIENT].replace('{"kind": P.SLO}',
+                                           '{"kind": P.STATS}')
+    assert client != src[verbs_mod.CLIENT]
+    msgs = [f.message for f in verbs_mod.check_texts(
+        src[verbs_mod.PROTOCOL], src[verbs_mod.SERVER], client,
+        src[verbs_mod.SMI])]
+    assert any("SLO has no client binding" in m for m in msgs), msgs
+
+
+def test_verbs_analyzer_requires_slo_smi_binding():
+    from vtpu.tools.analyze import verbs as verbs_mod
+    src = _tree_sources()
+    smi = src[verbs_mod.SMI].replace('{"kind": P.SLO}',
+                                     '{"kind": P.STATS}')
+    assert smi != src[verbs_mod.SMI]
+    msgs = [f.message for f in verbs_mod.check_texts(
+        src[verbs_mod.PROTOCOL], src[verbs_mod.SERVER],
+        src[verbs_mod.CLIENT], smi)]
+    assert any("SLO has no vtpu-smi binding" in m for m in msgs), msgs
+
+
+def test_verbs_analyzer_slo_must_stay_idempotent_classified():
+    from vtpu.tools.analyze import verbs as verbs_mod
+    src = _tree_sources()
+    proto = src[verbs_mod.PROTOCOL].replace(
+        "SLO, SUSPEND, RESUME, RESIZE, DRAIN)",
+        "SUSPEND, RESUME, RESIZE, DRAIN)")
+    assert proto != src[verbs_mod.PROTOCOL]
+    msgs = [f.message for f in verbs_mod.check_texts(
+        proto, src[verbs_mod.SERVER], src[verbs_mod.CLIENT],
+        src[verbs_mod.SMI])]
+    assert any("SLO is served but unclassified" in m
+               for m in msgs), msgs
+
+
+def test_wirefields_analyzer_requires_slo_entry():
+    from vtpu.tools.analyze import verbs as verbs_mod
+    from vtpu.tools.analyze import wirefields
+    src = _tree_sources()
+    proto = src[verbs_mod.PROTOCOL].replace(
+        '    SLO: {"required": (), "optional": ("tenant", "trace")},',
+        "")
+    assert proto != src[verbs_mod.PROTOCOL]
+    msgs = [f.message for f in wirefields.check_texts({
+        wirefields.PROTOCOL: proto,
+        wirefields.SERVER: src[verbs_mod.SERVER],
+        wirefields.CLIENT: src[verbs_mod.CLIENT]})]
+    assert any('"slo"' in m and "WIRE_FIELDS" in m for m in msgs), msgs
+
+
+def test_analyzers_real_tree_clean_for_slo():
+    """The shipping tree carries the full SLO contract: zero findings
+    from the verbs and wirefields checkers."""
+    from vtpu.tools.analyze import verbs as verbs_mod
+    from vtpu.tools.analyze import wirefields
+    src = _tree_sources()
+    assert verbs_mod.check_texts(
+        src[verbs_mod.PROTOCOL], src[verbs_mod.SERVER],
+        src[verbs_mod.CLIENT], src[verbs_mod.SMI]) == []
+    assert wirefields.check_texts({
+        wirefields.PROTOCOL: src[verbs_mod.PROTOCOL],
+        wirefields.SERVER: src[verbs_mod.SERVER],
+        wirefields.CLIENT: src[verbs_mod.CLIENT]}) == []
