@@ -88,6 +88,21 @@ class VtpuBrokerUnavailable(RuntimeError_):
     reattach is invisible to the caller beyond this window."""
 
 
+class VtpuOverload(RuntimeError_):
+    """The broker SHED this request under overload (typed ``OVERLOAD``
+    reply, docs/SCHEDULING.md): the work was never enqueued, so a
+    retry cannot double-execute.  Synchronous requests retry
+    transparently with bounded full-jitter backoff around the reply's
+    ``retry_ms`` hint (``VTPU_OVERLOAD_RETRIES`` attempts) and raise
+    this only when the broker stays saturated; pipelined callers see
+    it per shed reply and own their own pacing — either way, never a
+    silent hang."""
+
+    def __init__(self, msg: str, retry_ms: Optional[int] = None):
+        super().__init__(msg)
+        self.retry_ms = retry_ms
+
+
 class VtpuStateLost(RuntimeError_):
     """The broker restarted under this client (fresh HELLO epoch): every
     RemoteArray / RemoteExecutable handle is gone.  The client has
@@ -293,6 +308,12 @@ class RuntimeClient:
             self._backoff_base)
         self._backoff_rng = random.Random(
             f"{self.tenant}\x00{os.getpid()}")
+        # Overload shedding (docs/SCHEDULING.md): synchronous requests
+        # answered OVERLOAD retry this many times with full-jitter
+        # backoff around the broker's retry_ms hint before surfacing
+        # the typed VtpuOverload.
+        self._overload_retries = max(
+            int(_env_float("VTPU_OVERLOAD_RETRIES", 4.0)), 0)
         # Fail-closed degraded mode: past this many seconds of broker
         # unreachability the client stops blocking and enforces the
         # last-granted quotas locally (runtime/degraded.py).  0 keeps
@@ -311,7 +332,26 @@ class RuntimeClient:
         self._granted_hbm = int(hello.get("hbm_limit") or 0)
         self._granted_core = int(hello.get("core_limit") or 0)
         self.epoch: Optional[str] = None
-        self.epoch = self._connect()[0]
+        # First dial: an OVERLOAD HELLO refusal (slot exhaustion under
+        # join churn) retries with jittered backoff inside the
+        # reconnect budget — the thousand-tenant join storm backs off
+        # instead of failing hard (docs/SCHEDULING.md).
+        deadline = time.monotonic() + max(self._reconnect_timeout, 0.0)
+        attempt = 0
+        while True:
+            try:
+                self.epoch = self._connect()[0]
+                break
+            except VtpuOverload as e:
+                attempt += 1
+                if time.monotonic() >= deadline:
+                    raise
+                base = max(float(e.retry_ms or 50.0) / 1e3,
+                           self._backoff_base)
+                delay = full_jitter_delay(self._backoff_rng, base,
+                                          self._backoff_cap, attempt)
+                time.sleep(max(min(delay,
+                                   deadline - time.monotonic()), 0.0))
 
     def _connect(self):
         """Dial + HELLO; returns (epoch, created, resumed) where
@@ -343,6 +383,12 @@ class RuntimeClient:
                 self.sock.close()
             except OSError:
                 pass
+            if resp.get("code") == "OVERLOAD":
+                # Typed + retryable: __init__ and the reconnect loops
+                # back off on it (VtpuOverload subclasses the
+                # RuntimeError_ those loops already retry).
+                raise VtpuOverload(resp.get("error", "overloaded"),
+                                   retry_ms=resp.get("retry_ms"))
             raise RuntimeError_(
                 f"{resp.get('code', '')}: {resp.get('error', '')}")
         self.tenant_index = resp["tenant_index"]
@@ -715,8 +761,44 @@ class RuntimeClient:
             self._wire_out -= len(out)
             self._ready.extend(out)
 
+    def _raise_reply_error(self, resp: Dict[str, Any]) -> None:
+        """Typed error for a non-ok reply (shared by every reply-
+        consuming path): quota -> VtpuQuotaError, shed -> VtpuOverload,
+        anything else -> RuntimeError_."""
+        code = resp.get("code", "")
+        if code == "RESOURCE_EXHAUSTED":
+            raise VtpuQuotaError(resp.get("error", code))
+        if code == "OVERLOAD":
+            raise VtpuOverload(resp.get("error", code),
+                               retry_ms=resp.get("retry_ms"))
+        raise RuntimeError_(f"{code}: {resp.get('error', '')}")
+
+    def _overload_pause(self, attempt: int, e: VtpuOverload) -> bool:
+        """One bounded full-jitter pause after a shed reply; False when
+        the retry budget is spent (the caller re-raises).  A shed
+        request was never enqueued, so re-sending cannot double-run —
+        that is what makes the transparent retry safe for EVERY
+        synchronous verb (docs/SCHEDULING.md)."""
+        if attempt > self._overload_retries:
+            return False
+        base = max(float(e.retry_ms or 50.0) / 1e3, self._backoff_base)
+        time.sleep(full_jitter_delay(self._backoff_rng, base,
+                                     self._backoff_cap, attempt))
+        return True
+
     def _rpc(self, msg: Dict[str, Any],
              _retry: bool = True) -> Dict[str, Any]:
+        attempt = 0
+        while True:
+            try:
+                return self._rpc_once(msg, _retry=_retry)
+            except VtpuOverload as e:
+                attempt += 1
+                if not self._overload_pause(attempt, e):
+                    raise
+
+    def _rpc_once(self, msg: Dict[str, Any],
+                  _retry: bool = True) -> Dict[str, Any]:
         self._sync_prelude()
         try:
             P.send_msg(self.sock, self._maybe_stamp(msg))
@@ -731,14 +813,11 @@ class RuntimeClient:
                 # broker instance — the caller never sees the crash.
                 if e.resumed and _retry and not msg.get("staged") \
                         and msg.get("kind") in self._RESUME_RETRY_KINDS:
-                    return self._rpc(msg, _retry=False)
+                    return self._rpc_once(msg, _retry=False)
                 raise
         self._absorb_lease(resp)
         if not resp.get("ok"):
-            code = resp.get("code", "")
-            if code == "RESOURCE_EXHAUSTED":
-                raise VtpuQuotaError(resp.get("error", code))
-            raise RuntimeError_(f"{code}: {resp.get('error', '')}")
+            self._raise_reply_error(resp)
         return resp
 
     def _rpc_frames(self, msg: Dict[str, Any], payloads,
@@ -746,7 +825,19 @@ class RuntimeClient:
         """Synchronous request whose payload rides as raw frames in ONE
         gather write (zero-copy PUT); reply handling mirrors _rpc,
         including the transparent idempotent retry on a journal-resumed
-        reconnect."""
+        reconnect and the bounded backoff-retry on an OVERLOAD shed."""
+        attempt = 0
+        while True:
+            try:
+                return self._rpc_frames_once(msg, payloads,
+                                             _retry=_retry)
+            except VtpuOverload as e:
+                attempt += 1
+                if not self._overload_pause(attempt, e):
+                    raise
+
+    def _rpc_frames_once(self, msg: Dict[str, Any], payloads,
+                         _retry: bool = True) -> Dict[str, Any]:
         self._sync_prelude()
         try:
             bufs = [P.frame_header(self._maybe_stamp(msg))]
@@ -760,14 +851,12 @@ class RuntimeClient:
                 raise AssertionError("unreachable")
             except VtpuConnectionLost as e:
                 if e.resumed and _retry:
-                    return self._rpc_frames(msg, payloads, _retry=False)
+                    return self._rpc_frames_once(msg, payloads,
+                                                 _retry=False)
                 raise
         self._absorb_lease(resp)
         if not resp.get("ok"):
-            code = resp.get("code", "")
-            if code == "RESOURCE_EXHAUSTED":
-                raise VtpuQuotaError(resp.get("error", code))
-            raise RuntimeError_(f"{code}: {resp.get('error', '')}")
+            self._raise_reply_error(resp)
         return resp
 
     def close(self) -> None:
@@ -925,10 +1014,10 @@ class RuntimeClient:
             self._ready.extend(out[1:])
         self._absorb_lease(resp)
         if not resp.get("ok"):
-            code = resp.get("code", "")
-            if code == "RESOURCE_EXHAUSTED":
-                raise VtpuQuotaError(resp.get("error", code))
-            raise RuntimeError_(f"{code}: {resp.get('error', '')}")
+            # Pipelined callers see the typed error per shed reply
+            # (VtpuOverload carries the retry_ms hint) and own their
+            # own pacing — the send/recv pairing stays theirs.
+            self._raise_reply_error(resp)
         return resp
 
     def get(self, aid: str) -> np.ndarray:
@@ -989,10 +1078,7 @@ class RuntimeClient:
                 raise
         self._absorb_lease(r)
         if not r.get("ok"):
-            code = r.get("code", "")
-            if code == "RESOURCE_EXHAUSTED":
-                raise VtpuQuotaError(r.get("error", code))
-            raise RuntimeError_(f"{code}: {r.get('error', '')}")
+            self._raise_reply_error(r)
         return arr
 
     def delete(self, aid: str) -> None:

@@ -122,6 +122,28 @@ def _apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> None:
             t.setdefault("ema", {})[rec["key"]] = rec.get("ema")
             if rec.get("execs") is not None:
                 t["execs"] = rec["execs"]
+    elif op == "credit":
+        # vtpu-elastic burst-credit bank (docs/SCHEDULING.md): the
+        # newest balance wins whole — counters are cumulative, so
+        # replaying an older record over a newer would re-mint spent
+        # credit.
+        t = tenants.get(rec.get("name"))
+        if t is not None:
+            t["credit"] = {"us": rec.get("us", 0.0),
+                           "minted": rec.get("minted", 0.0),
+                           "spent": rec.get("spent", 0.0)}
+    elif op == "suspend":
+        # Admin SUSPEND or an auto-preemption park (auto=True, with
+        # the preemptor's name): recovery re-freezes / re-parks the
+        # tenant instead of silently unfreezing it across a crash.
+        t = tenants.get(rec.get("name"))
+        if t is not None:
+            t["suspended"] = {"auto": bool(rec.get("auto")),
+                              "by": rec.get("by")}
+    elif op == "resume":
+        t = tenants.get(rec.get("name"))
+        if t is not None:
+            t.pop("suspended", None)
     elif op == "slo":
         # vtpu-slo plane state (runtime/slo.py export_state): the
         # newest record wins whole — sketches are cumulative, so

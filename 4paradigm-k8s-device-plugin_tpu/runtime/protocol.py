@@ -267,12 +267,26 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
     HANDOVER: {"required": (), "optional": ("timeout",)},
 }
 
+# ---------------------------------------------------------------------------
+# Overload shedding (docs/SCHEDULING.md): under backlog pressure the
+# broker answers an execute / EXEC_BATCH / HELLO with the typed error
+# code ``"OVERLOAD"`` (an error code like RESOURCE_EXHAUSTED, not a
+# verb) instead of queueing unboundedly — lowest priority sheds first,
+# and the reply carries a ``retry_ms`` hint the client jitters its
+# bounded backoff around (runtime/client.py VtpuOverload; never a
+# silent hang).  Shed EXEC_BATCH replies keep the positional
+# ``results`` frame shape (every slot carries the OVERLOAD result), so
+# pipelined reply accounting never desyncs.
+# ---------------------------------------------------------------------------
+
 # Optional REPLY fields newer brokers piggyback on existing replies
 # (the client side of the same contract): each must be absorbed with a
 # legacy-default ``.get`` in runtime/client.py — an old broker's reply
 # simply lacks them.  ``lease``: the client-side rate-lease grant/
-# revoke rider on execute/EXEC_BATCH replies (docs/PERF.md).
-REPLY_OPTIONAL_FIELDS = ("lease",)
+# revoke rider on execute/EXEC_BATCH replies (docs/PERF.md);
+# ``retry_ms``: the backoff hint on OVERLOAD shed replies
+# (docs/SCHEDULING.md).
+REPLY_OPTIONAL_FIELDS = ("lease", "retry_ms")
 
 
 class ProtocolError(RuntimeError):

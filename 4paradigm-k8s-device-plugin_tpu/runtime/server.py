@@ -173,6 +173,39 @@ RATE_LEASE_US = min(int(os.environ.get("VTPU_RATE_LEASE_US", "20000")),
 # picks up to this many ready items); 1 restores pick-per-wake.
 WAKE_BATCH = max(int(os.environ.get("VTPU_WAKE_BATCH", "32")), 1)
 
+# -- vtpu-elastic (docs/SCHEDULING.md) --------------------------------------
+# Work-conserving burst credits: a tenant that is IDLE (no queued work,
+# nothing in flight) banks the device-time share it could not use, at
+# its core share, capped at this many scheduler quanta of banked time.
+# A bursting tenant spends the bank when its token bucket refuses —
+# but NEVER while a co-tenant with queued work is bucket-throttled
+# (the hard-floor guard: floors re-engage within one scheduler pass of
+# demand returning).  0 disables the credit economy entirely.
+BURST_CAP_QUANTA = float(os.environ.get("VTPU_BURST_CAP_QUANTA", "20"))
+BURST_CAP_US = max(BURST_CAP_QUANTA, 0.0) * SCHED_QUANTUM_US
+# Priority preemption (SURVEY §2.9d suspend semantics, made real):
+# when a higher-priority tenant has had queued work continuously for
+# VTPU_PREEMPT_AFTER_MS while a lower-priority tenant occupies the
+# chip, the dispatcher revokes the low-priority tenant's rate lease,
+# lets its in-flight batch drain, and PARKS it (same queue-hold the
+# admin SUSPEND verb uses) until the high-priority demand subsides for
+# VTPU_PREEMPT_COOLDOWN_MS — or VTPU_PREEMPT_MAX_PARK_S elapses (the
+# anti-starvation bound: a parked tenant always runs again).
+# Suspend/resume transitions journal (ops "suspend"/"resume") so a
+# crash mid-park recovers the parked state.  VTPU_PREEMPT=0 disables.
+PREEMPT_ON = os.environ.get("VTPU_PREEMPT", "1") != "0"
+PREEMPT_AFTER_MS = float(os.environ.get("VTPU_PREEMPT_AFTER_MS", "250"))
+PREEMPT_MAX_PARK_S = float(os.environ.get("VTPU_PREEMPT_MAX_PARK_S",
+                                          "2"))
+PREEMPT_COOLDOWN_MS = float(os.environ.get("VTPU_PREEMPT_COOLDOWN_MS",
+                                           "100"))
+# In-flight cap for a victim resumed by the MAX-PARK anti-starvation
+# bound while its preemptor still demands: it makes bounded progress
+# (never starves) without flooding the device queue the moment it
+# wakes — the preemptor's tail latency stays ~2 item-times instead of
+# a full MAX_INFLIGHT window per park cycle.
+PREEMPT_PROBATION_INFLIGHT = 2
+
 
 def sparse_batch_learn_scale(batch_est_us: float, disp_us: float,
                              n_items: int) -> Optional[float]:
@@ -310,6 +343,37 @@ class Tenant:
         # Cached metered? verdict (core_limit_pct > 0): device_stats is
         # a native region call and was paid once per DISPATCH.
         self._metered_cache: Optional[Tuple[bool, float]] = None
+        # -- vtpu-elastic burst credits (docs/SCHEDULING.md) --
+        # Banked device time (µs) an idle tenant accrued at its core
+        # share; spent when the token bucket refuses a burst.  GUARDED
+        # BY the primary chip's scheduler.mu like the lease fields;
+        # credit_spent_us additionally absorbs the metering thread's
+        # billing corrections (plain float adds, same contract as the
+        # scheduler's slo_busy vector — a torn read skews a stat, never
+        # enforcement).  minted/spent are cumulative (journaled by the
+        # keeper; replayed at recovery so a crash never re-mints).
+        self.credit_us = 0.0
+        self.credit_minted_us = 0.0
+        self.credit_spent_us = 0.0
+        # Wall instant the tenant last became idle (no queued work, no
+        # in-flight items) — the open end of the next mint window; None
+        # while the tenant is active.  Accrual starts at bind.
+        self.credit_idle_from: Optional[float] = time.monotonic()
+        self.bind_ts = time.monotonic()
+        # Grant core share cached for credit accrual (region reads are
+        # native calls); seeded at bind, refreshed by RESIZE.
+        self.core_pct = 0
+        # Set by _credit_admit_locked for the admission that just ran
+        # (read back immediately by _pick_locked under scheduler.mu).
+        self.last_admit_credit = False
+        # Last submit instant (scheduler.mu): a demand burst survives
+        # gaps shorter than the preemption cooldown, so a closed-loop
+        # latency pinger — the tenant preemption exists to protect —
+        # still reads as SUSTAINED demand.
+        self.last_active = 0.0
+        # -- vtpu-elastic preemption / admission counters --
+        self.preemptions = 0
+        self.shed_total = 0
 
     # -- chip-set accounting ------------------------------------------------
 
@@ -491,7 +555,7 @@ class WorkItem:
                  "steps", "carry", "metered", "est_us", "first_run",
                  "free_ids", "t_enq", "t_enq_wall", "t_bucket0",
                  "bucket_wait_us", "trace_id", "trace_ts", "batch",
-                 "batch_idx", "slo_busy0")
+                 "batch_idx", "slo_busy0", "credit_funded")
 
     def __init__(self, tenant, session, exe, key, arg_ids, out_ids,
                  steps=1, carry=(), free_ids=()):
@@ -534,6 +598,10 @@ class WorkItem:
         # blame denominators are the co-tenant deltas between this and
         # retire.  None with the plane off (zero hot-path touch).
         self.slo_busy0: Optional[tuple] = None
+        # vtpu-elastic: this item was admitted from the tenant's burst-
+        # credit bank, not the token bucket — the metering correction
+        # bills the bank instead (docs/SCHEDULING.md).
+        self.credit_funded = False
 
 
 class _ItemError(Exception):
@@ -569,6 +637,112 @@ class _BatchReply:
             return self.left == 0
 
 
+class SlotsExhausted(RuntimeError):
+    """Every tenant slot of a requested chip is bound: a transient
+    capacity condition (slots recycle as tenants churn), answered with
+    the typed retryable OVERLOAD code — never the INTERNAL soup a
+    thousand-tenant join storm would otherwise see."""
+
+
+class AdmissionState:
+    """Overload-safe admission control (docs/SCHEDULING.md).
+
+    Every execute is judged BEFORE it reserves a reply slot or touches
+    the scheduler: when the chip's backlog (queued, undispatched items)
+    crosses a priority-scaled fraction of ``VTPU_MAX_BACKLOG`` — or one
+    tenant alone exceeds ``VTPU_TENANT_QUEUE_CAP`` — the request is
+    SHED with a typed ``OVERLOAD`` reply carrying a ``retry_ms`` hint
+    the client jitters its backoff around (never a silent hang, never
+    unbounded queue growth).  Lowest priority sheds first: priority 0
+    (the borrow-don't-wait class) is only refused at the hard cap, and
+    the elastic keeper's burn hook (``burn_hot``) halves the lower
+    priorities' thresholds while any priority-0 tenant's SLO burn
+    alert is firing — load shedding driven by the budget actually
+    being burned, not queue depth alone.
+
+    Lock-free by design: counters are plain ints and the backlog reads
+    are advisory snapshots of scheduler-owned fields — a torn read
+    sheds (or admits) one request a beat early, never corrupts state.
+    ``shed_log`` is an mc-only oracle (None in production)."""
+
+    def __init__(self):
+        self.max_backlog = max(
+            int(os.environ.get("VTPU_MAX_BACKLOG", "4096")), 1)
+        self.tenant_cap = max(
+            int(os.environ.get("VTPU_TENANT_QUEUE_CAP", "512")), 1)
+        self.shed_burn = os.environ.get("VTPU_SHED_BURN", "1") != "0"
+        self.burn_hot = False   # written by the elastic keeper
+        self.shed_total = 0
+        self.shed_log: Optional[List[tuple]] = None
+
+    def shed_fraction(self, priority: int) -> float:
+        """Backlog fraction past which this priority sheds.  Priority
+        0 holds out to the hard cap; everyone else sheds earlier, and
+        earlier still while a priority-0 SLO budget is burning."""
+        if priority <= 0:
+            return 1.0
+        f = 0.6 if priority == 1 else 0.4
+        if self.burn_hot and self.shed_burn:
+            f *= 0.5
+        return f
+
+    def check(self, scheduler: "DeviceScheduler", t: "Tenant",
+              n_items: int) -> Optional[int]:
+        """Admit or shed ``n_items`` from tenant ``t``: returns None to
+        admit, or a suggested retry_ms to put in the OVERLOAD reply."""
+        q = scheduler.queues.get(t.name)
+        per = len(q) if q is not None else 0
+        level = (scheduler.total_backlog + n_items) / self.max_backlog
+        if per + n_items <= self.tenant_cap \
+                and level <= self.shed_fraction(t.priority):
+            return None
+        self.shed_total += 1
+        t.shed_total += 1
+        if self.shed_log is not None:
+            self.shed_log.append((t.name, t.priority, level))
+        # Hint scaled by how deep the overload is; the client adds
+        # full jitter on top, so a shed stampede cannot re-align.
+        return int(50 + min(level, 4.0) * 100)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"shed_total": self.shed_total,
+                "burn_hot": self.burn_hot,
+                "max_backlog": self.max_backlog,
+                "tenant_queue_cap": self.tenant_cap}
+
+
+def preempt_decision(entries: List[Tuple[str, int, float, int]],
+                     now: float,
+                     after_ms: float = PREEMPT_AFTER_MS
+                     ) -> Optional[Tuple[str, str]]:
+    """The preemption policy as a pure function (driven directly by
+    ``vtpu-smi chaos --smoke`` and the unit tests): given per-tenant
+    ``(name, priority, demand_since, load)`` rows — demand_since is
+    when the tenant's queue last became non-empty (0 = no demand),
+    load its queued+in-flight item count — pick (preemptor, victim):
+    the highest-priority tenant whose demand has been sustained past
+    ``after_ms`` preempts the BUSIEST strictly-lower-priority tenant.
+    Returns None when no preemption is due."""
+    hi: Optional[Tuple[str, int, float]] = None
+    for name, pri, since, _load in entries:
+        if since <= 0.0:
+            continue
+        if hi is None or pri < hi[1] or \
+                (pri == hi[1] and since < hi[2]):
+            hi = (name, pri, since)
+    if hi is None or (now - hi[2]) * 1e3 < after_ms:
+        return None
+    victim: Optional[Tuple[str, int]] = None
+    for name, pri, _since, load in entries:
+        if pri <= hi[1] or load <= 0:
+            continue
+        if victim is None or load > victim[1]:
+            victim = (name, load)
+    if victim is None:
+        return None
+    return hi[0], victim[0]
+
+
 class DeviceScheduler:
     """Per-tenant queues + round-robin dispatch gated on the token
     buckets (the deficit-round-robin role is played by the buckets
@@ -584,6 +758,37 @@ class DeviceScheduler:
         self.not_ready_until: Dict[str, float] = {}
         self.rr: List[str] = []
         self._rr_pos = 0
+        # -- vtpu-elastic (docs/SCHEDULING.md); all guarded by self.mu --
+        # Auto-preempted tenants: name -> {"since", "by", "idle_since"?}
+        # — their queues hold exactly like admin-suspended ones.
+        self.preempted: Dict[str, Dict[str, Any]] = {}
+        # Journal/log records produced under self.mu (suspend/resume
+        # transitions): file I/O is banned here, so the dispatch loop
+        # flushes them once it has released the lock.
+        self.preempt_recs: List[dict] = []
+        # Victims resumed by the max-park bound while their preemptor
+        # still demands: name -> (preemptor, grace deadline).  Dispatch
+        # caps them at PREEMPT_PROBATION_INFLIGHT until the pressure
+        # ends, and they cannot be RE-picked as victims before the
+        # grace deadline — without it, the check that un-parks a
+        # still-busiest victim would re-park it in the same pass,
+        # leaving it starved with zero dispatch window (livelock).
+        self.probation: Dict[str, Tuple[str, float]] = {}
+        # When each tenant's queue last became non-empty (sustained-
+        # demand clock for preemption); absent = no current demand.
+        self.demand_since: Dict[str, float] = {}
+        # name -> Tenant for every tenant that ever submitted here
+        # (preemption victims may have in-flight work but an empty
+        # queue, so items alone cannot name them).
+        self.known: Dict[str, Tenant] = {}
+        # Queued-but-undispatched item count (admission control reads
+        # it lock-free as an advisory snapshot).
+        self.total_backlog = 0
+        self._preempt_ts = 0.0
+        # mc oracle (tools/mc): harness sets a list; the broker then
+        # records credit mints/spends/denials into it.  None (the
+        # production value) records nothing.
+        self.credit_log: Optional[List[tuple]] = None
         self._completion_q: "queue.Queue" = queue.Queue()
         self._pool_us = 0.0  # unbilled device time (metering loop only)
         self._prev_obs = 0.0  # last readiness observation (metering)
@@ -632,11 +837,33 @@ class DeviceScheduler:
                 item.t_enq = now_m
                 item.t_enq_wall = now_w
                 item.slo_busy0 = snap
-                name = item.tenant.name
+                t = item.tenant
+                name = t.name
                 if name not in self.queues:
                     self.queues[name] = collections.deque()
                     self.rr.append(name)
-                self.queues[name].append(item)
+                q = self.queues[name]
+                if not q and t.credit_idle_from is not None:
+                    # Idle -> active transition: close the mint window
+                    # (bank the share the tenant could not use) and
+                    # open/extend the demand burst the preemption
+                    # policy reads.  Demand means LOAD (queued or in
+                    # flight), and a burst survives gaps shorter than
+                    # the preemption cooldown — a closed-loop pinger's
+                    # sub-cooldown think time still reads as SUSTAINED
+                    # demand (it is exactly the tenant preemption
+                    # protects).
+                    self._mint_credit_locked(t, now_m)
+                    t.credit_idle_from = None
+                    if now_m - t.last_active \
+                            > PREEMPT_COOLDOWN_MS / 1e3:
+                        self.demand_since[name] = now_m
+                    else:
+                        self.demand_since.setdefault(name, now_m)
+                t.last_active = now_m
+                self.known[name] = t
+                q.append(item)
+                self.total_backlog += 1
             self._notify_locked()
 
     def _notify_locked(self) -> None:
@@ -678,7 +905,8 @@ class DeviceScheduler:
         with self.mu:
             while any(self.inflight.values()) \
                     or any(len(q) for n, q in self.queues.items()
-                           if n not in self.state.suspended):
+                           if n not in self.state.suspended
+                           and n not in self.preempted):
                 if time.monotonic() >= deadline:
                     return False
                 self._waiting += 1
@@ -690,9 +918,15 @@ class DeviceScheduler:
 
     def forget_tenant(self, name: str) -> None:
         with self.mu:
-            self.queues.pop(name, None)
+            q = self.queues.pop(name, None)
+            if q:
+                self.total_backlog -= len(q)
             self.inflight.pop(name, None)
             self.not_ready_until.pop(name, None)
+            self.preempted.pop(name, None)
+            self.probation.pop(name, None)
+            self.demand_since.pop(name, None)
+            self.known.pop(name, None)
             if name in self.rr:
                 self.rr.remove(name)
 
@@ -706,14 +940,17 @@ class DeviceScheduler:
         connection that would have consumed the replies is gone."""
         purged = []
         with self.mu:
-            for q in self.queues.values():
+            for name, q in self.queues.items():
                 kept = [it for it in q if it.session is not session]
                 if len(kept) != len(q):
                     purged.extend(it for it in q
                                   if it.session is session)
                     q.clear()
                     q.extend(kept)
+                    if not q and not self.inflight.get(name):
+                        self.demand_since.pop(name, None)
             if purged:
+                self.total_backlog -= len(purged)
                 self._notify_locked()
         for it in purged:
             session.abandon(it)
@@ -739,6 +976,8 @@ class DeviceScheduler:
         """
         now = time.monotonic()
         soonest = None
+        if PREEMPT_ON:
+            self._preempt_check_locked(now)
         if self.queued_est_us >= MAX_QUEUED_US:
             # Enough runway queued on the device; check back shortly
             # (retirements notify self.mu, so the wait usually ends
@@ -751,9 +990,11 @@ class DeviceScheduler:
             q = self.queues.get(name)
             if not q:
                 continue
-            if name in self.state.suspended:
-                continue  # admin-suspended: hold the queue
-            if self.inflight.get(name, 0) >= MAX_INFLIGHT:
+            if name in self.state.suspended or name in self.preempted:
+                continue  # admin-suspended or preempted: hold the queue
+            cap = (PREEMPT_PROBATION_INFLIGHT
+                   if name in self.probation else MAX_INFLIGHT)
+            if self.inflight.get(name, 0) >= cap:
                 continue
             nr = self.not_ready_until.get(name, 0.0)
             if nr > now:
@@ -765,6 +1006,7 @@ class DeviceScheduler:
                       float(self.state.min_exec_cost_us)) * item.steps
             metered = t.metered_on(self.chip, now)
             if metered:
+                t.last_admit_credit = False
                 wait_ns = self._lease_admit_locked(t, est, now)
                 if wait_ns:
                     # Trace: the item is now provably waiting on the
@@ -779,9 +1021,11 @@ class DeviceScheduler:
                               name, est, wait_ns / 1e6)
                     continue
             q.popleft()
+            self.total_backlog -= 1
             if item.t_bucket0 is not None:
                 item.bucket_wait_us = max(now - item.t_bucket0, 0.0) * 1e6
             item.metered = metered
+            item.credit_funded = metered and t.last_admit_credit
             item.est_us = est
             # First device execution of this (program, chain) variant:
             # its observed window embeds program load / backend warmup
@@ -809,7 +1053,10 @@ class DeviceScheduler:
         self.mu — lease state is scheduler.mu-guarded."""
         q = float(self.state.rate_lease_us)
         if q <= 0:
-            return t.rate_acquire_all(int(est), t.priority)
+            wait_ns = t.rate_acquire_all(int(est), t.priority)
+            if wait_ns and self._credit_admit_locked(t, est, now):
+                return 0
+            return wait_ns
         if t.lease_us > 0.0 and now >= t.lease_exp:
             # Expired: refund the remainder so an idling tenant's
             # pre-debit flows back to its co-tenants.
@@ -832,10 +1079,136 @@ class DeviceScheduler:
         if wait_ns == 0:
             t.lease_us = 0.0
             return 0
+        # Bucket exhausted: a banked burst credit may still admit the
+        # item (docs/SCHEDULING.md).  Credit admissions deliberately
+        # NEVER fund a lease — a lease can only ever carry bucket
+        # budget, so borrowed credit can never ride one past a
+        # floor-demand signal (the mc token-conservation row checks
+        # exactly this split).
+        if self._credit_admit_locked(t, est, now):
+            return 0
         return wait_ns
+
+    def _mint_credit_locked(self, t: Tenant, now: float) -> None:
+        """Close an idle window: bank the device-time share the tenant
+        could not use (idle seconds x core share), clamped to the burst
+        cap.  Caller holds self.mu; ``t.credit_idle_from`` is the open
+        end of the window."""
+        if BURST_CAP_US <= 0 or t.core_pct <= 0:
+            return
+        idle_s = max(now - (t.credit_idle_from or now), 0.0)
+        if idle_s <= 0.0:
+            return
+        mint = min(idle_s * t.core_pct * 1e4,       # pct/100 * 1e6 µs/s
+                   max(BURST_CAP_US - t.credit_us, 0.0))
+        if mint <= 0.0:
+            return
+        t.credit_us += mint
+        t.credit_minted_us += mint
+        if self.credit_log is not None:
+            self.credit_log.append(("mint", t.name, mint, ()))
+
+    def _credit_admit_locked(self, t: Tenant, est: float,
+                             now: float) -> bool:
+        """Admit one item from the tenant's burst-credit bank after the
+        token bucket refused.  The HARD-FLOOR guard: no spend while any
+        co-tenant with queued work is bucket-throttled on this chip —
+        the moment a floor-demand signal appears, the burster falls
+        back to its plain bucket rate (floors re-engage within one
+        scheduler pass).  Caller holds self.mu."""
+        if BURST_CAP_US <= 0 or t.credit_us < est:
+            return False
+        contended = tuple(
+            n for n, q in self.queues.items()
+            if q and n != t.name and n not in self.preempted
+            and self.not_ready_until.get(n, 0.0) > now)
+        if contended:
+            if self.credit_log is not None:
+                self.credit_log.append(("deny", t.name, est, contended))
+            return False
+        t.credit_us -= est
+        t.credit_spent_us += est
+        t.last_admit_credit = True
+        if self.credit_log is not None:
+            self.credit_log.append(("spend", t.name, est, contended))
+        return True
+
+    def _preempt_check_locked(self, now: float) -> None:
+        """Priority preemption (docs/SCHEDULING.md): park the busiest
+        lower-priority tenant while a higher-priority one shows
+        sustained demand; un-park on cooldown or the max-park bound.
+        Caller holds self.mu; journal records defer to preempt_recs
+        (file I/O is banned under the scheduler lock) and the dispatch
+        loop flushes them."""
+        if now < self._preempt_ts:
+            return
+        self._preempt_ts = now + 0.01
+        cooldown_s = PREEMPT_COOLDOWN_MS / 1e3
+        # Expire demand bursts whose idle gap outlived the cooldown.
+        for name in list(self.demand_since):
+            t = self.known.get(name)
+            if t is None:
+                del self.demand_since[name]
+                continue
+            load = len(self.queues.get(name) or ()) \
+                + self.inflight.get(name, 0)
+            if load == 0 and now - t.last_active > cooldown_s:
+                del self.demand_since[name]
+        # Un-park: preemptor's demand burst over, or max park time.
+        for name in list(self.preempted):
+            info = self.preempted[name]
+            if info.get("by", "") in self.demand_since:
+                info.pop("idle_since", None)
+            elif "idle_since" not in info:
+                info["idle_since"] = now
+            cooled = "idle_since" in info and \
+                (now - info["idle_since"]) * 1e3 >= PREEMPT_COOLDOWN_MS
+            if cooled or now - info["since"] >= PREEMPT_MAX_PARK_S:
+                del self.preempted[name]
+                if not cooled:
+                    # Anti-starvation resume under live pressure:
+                    # bounded progress on probation, with a grace
+                    # window before it may be parked again.
+                    self.probation[name] = (info.get("by", ""),
+                                            now + cooldown_s)
+                self.preempt_recs.append(
+                    {"op": "resume", "name": name, "auto": True})
+                self._notify_locked()
+        # Probation lifts the moment the preemptor's demand burst ends.
+        for name in list(self.probation):
+            if self.probation[name][0] not in self.demand_since:
+                del self.probation[name]
+        entries = []
+        for name, t in self.known.items():
+            if name in self.state.suspended or name in self.preempted:
+                continue
+            pro = self.probation.get(name)
+            if pro is not None and pro[1] > now:
+                continue  # grace: not re-parkable yet
+            q = self.queues.get(name)
+            load = (len(q) if q else 0) + self.inflight.get(name, 0)
+            entries.append((name, t.priority,
+                            self.demand_since.get(name, 0.0), load))
+        pick = preempt_decision(entries, now)
+        if pick is None:
+            return
+        by, vname = pick
+        vt = self.known[vname]
+        self.preempted[vname] = {"since": now, "by": by}
+        self.probation.pop(vname, None)
+        # Revoke the victim's lease NOW: pre-debited budget must not
+        # ride out the park (and the refund flows straight to the
+        # preemptor's bucket share).  In-flight items drain naturally
+        # through the metering loop — parking only stops new dispatch.
+        vt.lease_release()
+        vt.lease_revoked = True
+        vt.preemptions += 1
+        self.preempt_recs.append(
+            {"op": "suspend", "name": vname, "by": by, "auto": True})
 
     def _dispatch_loop(self):
         while not self._stop:
+            recs: Optional[List[dict]] = None
             with self.mu:
                 items = []
                 soonest = None
@@ -847,7 +1220,12 @@ class DeviceScheduler:
                     if item is None:
                         break
                     items.append(item)
-                if not items:
+                if self.preempt_recs:
+                    # Suspend/resume transitions deferred by the
+                    # preemption check: journaled below, outside the
+                    # lock (the no-blocking-under discipline).
+                    recs, self.preempt_recs = self.preempt_recs, []
+                if not items and recs is None:
                     timeout = 0.5
                     if soonest is not None:
                         timeout = max(min(soonest - time.monotonic(), 0.5),
@@ -858,6 +1236,10 @@ class DeviceScheduler:
                     finally:
                         self._waiting -= 1
                     continue
+            if recs:
+                self._flush_preempt_recs(recs)
+            if not items:
+                continue
             done = []
             for item in items:
                 r = self._dispatch_item(item)
@@ -868,6 +1250,31 @@ class DeviceScheduler:
                 # dispatch batch — the per-item put was a futex/GIL
                 # handoff per step under pipelined load.
                 self._completion_q.put(done)
+
+    def _flush_preempt_recs(self, recs: List[dict]) -> None:
+        """Journal + log the preemption transitions the check deferred
+        (runs with NO scheduler lock held).  A failed append degrades
+        crash recovery to "victim resumes un-parked" — availability
+        over a dead dispatcher thread."""
+        jr = self.state.journal
+        for rec in recs:
+            name = rec["name"]
+            try:
+                if rec["op"] == "suspend":
+                    log.info("preempt: parked tenant %r (sustained "
+                             "higher-priority demand from %r)",
+                             name, rec.get("by"))
+                    if jr is not None:
+                        jr.append({"op": "suspend", "name": name,
+                                   "by": rec.get("by"), "auto": True})
+                else:
+                    log.info("preempt: resumed tenant %r", name)
+                    if jr is not None:
+                        jr.append({"op": "resume", "name": name,
+                                   "auto": True})
+            except OSError as e:
+                log.warn("journal: dropping %r record for %s (%s)",
+                         rec.get("op"), name, e)
 
     def _dispatch_item(self, item: WorkItem):
         # vtpu-chaos dispatch hook: `sigkill_broker@dispatch:after=N`
@@ -1002,11 +1409,22 @@ class DeviceScheduler:
         """Retire a whole metered batch under one lock acquisition with
         at most one wake (wake batching: the per-item notify_all was a
         futex storm under pipelined load)."""
+        now = time.monotonic()
         with self.mu:
             for item in items:
-                name = item.tenant.name
+                t = item.tenant
+                name = t.name
                 if name in self.inflight:  # forgotten stay forgotten
                     self.inflight[name] = max(self.inflight[name] - 1, 0)
+                    if self.inflight[name] == 0 \
+                            and not self.queues.get(name) \
+                            and t.credit_idle_from is None:
+                        # Fully idle (nothing queued, nothing in
+                        # flight): open the burst-credit mint window.
+                        # The demand burst is NOT closed here — it
+                        # expires in the preemption check once the
+                        # idle gap outlives the cooldown.
+                        t.credit_idle_from = now
                 self.queued_est_us = max(
                     self.queued_est_us - item.est_us, 0.0)
             self._notify_locked()
@@ -1217,9 +1635,22 @@ class DeviceScheduler:
                 # ages.  The EMA (growth-clamped below) catches
                 # real cost within a few items, so sustained
                 # under-charging is impossible.
-                t.rate_adjust_all(
-                    int(min(charged, item.est_us * 4.0)
-                        - item.est_us))
+                corr = min(charged, item.est_us * 4.0) - item.est_us
+                if item.credit_funded:
+                    # The estimate came from the burst-credit bank:
+                    # bill the correction there too (overdraft past
+                    # the balance falls through to the bucket, so
+                    # the books never go negative and measured cost
+                    # is never unaccounted — the mc conservation
+                    # row audits exactly this split).
+                    take = min(corr, t.credit_us) if corr > 0 else corr
+                    t.credit_us -= take
+                    t.credit_spent_us += take
+                    rest = int(corr - take)
+                    if rest:
+                        t.rate_adjust_all(rest)
+                else:
+                    t.rate_adjust_all(int(corr))
             if per_step is not None:
                 # Growth-clamped EMA — INCLUDING the first learned
                 # sample: seeding raw would let one outlier
@@ -1691,6 +2122,11 @@ class RuntimeState:
         self.default_core = core_limit
         self.min_exec_cost_us = min_exec_cost_us
         self.tenants: Dict[str, Tenant] = {}
+        # vtpu-elastic overload-safe admission control
+        # (docs/SCHEDULING.md): lock-free shed decisions read by every
+        # session's enqueue path; the elastic keeper feeds its SLO-burn
+        # input.
+        self.admission = AdmissionState()
         # Admin-suspended tenant names (reference suspend_all/resume_all
         # analogue, SURVEY §2.9d): their queues stop dispatching.  Set
         # only via the host-side admin socket; reads are racy-by-design
@@ -1873,7 +2309,31 @@ class RuntimeState:
                 t = Tenant(name, slots[0], int(rec.get("priority", 1)),
                            bool(rec.get("over", False)),
                            chips=chips, slots=slots)
+                t.core_pct = int(core) if core is not None \
+                    else self.default_core
                 t.spill_overshoot = rec.get("spill")
+                # Burst-credit bank survives the crash (journal op
+                # "credit"): the replayed balance/counters re-seed so a
+                # kill -9 neither zeroes banked time nor re-mints it.
+                cr = rec.get("credit")
+                if isinstance(cr, dict):
+                    t.credit_us = min(max(float(cr.get("us", 0.0)), 0.0),
+                                      BURST_CAP_US)
+                    t.credit_minted_us = float(cr.get("minted", 0.0))
+                    t.credit_spent_us = float(cr.get("spent", 0.0))
+                # Suspend state survives too: an admin-suspended tenant
+                # recovers frozen; an auto-preempted one recovers
+                # parked on its primary chip (the max-park bound still
+                # un-parks it, so a dead preemptor cannot starve it).
+                susp = rec.get("suspended")
+                if isinstance(susp, dict):
+                    if susp.get("auto"):
+                        with chips[0].scheduler.mu:
+                            chips[0].scheduler.preempted[name] = {
+                                "since": now,
+                                "by": str(susp.get("by", ""))}
+                    else:
+                        self.suspended.add(name)
                 t.cost_ema = {str(k): float(v)
                               for k, v in (rec.get("ema") or {}).items()}
                 t.executions = int(rec.get("execs", 0))
@@ -2044,6 +2504,30 @@ class RuntimeState:
                     # successor's attainment view, never enforcement.
                     log.warn("journal: dropping %d slo record(s) (%s)",
                              len(recs), e)
+        if self.journal is not None and BURST_CAP_US > 0:
+            # Burst-credit balances journal once per keeper tick
+            # (docs/SCHEDULING.md): a crashed broker's successor
+            # re-seeds each bank within a tick of pre-crash instead of
+            # zeroing (or double-minting) banked device time.  The
+            # reads are advisory snapshots of scheduler.mu-guarded
+            # floats — a torn read journals a stale balance, which the
+            # next tick overwrites.
+            with self.mu:
+                tenants = list(self.tenants.items())
+            crecs: List[dict] = []
+            for name, t in tenants:
+                if t.credit_minted_us > 0.0:
+                    crecs.append({
+                        "op": "credit", "name": name,
+                        "us": round(t.credit_us, 1),
+                        "minted": round(t.credit_minted_us, 1),
+                        "spent": round(t.credit_spent_us, 1)})
+            if crecs:
+                try:
+                    self.journal.append_many(crecs)
+                except OSError as e:
+                    log.warn("journal: dropping %d credit record(s) "
+                             "(%s)", len(crecs), e)
         if self.journal is not None and self.journal.snapshot_due():
             self.journal.write_snapshot(self._snapshot_dict)
 
@@ -2072,6 +2556,20 @@ class RuntimeState:
                 "ema": {k: float(v) for k, v in t.cost_ema.items()},
                 "execs": t.executions,
             }
+            if t.credit_minted_us > 0.0:
+                tenants[name]["credit"] = {
+                    "us": round(t.credit_us, 1),
+                    "minted": round(t.credit_minted_us, 1),
+                    "spent": round(t.credit_spent_us, 1)}
+            # Suspend/park state rides the snapshot so compaction
+            # cannot age a live suspend record out of the journal.
+            if name in self.suspended:
+                tenants[name]["suspended"] = {"auto": False}
+            else:
+                info = t.chip.scheduler.preempted.get(name)
+                if info is not None:
+                    tenants[name]["suspended"] = {
+                        "auto": True, "by": info.get("by")}
             # SLO plane state rides the snapshot too (slo.mu is leaf;
             # no other lock is held here), so compaction never ages
             # attainment history out of the journal.
@@ -2105,6 +2603,19 @@ class RuntimeState:
             out["tenants_awaiting_resume"] = len(self.recovered)
         if self.journal is not None:
             out.update(self.journal.stats())
+        return out
+
+    def admission_stats(self) -> Dict[str, Any]:
+        """Admission/overload view riding every STATS reply
+        (docs/SCHEDULING.md): shed totals, the burn→shed flag, and the
+        live backlog + parked tenants across chips (advisory unlocked
+        reads, like the pool counters)."""
+        out = self.admission.stats()
+        with self.chips_mu:
+            chips = list(self.chips.values())
+        out["backlog"] = sum(c.scheduler.total_backlog for c in chips)
+        out["preempted"] = sorted(
+            n for c in chips for n in c.scheduler.preempted)
         return out
 
     def slo_report(self, tenant: Optional[str] = None,
@@ -2200,7 +2711,11 @@ class RuntimeState:
                     index = next((i for i in range(MAX_TENANTS)
                                   if i not in used), None)
                     if index is None:
-                        raise RuntimeError(
+                        # Typed + retryable: under thousand-tenant
+                        # churn this is a transient capacity signal
+                        # (slots recycle), answered as OVERLOAD so the
+                        # client backs off instead of failing INTERNAL.
+                        raise SlotsExhausted(
                             f"tenant slots exhausted on chip "
                             f"{chip.index}")
                     slots.append(index)
@@ -2223,6 +2738,8 @@ class RuntimeState:
                     chip.region.set_core_limit(
                         index, core_limit if core_limit is not None
                         else self.default_core)
+                t.core_pct = int(core_limit if core_limit is not None
+                                 else self.default_core)
                 self.tenants[name] = t
             t.connections += 1
         if deferred_close is not None and self.journal is not None:
@@ -2250,6 +2767,16 @@ class RuntimeState:
         # slot next.  (All items are dispatched by now — the session
         # drained its replies — so inflight-only quiesce suffices.)
         t.chip.scheduler.quiesce(t.name)
+        # Reclaim the unburned rate lease BEFORE the slot can recycle:
+        # the pop below frees the slot index, and a concurrent HELLO
+        # that claims it resets the bucket — a refund landing after
+        # that re-seed would over-credit the NEW tenant (double
+        # credit; found by the mc overload_shed scenario's concurrent
+        # bind/teardown interleavings).  If the teardown aborts below
+        # (reconnect won the race), the live tenant simply starts with
+        # a zero lease and re-acquires on its next dispatch.
+        with t.chip.scheduler.mu:
+            t.lease_release()
         with self.mu:
             # The quiesce ran unlocked (it can take seconds): a client
             # reconnecting under the same tenant name in that window
@@ -2273,11 +2800,6 @@ class RuntimeState:
             # reusing the name must not start silently frozen (the only
             # clue would be the admin-side STATS list).
             self.suspended.discard(t.name)
-        # Reclaim the unburned rate lease BEFORE the slot recycles: the
-        # next tenant on this slot must not inherit (or lose) the
-        # pre-debited budget.  scheduler.mu guards lease state.
-        with t.chip.scheduler.mu:
-            t.lease_release()
         # The close record goes out AFTER state.mu is released (lock
         # discipline: journal file I/O never runs under fast locks) but
         # before this thread's _cleanup drops the arrays — replay order
@@ -2563,19 +3085,29 @@ class TenantSession(socketserver.BaseRequestHandler):
                             str(msg["tenant"]), str(r_epoch))
                         resumed = tenant is not None
                     if tenant is None:
-                        tenant, created = self.state.tenant(
-                            str(msg["tenant"]),
-                            int(msg.get("priority", 1)),
-                            bool(msg.get("oversubscribe", False)),
-                            device=int(msg.get("device", 0)),
-                            devices=[int(d) for d in devs] if devs
-                            else None,
-                            hbm_limit=int(hbm) if hbm is not None
-                            else None,
-                            hbm_limits=[int(h) for h in hbms] if hbms
-                            else None,
-                            core_limit=int(core) if core is not None
-                            else None)
+                        try:
+                            tenant, created = self.state.tenant(
+                                str(msg["tenant"]),
+                                int(msg.get("priority", 1)),
+                                bool(msg.get("oversubscribe", False)),
+                                device=int(msg.get("device", 0)),
+                                devices=[int(d) for d in devs] if devs
+                                else None,
+                                hbm_limit=int(hbm) if hbm is not None
+                                else None,
+                                hbm_limits=[int(h) for h in hbms] if hbms
+                                else None,
+                                core_limit=int(core) if core is not None
+                                else None)
+                        except SlotsExhausted as e:
+                            # Transient capacity: typed OVERLOAD so the
+                            # client retries with jittered backoff
+                            # instead of dying on INTERNAL
+                            # (docs/SCHEDULING.md).
+                            self._send({"ok": False, "code": "OVERLOAD",
+                                        "error": str(e),
+                                        "retry_ms": 200})
+                            continue
                     if overshoot is not None and \
                             tenant.spill_overshoot is None:
                         # First HELLO wins, like the hbm/core grant.
@@ -2612,7 +3144,9 @@ class TenantSession(socketserver.BaseRequestHandler):
                     # broker when the probe HELLO'd chip 0.
                     self._send({"ok": True, "tenants": self._stats(),
                                 "journal": self.state.journal_stats(),
-                                "pool": dict(self.state.pool_stats)})
+                                "pool": dict(self.state.pool_stats),
+                                "admission":
+                                    self.state.admission_stats()})
                     continue
                 if kind == P.TRACE:
                     # BIND-FREE like STATS (same no-chip-claim
@@ -2949,7 +3483,9 @@ class TenantSession(socketserver.BaseRequestHandler):
                     tenant.chip.scheduler.quiesce(tenant.name)
                     self._send({"ok": True, "tenants": self._stats(),
                                 "journal": self.state.journal_stats(),
-                                "pool": dict(self.state.pool_stats)})
+                                "pool": dict(self.state.pool_stats),
+                                "admission":
+                                    self.state.admission_stats()})
 
                 else:
                     self._send_err("BAD_KIND", str(kind))
@@ -3083,6 +3619,14 @@ class TenantSession(socketserver.BaseRequestHandler):
             self.pending += n
 
     def _enqueue_execute(self, t: Tenant, msg) -> None:
+        retry_ms = self.state.admission.check(t.chip.scheduler, t, 1)
+        if retry_ms is not None:
+            # Shed (docs/SCHEDULING.md): typed retryable refusal, one
+            # reply frame exactly like the execute it answers — the
+            # pipelined client's reply accounting never desyncs.
+            self._drain()
+            self._send(self._overload_result(t, retry_ms))
+            return
         try:
             item = self._build_item(t, msg, trace=msg.get("trace"))
         except _ItemError as e:
@@ -3092,11 +3636,32 @@ class TenantSession(socketserver.BaseRequestHandler):
         self._reserve_pending(1)
         t.chip.scheduler.submit(item)
 
+    @staticmethod
+    def _overload_result(t: Tenant, retry_ms: int) -> dict:
+        return {"ok": False, "code": "OVERLOAD",
+                "error": f"RESOURCE_EXHAUSTED: broker shedding load "
+                         f"(tenant {t.name}, priority {t.priority}); "
+                         f"back off and retry",
+                "retry_ms": retry_ms}
+
     def _enqueue_batch(self, t: Tenant, msg) -> None:
         specs = msg.get("items")
         if not isinstance(specs, list) or not specs:
             self._drain()
             self._send_err("BAD_BATCH", "items must be a non-empty list")
+            return
+        retry_ms = self.state.admission.check(t.chip.scheduler, t,
+                                              len(specs))
+        if retry_ms is not None:
+            # Shed the whole batch: one positional reply whose every
+            # slot carries the typed OVERLOAD result (same frame shape
+            # as a served batch, so old and pipelined clients stay in
+            # sync; errors are per-slot exactly like validation
+            # failures).
+            self._drain()
+            res = self._overload_result(t, retry_ms)
+            self._send({"ok": True,
+                        "results": [dict(res) for _ in specs]})
             return
         batch = _BatchReply(len(specs))
         trace = msg.get("trace")
@@ -3257,6 +3822,15 @@ def collect_stats(state: RuntimeState):
             # grant count.  Unlocked read — advisory observability.
             "lease_us": int(t.lease_us),
             "lease_grants": int(t.lease_grants),
+            # vtpu-elastic (docs/SCHEDULING.md): burst-credit bank,
+            # preemption park state and shed counters — what `vtpu-smi
+            # top` renders.  Unlocked advisory reads like the lease.
+            "credit_us": int(t.credit_us),
+            "credit_minted_us": int(t.credit_minted_us),
+            "credit_spent_us": int(t.credit_spent_us),
+            "preempted": name in t.chip.scheduler.preempted,
+            "preemptions": int(t.preemptions),
+            "shed_total": int(t.shed_total),
         }
         # Flight-recorder rollup (latency histogram, queue/bucket wait
         # totals): rides on STATS so the metrics server gets per-tenant
@@ -3301,6 +3875,9 @@ def resize_tenant(state: RuntimeState, t: Tenant,
                 else int(t.chip.region.device_stats(t.index)
                          .core_limit_pct))
     t.grant = {"hbm": new_hbm, "core": new_core}
+    # Credit accrual tracks the new share immediately (the cached pct
+    # is what the mint path prices idle time at).
+    t.core_pct = new_core
     with t.chip.scheduler.mu:
         if core_limit is not None:
             # Re-clamp: refund the pre-debited lease and flag the
@@ -3386,6 +3963,13 @@ class AdminSession(socketserver.BaseRequestHandler):
                         with t_obj.chip.scheduler.mu:
                             t_obj.lease_release()
                             t_obj.lease_revoked = True
+                    if kind == P.RESUME and t_obj is not None:
+                        # An operator RESUME also clears an auto-park:
+                        # the admin's word outranks the preemption
+                        # policy's.
+                        with t_obj.chip.scheduler.mu:
+                            t_obj.chip.scheduler.preempted.pop(
+                                name, None)
                     # Wake every chip's dispatcher: a resumed tenant
                     # must not wait out a scheduler sleep.  chips is
                     # mutated under chips_mu (first HELLO on a chip).
@@ -3393,6 +3977,23 @@ class AdminSession(socketserver.BaseRequestHandler):
                         chips = list(self.state.chips.values())
                     for chip in chips:
                         chip.scheduler.kick()
+                    # Journaled (ops "suspend"/"resume", replay arm in
+                    # runtime/journal.py): a broker crash can no longer
+                    # silently unfreeze an admin-suspended tenant.
+                    jr = self.state.journal
+                    if jr is not None:
+                        try:
+                            if kind == P.SUSPEND:
+                                jr.append({"op": "suspend",
+                                           "name": name,
+                                           "auto": False})
+                            else:
+                                jr.append({"op": "resume",
+                                           "name": name,
+                                           "auto": False})
+                        except OSError as e:
+                            log.error("journal: %s record for %s lost "
+                                      "(%s)", kind, name, e)
                     log.info("admin: %s tenant %r (known=%s)", kind,
                              name, known)
                     P.send_msg(self.request,
@@ -3443,7 +4044,9 @@ class AdminSession(socketserver.BaseRequestHandler):
                                 "tenants": collect_stats(self.state),
                                 "suspended": suspended,
                                 "journal": self.state.journal_stats(),
-                                "pool": dict(self.state.pool_stats)})
+                                "pool": dict(self.state.pool_stats),
+                                "admission":
+                                    self.state.admission_stats()})
                 elif kind == P.TRACE:
                     # Host-side flight-recorder read (vtpu-smi trace):
                     # same body as the tenant-socket verb.
@@ -3494,6 +4097,12 @@ class AdminSession(socketserver.BaseRequestHandler):
 class _Server(socketserver.ThreadingUnixStreamServer):
     daemon_threads = True
     allow_reuse_address = True
+    # Bounded accept queue (docs/SCHEDULING.md): connections past this
+    # listen backlog queue in the kernel and eventually fail to dial —
+    # a thousand-tenant join storm exerts backpressure at the socket
+    # instead of spawning an unbounded session-thread herd.
+    request_queue_size = max(
+        int(os.environ.get("VTPU_ACCEPT_BACKLOG", "128")), 1)
     admin_server: "Optional[_Server]" = None
 
     def shutdown(self):
@@ -3521,6 +4130,39 @@ def _journal_keeper(state: RuntimeState) -> None:
             state.journal_tick()
         except Exception as e:  # noqa: BLE001 - upkeep must survive
             log.warn("journal keeper: %s", e)
+
+
+def _elastic_keeper(state: RuntimeState) -> None:
+    """The broker's overload self-watchdog (docs/SCHEDULING.md): runs
+    OUTSIDE the dispatch loop so a saturated dispatcher cannot starve
+    the very machinery that sheds its load.  Each tick it (1) feeds the
+    SLO-burn signal into admission — while any priority-0 tenant's
+    short-window burn alert fires, lower priorities shed at half their
+    normal backlog threshold — and (2) screams when a chip's backlog
+    has reached the hard cap (every new request is already being shed
+    by then; the log line is the operator's saturation evidence)."""
+    while not state._keeper_stop.wait(0.5):  # noqa: SLF001
+        try:
+            hot = False
+            if state.slo.enabled and state.admission.shed_burn:
+                alerts = state.slo.burn_alerts()
+                if alerts:
+                    with state.mu:
+                        pris = {n: t.priority
+                                for n, t in state.tenants.items()}
+                    hot = any(pris.get(n, 1) <= 0 for n in alerts)
+            state.admission.burn_hot = hot
+            with state.chips_mu:
+                chips = list(state.chips.values())
+            for chip in chips:
+                bl = chip.scheduler.total_backlog
+                if bl >= state.admission.max_backlog:
+                    log.warn(
+                        "admission: chip %d backlog %d at the hard cap "
+                        "%d — shedding ALL new work until it drains",
+                        chip.index, bl, state.admission.max_backlog)
+        except Exception as e:  # noqa: BLE001 - watchdog must survive
+            log.warn("elastic keeper: %s", e)
 
 
 def _lease_keeper(state: RuntimeState) -> None:
@@ -3572,6 +4214,8 @@ def make_server(socket_path: str, hbm_limit: int, core_limit: int,
                          daemon=True, name="vtpu-rt-journal").start()
     threading.Thread(target=_lease_keeper, args=(state,),
                      daemon=True, name="vtpu-rt-lease").start()
+    threading.Thread(target=_elastic_keeper, args=(state,),
+                     daemon=True, name="vtpu-rt-elastic").start()
     handler = type("BoundSession", (TenantSession,), {"state": state})
     srv = _Server(socket_path, handler)
     srv.state = state  # type: ignore[attr-defined]

@@ -259,6 +259,12 @@ class Bridge:
             collections.deque()
         self._free: List[str] = []
         self._closed = False
+        # Overload pacing (docs/SCHEDULING.md): when the broker sheds a
+        # bridged execute (typed VtpuOverload reply), subsequent sends
+        # hold off until this monotonic instant — the bridged train
+        # loop backs off around the broker's retry_ms hint instead of
+        # hammering a saturated broker.
+        self._overload_hold = 0.0
 
     # -- deferred frees --
     def free_later(self, aid: str) -> None:
@@ -279,13 +285,24 @@ class Bridge:
                 a._err = err  # noqa: SLF001
 
     def _recv_one_locked(self) -> None:
-        from ..runtime.client import VtpuConnectionLost, VtpuStateLost
+        from ..runtime.client import (VtpuConnectionLost, VtpuOverload,
+                                      VtpuStateLost)
         kind, batch = self._outstanding.popleft()
         try:
             if kind == "exe":
                 self.client.execute_recv()
             else:  # transient-put ack
                 self.client.recv_reply()
+        except VtpuOverload as e:
+            # The broker shed this step: only this batch is poisoned
+            # (the typed error surfaces on its fetch), and the pacing
+            # hold makes the NEXT sends back off around the broker's
+            # hint — bounded, jitter-free here because the broker's
+            # shed decision itself already varies with load.
+            self._overload_hold = time.monotonic() + \
+                max(float(e.retry_ms or 50), 10.0) / 1e3
+            self._poison_batch(batch, e)
+            raise
         except (VtpuStateLost, VtpuConnectionLost) as e:
             # Connection-level loss: every reply still outstanding died
             # with the old socket — poison this batch AND the rest, or
@@ -368,6 +385,10 @@ class Bridge:
                     out_avals: Sequence[Any]) -> List[BridgeArray]:
         from ..runtime.client import VtpuConnectionLost, VtpuStateLost
         try:
+            hold = self._overload_hold - time.monotonic()
+            if hold > 0:
+                # Shed recently: pace this send (overload backpressure).
+                time.sleep(min(hold, 2.0))
             while len(self._outstanding) >= _MAX_OUTSTANDING:
                 self._recv_one_locked()
             arg_ids = []

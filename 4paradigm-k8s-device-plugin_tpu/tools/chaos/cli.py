@@ -92,6 +92,46 @@ def _smoke() -> List[str]:
     kinds = RuntimeClient._RESUME_RETRY_KINDS
     if not kinds or P.EXECUTE in kinds or P.EXEC_BATCH in kinds:
         errs.append(f"retry-kind derivation broken: {sorted(kinds)}")
+
+    # Preemption policy (docs/SCHEDULING.md): the pure decision
+    # function the churn schedule's park-then-kill scenario rides on.
+    # Sustained priority-0 demand must pick the busiest lower-priority
+    # victim; same-priority load, un-sustained demand, and a
+    # victimless chip must all decline.
+    from ...runtime.server import preempt_decision
+    pick = preempt_decision(
+        [("hi", 0, 1.0, 4), ("lo1", 1, 1.0, 2), ("lo2", 1, 0.0, 9)],
+        now=2.0, after_ms=250.0)
+    if pick != ("hi", "lo2"):
+        errs.append(f"preempt_decision missed the busiest lower-"
+                    f"priority victim: {pick}")
+    if preempt_decision([("hi", 0, 1.9, 4), ("lo", 1, 1.0, 2)],
+                        now=2.0, after_ms=250.0) is not None:
+        errs.append("preempt_decision fired on UN-sustained demand")
+    if preempt_decision([("a", 1, 1.0, 4), ("b", 1, 1.0, 4)],
+                        now=2.0, after_ms=250.0) is not None:
+        errs.append("preempt_decision fired without a lower-priority "
+                    "victim")
+    if preempt_decision([("hi", 0, 1.0, 4), ("idle", 1, 0.0, 0)],
+                        now=2.0, after_ms=250.0) is not None:
+        errs.append("preempt_decision picked a loadless victim")
+
+    # Overload shedding: lowest priority first, priority 0 only at the
+    # hard cap, burn-hot halves the lower tiers' thresholds.
+    from ...runtime.server import AdmissionState
+    adm = AdmissionState()
+    if not (adm.shed_fraction(0) == 1.0
+            and adm.shed_fraction(1) < 1.0
+            and adm.shed_fraction(2) <= adm.shed_fraction(1)):
+        errs.append("shed fractions are not priority-ordered")
+    cold = adm.shed_fraction(1)
+    adm.burn_hot = True
+    if not adm.shed_fraction(1) < cold:
+        errs.append("burn-hot did not tighten the priority-1 shed "
+                    "threshold")
+    if adm.shed_fraction(0) != 1.0:
+        errs.append("burn-hot must never lower the priority-0 "
+                    "threshold below the hard cap")
     return errs
 
 
@@ -119,6 +159,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help=argparse.SUPPRESS)
     ap.add_argument("--child-seed", type=int, default=0, dest="seed",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--child-priority", type=int, default=1,
+                    dest="priority", help=argparse.SUPPRESS)
     ap.add_argument("--hbm", type=int, default=0,
                     help=argparse.SUPPRESS)
     ap.add_argument("--core", type=int, default=0,

@@ -44,6 +44,7 @@ def tenant_main(ns) -> int:
     while client is None:
         try:
             client = RuntimeClient(ns.socket, tenant=ns.name,
+                                   priority=ns.priority,
                                    hbm_limit=ns.hbm or None,
                                    core_limit=ns.core or None)
         except (OSError, RuntimeError_):

@@ -395,6 +395,11 @@ class Harness:
         st.default_core = default_core
         st.min_exec_cost_us = min_exec_cost_us
         st.tenants = {}
+        # vtpu-elastic admission control, with the mc shed oracle armed
+        # (the broker records every shed decision into it; the
+        # shed-precedence invariant judges the log).
+        st.admission = S.AdmissionState()
+        st.admission.shed_log = []
         st.suspended = set()
         st.blob_cache = collections.OrderedDict()
         st.chain_cache = collections.OrderedDict()
@@ -409,6 +414,9 @@ class Harness:
         st.chips = {}
         for i in range(n_chips):
             st.chips[i] = FakeChip(st, i, self.clock, cap_us, refill)
+            # Arm the credit oracle: every burst-credit mint / spend /
+            # floor-guard denial is recorded for the credit invariants.
+            st.chips[i].scheduler.credit_log = []
         return st
 
     def session(self, sock: Optional[ScriptSock] = None) -> Any:
@@ -528,7 +536,8 @@ class Harness:
                 continue
             now = self.clock.now()
             for name, q in ds.queues.items():
-                if not q or name in self.state.suspended:
+                if not q or name in self.state.suspended \
+                        or name in ds.preempted:
                     continue
                 if ds.inflight.get(name, 0) >= S.MAX_INFLIGHT:
                     continue
@@ -547,7 +556,8 @@ class Harness:
             if ds._completion_q.items:  # MCQueue
                 return False
             for name, q in ds.queues.items():
-                if q and name not in self.state.suspended:
+                if q and name not in self.state.suspended \
+                        and name not in ds.preempted:
                     return False
         return True
 

@@ -55,6 +55,7 @@ def _chk_token_conservation(h: Any) -> List[str]:
         return []
     out: List[str] = []
     lease_by_slot: dict = {}
+    credit_by_slot: dict = {}
     live = list(h.state.tenants.values()) \
         + [e[0] for e in h.state.recovered.values()]
     for t in live:
@@ -62,6 +63,14 @@ def _chk_token_conservation(h: Any) -> List[str]:
             key = (chip.index, slot)
             lease_by_slot[key] = lease_by_slot.get(key, 0.0) \
                 + float(t.lease_us)
+            # Burst-credit-funded device time never touched the bucket
+            # (docs/SCHEDULING.md): it is billed to the credit bank, so
+            # the bucket's net debit must fall short of metered busy
+            # time by exactly the spent credit — anything else means a
+            # lease carried borrowed credit, or a credit admit was
+            # double-billed.
+            credit_by_slot[key] = credit_by_slot.get(key, 0.0) \
+                + float(t.credit_spent_us)
     for chip in h.state.chips.values():
         r = chip.region
         for s in range(r.nslots):
@@ -71,15 +80,17 @@ def _chk_token_conservation(h: Any) -> List[str]:
                         f"unmetered slot chip{chip.index}/{s} has a "
                         f"net bucket debit of {r.net_debit[s]:.0f}us")
                 continue
-            expect = r.busy_since_reset(s) \
-                + lease_by_slot.get((chip.index, s), 0.0)
+            leases = lease_by_slot.get((chip.index, s), 0.0)
+            credit = credit_by_slot.get((chip.index, s), 0.0)
+            expect = r.busy_since_reset(s) + leases - credit
             if abs(r.net_debit[s] - expect) > EPS_US:
                 out.append(
                     f"token conservation broken on chip{chip.index} "
                     f"slot {s}: net debit {r.net_debit[s]:.0f}us != "
                     f"busy {r.busy_since_reset(s)}us + outstanding "
-                    f"leases {lease_by_slot.get((chip.index, s), 0.0):.0f}"
-                    f"us (quota leak / double credit)")
+                    f"leases {leases:.0f}us - spent credit "
+                    f"{credit:.0f}us (quota leak / double credit / "
+                    f"credit-funded lease)")
     return out
 
 
@@ -121,6 +132,74 @@ def _chk_lease_nonneg(h: Any) -> List[str]:
         if t.lease_us < -1e-9:
             out.append(f"tenant {t.name!r} lease balance is negative: "
                        f"{t.lease_us}")
+    return out
+
+
+def _chk_credit_bounds(h: Any) -> List[str]:
+    """Burst-credit sanity at every step (docs/SCHEDULING.md): a
+    balance can never be negative (spending credit that was never
+    banked) nor exceed the burst cap, and a tenant's cumulative mint
+    can never exceed its core share of the wall time since bind — the
+    'credit minted from nothing' bug class."""
+    from ...runtime import server as S
+    cap = S.BURST_CAP_US
+    out: List[str] = []
+    now = h.clock.now()
+    seen: set = set()
+    every = (list(h.state.tenants.values())
+             + [e[0] for e in h.state.recovered.values()]
+             + list(h.all_tenants))
+    for t in every:
+        if id(t) in seen:
+            continue
+        seen.add(id(t))
+        if t.credit_us < -EPS_US:
+            out.append(f"tenant {t.name!r} credit balance is negative: "
+                       f"{t.credit_us:.0f}us")
+        if t.credit_us > cap + EPS_US:
+            out.append(f"tenant {t.name!r} credit balance "
+                       f"{t.credit_us:.0f}us exceeds the burst cap "
+                       f"{cap:.0f}us")
+        max_mint = max(now - t.bind_ts, 0.0) * t.core_pct * 1e4 + EPS_US
+        if t.credit_minted_us > max_mint:
+            out.append(
+                f"tenant {t.name!r} minted {t.credit_minted_us:.0f}us "
+                f"of credit but its {t.core_pct}% share of the "
+                f"{now - t.bind_ts:.3f}s since bind is only "
+                f"{max_mint:.0f}us (credit minted from nothing)")
+    return out
+
+
+def _chk_floor_under_burst(h: Any) -> List[str]:
+    """Hard-floor guard: no burst-credit spend may ever happen while a
+    co-tenant with queued work sits bucket-throttled — the broker logs
+    every spend with the contention snapshot it computed, and a spend
+    recorded as contended means the guard was bypassed."""
+    out: List[str] = []
+    for chip in h.state.chips.values():
+        for ev in (chip.scheduler.credit_log or ()):
+            kind, name, us, contended = ev
+            if kind == "spend" and contended:
+                out.append(
+                    f"tenant {name!r} spent {us:.0f}us of burst credit "
+                    f"on chip{chip.index} while floor-demanding "
+                    f"co-tenant(s) {list(contended)} were throttled "
+                    f"with backlog (hard floor violated under burst)")
+    return out
+
+
+def _chk_shed_precedence(h: Any) -> List[str]:
+    """Overload shedding must shed lowest priority first: a priority-0
+    (floor-demanding) tenant's request may only ever be refused at the
+    hard backlog cap (overload level > 1.0), never while lower
+    priorities would still be admitted."""
+    out: List[str] = []
+    for name, pri, level in (h.state.admission.shed_log or ()):
+        if pri <= 0 and level <= 1.0 + 1e-9:
+            out.append(
+                f"floor-demanding (priority {pri}) tenant {name!r} "
+                f"was shed at overload level {level:.2f} — only the "
+                f"hard cap (level > 1.0) may refuse priority 0")
     return out
 
 
@@ -231,6 +310,22 @@ INVARIANTS: Tuple[Invariant, ...] = (
         "lease-nonnegative", "interleave", "step",
         "pre-debited lease balances never go negative",
         _chk_lease_nonneg),
+    Invariant(
+        "credit-bounds", "interleave", "step",
+        "burst-credit balances stay within [0, cap] and cumulative "
+        "mint never exceeds the tenant's core share of wall time "
+        "since bind (no credit minted from nothing)",
+        _chk_credit_bounds),
+    Invariant(
+        "floor-under-burst", "interleave", "terminal",
+        "no burst-credit spend while a co-tenant with queued work is "
+        "bucket-throttled (hard floors never violated by bursting)",
+        _chk_floor_under_burst),
+    Invariant(
+        "shed-precedence", "interleave", "terminal",
+        "overload shedding refuses lowest priority first; priority 0 "
+        "is only ever shed at the hard backlog cap",
+        _chk_shed_precedence),
     Invariant(
         "no-lost-wake", "interleave", "step",
         "the dispatcher never idle-sleeps while dispatchable work is "

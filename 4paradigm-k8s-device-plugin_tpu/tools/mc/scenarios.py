@@ -239,6 +239,126 @@ def _setup_churn_rebind(h: Harness, sched: mcsched.Scheduler) -> None:
     sched.spawn(steady, "clientB")
 
 
+def _setup_burst_credits(h: Harness, sched: mcsched.Scheduler) -> None:
+    """vtpu-elastic work conservation (docs/SCHEDULING.md): tenant A
+    idles long enough to bank credit (one mint at its next submit),
+    then bursts a batch whose tail exceeds the frozen bucket's seed —
+    the third item must admit FROM THE BANK.  B runs within its own
+    bucket throughout.  Covers: idle-window mint, credit-funded
+    admission, the token-conservation split (net debit == busy +
+    leases - spent credit), credit bounds."""
+    sA, sB = h.session(), h.session()
+
+    def burster() -> None:
+        t = h.tenant(sA, "A", core_limit=50)
+        t.executables["p"] = fake_program()
+        # Idle on the logical clock: the mint window is open from bind
+        # and closes (banking 0.5s x 50% = 250ms of device time) at
+        # the submit below.
+        h.clock.sleep(0.5)
+        sA._enqueue_batch(t, {"items": [
+            h.exec_spec("p", [], ["o1"]),
+            h.exec_spec("p", ["o1"], ["o2"]),
+            h.exec_spec("p", ["o2"], ["o3"]),
+        ]})
+        sA._drain()
+        _teardown(h, sA, t)
+
+    def steady() -> None:
+        t = h.tenant(sB, "B", core_limit=50)
+        t.executables["q"] = fake_program()
+        sB._enqueue_execute(t, h.exec_spec("q", [], ["y1"]))
+        sB._drain()
+        _teardown(h, sB, t)
+
+    sched.spawn(burster, "clientA")
+    sched.spawn(steady, "clientB")
+
+
+def _setup_burst_floor(h: Harness, sched: mcsched.Scheduler) -> None:
+    """The hard-floor guard under contention (refill bucket): A's
+    program costs more than its whole bucket seed, so it can ONLY run
+    from banked credit — and B's small bucket throttles it mid-batch,
+    so A's spend attempts interleave with a floor-demanding co-tenant.
+    Every interleaving must show A spending only while B is NOT
+    throttled-with-backlog (floor-under-burst), with A's admission
+    eventually succeeding once B drains (no starvation)."""
+    sA, sB = h.session(), h.session()
+
+    def burster() -> None:
+        t = h.tenant(sA, "A", core_limit=50)
+        t.executables["p"] = fake_program()
+        # A's learned cost exceeds the bucket seed: bucket admission
+        # can never succeed, only the credit bank can fund it.
+        t.cost_ema["p"] = 20_000.0
+        # Idle long enough to bank the burst (50% x 50ms = 25ms of
+        # device time > the 20ms ask) — and SHORT enough that B is
+        # still throttled mid-batch when the burst arrives.
+        h.clock.sleep(0.05)
+        sA._enqueue_execute(t, h.exec_spec("p", [], ["o1"]))
+        sA._drain()
+        _teardown(h, sA, t)
+
+    def floor() -> None:
+        t = h.tenant(sB, "B", core_limit=50)
+        t.executables["q"] = fake_program()
+        # Pre-drain B's bucket deep into deficit: its batch is then
+        # bucket-throttled with backlog for ~60ms of refill — the
+        # floor-demand window A's credit burst must NOT cut into.
+        t.chip.region.rate_adjust(t.index, 30_000)
+        sB._enqueue_batch(t, {"items": [
+            h.exec_spec("q", [], ["y1"]),
+            h.exec_spec("q", ["y1"], ["y2"]),
+            h.exec_spec("q", ["y2"], ["y3"]),
+        ]})
+        sB._drain()
+        _teardown(h, sB, t)
+
+    # B first: the canonical schedules then have B's throttle (the
+    # floor-demand signal) registered before A's burst arrives — the
+    # deny path of the guard is exercised from schedule one, and the
+    # DFS still explores the spend-first orders.
+    sched.spawn(floor, "clientB")
+    sched.spawn(burster, "clientA")
+
+
+def _setup_overload_shed(h: Harness, sched: mcsched.Scheduler) -> None:
+    """Overload admission control: with a tiny backlog cap, the
+    priority-1 tenant's batch must be SHED (typed OVERLOAD results,
+    one positional reply) while the priority-0 tenant's work is still
+    admitted — lowest priority first, judged by the shed-precedence
+    row over the admission oracle log."""
+    h.state.admission.max_backlog = 4
+    h.state.admission.tenant_cap = 8
+    sC, sD = h.session(), h.session()
+
+    def hi() -> None:
+        t = h.tenant(sC, "C", priority=0, core_limit=50)
+        t.executables["p"] = fake_program()
+        sC._enqueue_batch(t, {"items": [
+            h.exec_spec("p", [], ["o1"]),
+            h.exec_spec("p", ["o1"], ["o2"]),
+        ]})
+        sC._drain()
+        _teardown(h, sC, t)
+
+    def lo() -> None:
+        t = h.tenant(sD, "D", priority=1, core_limit=50)
+        t.executables["q"] = fake_program()
+        # 3 items against a cap of 4: level >= 0.75 > the priority-1
+        # shed fraction — refused in EVERY interleaving.
+        sD._enqueue_batch(t, {"items": [
+            h.exec_spec("q", [], ["y1"]),
+            h.exec_spec("q", ["y1"], ["y2"]),
+            h.exec_spec("q", ["y2"], ["y3"]),
+        ]})
+        sD._drain()
+        _teardown(h, sD, t)
+
+    sched.spawn(hi, "clientC")
+    sched.spawn(lo, "clientD")
+
+
 # ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
@@ -266,6 +386,23 @@ SCENARIOS: List[Scenario] = [
     Scenario("churn_rebind",
              "release + rebind recycles the slot mid-traffic",
              _setup_churn_rebind, with_journal=True),
+    Scenario("burst_credits",
+             "idle tenant banks burst credit and spends it past the "
+             "frozen bucket seed",
+             _setup_burst_credits,
+             harness_kw={"cap_us": 12_000, "rate_lease_us": 0},
+             with_journal=False),
+    Scenario("burst_floor",
+             "credit burster races a bucket-throttled floor-demanding "
+             "co-tenant",
+             _setup_burst_floor,
+             harness_kw={"cap_us": 6_000, "rate_lease_us": 0,
+                         "refill": True},
+             with_journal=False),
+    Scenario("overload_shed",
+             "priority-1 batch shed at a tiny backlog cap; priority-0 "
+             "admitted",
+             _setup_overload_shed, with_journal=False),
 ]
 
 

@@ -139,6 +139,72 @@ def _seed_unflushed_journal() -> Iterator[None]:
         S.flush_tenant_journal = orig
 
 
+@contextlib.contextmanager
+def _seed_credit_mint_nothing() -> Iterator[None]:
+    """Accrual mints a fat constant per call instead of pricing the
+    idle window at the core share: credit appears from nothing."""
+    from ...runtime import server as S
+    orig = S.DeviceScheduler._mint_credit_locked
+
+    def fabricate(self: Any, t: Any, now: float) -> None:
+        t.credit_us += 1_000_000.0
+        t.credit_minted_us += 1_000_000.0
+
+    S.DeviceScheduler._mint_credit_locked = fabricate
+    try:
+        yield
+    finally:
+        S.DeviceScheduler._mint_credit_locked = orig
+
+
+@contextlib.contextmanager
+def _seed_floor_violated() -> Iterator[None]:
+    """The credit-spend path ignores the floor guard: a burster keeps
+    spending while a co-tenant with backlog sits bucket-throttled (the
+    contention snapshot is still computed and logged truthfully — only
+    the DENY decision is dropped)."""
+    from ...runtime import server as S
+
+    def no_guard(self: Any, t: Any, est: float, now: float) -> bool:
+        if S.BURST_CAP_US <= 0 or t.credit_us < est:
+            return False
+        contended = tuple(
+            n for n, q in self.queues.items()
+            if q and n != t.name and n not in self.preempted
+            and self.not_ready_until.get(n, 0.0) > now)
+        t.credit_us -= est
+        t.credit_spent_us += est
+        t.last_admit_credit = True
+        if self.credit_log is not None:
+            self.credit_log.append(("spend", t.name, est, contended))
+        return True
+
+    orig = S.DeviceScheduler._credit_admit_locked
+    S.DeviceScheduler._credit_admit_locked = no_guard
+    try:
+        yield
+    finally:
+        S.DeviceScheduler._credit_admit_locked = orig
+
+
+@contextlib.contextmanager
+def _seed_shed_floor_demander() -> Iterator[None]:
+    """Shedding inverted: the floor-demanding priority-0 class sheds
+    FIRST (at a 0.1 backlog fraction) while the lower priorities hold
+    out to the cap."""
+    from ...runtime import server as S
+    orig = S.AdmissionState.shed_fraction
+
+    def inverted(self: Any, priority: int) -> float:
+        return 0.1 if priority <= 0 else 1.0
+
+    S.AdmissionState.shed_fraction = inverted
+    try:
+        yield
+    finally:
+        S.AdmissionState.shed_fraction = orig
+
+
 # ---------------------------------------------------------------------------
 # Crash-engine seeds
 # ---------------------------------------------------------------------------
@@ -287,6 +353,12 @@ SEEDS: Tuple[Seed, ...] = (
          "tenant_crash", _seed_unflushed_journal),
     Seed("terminal-deferred-leftover", "interleave", "deferred-flush",
          "batch_pipeline", _seed_unflushed_journal),
+    Seed("credit-minted-from-nothing", "interleave", "credit-bounds",
+         "burst_credits", _seed_credit_mint_nothing),
+    Seed("floor-violated-under-burst", "interleave", "floor-under-burst",
+         "burst_floor", _seed_floor_violated),
+    Seed("shed-of-floor-demander", "interleave", "shed-precedence",
+         "overload_shed", _seed_shed_floor_demander),
     Seed("skipped-replay-arm", "crash", "replay-ground-truth",
          "", _seed_skipped_replay_arm),
     Seed("nondeterministic-replay", "crash", "replay-deterministic",

@@ -329,6 +329,13 @@ def _top_rows(slo_resp: dict, stats_resp: dict) -> List[Dict]:
             "top_blamer": body.get("top_blamer"),
             "hbm_used": st.get("used_bytes", 0),
             "suspended": st.get("suspended", False),
+            # vtpu-elastic (docs/SCHEDULING.md): burst-credit balance,
+            # preemption park state and shed counters ride the same
+            # bind-free STATS reply.
+            "credit_ms": round(st.get("credit_us", 0) / 1e3, 1),
+            "preempted": st.get("preempted", False),
+            "preemptions": st.get("preemptions", 0),
+            "shed": st.get("shed_total", 0),
         })
     rows.sort(key=lambda r: -r["steps_per_s"])
     return rows
@@ -339,22 +346,28 @@ def render_top(rows: List[Dict], enabled: bool = True,
     """The htop-style per-tenant SLO table (docs/OBSERVABILITY.md)."""
     hdr = (f"{'TENANT':<18} {'STEPS/S':>8} {'P50 E2E':>9} "
            f"{'P99 E2E':>9} {'P99 QUE':>9} {'P99 DEV':>9} "
-           f"{'ATTAIN%':>8} {'BURN':>6} {'FAIR':>5} {'TOP BLAMER':<16}")
+           f"{'ATTAIN%':>8} {'BURN':>6} {'FAIR':>5} {'CREDIT':>8} "
+           f"{'SHED':>5} {'TOP BLAMER':<16}")
     lines = ["vtpu-smi top — per-tenant SLO / fairness / blame"
              + (f"  (jain={jain})" if jain is not None else "")
              + ("" if enabled else "  [SLO PLANE DISABLED: VTPU_SLO=0]"),
              hdr, "-" * len(hdr)]
     for r in rows:
+        # State flag: '!' burn alert, 's' admin-suspended, 'p'
+        # preemption-parked (docs/SCHEDULING.md).
         flag = "!" if r["burn_alert"] else (
-            "s" if r["suspended"] else " ")
+            "s" if r["suspended"] else (
+                "p" if r.get("preempted") else " "))
         fair = (f"{r['fair_ratio']:.2f}" if r["fair_ratio"] is not None
                 else "-")
+        credit = f"{r.get('credit_ms', 0):.0f}ms"
         lines.append(
             f"{r['tenant'][:17]:<17}{flag} {r['steps_per_s']:>8.1f} "
             f"{r['p50_e2e_us']:>9.0f} {r['p99_e2e_us']:>9.0f} "
             f"{r['p99_queue_us']:>9.0f} {r['p99_device_us']:>9.0f} "
             f"{r['attainment_pct']:>8.2f} {r['burn_rate']:>6.1f} "
-            f"{fair:>5} {(r['top_blamer'] or '-')[:16]:<16}")
+            f"{fair:>5} {credit:>8} {r.get('shed', 0):>5} "
+            f"{(r['top_blamer'] or '-')[:16]:<16}")
     if not rows:
         lines.append("(no tenants with SLO history)")
     return "\n".join(lines)

@@ -176,6 +176,18 @@ ENV_FLAGS = {
     "VTPU_RATE_LEASE_US": ("broker", True),
     "VTPU_RECV_POOL_MB": ("broker", True),
     "VTPU_WAKE_BATCH": ("broker", False),
+    # vtpu-elastic (docs/SCHEDULING.md): burst-credit economy,
+    # priority preemption, overload-safe admission control.
+    "VTPU_BURST_CAP_QUANTA": ("broker", True),
+    "VTPU_PREEMPT": ("broker", True),
+    "VTPU_PREEMPT_AFTER_MS": ("broker", True),
+    "VTPU_PREEMPT_MAX_PARK_S": ("broker", True),
+    "VTPU_PREEMPT_COOLDOWN_MS": ("broker", False),
+    "VTPU_MAX_BACKLOG": ("broker", True),
+    "VTPU_TENANT_QUEUE_CAP": ("broker", True),
+    "VTPU_ACCEPT_BACKLOG": ("broker", False),
+    "VTPU_SHED_BURN": ("broker", True),
+    "VTPU_OVERLOAD_RETRIES": ("shim", True),
     # vtpu-chaos (docs/CHAOS.md): deterministic fault injection +
     # client churn hardening + broker-loss degraded mode.
     "VTPU_FAULTS": ("chaos", True),

@@ -1,0 +1,755 @@
+#!/usr/bin/env python3
+"""Thousand-tenant traffic simulation bench (docs/SCHEDULING.md).
+
+PR 5's broker_bench proved the hot path on CPU; this bench proves the
+ELASTIC ECONOMY under hostile traffic shapes the 1-4-tenant benches
+never exercise: Poisson/bursty arrivals, heavy-tailed request sizes,
+join/leave/crash churn, hundreds of distinct tenants MULTIPLEXED over
+the broker's per-chip slots (slots recycle as tenants churn; a full
+chip answers the typed OVERLOAD code and the joiner backs off — that
+IS the admission story under a join storm).  Three cells, each against
+a real broker subprocess on the CPU backend:
+
+  burst     work conservation: one bursting + one idle tenant under
+            STRICT shares (VTPU_WORK_CONSERVING=0).  The burster banks
+            credit while idle and then exceeds its static bucket rate
+            (A/B against VTPU_BURST_CAP_QUANTA=0), and the idle
+            tenant's floor re-engages within a scheduler quantum of
+            its demand returning (first-dispatch latency).
+  preempt   priority is real: a priority-0 pinger's RTT p99 is
+            measured solo, under a priority-1 saturator with
+            preemption DISABLED (the PR 7 unpreempted regime), and
+            with preemption on — the preempted p99 must recover to
+            <= 2x solo.
+  overload  the thousand-tenant cell: N distinct tenants (512 full /
+            64 smoke) churn over an 8-chip CPU mesh with Poisson
+            arrivals, pareto-tailed chain lengths and crash-leavers,
+            while per-chip priority-0 floor tenants demand their floor
+            throughout.  Gates: every floor tenant's attainment >= 99%
+            at saturation, RTT p99 bounded (no unbounded queue
+            growth), shedding typed (client VtpuOverload counters).
+
+Usage:
+  python benchmarks/traffic_sim.py [--quick] [--cell all|burst|preempt|overload]
+      [--tenants N] [--seed K] [--out BENCH_TRAFFIC_r01.json]
+  python benchmarks/traffic_sim.py --smoke --check BENCH_TRAFFIC_r01.json
+
+``--smoke`` is the CI shape (64 tenants, short windows); ``--check``
+re-runs it and gates the fairness/attainment/preemption criteria
+against both absolute floors and the committed recording.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import socket as socketmod
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SCHED_QUANTUM_S = 0.1   # broker SCHED_QUANTUM_US, the floor-re-engage gate unit
+
+# -- absolute acceptance gates (ISSUE 10) -----------------------------------
+GATE_BURST_GAIN = 1.15        # credits-on vs credits-off burster steps
+GATE_PREEMPT_P99_X = 2.0      # preempted p99 <= this x solo p99
+GATE_FLOOR_ATTAIN_PCT = 99.0  # every floor tenant, at saturation
+GATE_RTT_P99_S = 1.0          # overload cell client RTT p99 bound
+
+
+def _broker_env(extra: Dict[str, str], chips: int) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                      + f" --xla_force_host_platform_device_count={chips}"
+                      ).strip(),
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "VTPU_LOG_LEVEL": "0",
+        "VTPU_TRACE": "0",
+        # Short SLO windows so attainment/burn reflect the bench run.
+        "VTPU_SLO_WINDOWS": "10,60",
+    })
+    env.pop("VTPU_FAULTS", None)
+    env.pop("VTPU_JOURNAL_DIR", None)
+    env.update(extra)
+    return env
+
+
+class Broker:
+    """One broker subprocess + admin-socket helpers."""
+
+    def __init__(self, tmp: str, extra_env: Dict[str, str],
+                 chips: int = 1, core_limit: int = 40):
+        self.sock = os.path.join(tmp, "ts.sock")
+        self.log_path = os.path.join(tmp, "broker.log")
+        cmd = [sys.executable, "-m", "vtpu.runtime.server",
+               "--socket", self.sock, "--hbm-limit", "64Mi",
+               "--core-limit", str(core_limit)]
+        self.proc = subprocess.Popen(
+            cmd, cwd=REPO, env=_broker_env(extra_env, chips),
+            stdout=open(self.log_path, "ab"), stderr=subprocess.STDOUT)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if os.path.exists(self.sock):
+                s = socketmod.socket(socketmod.AF_UNIX,
+                                     socketmod.SOCK_STREAM)
+                s.settimeout(1.0)
+                try:
+                    s.connect(self.sock)
+                    return
+                except OSError:
+                    pass
+                finally:
+                    s.close()
+            time.sleep(0.1)
+        raise RuntimeError("broker never bound its socket")
+
+    def admin(self, msg: dict) -> Optional[dict]:
+        from vtpu.runtime import protocol as P
+        s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        s.settimeout(5.0)
+        try:
+            s.connect(self.sock + ".admin")
+            P.send_msg(s, msg)
+            return P.recv_msg(s)
+        except OSError:
+            return None
+        finally:
+            s.close()
+
+    def stats(self) -> Optional[dict]:
+        from vtpu.runtime import protocol as P
+        return self.admin({"kind": P.STATS})
+
+    def slo(self) -> Optional[dict]:
+        from vtpu.runtime import protocol as P
+        return self.admin({"kind": P.SLO})
+
+    def close(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+_EXPORT_CACHE: Dict[int, bytes] = {}
+
+
+def _program_blob() -> bytes:
+    """One tiny single-device program every simulated tenant shares
+    (the broker's blob dedup makes this the common co-tenancy shape)."""
+    blob = _EXPORT_CACHE.get(0)
+    if blob is None:
+        import jax
+        import jax.export  # noqa: F401
+        import numpy as np
+        x = jax.ShapeDtypeStruct((256,), np.float32)
+        exported = jax.export.export(
+            jax.jit(lambda a: a * 1.0001 + 1.0),
+            platforms=("cpu", "tpu"))(x)
+        blob = bytes(exported.serialize())
+        _EXPORT_CACHE[0] = blob
+    return blob
+
+
+def _client(broker: Broker, name: str, priority: int = 1,
+            device: int = 0, core: int = 0,
+            floor_steps: Optional[float] = None):
+    from vtpu.runtime.client import RuntimeClient
+    if floor_steps is not None:
+        os.environ["VTPU_SLO_FLOOR_STEPS"] = str(floor_steps)
+    try:
+        return RuntimeClient(broker.sock, tenant=name,
+                             priority=priority, device=device,
+                             core_limit=core or None)
+    finally:
+        os.environ.pop("VTPU_SLO_FLOOR_STEPS", None)
+
+
+def _setup(c):
+    """(exe_id, x_handle) — one resident input + the shared program."""
+    import numpy as np
+    hx = c.put(np.ones(256, np.float32), "x")
+    exe = c.compile_blob(_program_blob())
+    return exe.id, hx
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+# ---------------------------------------------------------------------------
+# Cell 1: work-conserving burst credits
+# ---------------------------------------------------------------------------
+
+def _burst_once(tmp: str, credits_on: bool,
+                quick: bool) -> Dict[str, Any]:
+    idle_s = 1.0 if quick else 2.0
+    burst_s = 2.0 if quick else 4.0
+    b = Broker(tmp, {
+        # STRICT shares: the native work-conserving refill would mask
+        # the credit economy (idle share redistributes instantly);
+        # credits are the TEMPORAL analogue and need fixed buckets to
+        # show against.
+        "VTPU_WORK_CONSERVING": "0",
+        "VTPU_BURST_CAP_QUANTA": "20" if credits_on else "0",
+        # A real (if tiny) floor on estimates so the bucket actually
+        # paces the burster instead of metering everything to ~0.
+        "VTPU_MIN_EXEC_COST_US": "500",
+    }, chips=1, core_limit=40)
+    out: Dict[str, Any] = {}
+    try:
+        burster = _client(b, "burster", core=40)
+        idler = _client(b, "idler", core=40)
+        exe_b, hx_b = _setup(burster)
+        exe_i, hx_i = _setup(idler)
+        # Warm + learn the cost EMA, then go idle to bank credit.
+        for _ in range(50):
+            burster.execute(exe_b, [hx_b])
+        time.sleep(idle_s)
+        # Burst phase: pipelined send/recv pairs for burst_s.
+        t0 = time.monotonic()
+        steps = 0
+        outstanding = 0
+        while time.monotonic() - t0 < burst_s:
+            while outstanding < 32:
+                burster.execute_send_ids(exe_b, ["x"], ["y"])
+                outstanding += 1
+            while outstanding > 16:
+                burster.recv_reply()
+                outstanding -= 1
+                steps += 1
+        while outstanding:
+            burster.recv_reply()
+            outstanding -= 1
+            steps += 1
+        out["burst_steps_per_s"] = round(steps / burst_s, 1)
+        st = (b.stats() or {}).get("tenants", {})
+        out["credit_spent_us"] = int(
+            (st.get("burster") or {}).get("credit_spent_us", 0))
+        if credits_on:
+            # Floor re-engagement: the idler demands; its first reply
+            # (dispatch) must land within ~a scheduler quantum — the
+            # instant the floor-demand signal also cuts off the
+            # burster's credit spending.
+            t_demand = time.monotonic()
+            idler.execute(exe_i, [hx_i])
+            out["floor_reengage_ms"] = round(
+                (time.monotonic() - t_demand) * 1e3, 1)
+        burster.close()
+        idler.close()
+    finally:
+        b.close()
+    return out
+
+
+def cell_burst(quick: bool) -> Dict[str, Any]:
+    with tempfile.TemporaryDirectory(prefix="vtpu-ts-burst-") as t1:
+        on = _burst_once(t1, credits_on=True, quick=quick)
+    with tempfile.TemporaryDirectory(prefix="vtpu-ts-burst0-") as t2:
+        off = _burst_once(t2, credits_on=False, quick=quick)
+    gain = (on["burst_steps_per_s"] / off["burst_steps_per_s"]
+            if off["burst_steps_per_s"] else 0.0)
+    return {
+        "steps_per_s_credits": on["burst_steps_per_s"],
+        "steps_per_s_nocredits": off["burst_steps_per_s"],
+        "burst_gain": round(gain, 3),
+        "credit_spent_us": on["credit_spent_us"],
+        "floor_reengage_ms": on.get("floor_reengage_ms"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Cell 2: priority preemption
+# ---------------------------------------------------------------------------
+
+def _rtt_pinger(c, exe: str, hx, duration_s: float,
+                rng: random.Random) -> List[float]:
+    """Closed-loop priority pinger: Poisson think time, sync execute,
+    RTT samples in seconds."""
+    samples: List[float] = []
+    t_end = time.monotonic() + duration_s
+    while time.monotonic() < t_end:
+        t0 = time.monotonic()
+        c.execute(exe, [hx])
+        samples.append(time.monotonic() - t0)
+        time.sleep(rng.expovariate(200.0))  # ~200 req/s offered
+    samples.sort()
+    return samples
+
+
+def _preempt_once(tmp: str, saturate: bool, preempt_on: bool,
+                  quick: bool, seed: int) -> Dict[str, Any]:
+    dur = 4.0 if quick else 8.0
+    b = Broker(tmp, {
+        "VTPU_PREEMPT": "1" if preempt_on else "0",
+        "VTPU_PREEMPT_AFTER_MS": "150",
+        "VTPU_PREEMPT_MAX_PARK_S": "1",
+    }, chips=1, core_limit=40)
+    out: Dict[str, Any] = {}
+    stop = threading.Event()
+    lo_steps = [0]
+
+    def saturator():
+        lo = _client(b, "lo", priority=1, core=40)
+        exe, hx = _setup(lo)
+        outstanding = 0
+        from vtpu.runtime.client import (RuntimeError_, VtpuOverload)
+        while not stop.is_set():
+            try:
+                while outstanding < 64 and not stop.is_set():
+                    lo.execute_send_ids(exe, ["x"], ["y"])
+                    outstanding += 1
+                while outstanding > 32:
+                    lo.recv_reply()
+                    outstanding -= 1
+                    lo_steps[0] += 1
+            except VtpuOverload:
+                time.sleep(0.01)
+                outstanding = 0
+            except (RuntimeError_, OSError):
+                outstanding = 0
+        try:
+            lo.close()
+        except OSError:
+            pass
+
+    th = None
+    try:
+        if saturate:
+            th = threading.Thread(target=saturator, daemon=True)
+            th.start()
+            time.sleep(1.0)  # saturator ramp (compile + queue fill)
+        hi = _client(b, "hi", priority=0, core=40)
+        exe_hi, hx_hi = _setup(hi)
+        samples = _rtt_pinger(hi, exe_hi, hx_hi, dur,
+                              random.Random(seed))
+        out["p50_us"] = round(_pct(samples, 0.50) * 1e6, 1)
+        out["p99_us"] = round(_pct(samples, 0.99) * 1e6, 1)
+        out["n"] = len(samples)
+        st = (b.stats() or {}).get("tenants", {})
+        out["preemptions"] = int(
+            (st.get("lo") or {}).get("preemptions", 0))
+        hi.close()
+    finally:
+        stop.set()
+        if th is not None:
+            th.join(timeout=10)
+        b.close()
+    if saturate:
+        out["lo_steps_per_s"] = round(lo_steps[0] / dur, 1)
+    return out
+
+
+def cell_preempt(quick: bool, seed: int) -> Dict[str, Any]:
+    with tempfile.TemporaryDirectory(prefix="vtpu-ts-solo-") as t1:
+        solo = _preempt_once(t1, saturate=False, preempt_on=True,
+                             quick=quick, seed=seed)
+    with tempfile.TemporaryDirectory(prefix="vtpu-ts-nop-") as t2:
+        unpre = _preempt_once(t2, saturate=True, preempt_on=False,
+                              quick=quick, seed=seed)
+    with tempfile.TemporaryDirectory(prefix="vtpu-ts-pre-") as t3:
+        pre = _preempt_once(t3, saturate=True, preempt_on=True,
+                            quick=quick, seed=seed)
+    return {
+        "solo": solo, "unpreempted": unpre, "preempted": pre,
+        "p99_ratio_unpreempted": round(
+            unpre["p99_us"] / solo["p99_us"], 3) if solo["p99_us"]
+        else None,
+        "p99_ratio_preempted": round(
+            pre["p99_us"] / solo["p99_us"], 3) if solo["p99_us"]
+        else None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Cell 3: overload / thousand-tenant churn
+# ---------------------------------------------------------------------------
+
+def _churner(b: Broker, name: str, device: int, seed: int,
+             t_end: float, counters: Dict[str, Any]) -> None:
+    """One simulated tenant's lifecycle: join (backing off on
+    OVERLOAD), a pareto-tailed burst of chained executes, then leave —
+    10% leave by CRASH (socket severed, no deletes: the broker's
+    teardown sweep must reclaim)."""
+    from vtpu.runtime.client import (RuntimeError_, VtpuOverload)
+    rng = random.Random(seed)
+    pri = 1 if rng.random() < 0.7 else 2
+    try:
+        c = _client(b, name, priority=pri, device=device, core=40)
+    except (RuntimeError_, OSError) as e:
+        with counters["mu"]:
+            counters["join_failed"] += 1
+            if isinstance(e, VtpuOverload):
+                counters["join_overload"] += 1
+        return
+    try:
+        exe, hx = _setup(c)
+        # Warm-up: one plain execute teaches the cost EMA the real
+        # per-step cost before any chained burst prices off the 5 ms
+        # seed (the regime every real tenant ramps through).
+        c.execute(exe, [hx])
+        bursts = 1 + int(rng.paretovariate(1.5))
+        for _ in range(min(bursts, 12)):
+            if time.monotonic() >= t_end:
+                break
+            # One pipelined burst: heavy-tailed chain lengths, a
+            # window of them in flight at once — this is what builds
+            # broker backlog and exercises the shed path.
+            window = 2 + int(rng.paretovariate(1.3) * 3)
+            window = min(window, 8)
+            t0 = time.monotonic()
+            sent = 0
+            chain_total = 0
+            try:
+                for _k in range(window):
+                    chain = min(1 + int(rng.paretovariate(1.2)), 8)
+                    c.execute_send_ids(exe, ["x"], ["y"],
+                                       repeats=chain)
+                    sent += 1
+                    chain_total += chain
+                shed = 0
+                for _k in range(sent):
+                    try:
+                        c.recv_reply()
+                    except VtpuOverload:
+                        shed += 1
+                rtt = time.monotonic() - t0
+                with counters["mu"]:
+                    counters["steps"] += chain_total
+                    counters["rtts"].append(rtt)
+                    counters["shed_seen"] += shed
+                if shed:
+                    time.sleep(rng.uniform(0.02, 0.08))
+            except VtpuOverload:
+                with counters["mu"]:
+                    counters["shed_seen"] += 1
+                time.sleep(rng.uniform(0.02, 0.08))
+            time.sleep(rng.expovariate(20.0))
+        if rng.random() < 0.1:
+            # Crash-leave: sever the socket, no cleanup.
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+            with counters["mu"]:
+                counters["crash_left"] += 1
+        else:
+            c.delete_many(["x", "y"])
+            c.close()
+        with counters["mu"]:
+            counters["completed"] += 1
+    except (RuntimeError_, OSError) as e:
+        with counters["mu"]:
+            counters["errored"] += 1
+            key = f"{type(e).__name__}: {str(e)[:90]}"
+            counters["error_kinds"][key] = \
+                counters["error_kinds"].get(key, 0) + 1
+        try:
+            c.close()
+        except OSError:
+            pass
+
+
+def cell_overload(tenants: int, quick: bool,
+                  seed: int) -> Dict[str, Any]:
+    chips = 8
+    dur = 10.0 if quick else 25.0
+    # Bounded client deadlines: a churner stuck behind a pathological
+    # EMA-ratcheted queue fails typed instead of dragging the bench.
+    os.environ["VTPU_RPC_TIMEOUT_S"] = "60"
+    with tempfile.TemporaryDirectory(prefix="vtpu-ts-ovl-") as tmp:
+        b = Broker(tmp, {
+            "VTPU_PREEMPT_AFTER_MS": "150",
+            "VTPU_PREEMPT_MAX_PARK_S": "1",
+            # Tight backlog caps so the shed path provably engages
+            # under the churn (the production default of 4096 would
+            # need far deeper pipelines to reach on CPU) — and so the
+            # EMA learn-up regime under GIL contention cannot build
+            # minute-deep throttled queues.
+            "VTPU_MAX_BACKLOG": "64",
+            "VTPU_TENANT_QUEUE_CAP": "24",
+        }, chips=chips, core_limit=40)
+        counters: Dict[str, Any] = {
+            "mu": threading.Lock(), "steps": 0, "rtts": [],
+            "shed_seen": 0, "join_failed": 0, "join_overload": 0,
+            "crash_left": 0, "completed": 0, "errored": 0,
+            "error_kinds": {},
+        }
+        stop = threading.Event()
+        floor_threads: List[threading.Thread] = []
+        floor_names = [f"floor-{k}" for k in range(chips)]
+        floor_steps: Dict[str, int] = {n: 0 for n in floor_names}
+
+        def floor_tenant(name: str, device: int) -> None:
+            """Persistent priority-0 floor demander: modest closed-loop
+            rate WITHIN its share — its attainment is the hard-floor
+            acceptance signal."""
+            from vtpu.runtime.client import RuntimeError_
+            rng = random.Random((seed, name).__hash__())
+            c = _client(b, name, priority=0, device=device, core=40,
+                        floor_steps=20.0)
+            exe, hx = _setup(c)
+            while not stop.is_set():
+                try:
+                    c.execute(exe, [hx])
+                    floor_steps[name] += 1
+                except (RuntimeError_, OSError):
+                    pass
+                time.sleep(rng.expovariate(100.0))
+            try:
+                c.close()
+            except OSError:
+                pass
+
+        t0 = time.monotonic()
+        t_end = t0 + dur
+        for k, name in enumerate(floor_names):
+            th = threading.Thread(target=floor_tenant,
+                                  args=(name, k), daemon=True)
+            th.start()
+            floor_threads.append(th)
+        # Churner arrival schedule: Poisson over the run, bounded
+        # concurrency (under the chip-slot budget: joins past it shed
+        # typed OVERLOAD anyway, and a GIL-bound bench process cannot
+        # honestly drive more).
+        rng = random.Random(seed)
+        sem = threading.Semaphore(chips * 6)
+        churn_threads: List[threading.Thread] = []
+        backlog_seen = 0
+        launched = 0
+        next_poll = t0
+        while time.monotonic() < t_end and launched < tenants:
+            if time.monotonic() >= next_poll:
+                st = b.stats() or {}
+                adm = st.get("admission") or {}
+                backlog_seen = max(backlog_seen,
+                                   int(adm.get("backlog", 0)))
+                next_poll = time.monotonic() + 0.5
+            if not sem.acquire(timeout=0.05):
+                continue
+            name = f"churn-{launched}"
+            dev = launched % chips
+
+            def run(name=name, dev=dev, s=launched):
+                try:
+                    _churner(b, name, dev, seed * 1000 + s, t_end,
+                             counters)
+                finally:
+                    sem.release()
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            churn_threads.append(th)
+            launched += 1
+            # Poisson arrivals paced so the whole population lands
+            # inside the run window.
+            time.sleep(rng.expovariate(max(tenants / (dur * 0.8),
+                                           1.0)))
+        join_deadline = time.monotonic() + 60.0
+        for th in churn_threads:
+            th.join(timeout=max(join_deadline - time.monotonic(),
+                                0.1))
+        # Final reads BEFORE the floor tenants stop (their rows must
+        # be live at saturation).
+        slo = b.slo() or {}
+        stats = b.stats() or {}
+        stop.set()
+        for th in floor_threads:
+            th.join(timeout=10)
+        b.close()
+    rows = slo.get("tenants") or {}
+    floor_att: Dict[str, float] = {}
+    floor_p99: Dict[str, float] = {}
+    for name in floor_names:
+        body = rows.get(name) or {}
+        wins = body.get("windows") or {}
+        short = wins[min(wins, key=float)] if wins else {}
+        floor_att[name] = float(short.get("attainment_pct", 0.0))
+        floor_p99[name] = float((body.get("phases") or {})
+                                .get("e2e", {}).get("p99_us", 0.0))
+    fairness = slo.get("fairness") or {}
+    adm = stats.get("admission") or {}
+    rtts = sorted(counters["rtts"])
+    return {
+        "tenants": tenants,
+        "launched": launched,
+        "completed": counters["completed"],
+        "errored": counters["errored"],
+        "crash_left": counters["crash_left"],
+        "join_failed": counters["join_failed"],
+        "error_kinds": dict(sorted(counters["error_kinds"].items(),
+                                   key=lambda kv: -kv[1])[:8]),
+        "join_overload": counters["join_overload"],
+        "client_shed_seen": counters["shed_seen"],
+        "broker_shed_total": int(adm.get("shed_total", 0)),
+        "steps_per_s": round(counters["steps"] / dur, 1),
+        "rtt_p50_us": round(_pct(rtts, 0.50) * 1e6, 1),
+        "rtt_p99_us": round(_pct(rtts, 0.99) * 1e6, 1),
+        "rtt_n": len(rtts),
+        "max_backlog_seen": backlog_seen,
+        "floor_attainment_pct": floor_att,
+        "floor_attainment_min_pct": round(min(floor_att.values()), 2)
+        if floor_att else 0.0,
+        # Broker-side RTT bound under overload: the floor tenants' own
+        # e2e p99 from the SLO plane (client churner RTTs embed the
+        # token bucket's throttle waits for oversubscribed low-pri
+        # tenants — enforcement, not queue growth).
+        "floor_e2e_p99_us": {n: round(v, 1)
+                             for n, v in floor_p99.items()},
+        "floor_e2e_p99_max_us": round(max(floor_p99.values()), 1)
+        if floor_p99 else 0.0,
+        "floor_steps_per_s": {n: round(s / dur, 1)
+                              for n, s in floor_steps.items()},
+        "jain": fairness.get("jain"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Gates
+# ---------------------------------------------------------------------------
+
+def check(result: Dict[str, Any],
+          committed: Optional[Dict[str, Any]]) -> List[str]:
+    errs: List[str] = []
+    burst = result.get("burst")
+    if burst:
+        if burst["burst_gain"] < GATE_BURST_GAIN:
+            errs.append(
+                f"burst: credits-on gain {burst['burst_gain']}x < "
+                f"{GATE_BURST_GAIN}x (work conservation does not pay)")
+        if burst["credit_spent_us"] <= 0:
+            errs.append("burst: no credit was ever spent")
+        re_ms = burst.get("floor_reengage_ms")
+        if re_ms is None or re_ms > SCHED_QUANTUM_S * 1e3 * 2.5:
+            errs.append(
+                f"burst: idle tenant's floor re-engaged in {re_ms}ms "
+                f"(> 2.5 scheduler quanta)")
+    pre = result.get("preempt")
+    if pre:
+        r = pre.get("p99_ratio_preempted")
+        if r is None or r > GATE_PREEMPT_P99_X:
+            errs.append(
+                f"preempt: hi-priority p99 under a saturating "
+                f"co-tenant is {r}x solo (> {GATE_PREEMPT_P99_X}x) "
+                f"with preemption on")
+        if int(pre.get("preempted", {}).get("preemptions", 0)) < 1:
+            errs.append("preempt: the preemption policy never engaged")
+    ovl = result.get("overload")
+    if ovl:
+        if ovl["floor_attainment_min_pct"] < GATE_FLOOR_ATTAIN_PCT:
+            errs.append(
+                f"overload: floor-tenant attainment "
+                f"{ovl['floor_attainment_min_pct']}% < "
+                f"{GATE_FLOOR_ATTAIN_PCT}% at saturation")
+        if ovl["floor_e2e_p99_max_us"] > GATE_RTT_P99_S * 1e6:
+            errs.append(
+                f"overload: floor-tenant broker e2e p99 "
+                f"{ovl['floor_e2e_p99_max_us']}us exceeds the "
+                f"{GATE_RTT_P99_S}s bound (unbounded queue growth)")
+        # The admission stat sums all 8 chips' backlogs; the per-chip
+        # cap in the overload cell is 256.
+        if ovl["max_backlog_seen"] >= 64 * 8:
+            errs.append(
+                f"overload: aggregate backlog reached the hard cap "
+                f"({ovl['max_backlog_seen']}) — shedding engaged too "
+                f"late to keep the queue bounded")
+        if ovl["tenants"] >= 256 and ovl["client_shed_seen"] \
+                + ovl["broker_shed_total"] == 0:
+            errs.append(
+                "overload: the shed path never engaged at full "
+                "saturation (no OVERLOAD replies observed)")
+        if ovl["completed"] < ovl["launched"] * 0.9:
+            errs.append(
+                f"overload: only {ovl['completed']} of "
+                f"{ovl['launched']} churners completed")
+        jain = ovl.get("jain")
+        if jain is not None and committed is not None:
+            ref = ((committed.get("overload") or {}).get("jain"))
+            if ref and jain < 0.5 * float(ref):
+                errs.append(
+                    f"overload: Jain fairness {jain} fell below half "
+                    f"the committed recording ({ref})")
+    return errs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(prog="traffic_sim", description=__doc__)
+    ap.add_argument("--cell", default="all",
+                    choices=("all", "burst", "preempt", "overload"))
+    ap.add_argument("--tenants", type=int, default=512,
+                    help="distinct churn tenants in the overload cell")
+    ap.add_argument("--quick", action="store_true",
+                    help="short windows")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI shape: --quick + 64 tenants + all cells")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default=None, metavar="FILE")
+    ap.add_argument("--check", default=None, metavar="JSON",
+                    help="gate against the committed recording")
+    ns = ap.parse_args()
+    if ns.smoke:
+        ns.quick = True
+        ns.tenants = min(ns.tenants, 64)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    result: Dict[str, Any] = {
+        "bench": "traffic_sim", "version": 1,
+        "quick": bool(ns.quick), "seed": ns.seed,
+    }
+    t0 = time.monotonic()
+    if ns.cell in ("all", "burst"):
+        print("[traffic_sim] burst cell ...", file=sys.stderr)
+        result["burst"] = cell_burst(ns.quick)
+        print(f"[traffic_sim]   {result['burst']}", file=sys.stderr)
+    if ns.cell in ("all", "preempt"):
+        print("[traffic_sim] preempt cell ...", file=sys.stderr)
+        result["preempt"] = cell_preempt(ns.quick, ns.seed)
+        print(f"[traffic_sim]   ratios: unpreempted="
+              f"{result['preempt']['p99_ratio_unpreempted']}x "
+              f"preempted={result['preempt']['p99_ratio_preempted']}x",
+              file=sys.stderr)
+    if ns.cell in ("all", "overload"):
+        print(f"[traffic_sim] overload cell ({ns.tenants} tenants) ...",
+              file=sys.stderr)
+        result["overload"] = cell_overload(ns.tenants, ns.quick,
+                                           ns.seed)
+        print(f"[traffic_sim]   {result['overload']}", file=sys.stderr)
+    result["wall_s"] = round(time.monotonic() - t0, 1)
+    committed = None
+    if ns.check:
+        try:
+            with open(ns.check) as f:
+                committed = json.load(f)
+        except OSError as e:
+            print(f"[traffic_sim] cannot read {ns.check}: {e}",
+                  file=sys.stderr)
+    errs = check(result, committed) if (ns.check or ns.smoke) else []
+    result["gates"] = {"ok": not errs, "errors": errs}
+    text = json.dumps(result, indent=2)
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    for e in errs:
+        print(f"[traffic_sim] GATE FAILED: {e}", file=sys.stderr)
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
