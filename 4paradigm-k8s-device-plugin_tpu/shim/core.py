@@ -28,6 +28,10 @@ _SEARCH_PATHS = (
 
 
 class DeviceStats(ctypes.Structure):
+    # Mirror of native vtpu_device_stats (vtpu_core.h).  Layout drift
+    # against the C struct is machine-checked by the vtpu-wmm atomics
+    # checker (`mirror:` declarations in the vtpu_core.h ground-truth
+    # block) — field order, offsets and sizes must all agree.
     _fields_ = [
         ("limit_bytes", ctypes.c_uint64),
         ("used_bytes", ctypes.c_uint64),
@@ -39,6 +43,8 @@ class DeviceStats(ctypes.Structure):
 
 
 class ProcStats(ctypes.Structure):
+    # Mirror of native vtpu_proc_stats (vtpu_core.h); drift-checked —
+    # see DeviceStats.
     _fields_ = [
         ("pid", ctypes.c_int),
         ("host_pid", ctypes.c_int),
@@ -48,11 +54,14 @@ class ProcStats(ctypes.Structure):
     ]
 
 
+# Mirror of VTPU_MAX_PROCS (vtpu_core.h); drift-checked by the
+# vtpu-wmm atomics checker's `mirror-const:` declaration.
 MAX_PROCS = 64
 
 
 class TraceEvent(ctypes.Structure):
-    """Mirror of native vtpu_trace_event (vtpu_core.h)."""
+    """Mirror of native vtpu_trace_event (vtpu_core.h); drift-checked
+    — see DeviceStats."""
 
     _fields_ = [
         ("t_ns", ctypes.c_uint64),

@@ -26,6 +26,15 @@ has grown hand-maintained contracts that generic linters cannot see:
     is registered in ``protocol.py``'s ``WIRE_FIELDS`` and read with a
     legacy-default ``.get`` on the serving side; an unregistered
     optional read (or a subscript read of a registered one) fails CI.
+  - **atomics** — the static half of vtpu-wmm: every access to an
+    mmap'd shared-region field in ``native/vtpucore`` must conform to
+    the protocol declared in the ``vtpu_core.h`` comment grammar
+    (lock/stable/crash-atomic/publish/seqlock categories with explicit
+    memory orders), publish/consume pairings hold in both directions,
+    ``__sync_*``/``volatile``/implicit-seq_cst are banned, and the
+    ``shim/core.py`` ctypes mirrors match the C struct layouts
+    field-for-field (offset/size) — the dynamic half is the
+    ``tools/wmm`` litmus explorer.
 
 Run as ``python -m vtpu.tools.analyze`` or ``vtpu-smi analyze``; CI runs
 it in the ``analyze`` job and fails on any finding.  There is NO
@@ -55,7 +64,8 @@ PKG_NAME = os.path.basename(PKG_DIR)
 
 @dataclass(frozen=True)
 class Finding:
-    checker: str   # locks | verbs | envflags | journal | excsafety | wirefields
+    checker: str   # locks | verbs | envflags | journal | excsafety
+    #              # | wirefields | atomics
     path: str      # repo-relative
     line: int
     message: str
@@ -76,12 +86,12 @@ def read_text(root: str, relpath: str) -> Optional[str]:
 
 
 def run_all(root: Optional[str] = None) -> List[Finding]:
-    from . import (envflags, excsafety, journal_schema, locks, verbs,
-                   wirefields)
+    from . import (atomics, envflags, excsafety, journal_schema, locks,
+                   verbs, wirefields)
     root = root or REPO_ROOT
     out: List[Finding] = []
     for mod in (locks, verbs, envflags, journal_schema, excsafety,
-                wirefields):
+                wirefields, atomics):
         out.extend(mod.check(root))
     return out
 

@@ -1,0 +1,1074 @@
+"""Shared-memory atomics checker — the static half of vtpu-wmm.
+
+The mmap'd shared region (``native/vtpucore``) is cross-process state
+mutated from C++ and mirrored into Python through ctypes; TSan only
+catches the races a test schedule happens to hit, and nothing catches
+a *memory-order* bug (a relaxed store where release was needed) on
+x86 at all — it only detonates on arm64, in production.  So the
+protocol is DECLARED, in a comment grammar inside ``vtpu_core.h``
+(mirroring the lock-order docstring grammar of ``locks.py``), and this
+checker proves the code matches the declaration:
+
+  - every access to a declared shared-region struct field conforms to
+    its category: ``mutex`` (the robust lock itself), ``lock`` (only
+    under ``lock_region`` / in ``*_locked`` helpers / init paths),
+    ``stable`` (written only by the flock-serialised ``init-writers``,
+    plain reads allowed), ``crash-atomic`` (lock discipline PLUS the
+    field must be one naturally-aligned machine word — the
+    degraded-mode ledger reads it with the writer possibly dead
+    mid-update), ``publish``/``seqlock`` (lock-free protocol fields:
+    atomic builtins with the EXACT declared orders only);
+  - publish/consume pairings hold in BOTH directions: a declared
+    publish with no conforming store site, or no consume-side load, is
+    a finding — as is any access at a different order;
+  - the seqlock writer/reader functions follow the declared shape
+    exactly (invalidate, release fence, payload helpers, release
+    fence, release publish; acquire load, copy, acquire fence,
+    re-check) — a dropped fence or re-check is a finding;
+  - ``*_locked`` helpers are only CALLED from functions that hold the
+    region lock;
+  - implicit-order constructs are banned outright in the analyzed
+    native sources: ``__sync_*`` builtins, ``volatile``,
+    ``std::atomic`` operations without an explicit
+    ``std::memory_order``, ``__ATOMIC_SEQ_CST`` on any declared field
+    (seq_cst is never what these protocols mean — it must be declared
+    if ever wanted);
+  - struct layout agreement: the ctypes mirrors in ``shim/core.py``
+    must match the C structs field-for-field (name, offset, size,
+    total size), and the mirrored constants must agree — today that
+    drift is a silent runtime corruption.
+
+``planned`` declarations (the ROADMAP item 2 exec ring) are parsed
+and recorded but exempt from code pairing: the spec leads the code.
+
+Stdlib-only (re + ctypes for authoritative mirror offsets); tests
+drive ``check_sources`` with seeded-violation fixture trees.
+"""
+
+from __future__ import annotations
+
+import ast
+import ctypes
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, read_text, PKG_NAME
+
+HEADER = "native/vtpucore/vtpu_core.h"
+NATIVE_ANALYZED = (
+    "native/vtpucore/vtpu_core.h",
+    "native/vtpucore/vtpu_core.cc",
+    "native/vtpu_preload/preload.cc",
+)
+SHIM = f"{PKG_NAME}/shim/core.py"
+ENVSPEC = f"{PKG_NAME}/utils/envspec.py"
+
+GT_HEADER = "shared-memory protocol ground truth (vtpu-wmm)"
+
+ORDERS = {
+    "relaxed": "__ATOMIC_RELAXED",
+    "acquire": "__ATOMIC_ACQUIRE",
+    "release": "__ATOMIC_RELEASE",
+    "acq_rel": "__ATOMIC_ACQ_REL",
+    "seq_cst": "__ATOMIC_SEQ_CST",
+}
+
+# C scalar types the layout engine understands: name -> (size, align).
+# Only LP64 scalars appear in the mirrored/shared structs; both x86-64
+# and arm64 agree on these.
+C_SCALARS = {
+    "uint64_t": (8, 8), "int64_t": (8, 8),
+    "uint32_t": (4, 4), "int32_t": (4, 4),
+    "pid_t": (4, 4), "int": (4, 4), "unsigned": (4, 4),
+}
+
+CTYPES_SCALARS = {
+    "c_uint64": ctypes.c_uint64, "c_int64": ctypes.c_int64,
+    "c_uint32": ctypes.c_uint32, "c_int32": ctypes.c_int32,
+    "c_int": ctypes.c_int, "c_uint": ctypes.c_uint,
+}
+
+
+# ---------------------------------------------------------------------------
+# C source preprocessing
+# ---------------------------------------------------------------------------
+
+def strip_comments(src: str) -> str:
+    """Blank out comments and string/char literals, preserving line
+    structure (so line numbers survive and commented-out code or the
+    word 'volatile' in prose never trips a ban)."""
+    out: List[str] = []
+    i, n = 0, len(src)
+    mode = ""  # "" | "block" | "line" | '"' | "'"
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if mode == "":
+            if c == "/" and nxt == "*":
+                mode = "block"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "/":
+                mode = "line"
+                out.append("  ")
+                i += 2
+                continue
+            if c in "\"'":
+                mode = c
+                out.append(c)
+                i += 1
+                continue
+            out.append(c)
+        elif mode == "block":
+            if c == "*" and nxt == "/":
+                mode = ""
+                out.append("  ")
+                i += 2
+                continue
+            out.append("\n" if c == "\n" else " ")
+        elif mode == "line":
+            if c == "\n":
+                mode = ""
+                out.append("\n")
+            else:
+                out.append(" ")
+        else:  # string/char literal
+            if c == "\\":
+                out.append("  ")
+                i += 2
+                continue
+            if c == mode:
+                mode = ""
+                out.append(c)
+            else:
+                out.append("\n" if c == "\n" else " ")
+        i += 1
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Ground-truth grammar
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SeqlockDecl:
+    name: str
+    seq: str = ""                      # Struct.field
+    payload: List[str] = field(default_factory=list)
+    helpers: Dict[str, str] = field(default_factory=dict)  # fn -> order
+    writer: str = ""
+    reader: str = ""
+
+
+@dataclass
+class GroundTruth:
+    structs: List[str] = field(default_factory=list)
+    # category per Struct.field ("mutex"|"lock"|"stable"|"crash-atomic"
+    # |"publish"|"seq"|"payload"); wildcards expanded later.
+    raw: Dict[str, List[str]] = field(default_factory=dict)
+    publishes: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    seqlocks: List[SeqlockDecl] = field(default_factory=list)
+    init_writers: Set[str] = field(default_factory=set)
+    locked_suffix: str = "_locked"
+    mirrors: List[Tuple[str, str, str]] = field(default_factory=list)
+    consts: List[Tuple[str, str, str]] = field(default_factory=list)
+    planned: Dict[str, List[str]] = field(default_factory=dict)
+
+
+_DIRECTIVE_RE = re.compile(
+    r"^\s{1,4}(structs|mutex|lock|stable|crash-atomic|init-writers|"
+    r"locked-suffix|publish|seqlock\s+[\w-]+|mirror|mirror-const|"
+    r"planned\s+[\w-]+):\s*(.*)$")
+_PUBLISH_RE = re.compile(
+    r"^(\S+)\s+(\w+)\s*->\s*consume:\s*(\w+)\s*$")
+_MIRROR_RE = re.compile(r"^(\S+)\s*==\s*(\S+?):(\w+)\s*$")
+
+
+def parse_ground_truth(header_src: str, path: str = HEADER
+                       ) -> Tuple[Optional[GroundTruth], List[Finding]]:
+    findings: List[Finding] = []
+    lines = header_src.splitlines()
+    start = next((i for i, ln in enumerate(lines) if GT_HEADER in ln),
+                 None)
+    if start is None:
+        return None, [Finding(
+            "atomics", path, 1,
+            f"vtpu_core.h has no `{GT_HEADER}` block — the shared-"
+            f"memory protocol must be declared")]
+    gt = GroundTruth()
+    # (directive key, value text, line) accumulated with continuations
+    entries: List[Tuple[str, str, int]] = []
+    for off, raw_line in enumerate(lines[start + 1:], start + 2):
+        if "*/" in raw_line:
+            break
+        body = re.sub(r"^\s*\*", "", raw_line)
+        body = body[1:] if body.startswith(" ") else body
+        m = _DIRECTIVE_RE.match(body)
+        if m:
+            entries.append((m.group(1), m.group(2).strip(), off))
+        elif entries and re.match(r"^\s{5,}\S", body):
+            key, val, ln = entries[-1]
+            entries[-1] = (key, f"{val} {body.strip()}", ln)
+    for key, val, ln in entries:
+        if key == "structs":
+            gt.structs = [t.strip() for t in val.split(",") if t.strip()]
+        elif key in ("mutex", "lock", "stable", "crash-atomic"):
+            gt.raw.setdefault(key, []).extend(
+                t.strip() for t in val.split(",") if t.strip())
+        elif key == "init-writers":
+            gt.init_writers.update(
+                t.strip() for t in val.split(",") if t.strip())
+        elif key == "locked-suffix":
+            gt.locked_suffix = val.strip()
+        elif key == "publish":
+            m = _PUBLISH_RE.match(val)
+            if not m:
+                findings.append(Finding(
+                    "atomics", path, ln,
+                    f"malformed publish declaration: {val!r} (want "
+                    f"`<Struct.field> <order> -> consume: <order>`)"))
+                continue
+            fld, sord, lord = m.groups()
+            if sord not in ORDERS or lord not in ORDERS:
+                findings.append(Finding(
+                    "atomics", path, ln,
+                    f"publish {fld}: unknown order "
+                    f"{sord!r}/{lord!r} (know {sorted(ORDERS)})"))
+                continue
+            gt.publishes[fld] = (sord, lord)
+        elif key.startswith("seqlock"):
+            decl = SeqlockDecl(name=key.split(None, 1)[1])
+            for tok in re.finditer(r"(\w+)=([^=]+?)(?=\s+\w+=|$)", val):
+                k, v = tok.group(1), tok.group(2).strip()
+                if k == "seq":
+                    decl.seq = v
+                elif k == "payload":
+                    decl.payload = [t.strip() for t in v.split(",")
+                                    if t.strip()]
+                elif k == "helpers":
+                    for h in re.finditer(r"(\w+)\((\w+)\)", v):
+                        if h.group(2) not in ORDERS:
+                            findings.append(Finding(
+                                "atomics", path, ln,
+                                f"seqlock {decl.name}: helper "
+                                f"{h.group(1)} has unknown order "
+                                f"{h.group(2)!r}"))
+                        decl.helpers[h.group(1)] = h.group(2)
+                elif k == "writer":
+                    decl.writer = v.split()[0]
+                elif k == "reader":
+                    decl.reader = v.split()[0]
+            if not (decl.seq and decl.payload and decl.helpers
+                    and decl.writer and decl.reader):
+                findings.append(Finding(
+                    "atomics", path, ln,
+                    f"seqlock {decl.name}: incomplete declaration "
+                    f"(need seq=, payload=, helpers=, writer=, "
+                    f"reader=)"))
+            gt.seqlocks.append(decl)
+        elif key == "mirror":
+            m = _MIRROR_RE.match(val)
+            if not m:
+                findings.append(Finding(
+                    "atomics", path, ln,
+                    f"malformed mirror declaration: {val!r} (want "
+                    f"`<c_struct> == <pyfile>:<PyClass>`)"))
+                continue
+            gt.mirrors.append(m.groups())
+        elif key == "mirror-const":
+            m = _MIRROR_RE.match(val)
+            if not m:
+                findings.append(Finding(
+                    "atomics", path, ln,
+                    f"malformed mirror-const declaration: {val!r}"))
+                continue
+            gt.consts.append(m.groups())
+        elif key.startswith("planned"):
+            gt.planned.setdefault(key.split(None, 1)[1], []).append(val)
+    if not gt.structs:
+        findings.append(Finding(
+            "atomics", path, start + 1,
+            "ground-truth block declares no `structs:` list"))
+    return gt, findings
+
+
+# ---------------------------------------------------------------------------
+# C struct parsing + layout
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CField:
+    name: str
+    ctype: str
+    array: Optional[int]   # None = scalar, 0 = flexible array
+
+
+_STRUCT_RE = re.compile(
+    r"typedef\s+struct(?:\s+\w+)?\s*\{(.*?)\}\s*(\w+)\s*;", re.S)
+_DEFINE_RE = re.compile(r"#define\s+(\w+)\s+(\d+)\b")
+_MEMBER_RE = re.compile(
+    r"^(\w[\w\s]*?)\s+(\w+)\s*(?:\[\s*(\w*)\s*\])?$")
+
+
+def parse_c_structs(stripped_sources: Dict[str, str]
+                    ) -> Tuple[Dict[str, List[CField]], Dict[str, int]]:
+    defines: Dict[str, int] = {}
+    structs: Dict[str, List[CField]] = {}
+    for src in stripped_sources.values():
+        for m in _DEFINE_RE.finditer(src):
+            defines.setdefault(m.group(1), int(m.group(2)))
+    for src in stripped_sources.values():
+        for m in _STRUCT_RE.finditer(src):
+            body, name = m.group(1), m.group(2)
+            fields: List[CField] = []
+            for stmt in body.split(";"):
+                stmt = " ".join(stmt.split())
+                if not stmt:
+                    continue
+                mm = _MEMBER_RE.match(stmt)
+                if not mm:
+                    continue
+                ctype = " ".join(mm.group(1).split())
+                arr = mm.group(3)
+                if arr is None:
+                    array: Optional[int] = None
+                elif arr == "":
+                    array = 0
+                elif arr.isdigit():
+                    array = int(arr)
+                else:
+                    array = defines.get(arr, -1)
+                fields.append(CField(mm.group(2), ctype, array))
+            structs[name] = fields
+    return structs, defines
+
+
+def c_layout(name: str, structs: Dict[str, List[CField]]
+             ) -> Optional[List[Tuple[str, int, int]]]:
+    """[(field, offset, size)] under natural LP64 alignment, or None
+    when the struct holds a type the engine cannot size (the robust
+    mutex — layouts are only needed for mirrored/plain-scalar
+    structs)."""
+    fields = structs.get(name)
+    if fields is None:
+        return None
+    out: List[Tuple[str, int, int]] = []
+    off = 0
+    maxal = 1
+    for f in fields:
+        if f.ctype in C_SCALARS:
+            size, align = C_SCALARS[f.ctype]
+        elif f.ctype in structs:
+            sub = c_layout(f.ctype, structs)
+            if sub is None:
+                return None
+            size = _c_size(f.ctype, structs)
+            align = max((s for _n, _o, s in sub if s in (1, 2, 4, 8)),
+                        default=8)
+        else:
+            return None
+        count = 1 if f.array is None else f.array
+        if count < 0:
+            return None
+        off = (off + align - 1) // align * align
+        out.append((f.name, off, size * count))
+        off += size * count
+        maxal = max(maxal, align)
+    return out
+
+
+def _c_size(name: str, structs: Dict[str, List[CField]]) -> int:
+    lay = c_layout(name, structs)
+    if not lay:
+        return 0
+    end = max(o + s for _n, o, s in lay)
+    al = max((s for f in structs[name]
+              for s in [C_SCALARS.get(f.ctype, (0, 1))[1]]), default=1)
+    al = max(al, 1)
+    return (end + al - 1) // al * al
+
+
+# ---------------------------------------------------------------------------
+# ctypes mirror parsing (shim/core.py, by AST — never imported)
+# ---------------------------------------------------------------------------
+
+def parse_ctypes_structs(shim_src: str, const_sources: Dict[str, str]
+                         ) -> Tuple[Dict[str, List[Tuple[str, str,
+                                                         Optional[int]]]],
+                                    Dict[str, int]]:
+    """{PyClass: [(field, ctype_name, arraylen)]} plus the integer
+    module constants of shim/envspec (for array lengths and
+    mirror-const)."""
+    consts: Dict[str, int] = {}
+    for src in const_sources.values():
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                consts.setdefault(node.targets[0].id, node.value.value)
+    structs: Dict[str, List[Tuple[str, str, Optional[int]]]] = {}
+    try:
+        tree = ast.parse(shim_src)
+    except SyntaxError:
+        return structs, consts
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "_fields_"
+                    and isinstance(stmt.value, (ast.List, ast.Tuple))):
+                continue
+            fields: List[Tuple[str, str, Optional[int]]] = []
+            for el in stmt.value.elts:
+                if not (isinstance(el, ast.Tuple) and len(el.elts) == 2
+                        and isinstance(el.elts[0], ast.Constant)):
+                    continue
+                fname = el.elts[0].value
+                t = el.elts[1]
+                arraylen: Optional[int] = None
+                if isinstance(t, ast.BinOp) and isinstance(t.op, ast.Mult):
+                    base, n = t.left, t.right
+                    if isinstance(n, ast.Name):
+                        arraylen = consts.get(n.id, -1)
+                    elif isinstance(n, ast.Constant):
+                        arraylen = n.value
+                    t = base
+                cname = t.attr if isinstance(t, ast.Attribute) else (
+                    t.id if isinstance(t, ast.Name) else "?")
+                fields.append((fname, cname, arraylen))
+            structs[node.name] = fields
+    return structs, consts
+
+
+def ctypes_layout(fields: List[Tuple[str, str, Optional[int]]]
+                  ) -> Optional[List[Tuple[str, int, int]]]:
+    """Authoritative offsets/sizes straight from a dynamically-built
+    ctypes.Structure — the exact layout the shim runs with."""
+    spec = []
+    for fname, cname, arraylen in fields:
+        base = CTYPES_SCALARS.get(cname)
+        if base is None or (arraylen is not None and arraylen < 0):
+            return None
+        spec.append((fname, base * arraylen if arraylen else base))
+    try:
+        T = type("_AtomicsMirror", (ctypes.Structure,),
+                 {"_fields_": spec})
+    except (TypeError, ValueError):
+        return None
+    return [(fname, getattr(T, fname).offset, getattr(T, fname).size)
+            for fname, _t in spec]
+
+
+# ---------------------------------------------------------------------------
+# Function extraction + statement model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CFunc:
+    name: str
+    path: str
+    line: int
+    statements: List[Tuple[int, str]]   # (line, text)
+    locked: bool = False
+
+
+def split_functions(stripped: str, path: str) -> List[CFunc]:
+    funcs: List[CFunc] = []
+    depth = 0
+    i, n = 0, len(stripped)
+    line = 1
+    body_start = None
+    fn_name = ""
+    fn_line = 0
+    body_depth = 0
+    while i < n:
+        c = stripped[i]
+        if c == "\n":
+            line += 1
+        elif c == "{":
+            if depth == 0 or (body_start is None and depth > 0):
+                # Function body iff the brace follows a ')'.
+                j = i - 1
+                while j >= 0 and stripped[j] in " \t\n":
+                    j -= 1
+                if j >= 0 and stripped[j] == ")" and body_start is None \
+                        and depth == 0:
+                    # walk back over the balanced parens to the name
+                    bal = 0
+                    k = j
+                    while k >= 0:
+                        if stripped[k] == ")":
+                            bal += 1
+                        elif stripped[k] == "(":
+                            bal -= 1
+                            if bal == 0:
+                                break
+                        k -= 1
+                    m = re.search(r"(\w+)\s*$", stripped[:max(k, 0)])
+                    if m:
+                        fn_name = m.group(1)
+                        fn_line = line
+                        body_start = i + 1
+                        body_depth = depth
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if body_start is not None and depth == body_depth:
+                body = stripped[body_start:i]
+                start_line = stripped[:body_start].count("\n") + 1
+                funcs.append(CFunc(fn_name, path, fn_line,
+                                   _statements(body, start_line)))
+                body_start = None
+        i += 1
+    return funcs
+
+
+def _statements(body: str, start_line: int) -> List[Tuple[int, str]]:
+    out: List[Tuple[int, str]] = []
+    cur: List[str] = []
+    line = start_line
+    cur_line = line
+    for c in body:
+        if c == "\n":
+            line += 1
+        if c in ";{}":
+            text = " ".join("".join(cur).split())
+            if text:
+                out.append((cur_line, text))
+            cur = []
+            cur_line = line
+        else:
+            if not cur and not c.isspace():
+                cur_line = line
+            cur.append(c)
+    text = " ".join("".join(cur).split())
+    if text:
+        out.append((cur_line, text))
+    return out
+
+
+_CHAIN_RE = re.compile(
+    r"\b\w+(?:\s*(?:->|\.)\s*\w+|\s*\[[^][]*\])+")
+_ATOMIC_OP_RE = re.compile(r"__atomic_(\w+)")
+_ATOMIC_ORDER_RE = re.compile(r"__ATOMIC_([A-Z_]+)")
+_WRITE_AFTER_RE = re.compile(
+    r"^\s*(=(?!=)|\+=|-=|\|=|&=|\^=|\+\+|--)")
+
+
+def chain_fields(stmt: str, known: Set[str]) -> List[Tuple[str, bool]]:
+    """Declared-field accesses in one statement: [(field, is_write)].
+    Only pointer-rooted chains count — a chain with no ``->`` is a
+    stack local (e.g. the writer's temporary vtpu_trace_event)."""
+    out: List[Tuple[str, bool]] = []
+    for m in _CHAIN_RE.finditer(stmt):
+        chain = m.group(0)
+        if "->" not in chain:
+            continue
+        tail = stmt[m.end():]
+        is_write = bool(_WRITE_AFTER_RE.match(tail))
+        accessed = re.findall(r"(?:->|\.)\s*(\w+)", chain)
+        for idx, name in enumerate(accessed):
+            if name in known:
+                last = idx == len(accessed) - 1
+                out.append((name, is_write and last))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The checker
+# ---------------------------------------------------------------------------
+
+class _Checker:
+    def __init__(self, gt: GroundTruth,
+                 structs: Dict[str, List[CField]]) -> None:
+        self.gt = gt
+        self.structs = structs
+        self.findings: List[Finding] = []
+        # field name -> set of categories (same name may exist in
+        # several structs; an access is fine if ANY category allows it
+        # — one-sided: misses possible, false positives not)
+        self.cats: Dict[str, Set[str]] = {}
+        self.publish_by_field: Dict[str, Tuple[str, str]] = {}
+        self.seq_fields: Set[str] = set()
+        self.helper_names: Dict[str, str] = {}
+        # pairing evidence: field -> {"store": [...], "load": [...]}
+        self.sites: Dict[str, Dict[str, List[str]]] = {}
+
+    def finding(self, path: str, line: int, msg: str) -> None:
+        self.findings.append(Finding("atomics", path, line, msg))
+
+    # -- category table ----------------------------------------------------
+
+    def build_categories(self, path: str) -> None:
+        gt = self.gt
+        declared_fields: Dict[str, str] = {}
+
+        def add(spec: str, cat: str, override_ok: bool = False) -> None:
+            if "." not in spec:
+                self.finding(path, 1,
+                             f"{cat} declaration {spec!r} is not "
+                             f"`Struct.field`")
+                return
+            sname, fname = spec.split(".", 1)
+            if sname not in gt.structs:
+                self.finding(path, 1,
+                             f"{cat} declares {spec!r} but {sname} is "
+                             f"not in the `structs:` list")
+                return
+            fields = self.structs.get(sname)
+            if fields is None:
+                self.finding(path, 1,
+                             f"declared struct {sname} not found in "
+                             f"the native sources")
+                return
+            names = [f.name for f in fields] if fname == "*" else [fname]
+            for nm in names:
+                if fname != "*" and nm not in [f.name for f in fields]:
+                    self.finding(path, 1,
+                                 f"{cat} declares {sname}.{nm} but "
+                                 f"{sname} has no such field")
+                    continue
+                key = f"{sname}.{nm}"
+                prev = declared_fields.get(key)
+                if prev and prev != cat and fname != "*" \
+                        and not override_ok:
+                    self.finding(path, 1,
+                                 f"{key} declared both {prev} and "
+                                 f"{cat}")
+                declared_fields[key] = cat
+                self.cats.setdefault(nm, set()).add(cat)
+
+        for cat in ("mutex", "lock", "stable"):
+            for spec in gt.raw.get(cat, ()):
+                add(spec, cat)
+        # crash-atomic refines lock (most-specific wins, no conflict)
+        for spec in gt.raw.get("crash-atomic", ()):
+            add(spec, "crash-atomic", override_ok=True)
+        for fld, (sord, lord) in gt.publishes.items():
+            add(fld, "publish")
+            self.publish_by_field[fld.split(".", 1)[1]] = (sord, lord)
+        for sl in gt.seqlocks:
+            if sl.seq:
+                add(sl.seq, "seq")
+                self.seq_fields.add(sl.seq.split(".", 1)[1])
+            for p in sl.payload:
+                add(p, "payload")
+            self.helper_names.update(sl.helpers)
+        # exhaustiveness: every field of every declared struct has a
+        # category
+        for sname in gt.structs:
+            for f in self.structs.get(sname, ()):
+                if f"{sname}.{f.name}" not in declared_fields:
+                    self.finding(
+                        path, 1,
+                        f"{sname}.{f.name} is a shared-region field "
+                        f"with NO declared access category — extend "
+                        f"the vtpu_core.h ground-truth block")
+
+    # -- per-function access discipline ------------------------------------
+
+    def scan_function(self, fn: CFunc) -> None:
+        gt = self.gt
+        is_init = fn.name in gt.init_writers
+        locked = fn.locked or is_init \
+            or fn.name.endswith(gt.locked_suffix)
+        known = set(self.cats)
+        for line, stmt in fn.statements:
+            has_atomic = "__atomic_" in stmt
+            orders = _ATOMIC_ORDER_RE.findall(stmt)
+            opm = _ATOMIC_OP_RE.search(stmt)
+            op = opm.group(1) if opm else ""
+            helper_called = next(
+                (h for h in self.helper_names
+                 if re.search(rf"\b{h}\s*\(", stmt)), None)
+            # *_locked callees only from locked contexts
+            for cm in re.finditer(
+                    rf"\b(\w+{re.escape(gt.locked_suffix)})\s*\(",
+                    stmt):
+                if not locked:
+                    self.finding(
+                        fn.path, line,
+                        f"{fn.name} calls {cm.group(1)} without "
+                        f"holding the region lock (the "
+                        f"`{gt.locked_suffix}` suffix is a held-lock "
+                        f"contract)")
+            for fname, is_write in chain_fields(stmt, known):
+                cats = self.cats[fname]
+                if has_atomic:
+                    self._check_atomic(fn, line, stmt, fname, cats,
+                                       op, orders)
+                    continue
+                if "mutex" in cats:
+                    continue
+                if helper_called and "payload" in cats:
+                    continue
+                if is_init:
+                    continue
+                if ("lock" in cats or "crash-atomic" in cats) and locked:
+                    continue
+                if "stable" in cats and not is_write:
+                    continue
+                if "stable" in cats and is_write:
+                    self.finding(
+                        fn.path, line,
+                        f"{fn.name} writes stable field `{fname}` "
+                        f"outside the declared init-writers "
+                        f"({sorted(gt.init_writers)})")
+                    continue
+                if cats & {"publish", "seq", "payload"}:
+                    self.finding(
+                        fn.path, line,
+                        f"{fn.name}: plain access to lock-free "
+                        f"protocol field `{fname}` — must go through "
+                        f"a declared atomic helper with an explicit "
+                        f"memory order")
+                    continue
+                self.finding(
+                    fn.path, line,
+                    f"{fn.name}: plain access to shared-region field "
+                    f"`{fname}` outside the region lock (no "
+                    f"lock_region in scope)")
+
+    def _check_atomic(self, fn: CFunc, line: int, stmt: str,
+                      fname: str, cats: Set[str], op: str,
+                      orders: List[str]) -> None:
+        if "SEQ_CST" in orders:
+            self.finding(
+                fn.path, line,
+                f"{fn.name}: __ATOMIC_SEQ_CST on `{fname}` — seq_cst "
+                f"is never declared for these protocols; declare the "
+                f"order the protocol actually needs")
+            return
+        is_store = op.startswith("store")
+        is_load = op.startswith("load")
+        is_rmw = op.startswith(("fetch", "exchange", "compare", "add",
+                                "sub", "and", "or", "xor"))
+        order = orders[0] if orders else ""
+        rec = self.sites.setdefault(fname, {"store": [], "load": []})
+        if is_store or is_rmw:
+            rec["store"].append(order)
+        if is_load or is_rmw:
+            rec["load"].append(order)
+        if "publish" in cats:
+            want_store, want_load = self.publish_by_field[fname]
+            if (is_store or is_rmw) and order != ORDERS[want_store] \
+                    .replace("__ATOMIC_", ""):
+                self.finding(
+                    fn.path, line,
+                    f"{fn.name}: `{fname}` published at __ATOMIC_"
+                    f"{order or '???'} but declared "
+                    f"`publish: ... {want_store}`")
+            if is_load and not is_rmw and order != ORDERS[want_load] \
+                    .replace("__ATOMIC_", ""):
+                self.finding(
+                    fn.path, line,
+                    f"{fn.name}: `{fname}` consumed at __ATOMIC_"
+                    f"{order or '???'} but declared "
+                    f"`consume: {want_load}`")
+
+    # -- publish/consume pairing (both directions) -------------------------
+
+    def check_pairing(self, path: str) -> None:
+        for fld, (sord, lord) in self.gt.publishes.items():
+            fname = fld.split(".", 1)[1]
+            rec = self.sites.get(fname, {"store": [], "load": []})
+            if not rec["store"]:
+                self.finding(
+                    path, 1,
+                    f"declared `publish: {fld} {sord}` has no "
+                    f"conforming publish site in the native sources "
+                    f"(pairing must hold in both directions)")
+            if not rec["load"]:
+                self.finding(
+                    path, 1,
+                    f"declared `publish: {fld}` has no consume-side "
+                    f"load site (declared `consume: {lord}`)")
+
+    # -- seqlock shape -----------------------------------------------------
+
+    def check_seqlocks(self, funcs: Dict[str, CFunc]) -> None:
+        for sl in self.gt.seqlocks:
+            if not (sl.seq and sl.writer and sl.reader):
+                continue
+            seq_field = sl.seq.split(".", 1)[1]
+            w = funcs.get(sl.writer)
+            r = funcs.get(sl.reader)
+            if w is None or r is None:
+                self.findings.append(Finding(
+                    "atomics", HEADER, 1,
+                    f"seqlock {sl.name}: declared writer/reader "
+                    f"{sl.writer}/{sl.reader} not found in the "
+                    f"native sources"))
+                continue
+            self._match_shape(
+                w, self._events(w, seq_field),
+                [("store", "RELAXED"), ("fence", "RELEASE"),
+                 ("helper", next(iter(sl.helpers))),
+                 ("fence", "RELEASE"), ("store", "RELEASE")],
+                sl.name, "writer: invalidate(relaxed), release "
+                "fence, payload, release fence, publish(release)")
+            helpers = list(sl.helpers)
+            reader_helper = helpers[1] if len(helpers) > 1 else helpers[0]
+            self._match_shape(
+                r, self._events(r, seq_field),
+                [("load", "ACQUIRE"), ("helper", reader_helper),
+                 ("fence", "ACQUIRE"), ("load", "ACQUIRE")],
+                sl.name, "reader: seq acquire, copy, acquire fence, "
+                "seq re-check(acquire)")
+
+    def _events(self, fn: CFunc, seq_field: str
+                ) -> List[Tuple[str, str]]:
+        events: List[Tuple[str, str]] = []
+        for _line, stmt in fn.statements:
+            if "__atomic_thread_fence" in stmt:
+                m = _ATOMIC_ORDER_RE.search(stmt)
+                events.append(("fence", m.group(1) if m else "?"))
+                continue
+            helper = next((h for h in self.helper_names
+                           if re.search(rf"\b{h}\s*\(", stmt)), None)
+            if helper:
+                events.append(("helper", helper))
+                continue
+            if re.search(rf"(?:->|\.)\s*{seq_field}\b", stmt) \
+                    and "__atomic_" in stmt:
+                opm = _ATOMIC_OP_RE.search(stmt)
+                m = _ATOMIC_ORDER_RE.search(stmt)
+                kind = "store" if opm and opm.group(1).startswith(
+                    "store") else "load"
+                events.append((kind, m.group(1) if m else "?"))
+        return events
+
+    def _match_shape(self, fn: CFunc, got: List[Tuple[str, str]],
+                     want: List[Tuple[str, str]], name: str,
+                     shape: str) -> None:
+        if got != want:
+            self.findings.append(Finding(
+                "atomics", fn.path, fn.line,
+                f"seqlock {name}: {fn.name} does not follow the "
+                f"declared shape ({shape}); observed "
+                f"{got!r}, expected {want!r} — a missing fence or "
+                f"re-check is a torn read on arm64"))
+
+
+# ---------------------------------------------------------------------------
+# Banned constructs (implicit orders)
+# ---------------------------------------------------------------------------
+
+_STD_ATOMIC_DECL_RE = re.compile(r"std::atomic<[^>]*>\s+(\w+)")
+_STD_ATOMIC_OP = ("load", "store", "exchange", "fetch_add", "fetch_sub",
+                  "compare_exchange_weak", "compare_exchange_strong")
+
+
+def banned_constructs(stripped: str, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    atomics: Set[str] = set(_STD_ATOMIC_DECL_RE.findall(stripped))
+    for i, line in enumerate(stripped.splitlines(), 1):
+        if "__sync_" in line:
+            out.append(Finding(
+                "atomics", path, i,
+                "__sync_* builtin: implicit seq_cst with no declared "
+                "order — use __atomic_* with the order the protocol "
+                "declares"))
+        if re.search(r"\bvolatile\b", line):
+            out.append(Finding(
+                "atomics", path, i,
+                "volatile is not a synchronization primitive — use "
+                "atomics with explicit orders"))
+        for name in atomics:
+            for op in _STD_ATOMIC_OP:
+                if re.search(rf"\b{name}\s*\.\s*{op}\s*\(", line) \
+                        and "memory_order" not in line:
+                    out.append(Finding(
+                        "atomics", path, i,
+                        f"std::atomic `{name}.{op}(...)` without an "
+                        f"explicit std::memory_order (implicit "
+                        f"seq_cst)"))
+            if re.search(rf"\b{name}\s*(\+\+|--|[+\-|&^]=)", line):
+                out.append(Finding(
+                    "atomics", path, i,
+                    f"std::atomic `{name}` mutated via operator "
+                    f"(implicit seq_cst RMW) — use an explicit-order "
+                    f"method"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Mirror (layout drift) checks
+# ---------------------------------------------------------------------------
+
+def check_mirrors(gt: GroundTruth, structs: Dict[str, List[CField]],
+                  defines: Dict[str, int], shim_src: str,
+                  const_sources: Dict[str, str]) -> List[Finding]:
+    out: List[Finding] = []
+    py_structs, py_consts = parse_ctypes_structs(shim_src,
+                                                 const_sources)
+    for cname, pyfile, pyclass in gt.mirrors:
+        clay = c_layout(cname, structs)
+        if clay is None:
+            out.append(Finding(
+                "atomics", HEADER, 1,
+                f"mirror: C struct {cname} not found or not "
+                f"layout-computable"))
+            continue
+        pyfields = py_structs.get(pyclass)
+        if pyfields is None:
+            out.append(Finding(
+                "atomics", f"{PKG_NAME}/{pyfile}", 1,
+                f"mirror: ctypes class {pyclass} not found in "
+                f"{pyfile}"))
+            continue
+        plan = ctypes_layout(pyfields)
+        rel = f"{PKG_NAME}/{pyfile}"
+        if plan is None:
+            out.append(Finding(
+                "atomics", rel, 1,
+                f"mirror: {pyclass} uses a ctype or array length the "
+                f"checker cannot resolve"))
+            continue
+        cnames = [n for n, _o, _s in clay]
+        pnames = [n for n, _o, _s in plan]
+        if cnames != pnames:
+            out.append(Finding(
+                "atomics", rel, 1,
+                f"LAYOUT DRIFT: {cname} fields {cnames} != {pyclass} "
+                f"ctypes fields {pnames} (order/name mismatch is "
+                f"silent cross-language corruption)"))
+            continue
+        for (fn_, co, cs), (_pn, po, ps) in zip(clay, plan):
+            if co != po or cs != ps:
+                out.append(Finding(
+                    "atomics", rel, 1,
+                    f"LAYOUT DRIFT: {cname}.{fn_} is offset {co} "
+                    f"size {cs} in C but offset {po} size {ps} in "
+                    f"{pyclass} — the ctypes mirror reads the wrong "
+                    f"bytes"))
+    for c_const, pyfile, py_const in gt.consts:
+        cval = defines.get(c_const)
+        pval = py_consts.get(py_const)
+        if cval is None:
+            out.append(Finding(
+                "atomics", HEADER, 1,
+                f"mirror-const: #define {c_const} not found in the "
+                f"native sources"))
+        elif pval is None:
+            out.append(Finding(
+                "atomics", f"{PKG_NAME}/{pyfile}", 1,
+                f"mirror-const: {py_const} not found in {pyfile}"))
+        elif cval != pval:
+            out.append(Finding(
+                "atomics", f"{PKG_NAME}/{pyfile}", 1,
+                f"LAYOUT DRIFT: {c_const} = {cval} in C but "
+                f"{py_const} = {pval} in {pyfile} — array extents "
+                f"disagree across the language boundary"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# crash-atomic layout rule
+# ---------------------------------------------------------------------------
+
+def check_crash_atomic(gt: GroundTruth,
+                       structs: Dict[str, List[CField]]
+                       ) -> List[Finding]:
+    out: List[Finding] = []
+    for spec in gt.raw.get("crash-atomic", ()):
+        if "." not in spec:
+            continue
+        sname, fname = spec.split(".", 1)
+        lay = c_layout(sname, structs)
+        if lay is None:
+            out.append(Finding(
+                "atomics", HEADER, 1,
+                f"crash-atomic {spec}: cannot compute the layout of "
+                f"{sname}"))
+            continue
+        ent = next(((o, s) for n, o, s in lay if n == fname), None)
+        if ent is None:
+            continue  # already reported by category building
+        off, size = ent
+        if size > 8 or size not in (1, 2, 4, 8) or off % size != 0:
+            out.append(Finding(
+                "atomics", HEADER, 1,
+                f"crash-atomic {spec}: offset {off} size {size} is "
+                f"not one naturally-aligned machine word — a "
+                f"degraded-mode read can tear while the writer is "
+                f"dead mid-update"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def check_sources(native_sources: Dict[str, str], shim_src: str,
+                  const_sources: Dict[str, str]) -> List[Finding]:
+    """Analyze an in-memory tree ({relpath: text} for the native
+    files; tests feed seeded-violation fixtures)."""
+    header_src = native_sources.get(HEADER)
+    if header_src is None:
+        return [Finding("atomics", HEADER, 1,
+                        "vtpu_core.h missing — cannot load the "
+                        "shared-memory protocol ground truth")]
+    gt, findings = parse_ground_truth(header_src)
+    if gt is None:
+        return findings
+    stripped = {rel: strip_comments(src)
+                for rel, src in native_sources.items()}
+    structs, defines = parse_c_structs(stripped)
+    checker = _Checker(gt, structs)
+    checker.findings.extend(findings)
+    checker.build_categories(HEADER)
+    funcs: Dict[str, CFunc] = {}
+    for rel, src in sorted(stripped.items()):
+        if not rel.endswith((".cc", ".c")):
+            continue
+        for fn in split_functions(src, rel):
+            fn.locked = bool(re.search(r"\block_region\s*\(",
+                                       " ".join(t for _l, t
+                                                in fn.statements)))
+            funcs[fn.name] = fn
+            checker.scan_function(fn)
+    checker.check_pairing(HEADER)
+    checker.check_seqlocks(funcs)
+    out = checker.findings
+    for rel, src in sorted(stripped.items()):
+        out.extend(banned_constructs(src, rel))
+    out.extend(check_crash_atomic(gt, structs))
+    out.extend(check_mirrors(gt, structs, defines, shim_src,
+                             const_sources))
+    # dedup (categories can be hit via several chains per line)
+    seen: Set[Tuple[str, int, str]] = set()
+    uniq: List[Finding] = []
+    for f in out:
+        key = (f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq
+
+
+def check(root: str) -> List[Finding]:
+    native_sources: Dict[str, str] = {}
+    for rel in NATIVE_ANALYZED:
+        text = read_text(root, rel)
+        if text is not None:
+            native_sources[rel] = text
+    if HEADER not in native_sources:
+        return []
+    shim_src = read_text(root, SHIM) or ""
+    const_sources = {}
+    for rel in (SHIM, ENVSPEC):
+        text = read_text(root, rel)
+        if text is not None:
+            const_sources[rel] = text
+    return check_sources(native_sources, shim_src, const_sources)

@@ -17,10 +17,15 @@ Phases:
                    operation is mid-flight).
   - ``cut``      — checked per journal truncation point by the
                    crash-cut engine (recovery safety).
+  - ``litmus``   — checked over every explored weak-memory execution
+                   of the vtpu-wmm litmus suite (``tools/wmm``): the
+                   shared-region lock-free protocols under C11-ish
+                   reordering, not just sequential consistency.
 
 A check returns a list of human-readable violation strings (empty =
 holds).  Its ``ctx`` is the interleaving ``Harness`` for step/terminal
-checks and a ``CutContext`` for cut checks.
+checks, a ``CutContext`` for cut checks, and a ``WmmContext``
+(``tools/wmm/model.py``) for litmus checks.
 """
 
 from __future__ import annotations
@@ -237,6 +242,22 @@ def _chk_deferred_flush(h: Any) -> List[str]:
 
 
 # ---------------------------------------------------------------------------
+# Weak-memory-engine checks (ctx = tools.wmm.model.WmmContext)
+#
+# The wmm engine and the litmus ``check`` functions deposit violation
+# strings into named buckets as executions are explored; each row
+# below drains its bucket.  The indirection keeps the registry the
+# single declaration point (docs/ANALYSIS.md renders this table) while
+# the detection itself lives with the operational model.
+# ---------------------------------------------------------------------------
+
+def _wmm_bucket(row: str) -> Callable[[Any], List[str]]:
+    def chk(ctx: Any) -> List[str]:
+        return ctx.take(row)
+    return chk
+
+
+# ---------------------------------------------------------------------------
 # Crash-cut-engine checks (ctx = tools.mc.crashcut.CutContext)
 # ---------------------------------------------------------------------------
 
@@ -364,6 +385,42 @@ INVARIANTS: Tuple[Invariant, ...] = (
         "corruption-fails-closed", "crash", "cut",
         "non-tail journal damage raises JournalCorrupt (no guessed "
         "quota state)", _chk_fail_closed),
+    Invariant(
+        "wmm-no-torn-payload", "wmm", "litmus",
+        "no seqlock/ring reader ever ACCEPTS a torn or stale payload "
+        "under any allowed reordering of the declared orders",
+        _wmm_bucket("wmm-no-torn-payload")),
+    Invariant(
+        "wmm-data-race", "wmm", "litmus",
+        "no plain (non-atomic) access to shared-region state races a "
+        "concurrent write (C11 undefined behavior)",
+        _wmm_bucket("wmm-data-race")),
+    Invariant(
+        "wmm-ledger-conserved", "wmm", "litmus",
+        "lock-free ledger charge/free conserves exactly: no lost "
+        "update double-admits past the limit or double-frees",
+        _wmm_bucket("wmm-ledger-conserved")),
+    Invariant(
+        "wmm-lease-bounded", "wmm", "litmus",
+        "rate-lease burn + revoke refund + residue never exceeds the "
+        "one pre-debited quantum (no unmetered device time)",
+        _wmm_bucket("wmm-lease-bounded")),
+    Invariant(
+        "wmm-credit-bounds", "wmm", "litmus",
+        "burst-credit bank stays within [0, cap] and spends within "
+        "mints under cross-process atomics",
+        _wmm_bucket("wmm-credit-bounds")),
+    Invariant(
+        "wmm-crash-atomic", "wmm", "litmus",
+        "degraded-mode quota reads observe old-or-new grants only "
+        "(never torn), and the quota still bites with the broker "
+        "dead mid-update", _wmm_bucket("wmm-crash-atomic")),
+    Invariant(
+        "wmm-ring-fifo", "wmm", "litmus",
+        "the planned interposer-only execute ring delivers "
+        "descriptors in FIFO order, never executes an unpublished "
+        "descriptor, and its credit gate never leaks or over-admits",
+        _wmm_bucket("wmm-ring-fifo")),
 )
 
 

@@ -457,16 +457,20 @@ def cmd_leases(ns) -> int:
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
     ap.add_argument("cmd", nargs="?", default=None,
-                    choices=("trace", "leases", "analyze", "mc",
+                    choices=("trace", "leases", "analyze", "mc", "wmm",
                              "metricsd", "chaos", "top"),
                     help="trace: flight-recorder spans (needs "
                          "--broker; --dump FILE exports Chrome-trace "
                          "JSON); leases: chip-lease sidecar forensics; "
-                         "analyze: cross-layer invariant linters "
+                         "analyze: cross-layer invariant linters incl. "
+                         "the shared-memory atomics checker "
                          "(docs/ANALYSIS.md); mc: deterministic model "
                          "checking of quota/lease/crash-recovery "
                          "invariants (--smoke for the quick wiring "
-                         "check); metricsd: the quota-virtualized "
+                         "check); wmm: weak-memory-model litmus "
+                         "exploration of the shared-region lock-free "
+                         "protocols (--smoke for the wiring check); "
+                         "metricsd: the quota-virtualized "
                          "view stock tpu-info sees (docs/METRICSD.md); "
                          "top: live htop-style per-tenant SLO / "
                          "fairness / blame table (needs --broker; "
@@ -498,8 +502,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(no broker; the analyze CI job's wiring "
                          "check)")
     ap.add_argument("--smoke", action="store_true",
-                    help="with `mc`/`chaos`: tiny-budget wiring check "
-                         "(the analyze CI job's smokes)")
+                    help="with `mc`/`wmm`/`chaos`: tiny-budget wiring "
+                         "check (the analyze CI job's smokes)")
     ap.add_argument("--sweep-host", action="store_true",
                     help="reclaim slots of dead host pids (node mode only)")
     ap.add_argument("--broker", default=None, metavar="SOCKET",
@@ -575,6 +579,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         if ns.cmd_arg:
             args.extend(["--scenario", ns.cmd_arg])
         return mc_main(args)
+    if ns.cmd == "wmm":
+        # Weak-memory litmus explorer (tools/wmm): the shared-region
+        # lock-free protocols under C11-ish reordering, held to the
+        # wmm rows of the mc invariant registry (docs/ANALYSIS.md
+        # "Weak memory model").  --smoke is the cheap wiring check
+        # the analyze CI job runs; budgets, the floor gate and
+        # selfcheck live on `python -m vtpu.tools.wmm` directly.
+        from .wmm import main as wmm_main
+        args = []
+        if ns.json:
+            args.append("--json")
+        if ns.smoke:
+            args.append("--smoke")
+        if ns.cmd_arg:
+            args.extend(["--litmus", ns.cmd_arg])
+        return wmm_main(args)
 
     admin_verbs = (ns.suspend or ns.resume or ns.resize
                    or ns.broker_stats or ns.drain or ns.handover

@@ -1,0 +1,21 @@
+"""vtpu-wmm — weak-memory-model checking of the shared-region
+lock-free protocols (docs/ANALYSIS.md "Weak memory model").
+
+The dynamic half of the vtpu-wmm pair (the static half is
+``tools/analyze/atomics.py``): an operational C11-ish simulator
+(per-location message histories + per-thread views, the promise-free
+view-based semantics) that exhaustively explores litmus programs
+modeling the REAL shared-region protocols — trace-ring seqlock
+publish/wrap/read, region-ledger CAS charge/free, rate-lease burn,
+burst-credit mint/spend, degraded-mode quota reads with the broker
+dead mid-update, and the PLANNED interposer-only shm execute ring
+(ROADMAP item 2) — and holds every reachable outcome to the ``wmm``
+rows of the ``tools/mc/invariants.py`` registry.
+
+Run as ``python -m vtpu.tools.wmm`` or ``vtpu-smi wmm [--smoke]``;
+``--selfcheck`` proves each deliberately weakened protocol variant is
+caught.  Stdlib-only; deterministic; explored-execution counts are
+floor-gated in CI like the mc job.
+"""
+
+from .cli import main  # noqa: F401

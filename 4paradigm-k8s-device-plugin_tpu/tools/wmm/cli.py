@@ -1,0 +1,133 @@
+"""vtpu-wmm command line — litmus suite, budgets, floor gate,
+selfcheck.
+
+Exploration is fully deterministic (DFS over scheduling/visibility
+decisions; no randomness anywhere), so CI needs no seed pinning: the
+same tree + the same budget flags explore the same executions.  The
+CI ``wmm`` job prints the explored-execution counts and floor-gates
+them (``--min-executions``): a refactor that silently shrinks the
+explored space — a litmus that stopped branching, a budget knob
+regression — fails loudly instead of shipping a weaker checker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from . import litmus as lt
+from . import model, selfcheck
+
+
+def _run_suite(ns: argparse.Namespace) -> Dict[str, Any]:
+    wanted = [lt.get(ns.litmus)] if ns.litmus else list(lt.LITMUS)
+    out: Dict[str, Any] = {"litmus": {}, "executions": 0,
+                           "decisions": 0, "violations": []}
+    for item in wanted:
+        stats = model.explore_litmus(
+            item, max_executions=ns.max_executions,
+            preemption_bound=ns.preemptions)
+        out["litmus"][item.name] = {
+            "protocol": item.protocol,
+            "executions": stats.executions,
+            "decisions": stats.decisions,
+            "truncated": stats.truncated,
+            "violations": stats.violations,
+            "witness": stats.witness,
+        }
+        out["executions"] += stats.executions
+        out["decisions"] += stats.decisions
+        out["violations"].extend(
+            f"{item.name}: {v}" for v in stats.violations)
+    return out
+
+
+def _run_selfcheck(ns: argparse.Namespace) -> int:
+    results = selfcheck.run_all(max_executions=ns.max_executions)
+    missed = [s.name for s, caught, _n in results if not caught]
+    for seed, caught, n in results:
+        mark = "caught" if caught else "MISSED"
+        print(f"  seed {seed.name:30s} -> {seed.invariant:22s} "
+              f"{mark} ({n} violation(s))")
+    if missed:
+        print(f"vtpu-wmm selfcheck: {len(missed)} seed(s) NOT caught: "
+              f"{missed}")
+        return 1
+    print(f"vtpu-wmm selfcheck: all {len(results)} seeded weak-memory "
+          f"bugs caught")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="vtpu-wmm",
+        description="weak-memory-model checking of the shared-region "
+                    "lock-free protocols (docs/ANALYSIS.md)")
+    ap.add_argument("--litmus", default=None,
+                    help="run one litmus program by name")
+    ap.add_argument("--list", action="store_true",
+                    help="list litmus programs and selfcheck seeds, "
+                         "then exit")
+    ap.add_argument("--max-executions", type=int, default=None,
+                    help="execution budget PER litmus (deterministic "
+                         "DFS; default VTPU_WMM_MAX_EXECUTIONS or "
+                         f"{model.DEFAULT_MAX_EXECUTIONS})")
+    ap.add_argument("--preemptions", type=int, default=None,
+                    help="CHESS-style preemption budget per execution "
+                         "(default VTPU_WMM_PREEMPTIONS or "
+                         f"{model.DEFAULT_PREEMPTION_BOUND}; message-"
+                         "visibility choices are never bounded)")
+    ap.add_argument("--min-executions", type=int, default=0,
+                    help="fail unless the suite explored at least "
+                         "this many executions in total (CI floor "
+                         "gate)")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="run the seeded-violation matrix instead: "
+                         "every weakened protocol variant must be "
+                         "caught by its invariant row")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny budget: the analyze-job wiring check, "
+                         "not the real exploration")
+    ap.add_argument("--json", action="store_true")
+    ns = ap.parse_args(argv)
+
+    if ns.list:
+        print("litmus programs:")
+        for item in lt.LITMUS:
+            print(f"  {item.name:16s} [{item.protocol:16s}] "
+                  f"{item.description}")
+        print("selfcheck seeds:")
+        for seed in selfcheck.SEEDS:
+            print(f"  {seed.name:30s} -> {seed.invariant}")
+        return 0
+
+    if ns.smoke and ns.max_executions is None:
+        ns.max_executions = 60
+
+    if ns.selfcheck:
+        return _run_selfcheck(ns)
+
+    report = _run_suite(ns)
+    if ns.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for name, s in report["litmus"].items():
+            print(f"  wmm {name:16s} executions={s['executions']:6d} "
+                  f"decisions={s['decisions']:8d}"
+                  + (f" truncated={s['truncated']}"
+                     if s["truncated"] else ""))
+        print(f"  wmm TOTAL: {report['executions']} executions, "
+              f"{report['decisions']} decisions")
+        for v in report["violations"]:
+            print(f"VIOLATION: {v}")
+        print(f"vtpu-wmm: {len(report['violations'])} violation(s)")
+
+    if ns.min_executions and report["executions"] < ns.min_executions:
+        print(f"vtpu-wmm: explored-execution FLOOR MISSED: "
+              f"{report['executions']} < --min-executions "
+              f"{ns.min_executions} — the explored space silently "
+              f"shrank", file=sys.stderr)
+        return 1
+    return 1 if report["violations"] else 0

@@ -1,0 +1,523 @@
+"""vtpu-wmm litmus suite: the REAL shared-region protocol shapes.
+
+Each litmus here is a faithful miniature of a protocol the enforcement
+stack runs (or — for the exec ring — is specified to run) over the
+mmap'd shared region, written at the exact memory orders the
+declaration grammar in ``native/vtpucore/vtpu_core.h`` commits to.
+The engine explores every scheduling/visibility choice within the
+bounds and holds the outcomes to the ``wmm`` rows of the
+``tools/mc/invariants.py`` registry.
+
+Every factory takes a ``broken=`` parameter used ONLY by
+``selfcheck.py``: a deliberately weakened variant (release downgraded
+to relaxed, missing reader re-check, non-atomic ledger access, torn
+two-word crash-atomic update) that the matching invariant row must
+catch — the proof the simulator can actually see weak-memory bugs.
+
+Protocol sources:
+
+  - ``trace_ring``      — vtpu_trace_emit / vtpu_trace_read
+                          (per-slot seqlock, single-writer ring)
+  - ``ledger_cas``      — the declared lock-free charge/free shape of
+                          the interposer-only data plane (today the
+                          ledger runs under the robust mutex; ROADMAP
+                          item 2 moves it onto this CAS protocol)
+  - ``rate_lease``      — shim/core.py RateLease pre-debit/burn/refund
+                          over the bucket
+  - ``credit_bank``     — burst-credit mint/spend (docs/SCHEDULING.md)
+                          as cross-process atomics
+  - ``degraded_quota``  — runtime/degraded.py: quota read with the
+                          broker GONE mid-update (crash-atomic fields)
+  - ``exec_ring``       — the PLANNED interposer-only shm execute ring
+                          (SPSC descriptor ring + credit gate), spec'd
+                          in vtpu_core.h ahead of the ROADMAP item 2
+                          build so it lands on verified orders
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Tuple
+
+from .model import ACQ, ACQ_REL, PLAIN, REL, RLX, WmmContext
+
+
+@dataclass(frozen=True)
+class Litmus:
+    name: str
+    description: str
+    protocol: str          # declared protocol this models
+    init: Dict[str, int]
+    threads: Tuple[Callable, ...]   # each: (out) -> generator
+    check: Callable[[WmmContext, Dict[str, Any], Dict[str, int]], None]
+    rows: Tuple[str, ...]  # invariant rows it exercises
+
+
+# ---------------------------------------------------------------------------
+# 1. trace-ring seqlock (vtpu_trace_emit / vtpu_trace_read)
+# ---------------------------------------------------------------------------
+
+def make_trace_ring(broken: str = "") -> Litmus:
+    """2-slot ring, 3 events (one wrap), 2-word payload.  The writer
+    follows the vtpu_core.cc publish shape exactly: claim the index
+    with an acq_rel fetch_add on head, invalidate (seq=0 relaxed),
+    release fence, relaxed payload, release fence, publish seq=idx+1
+    release.  The reader: head acquire, seq acquire, relaxed copy,
+    acquire fence, seq re-check.  Both release fences and the re-check
+    are load-bearing — the broken variants drop them."""
+    events = 3
+
+    def writer(out: Dict[str, Any]):
+        for _ in range(events):
+            idx = yield ("rmw", "head", 1, ACQ_REL)
+            s = idx % 2
+            val = 100 + idx
+            if broken == "relaxed-publish":
+                yield ("store", f"seq{s}", 0, RLX)
+                yield ("store", f"pay_a{s}", val, RLX)
+                yield ("store", f"pay_b{s}", val, RLX)
+                yield ("store", f"seq{s}", idx + 1, RLX)
+            else:
+                yield ("store", f"seq{s}", 0, RLX)
+                yield ("fence", REL)
+                yield ("store", f"pay_a{s}", val, RLX)
+                yield ("store", f"pay_b{s}", val, RLX)
+                yield ("fence", REL)
+                yield ("store", f"seq{s}", idx + 1, REL)
+
+    def reader(out: Dict[str, Any]):
+        head = yield ("load", "head", ACQ)
+        got = []
+        for i in range(max(0, head - 2), head):
+            s = i % 2
+            seq = yield ("load", f"seq{s}", ACQ)
+            if seq != i + 1:
+                continue
+            a = yield ("load", f"pay_a{s}", RLX)
+            b = yield ("load", f"pay_b{s}", RLX)
+            if broken != "missing-recheck":
+                yield ("fence", ACQ)
+                seq2 = yield ("load", f"seq{s}", ACQ)
+                if seq2 != i + 1:
+                    continue  # torn by a wrap: discard, as the C does
+            got.append((i, a, b))
+        out["got"] = got
+
+    def check(ctx: WmmContext, out: Dict[str, Any],
+              final: Dict[str, int]) -> None:
+        for i, a, b in out.get("got", ()):
+            want = 100 + i
+            if a != want or b != want:
+                ctx.report(
+                    "wmm-no-torn-payload",
+                    f"trace_ring: reader ACCEPTED slot for event {i} "
+                    f"with payload ({a},{b}) != ({want},{want}) — "
+                    f"torn/stale read survived the seqlock")
+
+    init = {"head": 0}
+    for s in (0, 1):
+        init.update({f"seq{s}": 0, f"pay_a{s}": 0, f"pay_b{s}": 0})
+    return Litmus(
+        "trace_ring",
+        "seqlock publish/wrap/read of the per-process trace event ring",
+        "trace-slot", init, (writer, reader), check,
+        ("wmm-no-torn-payload",))
+
+
+# ---------------------------------------------------------------------------
+# 2. region ledger charge/free as lock-free CAS (data-plane shape)
+# ---------------------------------------------------------------------------
+
+def make_ledger_cas(broken: str = "") -> Litmus:
+    """Two tenants charge against one 100-byte device ledger
+    (limit-checked CAS loop, the declared interposer-only shape); one
+    frees its charge.  Conservation: the final ledger equals the
+    surviving charges exactly — a lost update (the non-atomic broken
+    variant) double-admits past the limit or double-frees."""
+    limit = 100
+
+    def charger(tag: str, nbytes: int, free_after: bool):
+        def th(out: Dict[str, Any]):
+            charged = False
+            for _ in range(4):
+                if broken == "plain-rmw":
+                    v = yield ("load", "used", PLAIN)
+                else:
+                    v = yield ("load", "used", RLX)
+                if v + nbytes > limit:
+                    break
+                if broken == "plain-rmw":
+                    yield ("store", "used", v + nbytes, PLAIN)
+                    ok = True
+                else:
+                    ok = yield ("cas", "used", v, v + nbytes, ACQ_REL)
+                if ok:
+                    charged = True
+                    out[f"charged_{tag}"] = nbytes
+                    break
+            if charged and free_after:
+                yield ("rmw", "used", -nbytes, ACQ_REL)
+                out[f"freed_{tag}"] = nbytes
+                if broken == "double-free":
+                    # the release path runs again (the retry-after-
+                    # partial-teardown bug class): same bytes returned
+                    # twice, atomically — no race, pure conservation
+                    yield ("rmw", "used", -nbytes, ACQ_REL)
+        return th
+
+    def check(ctx: WmmContext, out: Dict[str, Any],
+              final: Dict[str, int]) -> None:
+        expect = (out.get("charged_t0", 0) - out.get("freed_t0", 0)
+                  + out.get("charged_t1", 0) - out.get("freed_t1", 0))
+        if final["used"] != expect:
+            ctx.report(
+                "wmm-ledger-conserved",
+                f"ledger_cas: final ledger {final['used']}B != "
+                f"surviving charges {expect}B (lost update: double "
+                f"admit or double free)")
+        if final["used"] > limit:
+            ctx.report(
+                "wmm-ledger-conserved",
+                f"ledger_cas: ledger {final['used']}B exceeds the "
+                f"{limit}B limit — quota escaped the CAS admission")
+
+    return Litmus(
+        "ledger_cas",
+        "lock-free HBM ledger charge/free with limit-checked CAS",
+        "region-ledger", {"used": 0},
+        (charger("t0", 60, True), charger("t1", 60, False)), check,
+        ("wmm-ledger-conserved", "wmm-data-race"))
+
+
+# ---------------------------------------------------------------------------
+# 3. rate-lease pre-debit / burn / revoke-refund
+# ---------------------------------------------------------------------------
+
+def make_rate_lease(broken: str = "") -> Litmus:
+    """A client pre-debits one 40µs quantum from the bucket, burns it
+    in 15µs admissions against a shared lease balance, while the
+    broker's revoke path concurrently swaps the balance to zero and
+    refunds the remainder.  Burn+refund+residue must equal the one
+    debited quantum — the plain-RMW broken variant loses the revoke's
+    update and burns device time that was already refunded."""
+    quantum, burn = 40, 15
+
+    def client(out: Dict[str, Any]):
+        yield ("rmw", "tokens", -quantum, ACQ_REL)  # pre-debit
+        yield ("store", "lease", quantum, REL)
+        burned = 0
+        for _ in range(3):
+            for _ in range(3):  # bounded CAS loop
+                v = yield ("load", "lease",
+                           PLAIN if broken == "plain-burn" else RLX)
+                if v < burn:
+                    break
+                if broken == "plain-burn":
+                    yield ("store", "lease", v - burn, PLAIN)
+                    ok = True
+                else:
+                    ok = yield ("cas", "lease", v, v - burn, ACQ_REL)
+                if ok:
+                    burned += burn
+                    break
+        out["burned"] = burned
+
+    def revoker(out: Dict[str, Any]):
+        for _ in range(3):  # bounded CAS loop
+            v = yield ("load", "lease", ACQ)
+            if v <= 0:
+                break
+            ok = yield ("cas", "lease", v, 0, ACQ_REL)
+            if ok:
+                yield ("rmw", "tokens", v, REL)  # refund remainder
+                out["refunded"] = v
+                break
+
+    def check(ctx: WmmContext, out: Dict[str, Any],
+              final: Dict[str, int]) -> None:
+        burned = out.get("burned", 0)
+        refunded = out.get("refunded", 0)
+        residue = final["lease"]
+        if burned + refunded + residue != quantum:
+            ctx.report(
+                "wmm-lease-bounded",
+                f"rate_lease: burned {burned} + refunded {refunded} + "
+                f"residue {residue} != the one pre-debited quantum "
+                f"{quantum}µs (unmetered device time)")
+        if burned > quantum:
+            ctx.report(
+                "wmm-lease-bounded",
+                f"rate_lease: burned {burned}µs exceeds the single "
+                f"{quantum}µs quantum")
+
+    return Litmus(
+        "rate_lease",
+        "lease pre-debit/burn racing the broker's revoke-and-refund",
+        "rate-bucket", {"tokens": 100, "lease": 0},
+        (client, revoker), check,
+        ("wmm-lease-bounded", "wmm-data-race"))
+
+
+# ---------------------------------------------------------------------------
+# 4. burst-credit bank mint/spend
+# ---------------------------------------------------------------------------
+
+def make_credit_bank(broken: str = "") -> Litmus:
+    """An idle-accrual minter tops the bank up (capped CAS) while a
+    spender draws it down; the balance must stay within [0, cap] and
+    spends within mints.  The plain-mint broken variant writes the
+    bank non-atomically and uncapped — credit minted from nothing."""
+    cap = 50
+
+    def minter(out: Dict[str, Any]):
+        minted = 0
+        for _ in range(3):
+            for _ in range(3):
+                if broken == "plain-mint":
+                    v = yield ("load", "credit", PLAIN)
+                    yield ("store", "credit", v + 30, PLAIN)
+                    minted += 30
+                    break
+                v = yield ("load", "credit", RLX)
+                nv = min(v + 30, cap)
+                if nv == v:
+                    break
+                ok = yield ("cas", "credit", v, nv, ACQ_REL)
+                if ok:
+                    minted += nv - v
+                    break
+        out["minted"] = minted
+
+    def spender(out: Dict[str, Any]):
+        spent = 0
+        for _ in range(2):
+            for _ in range(3):
+                v = yield ("load", "credit", RLX)
+                if v < 20:
+                    break
+                ok = yield ("cas", "credit", v, v - 20, ACQ_REL)
+                if ok:
+                    spent += 20
+                    break
+        out["spent"] = spent
+
+    def check(ctx: WmmContext, out: Dict[str, Any],
+              final: Dict[str, int]) -> None:
+        bal = final["credit"]
+        minted = out.get("minted", 0)
+        spent = out.get("spent", 0)
+        if bal < 0 or bal > cap:
+            ctx.report(
+                "wmm-credit-bounds",
+                f"credit_bank: balance {bal}µs outside [0, {cap}] "
+                f"(cap bypassed or double spend)")
+        if bal != minted - spent:
+            ctx.report(
+                "wmm-credit-bounds",
+                f"credit_bank: balance {bal} != minted {minted} - "
+                f"spent {spent} (credit minted from nothing or a "
+                f"lost update)")
+
+    return Litmus(
+        "credit_bank",
+        "burst-credit mint (capped) racing spend over shared atomics",
+        "credit-bank", {"credit": 0}, (minter, spender), check,
+        ("wmm-credit-bounds", "wmm-data-race"))
+
+
+# ---------------------------------------------------------------------------
+# 5. degraded-mode quota read with the broker gone
+# ---------------------------------------------------------------------------
+
+def make_degraded_quota(broken: str = "") -> Litmus:
+    """The broker resizes a tenant's quota and may be SIGKILLed after
+    ANY instruction (crash choice points); the degraded-mode client
+    keeps admitting against the crash-atomic fields.  Whatever the cut
+    the client must observe the OLD or the NEW limit — the two-word
+    broken variant splits the limit across two words and the client
+    can combine halves of different epochs into a limit nobody ever
+    granted (the silent-corruption class the crash-atomic single-word
+    rule exists for)."""
+    old, new = 14, 28  # both decimal "words" differ between epochs
+
+    def broker(out: Dict[str, Any]):
+        if broken == "two-word":
+            die = yield ("choice", 2)
+            if die:
+                return
+            yield ("store", "limit_lo", new % 10, REL)
+            die = yield ("choice", 2)
+            if die:
+                return
+            yield ("store", "limit_hi", new // 10, REL)
+        else:
+            die = yield ("choice", 2)
+            if die:
+                return
+            yield ("store", "limit", new, REL)
+        die = yield ("choice", 2)
+        if die:
+            return
+        yield ("store", "epoch", 2, REL)
+
+    def client(out: Dict[str, Any]):
+        admits = 0
+        seen = []
+        for _ in range(3):
+            if broken == "two-word":
+                hi = yield ("load", "limit_hi", ACQ)
+                lo = yield ("load", "limit_lo", ACQ)
+                lim = hi * 10 + lo
+            else:
+                lim = yield ("load", "limit", ACQ)
+            seen.append(lim)
+            used = yield ("load", "used", ACQ)
+            if used + 2 <= lim:
+                yield ("rmw", "used", 2, ACQ_REL)
+                admits += 1
+        out["admits"] = admits
+        out["seen"] = seen
+
+    def check(ctx: WmmContext, out: Dict[str, Any],
+              final: Dict[str, int]) -> None:
+        for lim in out.get("seen", ()):
+            if lim not in (old, new):
+                ctx.report(
+                    "wmm-crash-atomic",
+                    f"degraded_quota: client observed limit {lim} — "
+                    f"neither the old grant {old} nor the new {new} "
+                    f"(torn quota under broker death)")
+        if final["used"] > new:
+            ctx.report(
+                "wmm-crash-atomic",
+                f"degraded_quota: admitted {final['used']}B against a "
+                f"max grant of {new}B — the quota stopped biting with "
+                f"the broker gone")
+
+    init = {"limit": old, "limit_lo": old % 10, "limit_hi": old // 10,
+            "used": 0, "epoch": 1}
+    return Litmus(
+        "degraded_quota",
+        "degraded-mode quota admission while the broker dies mid-resize",
+        "degraded-ledger", init, (broker, client), check,
+        ("wmm-crash-atomic",))
+
+
+# ---------------------------------------------------------------------------
+# 6. PLANNED interposer-only shm execute ring (SPSC + credit gate)
+# ---------------------------------------------------------------------------
+
+def make_exec_ring(broken: str = "") -> Litmus:
+    """The ROADMAP item 2 data plane, verified before it is built: a
+    capacity-2 SPSC descriptor ring.  Producer (the interposer) takes
+    one credit (CAS gate), writes the 2-word descriptor relaxed, then
+    publishes the new tail with a release store; consumer (the broker
+    drain loop) loads tail acquire, reads the descriptor, bumps head
+    release and returns the credit.  FIFO + no-torn-descriptor + the
+    gate never over-admitting must hold under every exploration — the
+    broken variant publishes tail relaxed, letting the consumer
+    execute a descriptor whose words were never made visible."""
+    items, capacity = 3, 2
+
+    def producer(out: Dict[str, Any]):
+        produced = 0
+        for i in range(items):
+            got_credit = False
+            for _ in range(6):  # bounded credit-gate spin
+                c = yield ("load", "credits", RLX)
+                if c <= 0:
+                    continue
+                ok = yield ("cas", "credits", c, c - 1, ACQ_REL)
+                if ok:
+                    got_credit = True
+                    break
+            if not got_credit:
+                break
+            ok_slot = False
+            for _ in range(6):  # bounded ring-full spin
+                h = yield ("load", "headc", ACQ)
+                if i - h < capacity:
+                    ok_slot = True
+                    break
+            if not ok_slot:
+                # Abort: the gate credit goes back (the spec's abort
+                # path — a taken credit never strands).
+                yield ("rmw", "credits", 1, REL)
+                break
+            s = i % capacity
+            yield ("store", f"desc_a{s}", 200 + i, RLX)
+            yield ("store", f"desc_b{s}", 200 + i, RLX)
+            if broken == "relaxed-tail":
+                yield ("store", "tail", i + 1, RLX)
+            else:
+                yield ("store", "tail", i + 1, REL)
+            produced += 1
+        out["produced"] = produced
+
+    def consumer(out: Dict[str, Any]):
+        done = []
+        for i in range(items):
+            ready = False
+            for _ in range(6):  # bounded not-yet-published spin
+                t = yield ("load", "tail", ACQ)
+                if t > i:
+                    ready = True
+                    break
+            if not ready:
+                break
+            s = i % capacity
+            a = yield ("load", f"desc_a{s}", RLX)
+            b = yield ("load", f"desc_b{s}", RLX)
+            done.append((i, a, b))
+            yield ("store", "headc", i + 1, REL)
+            yield ("rmw", "credits", 1, REL)
+        out["done"] = done
+
+    def check(ctx: WmmContext, out: Dict[str, Any],
+              final: Dict[str, int]) -> None:
+        done = out.get("done", ())
+        for pos, (i, a, b) in enumerate(done):
+            if i != pos:
+                ctx.report(
+                    "wmm-ring-fifo",
+                    f"exec_ring: descriptor {i} consumed at position "
+                    f"{pos} — FIFO order broken")
+            want = 200 + i
+            if a != want or b != want:
+                ctx.report(
+                    "wmm-ring-fifo",
+                    f"exec_ring: consumer EXECUTED descriptor {i} "
+                    f"with words ({a},{b}) != ({want},{want}) — "
+                    f"unpublished/torn descriptor crossed the ring")
+        inflight = out.get("produced", 0) - len(done)
+        if final["credits"] + inflight != capacity:
+            ctx.report(
+                "wmm-ring-fifo",
+                f"exec_ring: credit gate leaked — {final['credits']} "
+                f"credits + {inflight} in flight != capacity "
+                f"{capacity}")
+
+    init = {"tail": 0, "headc": 0, "credits": capacity}
+    for s in range(capacity):
+        init.update({f"desc_a{s}": 0, f"desc_b{s}": 0})
+    return Litmus(
+        "exec_ring",
+        "PLANNED interposer-only SPSC execute ring + credit gate "
+        "(ROADMAP item 2, pre-verified)",
+        "exec-ring", init, (producer, consumer), check,
+        ("wmm-ring-fifo", "wmm-no-torn-payload"))
+
+
+FACTORIES: Tuple[Callable[..., Litmus], ...] = (
+    make_trace_ring, make_ledger_cas, make_rate_lease,
+    make_credit_bank, make_degraded_quota, make_exec_ring)
+
+LITMUS: Tuple[Litmus, ...] = tuple(f() for f in FACTORIES)
+
+
+def get(name: str) -> Litmus:
+    for lt in LITMUS:
+        if lt.name == name:
+            return lt
+    raise KeyError(f"unknown litmus {name!r} "
+                   f"(have: {[x.name for x in LITMUS]})")

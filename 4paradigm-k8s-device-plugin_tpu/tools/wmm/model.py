@@ -1,0 +1,414 @@
+"""vtpu-wmm operational weak-memory engine.
+
+A small C11-ish memory model explored exhaustively: the machinery that
+lets the litmus programs in ``litmus.py`` exhibit the reorderings a
+weakly-ordered CPU (arm64) is allowed under the orders the code
+actually wrote — not the orders the author hoped for.
+
+The model is the classic *view-based* operational semantics (the
+promise-free core of Kang et al.'s "A Promising Semantics for
+Relaxed-Memory Concurrency", POPL'17 — also the shape tools like
+herd7's operational companions use):
+
+  - memory is, per location, an append-only list of **messages**
+    ``(ts, value, view)`` — every store ever made, never just "the"
+    current value;
+  - each thread carries a **current view** (per-location timestamp
+    floor): a load may read ANY message at or above the floor, which
+    is exactly how a stale cache line / store-buffer read manifests;
+  - release stores attach the writer's whole view to the message;
+    acquire loads join the message's view into the reader's — the
+    message-passing guarantee.  Relaxed accesses move only the one
+    location's floor; the stale-payload-behind-a-fresh-flag bug falls
+    straight out;
+  - release fences snapshot the thread view into the view attached to
+    LATER relaxed stores; acquire fences fold the views of earlier
+    relaxed reads into the thread view.  This models the Linux-style
+    seqlock discipline vtpu_core.cc uses (fence; relaxed payload;
+    fence; release publish) faithfully: drop a fence in the litmus and
+    the torn/stale read becomes reachable;
+  - RMWs read the NEWEST message and append adjacently (atomicity),
+    carrying the read message's view forward (C11 release sequences);
+  - **plain** (non-atomic) accesses are relaxed accesses that
+    additionally report a data race whenever the access is
+    nondeterministic — a plain load that could read more than one
+    message, or a plain store while an unobserved concurrent write
+    exists, is exactly a C11 data race (undefined behavior), so the
+    engine flags it instead of picking a value and hoping.
+
+Approximations (kept one-sided — the model may miss exotic behaviors,
+it does not invent impossible ones): stores append at the end of a
+location's history (no interleaved timestamps, which hides some 2+2W
+shapes irrelevant to our single-writer/CAS protocols), and there is a
+single global SC order for ``sc`` accesses.
+
+Exploration is a deterministic DFS over three kinds of decisions —
+which thread steps, which readable message a load observes, and
+explicit program ``choice`` points (crash injection) — with a
+CHESS-style preemption bound on the scheduling decisions only
+(message and choice alternatives are always fully explored).  Same
+program + same budgets => same executions, bit for bit; CI floor-gates
+the explored count like the mc job does.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Generator, List, Optional, Tuple
+
+# Memory orders.  ``PLAIN`` is a non-atomic access (race-checked);
+# everything else maps onto the C11 order of the same name.
+PLAIN = "plain"
+RLX = "rlx"
+ACQ = "acq"
+REL = "rel"
+ACQ_REL = "acq_rel"
+SC = "sc"
+
+_ACQ_ORDERS = (ACQ, ACQ_REL, SC)
+_REL_ORDERS = (REL, ACQ_REL, SC)
+
+DEFAULT_MAX_EXECUTIONS = 4000
+DEFAULT_PREEMPTION_BOUND = 2
+DEFAULT_MAX_STEPS = 2000
+
+
+def budget_env(name: str, default: int) -> int:
+    """Budget knob with a VTPU_WMM_* env override (docs/FLAGS.md)."""
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class WmmContext:
+    """Violation sink shared by the engine, the litmus ``check``
+    functions and the invariant registry: everything lands in a named
+    bucket matching one ``tools/mc/invariants.py`` wmm row, and
+    ``run_checks("wmm", "litmus", ctx)`` drains the buckets."""
+
+    def __init__(self) -> None:
+        self.buckets: Dict[str, List[str]] = {}
+
+    def report(self, row: str, msg: str) -> None:
+        self.buckets.setdefault(row, []).append(msg)
+
+    def take(self, row: str) -> List[str]:
+        return self.buckets.pop(row, [])
+
+    def pending(self) -> int:
+        return sum(len(v) for v in self.buckets.values())
+
+
+@dataclass
+class Msg:
+    ts: int
+    val: int
+    view: Dict[str, int]
+
+
+def _join(dst: Dict[str, int], src: Dict[str, int]) -> None:
+    for k, v in src.items():
+        if v > dst.get(k, 0):
+            dst[k] = v
+
+
+class _Thread:
+    def __init__(self, tid: int, gen: Generator) -> None:
+        self.tid = tid
+        self.gen = gen
+        self.cur: Dict[str, int] = {}
+        self.acq: Dict[str, int] = {}
+        self.rel: Dict[str, int] = {}
+        self.pending: Optional[Tuple] = None
+        self.done = False
+
+    def advance(self, result: Any) -> None:
+        """Feed the last op's result in; fetch the next op."""
+        try:
+            self.pending = self.gen.send(result)
+        except StopIteration:
+            self.pending = None
+            self.done = True
+
+
+@dataclass
+class _Node:
+    """One decision point along the current execution."""
+    kind: str                  # "sched" | "msg" | "choice"
+    alts: List[int]
+    chosen: int
+    prev: Optional[int] = None   # sched: thread that ran the last slice
+    used_before: int = 0         # sched: preemptions consumed before here
+    tried: set = field(default_factory=set)
+
+    def cost(self, alt: int) -> int:
+        if self.kind != "sched":
+            return 0
+        return 1 if (self.prev is not None and self.prev in self.alts
+                     and alt != self.prev) else 0
+
+
+class ReplayDivergence(RuntimeError):
+    pass
+
+
+@dataclass
+class LitmusStats:
+    name: str = ""
+    executions: int = 0
+    decisions: int = 0
+    truncated: int = 0
+    violations: List[str] = field(default_factory=list)
+    # decision script that produced the first violation
+    witness: Optional[List[int]] = None
+
+
+class Explorer:
+    """Exhaustive DFS over one litmus program's decision tree."""
+
+    def __init__(self, litmus: "Any", *,
+                 max_executions: Optional[int] = None,
+                 preemption_bound: Optional[int] = None,
+                 max_steps: Optional[int] = None) -> None:
+        self.litmus = litmus
+        self.max_executions = (
+            max_executions if max_executions is not None
+            else budget_env("VTPU_WMM_MAX_EXECUTIONS",
+                            DEFAULT_MAX_EXECUTIONS))
+        self.preemption_bound = (
+            preemption_bound if preemption_bound is not None
+            else budget_env("VTPU_WMM_PREEMPTIONS",
+                            DEFAULT_PREEMPTION_BOUND))
+        self.max_steps = (max_steps if max_steps is not None
+                          else budget_env("VTPU_WMM_MAX_STEPS",
+                                          DEFAULT_MAX_STEPS))
+        self.stats = LitmusStats(name=litmus.name)
+
+    # -- one execution -----------------------------------------------------
+
+    def _run_once(self, script: List[int], nodes: List[_Node],
+                  ctx: WmmContext) -> None:
+        mem: Dict[str, List[Msg]] = {
+            loc: [Msg(0, val, {})]
+            for loc, val in self.litmus.init.items()}
+        out: Dict[str, Any] = {}
+        threads = [_Thread(i, fn(out))
+                   for i, fn in enumerate(self.litmus.threads)]
+        for t in threads:
+            t.advance(None)
+
+        depth = 0
+
+        def choose(kind: str, alts: List[int],
+                   prev: Optional[int] = None) -> int:
+            nonlocal depth
+            self.stats.decisions += 1
+            if depth < len(nodes):
+                node = nodes[depth]
+                if node.chosen not in alts:
+                    raise ReplayDivergence(
+                        f"{self.litmus.name}: decision {depth} scripted "
+                        f"{node.chosen}, alternatives now {alts}")
+                node.alts = list(alts)
+                depth += 1
+                return node.chosen
+            # Past the script: default policy, recorded as a new node.
+            parent = None
+            for n in reversed(nodes):
+                if n.kind == "sched":
+                    parent = n
+                    break
+            if kind == "sched":
+                used = (parent.used_before + parent.cost(parent.chosen)
+                        if parent else 0)
+                pick = prev if (prev is not None and prev in alts) \
+                    else alts[0]
+                node = _Node(kind, list(alts), pick, prev=prev,
+                             used_before=used)
+            else:
+                # Loads default to the NEWEST readable message (the
+                # SC-like execution comes first; stale reads are the
+                # backtracked alternatives).
+                pick = alts[-1] if kind == "msg" else alts[0]
+                node = _Node(kind, list(alts), pick)
+            node.tried.add(pick)
+            nodes.append(node)
+            depth += 1
+            return pick
+
+        def enabled(t: _Thread) -> bool:
+            if t.done or t.pending is None:
+                return False
+            op = t.pending
+            if op[0] == "lock":
+                return mem[op[1]][-1].val == 0
+            return True
+
+        last_tid: Optional[int] = None
+        steps = 0
+        while True:
+            live = [t for t in threads if enabled(t)]
+            if not live:
+                break
+            steps += 1
+            if steps > self.max_steps:
+                self.stats.truncated += 1
+                break
+            tid = choose("sched", [t.tid for t in live], prev=last_tid)
+            last_tid = tid
+            th = threads[tid]
+            result = self._perform(th, th.pending, mem, choose, ctx)
+            th.advance(result)
+
+        final = {loc: msgs[-1].val for loc, msgs in mem.items()}
+        self.litmus.check(ctx, out, final)
+
+    def _perform(self, th: _Thread, op: Tuple, mem: Dict[str, List[Msg]],
+                 choose: Callable, ctx: WmmContext) -> Any:
+        kind = op[0]
+        if kind == "load":
+            _, loc, order = op
+            floor = th.cur.get(loc, 0)
+            readable = [m for m in mem[loc] if m.ts >= floor]
+            if order == PLAIN and len(readable) > 1:
+                ctx.report(
+                    "wmm-data-race",
+                    f"{self.litmus.name}: plain load of `{loc}` by "
+                    f"thread {th.tid} races a concurrent write "
+                    f"({len(readable)} values observable — C11 "
+                    f"undefined behavior)")
+            if len(readable) > 1:
+                idx = choose("msg", list(range(len(readable))))
+            else:
+                idx = 0
+            m = readable[idx]
+            th.cur[loc] = max(floor, m.ts)
+            if order in _ACQ_ORDERS:
+                _join(th.cur, m.view)
+            else:
+                _join(th.acq, m.view)
+                if m.ts > th.acq.get(loc, 0):
+                    th.acq[loc] = m.ts
+            return m.val
+        if kind == "store":
+            _, loc, val, order = op
+            msgs = mem[loc]
+            if order == PLAIN and msgs[-1].ts > th.cur.get(loc, 0):
+                ctx.report(
+                    "wmm-data-race",
+                    f"{self.litmus.name}: plain store to `{loc}` by "
+                    f"thread {th.tid} races an unobserved concurrent "
+                    f"write (C11 undefined behavior)")
+            ts = msgs[-1].ts + 1
+            base = th.cur if order in _REL_ORDERS else th.rel
+            view = dict(base)
+            view[loc] = ts
+            msgs.append(Msg(ts, val, view))
+            th.cur[loc] = ts
+            return None
+        if kind in ("rmw", "cas"):
+            loc = op[1]
+            order = op[-1]
+            m = mem[loc][-1]
+            success = True
+            if kind == "cas" and m.val != op[2]:
+                success = False
+            if order in _ACQ_ORDERS or (not success and order != PLAIN):
+                _join(th.cur, m.view)
+            th.cur[loc] = max(th.cur.get(loc, 0), m.ts)
+            if not success:
+                return False
+            newval = m.val + op[2] if kind == "rmw" else op[3]
+            ts = m.ts + 1
+            base = th.cur if order in _REL_ORDERS else th.rel
+            view = dict(base)
+            _join(view, m.view)  # release sequence: carry forward
+            view[loc] = ts
+            mem[loc].append(Msg(ts, newval, view))
+            th.cur[loc] = ts
+            return m.val if kind == "rmw" else True
+        if kind == "fence":
+            order = op[1]
+            if order in _ACQ_ORDERS:
+                _join(th.cur, th.acq)
+            if order in _REL_ORDERS:
+                th.rel = dict(th.cur)
+            return None
+        if kind == "lock":
+            loc = op[1]
+            m = mem[loc][-1]
+            _join(th.cur, m.view)  # acquire
+            ts = m.ts + 1
+            view = dict(th.cur)
+            _join(view, m.view)
+            view[loc] = ts
+            mem[loc].append(Msg(ts, 1, view))
+            th.cur[loc] = ts
+            return None
+        if kind == "unlock":
+            loc = op[1]
+            ts = mem[loc][-1].ts + 1
+            view = dict(th.cur)  # release
+            view[loc] = ts
+            mem[loc].append(Msg(ts, 0, view))
+            th.cur[loc] = ts
+            return None
+        if kind == "choice":
+            return choose("choice", list(range(op[1])))
+        raise ValueError(f"unknown wmm op {op!r}")
+
+    # -- DFS over executions -----------------------------------------------
+
+    def explore(self, ctx: Optional[WmmContext] = None) -> LitmusStats:
+        ctx = ctx if ctx is not None else WmmContext()
+        nodes: List[_Node] = []
+        script: List[int] = []
+        while True:
+            before = ctx.pending()
+            try:
+                self._run_once(script, nodes, ctx)
+            except ReplayDivergence as e:
+                self.stats.violations.append(f"[determinism] {e}")
+                self.stats.witness = list(script)
+                break
+            self.stats.executions += 1
+            if ctx.pending() > before and self.stats.witness is None:
+                self.stats.witness = [n.chosen for n in nodes]
+            if self.stats.executions >= self.max_executions:
+                break
+            # Backtrack: deepest node with an unexplored,
+            # budget-feasible alternative.
+            nxt = None
+            while nodes:
+                node = nodes[-1]
+                feasible = [
+                    a for a in node.alts
+                    if a not in node.tried
+                    and node.used_before + node.cost(a)
+                    <= self.preemption_bound]
+                if feasible:
+                    a = feasible[0]
+                    node.tried.add(a)
+                    new = _Node(node.kind, list(node.alts), a,
+                                prev=node.prev,
+                                used_before=node.used_before)
+                    new.tried = node.tried  # shared explored set
+                    nodes[-1] = new
+                    nxt = [n.chosen for n in nodes]
+                    break
+                nodes.pop()
+            if nxt is None:
+                break  # decision space exhausted
+            script = nxt
+            nodes = nodes[:len(script)]
+        from ..mc import invariants as inv_registry
+        self.stats.violations.extend(
+            inv_registry.run_checks("wmm", "litmus", ctx))
+        return self.stats
+
+
+def explore_litmus(litmus: Any, **kw: Any) -> LitmusStats:
+    return Explorer(litmus, **kw).explore()

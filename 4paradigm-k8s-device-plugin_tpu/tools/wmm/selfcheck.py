@@ -1,0 +1,101 @@
+"""vtpu-wmm seeded-violation selfcheck.
+
+A weak-memory simulator that reports "0 violations" is only
+trustworthy if a DELIBERATELY weakened protocol makes it scream.  Each
+seed below is a litmus variant with one real bug class injected —
+release downgraded to relaxed, the seqlock reader's re-check removed,
+a non-atomic read-modify-write on shared ledger state, a crash-atomic
+field torn across two words, the planned exec ring publishing its
+tail relaxed — and the matching invariant row must fire under the
+exploration budget.  ``python -m vtpu.tools.wmm --selfcheck`` runs the
+matrix (CI does); tests/test_wmm.py drives the seeds individually.
+
+The weakened variants live in the litmus factories' ``broken=``
+parameter, never in any checked source: the protocols stay correct,
+and a seed that stops firing means the SIMULATOR regressed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from . import litmus as lt
+from . import model
+
+
+@dataclass(frozen=True)
+class Seed:
+    name: str
+    litmus: lt.Litmus
+    invariant: str   # registry row expected to fire
+    bug: str         # one-line description of the injected bug
+
+
+SEEDS: Tuple[Seed, ...] = (
+    Seed("seqlock-release-downgraded",
+         lt.make_trace_ring(broken="relaxed-publish"),
+         "wmm-no-torn-payload",
+         "trace-ring publish all-relaxed (no fences, no release): the "
+         "reader accepts a slot whose payload was never made visible"),
+    Seed("seqlock-missing-recheck",
+         lt.make_trace_ring(broken="missing-recheck"),
+         "wmm-no-torn-payload",
+         "reader skips the seq re-check after the copy: a wrap "
+         "mid-copy hands back a half-old half-new payload"),
+    Seed("ledger-nonatomic-rmw",
+         lt.make_ledger_cas(broken="plain-rmw"),
+         "wmm-data-race",
+         "charge path does plain load+store instead of CAS: a data "
+         "race, and lost updates break ledger conservation"),
+    Seed("ledger-double-free",
+         lt.make_ledger_cas(broken="double-free"),
+         "wmm-ledger-conserved",
+         "release path runs twice: the same bytes are returned to the "
+         "ledger twice (atomically — no race, pure conservation "
+         "break)"),
+    Seed("lease-plain-burn",
+         lt.make_rate_lease(broken="plain-burn"),
+         "wmm-lease-bounded",
+         "lease burn is a plain read-modify-write racing the revoke "
+         "swap: burn + refund exceeds the one debited quantum"),
+    Seed("credit-uncapped-plain-mint",
+         lt.make_credit_bank(broken="plain-mint"),
+         "wmm-credit-bounds",
+         "mint writes the bank non-atomically and uncapped: credit "
+         "minted from nothing / balance past the cap"),
+    Seed("crash-atomic-torn-two-word",
+         lt.make_degraded_quota(broken="two-word"),
+         "wmm-crash-atomic",
+         "quota limit split across two words: the degraded client "
+         "combines halves of different epochs into a limit nobody "
+         "granted"),
+    Seed("exec-ring-relaxed-tail",
+         lt.make_exec_ring(broken="relaxed-tail"),
+         "wmm-ring-fifo",
+         "planned exec ring publishes tail relaxed: the consumer "
+         "executes a descriptor whose words were never published"),
+)
+
+
+def run_seed(seed: Seed,
+             max_executions: Optional[int] = None,
+             preemption_bound: Optional[int] = None
+             ) -> Tuple[bool, List[str]]:
+    """Explore one weakened litmus; ``caught`` is True when the
+    expected invariant row fired."""
+    stats = model.explore_litmus(
+        seed.litmus, max_executions=max_executions,
+        preemption_bound=preemption_bound)
+    tag = f"[{seed.invariant}]"
+    return any(tag in v for v in stats.violations), stats.violations
+
+
+def run_all(max_executions: Optional[int] = None
+            ) -> List[Tuple[Seed, bool, int]]:
+    results: List[Tuple[Seed, bool, int]] = []
+    for seed in SEEDS:
+        caught, violations = run_seed(seed,
+                                      max_executions=max_executions)
+        results.append((seed, caught, len(violations)))
+    return results

@@ -237,6 +237,12 @@ ENV_FLAGS = {
     "VTPU_TRACE_RING_KB": ("trace", True),
     "VTPU_SLOW_OP_FACTOR": ("trace", True),
     "VTPU_LEASE_SIDECAR": ("trace", True),
+    # vtpu-wmm (docs/ANALYSIS.md "Weak memory model"): exploration
+    # budgets of the weak-memory litmus engine.  Not operator-facing —
+    # CI and developers tune them per run.
+    "VTPU_WMM_MAX_EXECUTIONS": ("tools", False),
+    "VTPU_WMM_PREEMPTIONS": ("tools", False),
+    "VTPU_WMM_MAX_STEPS": ("tools", False),
     # Tools / bench.
     "VTPU_METRICS_PORT": ("tools", True),
     "VTPU_BENCH_CHAIN": ("bench", False),

@@ -200,7 +200,12 @@ vtpu_trace_ring* vtpu_trace_open(const char* path, uint32_t size_kb) {
     memset(shm, 0, sizeof(TraceShm));
     shm->capacity = cap;
     shm->version = VTPU_TRACE_VERSION;
-    __sync_synchronize();
+    /* Publication fence: release, not __sync_synchronize — the old
+     * implicit-seq_cst builtin predates C11 orders and says nothing
+     * about WHICH ordering the protocol needs (vtpu-wmm bans it).
+     * Release is the one actually required: the capacity/version
+     * stores must be visible before the magic that publishes them. */
+    __atomic_thread_fence(__ATOMIC_RELEASE);
     shm->magic = VTPU_TRACE_MAGIC;
   } else if (shm->version != VTPU_TRACE_VERSION ||
              shm->capacity == 0 ||
@@ -576,7 +581,8 @@ vtpu_region* vtpu_region_open_versioned(const char* path, int ndevices,
     }
     g->magic = VTPU_MAGIC;
     g->version = current_version;
-    __sync_synchronize();
+    /* Release fence (was __sync_synchronize; see trace_open note). */
+    __atomic_thread_fence(__ATOMIC_RELEASE);
     g->initialized = 1;
   } else if (g->version != current_version) {
     /* Version skew (daemon upgraded while pods run).  Fail-CLOSED with
@@ -610,7 +616,10 @@ vtpu_region* vtpu_region_open_versioned(const char* path, int ndevices,
           if (!any_active) g->dev[d].undebited_outstanding = 0;
         }
         g->version = current_version;
-        __sync_synchronize();
+        /* Release fence (was __sync_synchronize; see trace_open
+         * note).  The mutex release below already orders the stores
+         * for other lockers; the fence covers flock-only readers. */
+        __atomic_thread_fence(__ATOMIC_RELEASE);
         unlock_region(g);
       } else {
         flock(fd, LOCK_UN);

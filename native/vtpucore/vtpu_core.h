@@ -283,6 +283,80 @@ int vtpu_test_lock_region(vtpu_region* r);
  * Never called by product code paths. */
 void vtpu_test_set_proc_root(const char* root);
 
+/* ---- shared-memory protocol ground truth (vtpu-wmm) ---------------------
+ *
+ * The declared atomics discipline of every mmap'd shared-region field,
+ * machine-checked two ways (docs/ANALYSIS.md "Weak memory model"):
+ * statically by tools/analyze/atomics.py — every access must conform
+ * to its category below, plain reads/writes outside the discipline,
+ * implicit-seq_cst builtins (__sync_*), volatile, and undeclared
+ * orders are findings, and publish/consume pairings are proved in
+ * BOTH directions — and operationally by tools/wmm, whose litmus
+ * programs model these exact shapes under C11-ish reordering.
+ *
+ * Categories: `mutex` is the robust lock itself; `lock` fields are
+ * accessed only under it (or from `init-writers`, the flock-serialised
+ * creation paths, or `*_locked` helpers, which may only be CALLED with
+ * the lock held); `stable` fields are written during flock-serialised
+ * init only and readable plain afterwards; `crash-atomic` fields obey
+ * the lock discipline AND must be single naturally-aligned machine
+ * words, because the degraded-mode ledger (runtime/degraded.py) reads
+ * them while the broker may be dead mid-update — a torn quota word is
+ * a silent enforcement escape; `publish`/`consume` and `seqlock`
+ * declare the lock-free protocols with their exact memory orders.
+ *
+ * Mirrors: the ctypes structs in shim/core.py must agree field-for-
+ * field (name, offset, size) with the C structs here — drift is a
+ * silent cross-language memory corruption, so it is checked, not
+ * hoped.
+ *
+ *   structs: Region, DeviceState, ProcSlot, TraceShm, TraceSlot,
+ *            vtpu_trace_event
+ *   mutex: Region.mu
+ *   lock: Region.wc_mode, Region.dev, Region.proc, DeviceState.*,
+ *         ProcSlot.*
+ *   crash-atomic: DeviceState.limit_bytes, DeviceState.used_bytes
+ *   stable: Region.magic, Region.version, Region.initialized,
+ *           Region.ndevices, Region.pad0_, TraceShm.magic,
+ *           TraceShm.version, TraceShm.capacity, TraceShm.pad_,
+ *           TraceShm.slots
+ *   init-writers: vtpu_region_open_versioned, vtpu_trace_open
+ *   locked-suffix: _locked
+ *   publish: TraceShm.head acq_rel -> consume: acquire
+ *   seqlock trace-slot: seq=TraceSlot.seq
+ *       payload=TraceSlot.ev, vtpu_trace_event.*
+ *       helpers=ev_store(relaxed), ev_load(relaxed)
+ *       writer=vtpu_trace_emit reader=vtpu_trace_read
+ *   mirror: vtpu_device_stats == shim/core.py:DeviceStats
+ *   mirror: vtpu_proc_stats == shim/core.py:ProcStats
+ *   mirror: vtpu_trace_event == shim/core.py:TraceEvent
+ *   mirror-const: VTPU_MAX_DEVICES == utils/envspec.py:MAX_DEVICES_PER_NODE
+ *   mirror-const: VTPU_MAX_PROCS == shim/core.py:MAX_PROCS
+ *
+ * ---- PLANNED: interposer-only shm execute ring (ROADMAP item 2) ---------
+ *
+ * The steady-state data plane that takes the broker out of the execute
+ * path: one SPSC descriptor ring per (tenant process, chip) in the
+ * shared region, produced by the interposer, drained by the broker's
+ * completion loop; admission rides a credit gate so a dead/slow
+ * consumer back-pressures the producer instead of wedging it.  The
+ * protocol is DECLARED (and litmus-verified by tools/wmm's exec_ring
+ * program, including its seeded-broken selfcheck variant) before the
+ * structs exist, so the data-plane PR lands on pre-verified orders:
+ *
+ *   planned exec-ring: publish: ExecRing.tail release -> consume: acquire
+ *   planned exec-ring: publish: ExecRing.headc release -> consume: acquire
+ *   planned exec-ring: rmw: ExecRing.credits acq_rel
+ *   planned exec-ring: payload: ExecDesc.* relaxed
+ *
+ * Shape: ExecDesc { program id, arg blob offset/len, seq } written
+ * relaxed into slot tail%capacity, published by a release store of
+ * tail+1; the consumer loads tail acquire, executes, publishes headc
+ * release (slot reuse gate) and returns the credit with an acq_rel
+ * RMW.  FIFO, no-torn-descriptor and credit conservation are the
+ * wmm-ring-fifo invariant row.
+ */
+
 #ifdef __cplusplus
 }
 #endif

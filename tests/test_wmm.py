@@ -1,0 +1,374 @@
+"""vtpu-wmm tests (tools/wmm + tools/analyze/atomics.py,
+docs/ANALYSIS.md "Weak memory model").
+
+Four layers:
+
+  - engine sanity: the view-based operational model exhibits exactly
+    the C11 behaviors it should (message passing holds under
+    release/acquire, breaks under relaxed; plain races are flagged),
+    exploration is deterministic, and the explored space clears the
+    CI floor;
+  - the litmus suite: every REAL protocol shape explores its full
+    bounded space with zero invariant violations;
+  - seeded violations: every deliberately weakened protocol variant
+    (release downgraded, missing seqlock re-check, non-atomic ledger
+    RMW, torn two-word crash-atomic update, relaxed exec-ring tail —
+    including the PLANNED data-plane ring) is caught by its invariant
+    row;
+  - the atomics checker: clean on the real tree, and demonstrably
+    catches seeded grammar/order/pairing/shape violations and ctypes
+    struct-layout drift (the silent-corruption regression the mirror
+    check exists for).
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from vtpu.tools.analyze import atomics, read_text  # noqa: E402
+from vtpu.tools.mc import invariants  # noqa: E402
+from vtpu.tools.wmm import cli as wmm_cli  # noqa: E402
+from vtpu.tools.wmm import litmus as lt  # noqa: E402
+from vtpu.tools.wmm import model, selfcheck  # noqa: E402
+from vtpu.tools.wmm.litmus import Litmus  # noqa: E402
+from vtpu.tools.wmm.model import ACQ, REL, RLX, PLAIN  # noqa: E402
+
+SMALL = dict(max_executions=400)
+
+
+# ---------------------------------------------------------------------------
+# Engine sanity
+# ---------------------------------------------------------------------------
+
+def _mp_litmus(store_order, load_order):
+    """Classic message-passing shape: data then flag; reader must
+    never see the flag without the data when the orders synchronize."""
+    def writer(out):
+        yield ("store", "data", 1, RLX)
+        yield ("store", "flag", 1, store_order)
+
+    def reader(out):
+        f = yield ("load", "flag", load_order)
+        d = yield ("load", "data", RLX)
+        out["f"], out["d"] = f, d
+
+    def check(ctx, out, final):
+        if out.get("f") == 1 and out.get("d") == 0:
+            ctx.report("wmm-no-torn-payload",
+                       "stale data read behind a fresh flag")
+
+    return Litmus("mp", "", "test", {"data": 0, "flag": 0},
+                  (writer, reader), check, ("wmm-no-torn-payload",))
+
+
+def test_message_passing_holds_under_release_acquire():
+    stats = model.explore_litmus(_mp_litmus(REL, ACQ), **SMALL)
+    assert stats.violations == []
+    assert stats.executions > 1  # visibility choices were explored
+
+
+def test_message_passing_breaks_under_relaxed():
+    stats = model.explore_litmus(_mp_litmus(RLX, RLX), **SMALL)
+    assert any("wmm-no-torn-payload" in v for v in stats.violations)
+
+
+def test_plain_access_race_is_flagged():
+    def t0(out):
+        yield ("store", "x", 1, PLAIN)
+
+    def t1(out):
+        out["v"] = (yield ("load", "x", PLAIN))
+
+    racy = Litmus("racy", "", "test", {"x": 0}, (t0, t1),
+                  lambda ctx, out, final: None, ("wmm-data-race",))
+    stats = model.explore_litmus(racy, **SMALL)
+    assert any("wmm-data-race" in v for v in stats.violations)
+
+
+def test_exploration_is_deterministic():
+    a = model.explore_litmus(lt.make_trace_ring(), max_executions=600)
+    b = model.explore_litmus(lt.make_trace_ring(), max_executions=600)
+    assert (a.executions, a.decisions) == (b.executions, b.decisions)
+    assert a.violations == b.violations == []
+
+
+def test_explored_count_clears_ci_floor():
+    """The CI `wmm` job gates --min-executions 5000; prove the default
+    budgets actually clear it so the gate has meaning."""
+    total = 0
+    for item in lt.LITMUS:
+        total += model.explore_litmus(item).executions
+    assert total >= 5000, total
+
+
+# ---------------------------------------------------------------------------
+# Litmus suite + registry wiring
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("item", lt.LITMUS, ids=lambda x: x.name)
+def test_litmus_clean(item):
+    stats = model.explore_litmus(item)
+    assert stats.violations == [], stats.violations
+    assert stats.executions > 5  # the space actually branched
+
+
+def test_wmm_rows_are_registered():
+    rows = {inv.name for inv in invariants.for_engine("wmm", "litmus")}
+    assert len(rows) == 7
+    for item in lt.LITMUS:
+        assert set(item.rows) <= rows, (item.name, item.rows)
+    for seed in selfcheck.SEEDS:
+        assert seed.invariant in rows, seed.name
+
+
+def test_exec_ring_models_the_planned_spec():
+    """The planned interposer-only data plane (ROADMAP item 2) must be
+    litmus-covered ahead of the build — and its spec declared in the
+    vtpu_core.h grammar."""
+    assert lt.get("exec_ring").protocol == "exec-ring"
+    header = read_text(REPO_ROOT, atomics.HEADER)
+    gt, findings = atomics.parse_ground_truth(header)
+    assert findings == []
+    assert "exec-ring" in gt.planned
+    assert any("ExecRing.tail release" in d
+               for d in gt.planned["exec-ring"])
+
+
+# ---------------------------------------------------------------------------
+# Seeded weak-memory bugs (selfcheck)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", selfcheck.SEEDS, ids=lambda s: s.name)
+def test_seeded_weak_memory_bug_is_caught(seed):
+    caught, violations = selfcheck.run_seed(seed)
+    assert caught, (f"seed {seed.name} NOT caught "
+                    f"({len(violations)} violations: {violations[:3]})")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_smoke_and_list():
+    assert wmm_cli.main(["--smoke"]) == 0
+    assert wmm_cli.main(["--list"]) == 0
+
+
+def test_cli_floor_gate_fails_loudly():
+    assert wmm_cli.main(["--smoke", "--min-executions",
+                         str(10**9)]) == 1
+
+
+def test_vtpu_smi_wmm_wiring():
+    from vtpu.tools.vtpu_smi import main as smi_main
+    assert smi_main(["wmm", "--smoke"]) == 0
+
+
+def test_cli_selfcheck_small_budget():
+    assert wmm_cli.main(["--selfcheck"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Atomics checker: real tree + seeded violations
+# ---------------------------------------------------------------------------
+
+CC = "native/vtpucore/vtpu_core.cc"
+PRELOAD = "native/vtpu_preload/preload.cc"
+
+
+@pytest.fixture(scope="module")
+def real_tree():
+    native = {rel: read_text(REPO_ROOT, rel)
+              for rel in atomics.NATIVE_ANALYZED}
+    shim = read_text(REPO_ROOT, atomics.SHIM)
+    consts = {atomics.SHIM: shim,
+              atomics.ENVSPEC: read_text(REPO_ROOT, atomics.ENVSPEC)}
+    assert all(native.values()) and shim and consts[atomics.ENVSPEC]
+    return native, shim, consts
+
+
+def _check(native, shim, consts):
+    return atomics.check_sources(native, shim, consts)
+
+
+def test_atomics_clean_on_real_tree(real_tree):
+    native, shim, consts = real_tree
+    assert _check(native, shim, consts) == []
+
+
+def _mutated(native, old, new):
+    assert old in native[CC], old
+    out = dict(native)
+    out[CC] = native[CC].replace(old, new)
+    return out
+
+
+def test_atomics_catches_sync_builtin(real_tree):
+    native, shim, consts = real_tree
+    n = _mutated(native,
+                 "__atomic_thread_fence(__ATOMIC_RELEASE);\n    "
+                 "g->initialized = 1;",
+                 "__sync_synchronize();\n    g->initialized = 1;")
+    f = _check(n, shim, consts)
+    assert any("__sync_" in x.message for x in f), f
+
+
+def test_atomics_catches_downgraded_publish(real_tree):
+    """release downgraded to relaxed on the seqlock publish — the
+    exact bug class the wmm litmus proves torn-readable."""
+    native, shim, consts = real_tree
+    n = _mutated(native,
+                 "__atomic_store_n(&slot->seq, idx + 1, "
+                 "__ATOMIC_RELEASE);",
+                 "__atomic_store_n(&slot->seq, idx + 1, "
+                 "__ATOMIC_RELAXED);")
+    f = _check(n, shim, consts)
+    assert any("seqlock trace-slot" in x.message
+               and "vtpu_trace_emit" in x.message for x in f), f
+
+
+def test_atomics_catches_missing_reader_recheck_fence(real_tree):
+    native, shim, consts = real_tree
+    n = _mutated(native,
+                 "      ev_load(&ev, &slot->ev);\n"
+                 "      __atomic_thread_fence(__ATOMIC_ACQUIRE);",
+                 "      ev_load(&ev, &slot->ev);")
+    f = _check(n, shim, consts)
+    assert any("vtpu_trace_read" in x.message for x in f), f
+
+
+def test_atomics_catches_plain_protocol_read(real_tree):
+    native, shim, consts = real_tree
+    n = _mutated(native,
+                 "uint64_t head = __atomic_load_n(&s->head, "
+                 "__ATOMIC_ACQUIRE);",
+                 "uint64_t head = s->head;")
+    f = _check(n, shim, consts)
+    assert any("plain access" in x.message and "`head`" in x.message
+               for x in f), f
+
+
+def test_atomics_catches_unlocked_ledger_access(real_tree):
+    """The 'non-atomic ledger read' class: a new code path reading
+    region accounting without the robust mutex."""
+    native, shim, consts = real_tree
+    n = dict(native)
+    n[CC] = native[CC] + (
+        "\nuint64_t vtpu_rogue_peek(vtpu_region* r, int dev) {\n"
+        "  return r->shm->dev[dev].used_bytes;\n}\n")
+    f = _check(n, shim, consts)
+    assert any("vtpu_rogue_peek" in x.message
+               and "used_bytes" in x.message for x in f), f
+
+
+def test_atomics_catches_undeclared_seq_cst(real_tree):
+    native, shim, consts = real_tree
+    n = _mutated(native,
+                 "__atomic_fetch_add(&s->head, 1, __ATOMIC_ACQ_REL)",
+                 "__atomic_fetch_add(&s->head, 1, __ATOMIC_SEQ_CST)")
+    f = _check(n, shim, consts)
+    assert any("SEQ_CST" in x.message for x in f), f
+    # and the pairing direction: the declared publish lost its
+    # conforming store site
+    assert any("no conforming publish site" in x.message for x in f), f
+
+
+def test_atomics_catches_undeclared_field(real_tree):
+    """Grammar exhaustiveness: a new shared field with no declared
+    access category fails."""
+    native, shim, consts = real_tree
+    n = _mutated(native,
+                 "  uint64_t head; /* total events ever written */",
+                 "  uint64_t head; /* total events ever written */\n"
+                 "  uint64_t sneaky_cursor;")
+    f = _check(n, shim, consts)
+    assert any("sneaky_cursor" in x.message
+               and "NO declared access category" in x.message
+               for x in f), f
+
+
+def test_atomics_catches_locked_helper_called_unlocked(real_tree):
+    native, shim, consts = real_tree
+    n = dict(native)
+    n[CC] = native[CC] + (
+        "\nint vtpu_rogue_sweep(vtpu_region* r) {\n"
+        "  return sweep_locked(r->shm, 0);\n}\n")
+    f = _check(n, shim, consts)
+    assert any("sweep_locked" in x.message
+               and "without holding" in x.message for x in f), f
+
+
+def test_atomics_catches_implicit_std_atomic_order(real_tree):
+    native, shim, consts = real_tree
+    old = "dlopen_fn fn = next.load(std::memory_order_acquire);"
+    assert old in native[PRELOAD]
+    n = dict(native)
+    n[PRELOAD] = native[PRELOAD].replace(old,
+                                         "dlopen_fn fn = next.load();")
+    f = _check(n, shim, consts)
+    assert any("std::memory_order" in x.message for x in f), f
+
+
+# ---------------------------------------------------------------------------
+# Struct-layout drift (the silent-runtime-corruption regression)
+# ---------------------------------------------------------------------------
+
+def test_layout_drift_field_swap_caught(real_tree):
+    native, shim, consts = real_tree
+    swapped = shim.replace(
+        '("used_bytes", ctypes.c_uint64),\n'
+        '        ("peak_bytes", ctypes.c_uint64),',
+        '("peak_bytes", ctypes.c_uint64),\n'
+        '        ("used_bytes", ctypes.c_uint64),')
+    assert swapped != shim
+    f = _check(native, swapped, {**consts, atomics.SHIM: swapped})
+    assert any("LAYOUT DRIFT" in x.message for x in f), f
+
+
+def test_layout_drift_offset_size_caught(real_tree):
+    """Seeded offset/size mismatch between vtpu_core.h and the ctypes
+    mirror — today this drift would be a silent runtime corruption;
+    now it is a finding naming the exact field and offsets."""
+    native, shim, consts = real_tree
+    widened = shim.replace('("core_limit_pct", ctypes.c_int32),',
+                           '("core_limit_pct", ctypes.c_int64),')
+    assert widened != shim
+    f = _check(native, widened, {**consts, atomics.SHIM: widened})
+    drift = [x for x in f if "LAYOUT DRIFT" in x.message]
+    assert any("core_limit_pct" in x.message and "offset 24" in x.message
+               for x in drift), drift
+
+
+def test_layout_drift_const_mirror_caught(real_tree):
+    native, shim, consts = real_tree
+    shrunk = shim.replace("MAX_PROCS = 64", "MAX_PROCS = 32")
+    assert shrunk != shim
+    f = _check(native, shrunk, {**consts, atomics.SHIM: shrunk})
+    assert any("VTPU_MAX_PROCS" in x.message for x in f), f
+
+
+def test_layout_c_side_matches_ctypes_today(real_tree):
+    """Belt and suspenders: the independently-computed C layout equals
+    the live ctypes layout for every mirrored struct."""
+    native, shim, consts = real_tree
+    stripped = {r: atomics.strip_comments(s) for r, s in native.items()}
+    structs, _defines = atomics.parse_c_structs(stripped)
+    py_structs, _c = atomics.parse_ctypes_structs(shim, consts)
+    for cname, _pyfile, pyclass in (
+            ("vtpu_device_stats", "", "DeviceStats"),
+            ("vtpu_proc_stats", "", "ProcStats"),
+            ("vtpu_trace_event", "", "TraceEvent")):
+        clay = atomics.c_layout(cname, structs)
+        plan = atomics.ctypes_layout(py_structs[pyclass])
+        assert clay == plan, (cname, clay, plan)
+
+
+def test_analyze_run_all_includes_atomics_and_is_clean():
+    from vtpu.tools.analyze import run_all
+    findings = run_all(REPO_ROOT)
+    assert findings == [], [f.render() for f in findings]
