@@ -27,6 +27,7 @@ import numpy as np
 
 from ..utils import envspec
 from ..utils.dtypes import np_dtype as _np_dtype
+from . import fastlane as fastlane_mod
 from . import faults
 from . import protocol as P
 from . import trace as tracing
@@ -192,6 +193,37 @@ class RuntimeClient:
         except ValueError:
             self._batch_max = 64
         self._pending_batch: List[Dict[str, Any]] = []
+        # -- vtpu-fastlane (docs/PERF.md) --
+        # VTPU_FASTLANE=1 opts this tenant into the interposer-only
+        # data plane: HELLO negotiates a shm lane (SPSC execute ring +
+        # tensor arenas, fds passed once over the UDS), unchained
+        # executes and tensor payloads never cross the broker socket,
+        # and rate enforcement burns shared-region atomics directly.
+        # Chained work, park/probation, multi-container sharing and a
+        # closed gate all fall back to the brokered path transparently.
+        self._fl_want = fastlane_mod.client_wants()
+        self._lane: Optional[fastlane_mod.ClientLane] = None
+        # route cache: (exe, args, outs) -> {"id", "cost", "metas"}
+        # ("prime" = program not yet executed broker-side; one
+        # brokered step fills its static out metadata, then re-bind).
+        self._routes: Dict[tuple, Any] = {}
+        # steady-loop memo of the last route (list-equality compare
+        # beats tuple-hash construction per step) + a gate-check
+        # decimator (the drainer is the authoritative park gate; the
+        # client's check only needs sub-100-step latency).
+        self._fl_last: Optional[tuple] = None
+        self._fl_gate_in = 0
+        # Pipelined logical-reply tokens, in send order, ONLY while a
+        # lane is active: ("w",) = one wire reply, ("r", seq, route)
+        # (+ resolved result) = one ring completion.  recv_reply
+        # serves them in order so mixed ring/socket pipelines keep the
+        # FIFO reply contract.
+        self._pending: "collections.deque[tuple]" = collections.deque()
+        self._wire_buf: "collections.deque[dict]" = collections.deque()
+        # token-class counters (the deque is never scanned on the hot
+        # path): wire / ring tokens currently in _pending.
+        self._tok_wire = 0
+        self._tok_ring = 0
         # Logical replies already read off the wire (batch replies
         # explode into per-item results; sync requests absorb whatever
         # is outstanding) — recv_reply serves these, in wire order,
@@ -290,6 +322,8 @@ class RuntimeClient:
                     hello[field] = float(raw)
                 except ValueError:
                     pass
+        if self._fl_want:
+            hello["fastlane"] = True
         self._hello = hello
         # -- vtpu-chaos hardening (docs/CHAOS.md) --
         # Per-RPC deadline on EVERY socket op: no recv or connect in
@@ -402,6 +436,41 @@ class RuntimeClient:
         self._wire_out = 0
         self.lease_us = 0.0
         self.lease_exp = 0.0
+        # The old lane (and any un-consumed ring completions) died
+        # with the old epoch/socket exactly like in-flight wire
+        # replies; a fresh lane arrives in THIS reply when negotiated.
+        self._pending.clear()
+        self._wire_buf.clear()
+        self._tok_wire = 0
+        self._tok_ring = 0
+        self._routes.clear()
+        if self._lane is not None:
+            self._lane.close()
+            self._lane = None
+        fl = resp.get("fastlane")
+        if fl and self._fl_want:
+            fds = None
+            if fl.get("fds") and hasattr(socket, "recv_fds"):
+                # The arena fds ride a one-byte SCM_RIGHTS message
+                # right behind the HELLO reply (sent exactly once).
+                try:
+                    _m, fds, _fl, _ad = socket.recv_fds(self.sock, 1, 2)
+                except OSError:
+                    fds = None
+            try:
+                self._lane = fastlane_mod.ClientLane(fl, fds)
+            except (OSError, KeyError, ValueError) as e:
+                # Lane setup failure is never fatal: the brokered path
+                # serves everything (upgrade skew, missing native lib).
+                import logging as _logging
+                _logging.getLogger("vtpu").debug(
+                    "fastlane lane setup failed (%s); brokered", e)
+                self._lane = None
+                for fd in fds or ():
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
         # ``created`` defaults FALSE: True asserts state loss, and a
         # pre-contract broker (daemonset upgrade: new shim, old broker
         # kept alive across the plugin restart) sends neither key — a
@@ -519,6 +588,17 @@ class RuntimeClient:
     # -- degraded mode (docs/CHAOS.md) --
 
     def _enter_degraded(self) -> None:
+        # The lane died with the broker: close it (ring submits must
+        # stop; quotas keep biting through the degraded enforcer's
+        # region backend) and drop un-consumed ring tokens — their
+        # completions are as lost as in-flight wire replies.
+        if self._lane is not None:
+            self._lane.close()
+            self._lane = None
+        self._pending.clear()
+        self._wire_buf.clear()
+        self._tok_wire = 0
+        self._tok_ring = 0
         self._degraded = True
         self._deg_since = time.monotonic()
         self._deg_attempt = 0
@@ -714,6 +794,182 @@ class RuntimeClient:
         self.lease_us = max(self.lease_us - us, 0.0)
         return self.lease_us > 0.0
 
+    def _note_wire(self, n: int) -> None:
+        """Account ``n`` pipelined logical wire replies; with a
+        fastlane lane active, also append the order tokens that let
+        ring completions interleave with wire frames FIFO."""
+        self._wire_out += n
+        if self._lane is not None:
+            self._tok_wire += n
+            for _ in range(n):
+                self._pending.append(("w",))
+
+    # -- vtpu-fastlane (docs/PERF.md) ---------------------------------------
+
+    def _broker_alive(self) -> bool:
+        """Cheap peer-liveness probe for ring completion waits: a
+        SIGKILLed broker's kernel closes the UDS, so a zero-byte peek
+        reads EOF within one poll.  The socket is flipped
+        non-blocking for the peek — on a timeout-mode socket a plain
+        MSG_DONTWAIT recv retries internally and a quiet-but-alive
+        broker would misread as dead."""
+        try:
+            self.sock.setblocking(False)
+            try:
+                data = self.sock.recv(1, socket.MSG_PEEK)
+                return bool(data)
+            finally:
+                self.sock.settimeout(self._rpc_timeout
+                                     if self._rpc_timeout > 0 else None)
+        except (BlockingIOError, InterruptedError):
+            return True
+        except OSError:
+            return False
+
+    def _ring_resp(self, route, res) -> Dict[str, Any]:
+        """Fabricate the logical reply of one ring completion — output
+        shapes are static, so the FASTBIND metadata IS the reply."""
+        status, actual, _t_done = res
+        if status == fastlane_mod.EXEC_OK:
+            if actual > 0:
+                route["cost"] = 0.8 * route["cost"] \
+                    + 0.2 * max(float(actual), 1.0)
+            return {"ok": True, "outs": route["metas"],
+                    "device_time_us": float(actual)}
+        if status == fastlane_mod.EXEC_ECANCELED:
+            # The lane closed under this descriptor (teardown, forced
+            # fallback): the execute NEVER RAN — surface it like a
+            # connection loss so pipelined callers reset their pairing
+            # and resend, and force an immediate gate re-check so the
+            # very next send takes the brokered path.
+            self._fl_gate_in = 0
+            return {"ok": False, "code": "CONNECTION_LOST",
+                    "error": "fastlane lane closed; this execute was "
+                             "not run — resend (brokered path)"}
+        code = {fastlane_mod.EXEC_ENOTFOUND: "NOT_FOUND"}.get(
+            status, "INTERNAL")
+        return {"ok": False, "code": code,
+                "error": f"fastlane execute failed (status {status})"}
+
+    def _next_pending_reply(self) -> Dict[str, Any]:
+        """Materialise the oldest pipelined logical reply, whichever
+        transport carries it (token order == send order)."""
+        tok = self._pending.popleft()
+        if tok[0] == "w":
+            self._tok_wire -= 1
+            if self._wire_buf:
+                return self._wire_buf.popleft()
+            try:
+                raw = self._recv()
+            except (ConnectionError, P.ProtocolError, OSError):
+                self._on_disconnect()
+                raise AssertionError("unreachable")
+            out = self._explode(raw)
+            self._wire_out -= len(out)
+            self._wire_buf.extend(out)
+            return self._wire_buf.popleft()
+        _kind, seq, route = tok[:3]
+        self._tok_ring -= 1
+        if len(tok) > 3:
+            return self._ring_resp(route, tok[3])
+        lane = self._lane
+        if lane is None:
+            raise VtpuConnectionLost(
+                "CONNECTION_LOST: fastlane lane died with the broker "
+                "connection; in-flight ring executes were lost")
+        res = lane._done.pop(seq, None)  # steady-state fast path
+        if res is None:
+            try:
+                res = lane.wait_result(
+                    seq, self._rpc_timeout if self._rpc_timeout > 0
+                    else 120.0, alive_check=self._broker_alive)
+            except ConnectionError:
+                self._on_disconnect()
+                raise AssertionError("unreachable")
+        return self._ring_resp(route, res)
+
+    def _ring_pending_resolve(self) -> None:
+        """Resolve every outstanding ring token IN PLACE (order kept):
+        the barrier before any brokered send that could observe ring
+        outputs — once resolved, the drainer has bound them."""
+        lane = self._lane
+        if lane is None or not self._tok_ring:
+            return
+        for i, tok in enumerate(self._pending):
+            if tok[0] == "r" and len(tok) == 3:
+                try:
+                    res = lane.wait_result(
+                        tok[1], self._rpc_timeout
+                        if self._rpc_timeout > 0 else 120.0,
+                        alive_check=self._broker_alive)
+                except ConnectionError:
+                    self._on_disconnect()
+                    raise AssertionError("unreachable")
+                self._pending[i] = (tok[0], tok[1], tok[2], res)
+
+    def _fastlane_send(self, eid: str, arg_ids, out_ids) -> bool:
+        """Try to ship one unchained execute through the ring; False
+        falls back to the brokered path (unprimed program, closed
+        gate, ring pressure with a dead drainer...)."""
+        lane = self._lane
+        last = self._fl_last
+        if last is not None and last[0] == eid \
+                and last[1] == arg_ids and last[2] == out_ids:
+            route = last[3]
+        else:
+            key = (eid, tuple(arg_ids), tuple(out_ids))
+            route = self._routes.get(key)
+            if route is None or route == "prime":
+                # FASTBIND is synchronous: ordering with the pipeline
+                # is the _rpc prelude's problem (it absorbs all).
+                rep = self._rpc({"kind": P.FASTBIND, "exe": eid,
+                                 "args": list(arg_ids),
+                                 "outs": list(out_ids)})
+                if int(rep.get("route", -1)) < 0:
+                    # Program never executed broker-side: one brokered
+                    # step fills its static out metadata, then
+                    # re-bind.
+                    self._routes[key] = "prime"
+                    return False
+                route = {"id": int(rep["route"]),
+                         "cost": float(rep.get("cost_us", 5000.0)
+                                       or 1.0),
+                         "metas": rep.get("outs") or []}
+                self._routes[key] = route
+            self._fl_last = (eid, list(arg_ids), list(out_ids), route)
+        self._fl_gate_in -= 1
+        if self._fl_gate_in < 0:
+            # Decimated gate check: park/close latency stays < 64
+            # steps (and every full-ring flush re-checks anyway).
+            self._fl_gate_in = 63
+            if not lane.usable():
+                self._fl_gate_in = 0
+                return False
+        # Ordering barrier: brokered work already in flight must not
+        # be overtaken by a ring descriptor (the drainer races the
+        # dispatcher) — flush and absorb it first.  All-ring steady
+        # loops never pay this (counter check, no deque scan).
+        if self._pending_batch:
+            self._flush_batch()
+        if self._tok_wire:
+            while self._pending and self._pending[0][0] == "w":
+                self._ready.append(self._next_pending_reply())
+            if self._tok_wire:
+                return False  # mixed beyond the head: stay brokered
+        # Stage in the producer batch (one vectorized fill + one
+        # native call per burst); the flush happens when the batch
+        # fills or the first completion is awaited.
+        seq = lane.buffer(route["id"], route["cost"])
+        if len(lane._sub_items) >= 32:
+            try:
+                lane.flush(self._broker_alive)
+            except ConnectionError:
+                self._on_disconnect()
+                raise AssertionError("unreachable")
+        self._pending.append(("r", seq, route))
+        self._tok_ring += 1
+        return True
+
     def _explode(self, resp: Dict[str, Any]) -> List[Dict[str, Any]]:
         """One wire frame -> its logical replies: an EXEC_BATCH reply
         yields its positional per-item results; anything else is
@@ -740,7 +996,7 @@ class RuntimeClient:
             P.send_msg(self.sock, self._maybe_stamp(msg))
         except (ConnectionError, P.ProtocolError, OSError):
             self._on_disconnect()
-        self._wire_out += len(items)
+        self._note_wire(len(items))
 
     def _sync_prelude(self) -> None:
         """FIFO guard for synchronous requests: ship any buffered batch
@@ -751,6 +1007,13 @@ class RuntimeClient:
         if self._degraded:
             self._degraded_gate()
         self._flush_batch()
+        # Lane-mode: absorb every pipelined logical reply in TOKEN
+        # order (ring completions interleave with wire frames), so the
+        # sync request's reply is next on the socket AND every prior
+        # ring execute has been bound broker-side (a GET of a ring
+        # output must see it).
+        while self._pending:
+            self._ready.append(self._next_pending_reply())
         while self._wire_out > 0:
             try:
                 raw = self._recv()
@@ -861,6 +1124,10 @@ class RuntimeClient:
 
     def close(self) -> None:
         self._closed = True
+        if self._lane is not None:
+            self._lane.release_lease()
+            self._lane.close()
+            self._lane = None
         if self._deg_enforcer is not None:
             self._deg_enforcer.close()
             self._deg_enforcer = None
@@ -884,6 +1151,24 @@ class RuntimeClient:
             # last-granted HBM quota still decides over-quota uploads
             # even with the broker gone (docs/CHAOS.md).
             self._degraded_gate(nbytes=int(arr.nbytes))
+        lane = self._lane
+        if lane is not None and lane.tx is not None and lane.usable() \
+                and int(arr.nbytes) <= lane.arena_nbytes:
+            # vtpu-fastlane shm-arena upload (docs/PERF.md): one copy
+            # into the arena, a tiny offset/len header on the socket,
+            # ZERO payload bytes on the wire.  Synchronous, so the
+            # arena region is reusable the moment the ack lands.
+            nbytes = int(arr.nbytes)
+            if nbytes:
+                flat = arr.reshape(-1).view(np.uint8)
+                np.frombuffer(lane.tx, dtype=np.uint8,
+                              count=nbytes)[:] = flat
+            self._rpc({"kind": P.PUT, "id": aid,
+                       "shape": list(arr.shape),
+                       "dtype": arr.dtype.name, "nbytes": nbytes,
+                       "arena_off": 0})
+            self._track_put(aid, nbytes)
+            return RemoteArray(self, aid, arr.shape, arr.dtype)
         if self._raw:
             # Zero-copy upload: header + payload segments leave in one
             # gather write straight from the numpy buffer, answered by
@@ -987,7 +1272,7 @@ class RuntimeClient:
                     sent += 1
         except (ConnectionError, P.ProtocolError, OSError):
             self._on_disconnect()
-        self._wire_out += sent
+        self._note_wire(sent)
         return sent
 
     def recv_reply(self) -> Dict[str, Any]:
@@ -1003,15 +1288,21 @@ class RuntimeClient:
             if self._degraded:
                 self._degraded_gate()
             self._flush_batch()
-            try:
-                raw = self._recv()
-            except (ConnectionError, P.ProtocolError, OSError):
-                self._on_disconnect()
-                raise AssertionError("unreachable")
-            out = self._explode(raw)
-            self._wire_out -= len(out)
-            resp = out[0]
-            self._ready.extend(out[1:])
+            if self._pending:
+                # Lane-mode FIFO: the token deque (filled by the flush
+                # above and by ring submits) says whether the next
+                # logical reply is a ring completion or a wire frame.
+                resp = self._next_pending_reply()
+            else:
+                try:
+                    raw = self._recv()
+                except (ConnectionError, P.ProtocolError, OSError):
+                    self._on_disconnect()
+                    raise AssertionError("unreachable")
+                out = self._explode(raw)
+                self._wire_out -= len(out)
+                resp = out[0]
+                self._ready.extend(out[1:])
         self._absorb_lease(resp)
         if not resp.get("ok"):
             # Pipelined callers see the typed error per shed reply
@@ -1053,19 +1344,37 @@ class RuntimeClient:
         count; the payload recv_into's ONE exact-size buffer the
         returned array owns — no chunk list, no join, no final copy."""
         self._sync_prelude()
+        lane = self._lane
+        use_arena = (lane is not None and lane.rx is not None
+                     and lane.usable())
         try:
-            P.send_msg(self.sock, self._maybe_stamp(
-                {"kind": P.GET, "id": aid, "raw": True}))
-            r = self._recv()
+            msg = {"kind": P.GET, "id": aid, "raw": True}
+            if use_arena:
+                # vtpu-fastlane: prefer the shm rx arena — the broker
+                # falls back to raw framing when the tensor outgrows
+                # it, so both reply shapes are handled below.
+                msg["arena"] = True
+            P.send_msg(self.sock, self._maybe_stamp(msg))
+            resp = self._recv()
             arr = None
-            if r.get("ok"):
-                buf = bytearray(int(r["nbytes"]))
-                mv = memoryview(buf)
-                got = 0
-                for _ in range(int(r["raw_parts"])):
-                    got += P.recv_raw_into(self.sock, mv[got:])
-                arr = np.frombuffer(buf, dtype=_np_dtype(r["dtype"])
-                                    ).reshape(r["shape"])
+            if resp.get("ok"):
+                off = resp.get("arena_off")
+                nbytes = int(resp["nbytes"])
+                if off is not None and use_arena:
+                    arr = np.frombuffer(
+                        lane.rx, dtype=np.uint8,
+                        count=nbytes, offset=int(off)).view(
+                            _np_dtype(resp["dtype"])).reshape(
+                                resp["shape"]).copy()
+                else:
+                    buf = bytearray(nbytes)
+                    mv = memoryview(buf)
+                    got = 0
+                    for _ in range(int(resp["raw_parts"])):
+                        got += P.recv_raw_into(self.sock, mv[got:])
+                    arr = np.frombuffer(
+                        buf, dtype=_np_dtype(resp["dtype"])
+                    ).reshape(resp["shape"])
         except (ConnectionError, P.ProtocolError, OSError):
             try:
                 self._on_disconnect()
@@ -1076,9 +1385,9 @@ class RuntimeClient:
                 if e.resumed and _retry:
                     return self._get_raw(aid, _retry=False)
                 raise
-        self._absorb_lease(r)
-        if not r.get("ok"):
-            self._raise_reply_error(r)
+        self._absorb_lease(resp)
+        if not resp.get("ok"):
+            self._raise_reply_error(resp)
         return arr
 
     def delete(self, aid: str) -> None:
@@ -1189,6 +1498,17 @@ class RuntimeClient:
             # attempts, so hammering a broker-less socket spends the
             # tenant's own budget, not its neighbours' (docs/CHAOS.md).
             self._degraded_gate(est_us=5000.0)
+        # vtpu-fastlane (docs/PERF.md): unchained executes ride the
+        # shm ring — no socket frame, no broker wake.  Chained work
+        # (repeats), dispatch-time frees, a parked/closed gate or an
+        # unprimed program all fall back to the brokered path; a
+        # fallback with ring work still in flight resolves it first so
+        # the dispatcher can never observe half-bound ring outputs.
+        if self._lane is not None:
+            if repeats <= 1 and not free \
+                    and self._fastlane_send(eid, arg_ids, out_ids):
+                return
+            self._ring_pending_resolve()
         item: Dict[str, Any] = {"exe": eid, "args": list(arg_ids),
                                 "outs": list(out_ids)}
         if repeats > 1:
@@ -1207,7 +1527,7 @@ class RuntimeClient:
             P.send_msg(self.sock, self._maybe_stamp(msg))
         except (ConnectionError, P.ProtocolError, OSError):
             self._on_disconnect()
-        self._wire_out += 1
+        self._note_wire(1)
 
     def execute_recv(self) -> List[RemoteArray]:
         resp = self.recv_reply()

@@ -1,0 +1,1374 @@
+"""vtpu-fastlane — the interposer-only data plane (docs/PERF.md).
+
+The broker exits the execute path: unchained executes ride a native
+SPSC shm descriptor ring (``native/vtpucore`` ``vtpu_exec_*``, memory
+orders declared and litmus-verified in vtpu_core.h) from the tenant
+client straight to a broker-side drainer thread, and tensor payloads
+move through mmap'd shm arenas whose fds crossed the UDS exactly once
+at HELLO — no payload bytes, no msgpack frames, no scheduler wakes on
+the hot path.  Enforcement moves onto shared-region atomics the client
+burns directly: rate leases pre-debited from the SAME native token
+bucket every co-tenant reads, burst credits spent from the ring
+header's bank words (minted by the drainer at the tenant's core share
+while idle, zeroed the moment a co-tenant floor demands — the hard-
+floor guard), and HBM ledger charges through the unchanged PUT path.
+SLO phase timestamps are staged in the descriptors (submit stamp by
+the producer, completion stamp by the drainer) and harvested into the
+always-on SLO plane in batches, so attainment/blame/fairness (PR 8)
+keep reporting.
+
+The broker remains the CONTROL plane: admission (HELLO/FASTBIND),
+journal, preemption/park, RESIZE, recovery.  Park/probation, admin
+suspend, multi-chip grants, multi-container sharing, chained
+(``repeats``) work and teardown all force a transparent fallback to
+the brokered socket path — the drainer publishes the ring's gate word
+and the client re-routes without the application noticing.
+
+A dead broker degrades EXACTLY like docs/CHAOS.md degraded mode: the
+client's completion wait detects the dead peer (socket EOF), the
+normal reconnect/degraded machinery runs, quotas keep biting through
+the native region, and an epoch resume builds a FRESH lane (the old
+ring is drained/unlinked — in-flight-at-crash descriptors died
+unreplied, the same contract pipelined socket executes have).
+
+Mode knob: ``VTPU_FASTLANE`` — on the client, ``1`` opts the tenant
+in (default off); on the broker, ``0`` refuses lane setup (default:
+serve lanes to clients that ask).
+"""
+
+from __future__ import annotations
+
+import collections
+import mmap
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import logging as log
+
+# Ring descriptor status words (mirror shim.core / vtpu_core.h; the
+# module must import WITHOUT the native lib for the mc harness).
+EXEC_OK = 0
+EXEC_ENOTFOUND = -1
+EXEC_EINTERNAL = -2
+EXEC_ECANCELED = -3
+GATE_OPEN = 0
+GATE_PARKED = 1
+GATE_CLOSED = 2
+
+
+def broker_serves() -> bool:
+    """Broker side: serve fastlane lanes to clients that request one
+    (``VTPU_FASTLANE=0`` turns the data plane off node-wide)."""
+    return os.environ.get("VTPU_FASTLANE", "1") != "0"
+
+
+def client_wants() -> bool:
+    """Client side: opt this tenant into the interposer-only data
+    plane (default off — the brokered path is the compatibility
+    default; the fastlane A/B bench cell and latency-sensitive serving
+    pods set ``VTPU_FASTLANE=1``)."""
+    return os.environ.get("VTPU_FASTLANE", "0") == "1"
+
+
+def ring_entries() -> int:
+    try:
+        return int(os.environ.get("VTPU_FASTLANE_RING", "1024") or 0) \
+            or 1024
+    except ValueError:
+        return 1024
+
+
+def arena_bytes() -> int:
+    try:
+        mb = float(os.environ.get("VTPU_FASTLANE_ARENA_MB", "64"))
+    except ValueError:
+        mb = 64.0
+    return max(int(mb * (1 << 20)), 1 << 20)
+
+
+def spin_us() -> int:
+    """Busy-spin window of the native waits (client completion wait,
+    drainer idle wait) before degrading to 50µs naps — what keeps
+    synchronous RTTs in the tens of µs."""
+    try:
+        return max(int(os.environ.get("VTPU_FASTLANE_SPIN_US", "200")),
+                   0)
+    except ValueError:
+        return 200
+
+
+def drain_batch() -> int:
+    try:
+        return max(int(os.environ.get("VTPU_FASTLANE_BATCH", "128")),
+                   1)
+    except ValueError:
+        return 128
+
+
+class PyRing:
+    """Pure-python stand-in for the native ExecRing with the same
+    surface — what the mc scenarios (cooperative scheduler, no wall
+    clock, no mmap) drive the REAL drain logic with, and what keeps
+    the fastlane tests runnable when libvtpucore.so predates the
+    vtpu_exec_* symbols.  Production lanes are always native."""
+
+    def __init__(self, entries: int = 64):
+        self.capacity = entries
+        self.slots: List[Any] = [None] * entries
+        self.tail = 0
+        self.headc = 0
+        self.credits = entries
+        self._taken = 0
+        self._gate = GATE_OPEN
+        self._credit_us = 0
+        self.path = ""
+
+    def close(self) -> None:
+        pass
+
+    def submit(self, desc) -> bool:
+        if self.credits <= 0 or self.tail - self.headc >= self.capacity:
+            return False
+        self.credits -= 1
+        self.slots[self.tail % self.capacity] = desc
+        self.tail += 1
+        return True
+
+    def take(self, max_n: int = 0):
+        out = []
+        from_ = self.headc + self._taken
+        n = max_n or self.capacity
+        while from_ < self.tail and len(out) < n:
+            out.append(self.slots[from_ % self.capacity])
+            from_ += 1
+        self._taken += len(out)
+        return out
+
+    def complete(self, statuses, actuals, t_done_ns: int) -> None:
+        n = min(len(statuses), self._taken)
+        for i in range(n):
+            d = self.slots[(self.headc + i) % self.capacity]
+            d.status = int(statuses[i])
+            d.actual_us = int(actuals[i])
+            d.t_done_ns = int(t_done_ns)
+        self.headc += n
+        self.credits += n
+        self._taken -= n
+
+    def completions(self, from_seq: int, max_n: int = 0):
+        out = []
+        n = max_n or self.capacity
+        while from_seq < self.headc and len(out) < n:
+            out.append(self.slots[from_seq % self.capacity])
+            from_seq += 1
+        return out
+
+    @property
+    def depth(self) -> int:
+        return max(self.tail - self.headc, 0)
+
+    def gate(self) -> int:
+        return self._gate
+
+    def gate_set(self, v: int) -> None:
+        self._gate = int(v)
+
+    def credit_mint(self, us: int, cap_us: int) -> bool:
+        nv = min(self._credit_us + int(us), int(cap_us))
+        if nv <= self._credit_us:
+            return False
+        self._credit_us = nv
+        return True
+
+    def credit_spend(self, us: int) -> bool:
+        if self._credit_us < us:
+            return False
+        self._credit_us -= int(us)
+        return True
+
+    def credit_level(self) -> int:
+        return self._credit_us
+
+    def wait_tail(self, seq: int, timeout_s: float,
+                  spin_us_: int = 0) -> bool:
+        return self.tail >= seq
+
+    def wait_headc(self, seq: int, timeout_s: float,
+                   spin_us_: int = 0) -> bool:
+        return self.headc >= seq
+
+
+class PyDesc:
+    """Descriptor stand-in PyRing carries (ctypes-free)."""
+
+    __slots__ = ("eseq", "route", "arg_off", "arg_len", "cost_us",
+                 "t_sub_ns", "eflags", "status", "actual_us",
+                 "t_done_ns")
+
+    def __init__(self, **kw):
+        for f in self.__slots__:
+            setattr(self, f, int(kw.get(f, 0)))
+
+
+class Route:
+    """One FASTBIND-prepared execute route: program + resolved id
+    lists + static output metadata, so a ring descriptor needs only an
+    integer."""
+
+    __slots__ = ("exe_key", "prog", "arg_ids", "out_ids", "metas",
+                 "cost_us", "primed", "cacheable", "args_cache",
+                 "args_ver")
+
+    def __init__(self, exe_key: str, prog, arg_ids, out_ids, metas,
+                 cost_us: float):
+        self.exe_key = exe_key
+        self.prog = prog
+        self.arg_ids = list(arg_ids)
+        self.out_ids = list(out_ids)
+        self.metas = metas      # [{id, shape, dtype}] completion echo
+        self.cost_us = cost_us
+        # First ring execution binds outputs through the full
+        # drop/charge path; steady state (same ids, same static
+        # shapes) swaps array refs only.
+        self.primed = False
+        # Resolved-args cache: valid while the tenant's array table
+        # version is unchanged.  Only when the route's args never name
+        # its own outs — a self-feeding route re-resolves every item.
+        self.cacheable = not (set(self.arg_ids) & set(self.out_ids))
+        self.args_cache = None
+        self.args_ver = -1
+
+
+class BrokerLane:
+    """Broker-side state of one tenant's fastlane."""
+
+    def __init__(self, tenant, ring, tx_file, rx_file,
+                 paths: Dict[str, str]):
+        self.tenant = tenant
+        self.ring = ring
+        self.tx_file = tx_file          # (fd, mmap) or None
+        self.rx_file = rx_file
+        self.paths = paths              # for unlink at close
+        self.routes: List[Route] = []
+        # Union of every route's out ids: a route whose ARGS intersect
+        # it can never cache resolved args (its inputs are re-bound by
+        # ring executions, possibly of another route).
+        self.all_out_ids: set = set()
+        self.closed = False
+        # -- counters (STATS / vtpu-smi top / metrics_server) --
+        self.ring_steps = 0
+        self.fallback_steps = 0
+        self.errors = 0
+        self.credit_minted_us = 0.0
+        # burst-credit mint window (drainer-maintained)
+        self.idle_from: Optional[float] = time.monotonic()
+        # SLO busy snapshot for blame weights (per flush)
+        self._busy_snap: Optional[tuple] = None
+
+    def tx_view(self) -> Optional[memoryview]:
+        return memoryview(self.tx_file[1]) if self.tx_file else None
+
+    def rx_view(self) -> Optional[memoryview]:
+        return memoryview(self.rx_file[1]) if self.rx_file else None
+
+    def close(self, unlink: bool = True) -> None:
+        # `closed` only GATES the drain path (set early by close_lane/
+        # gate_close); `_freed` guards the native teardown itself.
+        if getattr(self, "_freed", False):
+            return
+        self._freed = True
+        self.closed = True
+        try:
+            self.ring.gate_set(GATE_CLOSED)
+        except OSError:
+            pass
+        for ent in (self.tx_file, self.rx_file):
+            if ent:
+                try:
+                    ent[1].close()
+                    os.close(ent[0])
+                except (OSError, ValueError):
+                    pass
+        try:
+            self.ring.close()
+        except OSError:
+            pass
+        if unlink:
+            for p in self.paths.values():
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def stats(self) -> Dict[str, Any]:
+        try:
+            depth = self.ring.depth
+            gate = self.ring.gate()
+            credit = self.ring.credit_level()
+        except (OSError, ValueError):
+            depth, gate, credit = 0, GATE_CLOSED, 0
+        arena = 0
+        for ent in (self.tx_file, self.rx_file):
+            if ent:
+                try:
+                    arena += len(ent[1])
+                except ValueError:
+                    pass
+        return {
+            "ring_depth": depth,
+            "ring_steps": self.ring_steps,
+            "fallback_steps": self.fallback_steps,
+            "errors": self.errors,
+            "gate": gate,
+            "credit_us": credit,
+            "credit_minted_us": int(self.credit_minted_us),
+            "arena_bytes": arena,
+            "routes": len(self.routes),
+        }
+
+
+def _drop_array(state, t, aid: str) -> None:
+    """Session-less twin of TenantSession.drop_array (caller holds
+    t.mu; journal del records defer to t.pending_journal exactly like
+    the session path — flushed by the drainer after release)."""
+    if aid in t.host_arrays:
+        arr = t.host_arrays.pop(aid)
+        t.drop_staged(aid)
+        t.nbytes.pop(aid, None)
+        t.host_bytes -= int(arr.nbytes)
+    elif aid in t.arrays:
+        nb = t.nbytes.pop(aid, 0)
+        del t.arrays[aid]
+        t.release_array(aid, default_nbytes=nb)
+    else:
+        return
+    t.arrays_ver += 1
+    if state.journal is not None \
+            and t.blob_meta.pop(aid, None) is not None:
+        t.pending_journal.append({"op": "del", "name": t.name,
+                                  "id": aid})
+
+
+class FastlaneHub:
+    """Per-broker fastlane manager: lane lifecycle, FASTBIND routes,
+    the per-chip drainer threads, and the STATS rollup.  ``hub.mu`` is
+    a leaf lock guarding only the lane registry (never held across
+    execution, journal writes or socket I/O)."""
+
+    def __init__(self, state):
+        self.state = state
+        self.mu = threading.Lock()
+        self.lanes: Dict[str, BrokerLane] = {}
+        self.drainers: Dict[int, "Drainer"] = {}
+        # Retired lanes awaiting native teardown: munmap/close must
+        # never run concurrently with a drainer touching the mapping,
+        # so the DRAINER reaps its chip's graveyard at the top of its
+        # loop (inline close only when no drainer exists).
+        self._dead: Dict[int, List[BrokerLane]] = {}
+        self.serve = broker_serves()
+        # mc/test oracle: when a list, every drain admission verdict is
+        # appended as (tenant, n_items, parked, closed).  None in
+        # production (records nothing).
+        self.admit_log: Optional[List[tuple]] = None
+        # When True (mc harness), never start drainer threads — the
+        # scenario drives drain_once() itself, cooperatively.
+        self.manual = False
+        self.ring_steps_total = 0
+        self.fallback_total = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def create_lane(self, tenant) -> Optional[Tuple[dict, List[int]]]:
+        """Build a lane for ``tenant`` at HELLO: native ring + two shm
+        arenas next to the chip's accounting region.  Returns (reply
+        descriptor, [tx_fd, rx_fd]) or None when fastlane is off /
+        unavailable / the tenant shape forces the brokered path
+        (multi-chip grants, multi-container sharing)."""
+        if not self.serve or self.manual:
+            return None
+        if len(tenant.chips) != 1 or tenant.connections > 1:
+            return None
+        try:
+            from ..shim import core as shim_core
+            if not getattr(shim_core.load(), "_vtpu_has_exec", False):
+                return None
+        except (OSError, FileNotFoundError):
+            return None
+        region_path = tenant.chip.region.path
+        base = f"{region_path}.lane{tenant.index}." \
+               f"{os.getpid():x}.{time.time_ns() & 0xffffff:x}"
+        paths = {"ring": base + ".ring", "tx": base + ".tx",
+                 "rx": base + ".rx"}
+        # Epoch resume drains the ring: a PREVIOUS epoch's lane files
+        # for this slot are dead weight (their in-flight descriptors
+        # died unreplied with the old broker) — sweep them before
+        # creating the fresh lane so nothing leaks across epochs.
+        lane_dir = os.path.dirname(region_path) or "."
+        prefix = os.path.basename(region_path) + f".lane{tenant.index}."
+        try:
+            for fn in os.listdir(lane_dir):
+                if fn.startswith(prefix) \
+                        and not fn.startswith(os.path.basename(base)):
+                    try:
+                        os.unlink(os.path.join(lane_dir, fn))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        try:
+            ring = shim_core.ExecRing(paths["ring"], ring_entries())
+            files = []
+            nbytes = arena_bytes()
+            for p in (paths["tx"], paths["rx"]):
+                fd = os.open(p, os.O_RDWR | os.O_CREAT, 0o666)
+                os.ftruncate(fd, nbytes)
+                files.append((fd, mmap.mmap(fd, nbytes)))
+        except OSError as e:
+            log.warn("fastlane: lane setup for %s failed (%s); "
+                     "staying brokered", tenant.name, e)
+            return None
+        lane = BrokerLane(tenant, ring, files[0], files[1], paths)
+        with self.mu:
+            old = self.lanes.pop(tenant.name, None)
+            self.lanes[tenant.name] = lane
+        if old is not None:
+            old.close()
+        tenant.fastlane = lane
+        self._ensure_drainer(tenant.chip)
+        reply = {
+            "ring": paths["ring"],
+            "entries": ring.capacity,
+            "arena_tx": paths["tx"],
+            "arena_rx": paths["rx"],
+            "arena_bytes": nbytes,
+            "region": region_path,
+            "slot": tenant.index,
+            "quantum_us": int(self.state.rate_lease_us),
+            "priority": tenant.priority,
+        }
+        return reply, [files[0][0], files[1][0]]
+
+    def _ensure_drainer(self, chip) -> None:
+        if self.manual:
+            return
+        with self.mu:
+            if chip.index not in self.drainers:
+                d = Drainer(self, chip)
+                self.drainers[chip.index] = d
+                d.start()
+
+    def bind_route(self, tenant, exe_key: str, arg_ids, out_ids
+                   ) -> dict:
+        """FASTBIND: resolve a (program, args, outs) triple to a route
+        index + the static completion metadata."""
+        lane = getattr(tenant, "fastlane", None)
+        if lane is None or lane.closed:
+            return {"ok": False, "code": "FASTLANE_OFF",
+                    "error": "no fastlane lane on this tenant"}
+        prog = tenant.executables.get(exe_key)
+        if prog is None:
+            return {"ok": False, "code": "NOT_FOUND", "error": exe_key}
+        cost = float(tenant.cost_ema.get(
+            exe_key, max(float(self.state.min_exec_cost_us), 5000.0)))
+        if prog.out_meta is None:
+            # Unprimed: the client runs ONE brokered execute (which
+            # fills out_meta) and re-binds.
+            return {"ok": True, "route": -1, "cost_us": cost}
+        out_ids = list(out_ids)
+        while len(out_ids) < len(prog.out_meta):
+            tenant.anon_seq += 1
+            out_ids.append(f"_anon{tenant.anon_seq}")
+        metas = [{"id": out_ids[i], "shape": m["shape"],
+                  "dtype": m["dtype"]}
+                 for i, m in enumerate(prog.out_meta)]
+        route = Route(exe_key, prog, arg_ids, out_ids, metas, cost)
+        with self.mu:
+            lane.routes.append(route)
+            idx = len(lane.routes) - 1
+            # Cacheability is judged against EVERY route's outs on
+            # this lane (ring executions re-bind them out from under a
+            # stale cache); a new route can demote older ones.
+            lane.all_out_ids.update(route.out_ids)
+            for r in lane.routes:
+                r.cacheable = not (set(r.arg_ids) & lane.all_out_ids)
+                r.args_cache = None
+        return {"ok": True, "route": idx, "cost_us": cost,
+                "outs": metas}
+
+    def gate_close(self, name: str) -> None:
+        """Force permanent fallback (e.g. a second container joined
+        the tenant): the client sees GATE_CLOSED and re-routes; any
+        descriptor already in the ring cancels (never ran) so producer
+        waits terminate and the pre-debits refund."""
+        with self.mu:
+            lane = self.lanes.get(name)
+        if lane is not None:
+            lane.closed = True
+            try:
+                lane.ring.gate_set(GATE_CLOSED)
+            except OSError:
+                pass
+            self._cancel_drain(lane)
+
+    def close_lane(self, name: str) -> None:
+        with self.mu:
+            lane = self.lanes.pop(name, None)
+        if lane is None:
+            return
+        lane.tenant.fastlane = None
+        lane.closed = True
+        try:
+            lane.ring.gate_set(GATE_CLOSED)
+        except (OSError, ValueError):
+            pass
+        # Drain the ring: submitted-but-unexecuted descriptors are
+        # completed ECANCELED (their replies died with the lane, like
+        # in-flight wire executes at teardown) and their pre-debited
+        # estimates REFUND through the shared bucket — a released
+        # tenant must leave the books exactly balanced (the mc
+        # token-conservation row checks this).
+        self._cancel_drain(lane)
+        chip_idx = lane.tenant.chip.index
+        with self.mu:
+            has_drainer = chip_idx in self.drainers
+            if has_drainer:
+                self._dead.setdefault(chip_idx, []).append(lane)
+        if not has_drainer:
+            lane.close()
+
+    def reap_dead(self, chip_index: int) -> None:
+        """Native teardown of retired lanes — called ONLY from the
+        owning drainer thread (or after it is joined)."""
+        with self.mu:
+            dead = self._dead.pop(chip_index, None)
+        for lane in dead or ():
+            lane.close()
+
+    def note_fallback(self, tenant, n: int = 1) -> None:
+        """A brokered execute ran while a lane exists — the operator-
+        visible 'which plane is this tenant on' counter."""
+        lane = getattr(tenant, "fastlane", None)
+        if lane is not None:
+            lane.fallback_steps += n
+            self.fallback_total += n
+
+    def stop(self) -> None:
+        with self.mu:
+            drainers = list(self.drainers.values())
+            lanes = list(self.lanes.values())
+            dead = [ln for lst in self._dead.values() for ln in lst]
+            self.lanes.clear()
+            self.drainers.clear()
+            self._dead.clear()
+        for d in drainers:
+            d.stop()  # joined: no drain pass can touch a mapping now
+        for lane in lanes + dead:
+            lane.close()
+
+    # -- stats -------------------------------------------------------------
+
+    def tenant_stats(self, name: str) -> Optional[Dict[str, Any]]:
+        with self.mu:
+            lane = self.lanes.get(name)
+        return lane.stats() if lane is not None else None
+
+    def stats(self) -> Dict[str, Any]:
+        with self.mu:
+            n = len(self.lanes)
+        return {"lanes": n, "ring_steps_total": self.ring_steps_total,
+                "fallback_steps_total": self.fallback_total,
+                "enabled": self.serve}
+
+    # -- the drain path ----------------------------------------------------
+
+    def drain_once(self, chip) -> int:
+        """One pass over every lane of ``chip``; returns items
+        executed.  Called by the drainer thread (production) or
+        directly by the mc scenarios (cooperative)."""
+        with self.mu:
+            lanes = [ln for ln in self.lanes.values()
+                     if ln.tenant.chip is chip]
+        done = 0
+        for lane in lanes:
+            done += self._drain_lane(lane)
+        return done
+
+    def _cancel_drain(self, lane: BrokerLane) -> None:
+        """Complete every submitted-but-unexecuted descriptor of a
+        closed/closing lane with ECANCELED and refund the client's
+        pre-debits — waits terminate promptly, books stay balanced."""
+        try:
+            while True:
+                descs = lane.ring.take(64)
+                if not descs:
+                    break
+                costs = sum(int(d.cost_us) for d in descs)
+                lane.ring.complete([EXEC_ECANCELED] * len(descs),
+                                   [0] * len(descs), time.time_ns())
+                if costs:
+                    lane.tenant.rate_adjust_all(-costs)
+        except (OSError, ValueError):
+            pass
+
+    @staticmethod
+    def _park_verdict(state, sched, t, now: float):
+        """(parked, probation, contended) under scheduler.mu — the
+        SAME state the brokered dispatcher gates on.  A separate seam
+        so the mc selfcheck can seed a gate that IGNORES the park
+        while the admit oracle still records ground truth."""
+        parked = t.name in state.suspended \
+            or t.name in sched.preempted
+        probation = t.name in sched.probation
+        contended = any(
+            q and n != t.name and n not in sched.preempted
+            and sched.not_ready_until.get(n, 0.0) > now
+            for n, q in sched.queues.items())
+        return parked, probation, contended
+
+    def _drain_lane(self, lane: BrokerLane) -> int:
+        state = self.state
+        t = lane.tenant
+        if lane.closed:
+            self._cancel_drain(lane)
+            if self.admit_log is not None:
+                self.admit_log.append((t.name, 0, False, True))
+            return 0
+        sched = t.chip.scheduler
+        now = time.monotonic()
+        with sched.mu:
+            parked, probation, contended = self._park_verdict(
+                state, sched, t, now)
+        if parked:
+            try:
+                if lane.ring.gate() != GATE_PARKED:
+                    lane.ring.gate_set(GATE_PARKED)
+            except OSError:
+                pass
+            if self.admit_log is not None:
+                self.admit_log.append((t.name, 0, True, False))
+            return 0
+        try:
+            if lane.ring.gate() == GATE_PARKED:
+                lane.ring.gate_set(GATE_OPEN)
+        except OSError:
+            return 0
+        # Hard-floor guard for the client-burned burst credits: the
+        # moment any co-tenant with queued work is bucket-throttled,
+        # the bank is zeroed (no spend can ride past a floor-demand
+        # signal) and minting stops.
+        if contended:
+            lvl = lane.ring.credit_level()
+            while lvl > 0 and lane.ring.credit_spend(lvl):
+                lvl = lane.ring.credit_level()
+        cap = 2 if probation else drain_batch()
+        ring = lane.ring
+        n = 0
+        cols = None
+        if getattr(ring, "take_np", None) is not None:
+            n, view = ring.take_np(cap)
+            if n:
+                # Column copies (the scratch view is reused): route,
+                # cost, submit stamp, arg blob offset/len.
+                cols = (view[:, 1].copy(), view[:, 4].copy(),
+                        view[:, 5].copy(), view[:, 2].copy(),
+                        view[:, 3].copy())
+        else:
+            import numpy as np
+            descs = ring.take(cap)
+            n = len(descs)
+            if n:
+                cols = tuple(
+                    np.array([getattr(d, f) for d in descs],
+                             dtype=np.uint64)
+                    for f in ("route", "cost_us", "t_sub_ns",
+                              "arg_off", "arg_len"))
+        if not n:
+            if lane.idle_from is None and ring.depth == 0:
+                lane.idle_from = now
+            t.fastlane_depth = ring.depth
+            return 0
+        # Idle -> busy: close the burst-credit mint window (bank the
+        # share the tenant could not use, capped; never while floors
+        # contend) — the fastlane twin of _mint_credit_locked.
+        if lane.idle_from is not None:
+            if not contended and t.core_pct > 0:
+                from .server import BURST_CAP_US
+                if BURST_CAP_US > 0:
+                    mint = min((now - lane.idle_from) * t.core_pct
+                               * 1e4, BURST_CAP_US)
+                    if mint >= 1.0 and lane.ring.credit_mint(
+                            int(mint), int(BURST_CAP_US)):
+                        lane.credit_minted_us += mint
+            lane.idle_from = None
+        if self.admit_log is not None:
+            # The oracle re-reads GROUND TRUTH (not the gate's verdict
+            # variable): a regression — or the seeded selfcheck
+            # variant — that ignores the park still logs parked=True
+            # with n>0, which is what fastlane-park-gate fires on.
+            truly_parked = t.name in state.suspended \
+                or t.name in sched.preempted
+            self.admit_log.append((t.name, n, truly_parked,
+                                   lane.closed))
+        return self._execute_batch(lane, n, cols)
+
+    def _execute_batch(self, lane: BrokerLane, n: int, cols) -> int:
+        """Resolve, execute and complete one drained batch (columns
+        are numpy arrays — per-item Python work is the route fn call
+        and the output swap; everything else is vectorized).  The t.mu
+        sections mirror the dispatcher's phases."""
+        import numpy as np
+        state = self.state
+        t = lane.tenant
+        route_c, cost_c, tsub_c, aoff_c, alen_c = cols
+        t0 = time.monotonic()
+        ring = lane.ring
+        st_np, ac_np = (ring.scratch_views()
+                        if getattr(ring, "take_np", None) is not None
+                        else (np.zeros(n, np.int64),
+                              np.zeros(n, np.uint64)))
+        if n < 8:
+            est_total = 0.0
+            for i in range(n):
+                st_np[i] = EXEC_OK
+                c_i = int(cost_c[i])
+                ac_np[i] = c_i
+                est_total += c_i
+        else:
+            st_np[:n] = EXEC_OK
+            ac_np[:n] = cost_c      # actual := estimate (CPU cell)
+            est_total = float(cost_c.sum())
+        routes = lane.routes
+        n_routes = len(routes)
+        route_l = route_c.tolist()
+        blobs = alen_c.any()
+        tx = lane.tx_view() if blobs else None
+        arrays_ver = t.arrays_ver
+        arrs = t.arrays
+        errors = 0
+        for i in range(n):
+            ridx = route_l[i]
+            if ridx >= n_routes:
+                st_np[i] = EXEC_ENOTFOUND
+                errors += 1
+                continue
+            route = routes[ridx]
+            try:
+                # Steady-state arg resolution: cached against the
+                # tenant's array-table version (bumped by every PUT /
+                # DELETE / brokered out-bind); routes whose args any
+                # lane route re-binds are never cached.
+                args = route.args_cache
+                if args is None or route.args_ver != arrays_ver:
+                    with t.mu:
+                        args = []
+                        for aid in route.arg_ids:
+                            a = arrs.get(aid)
+                            if a is None:
+                                a = t.staged.get(aid)
+                            if a is None:
+                                raise KeyError(aid)
+                            args.append(a)
+                    if route.cacheable:
+                        route.args_cache = args
+                        route.args_ver = arrays_ver
+                if blobs and tx is not None and route.arg_ids \
+                        and alen_c[i]:
+                    # Inline arg blob: byte-replace arg0 from the tx
+                    # arena (fresh host batch per step without a PUT
+                    # round trip).  Copied out — the client reuses
+                    # the arena once the completion publishes.
+                    a0 = args[0]
+                    off = int(aoff_c[i])
+                    blob = bytes(tx[off:off + int(alen_c[i])])
+                    args = list(args)
+                    args[0] = np.frombuffer(
+                        blob, dtype=a0.dtype).reshape(a0.shape)
+                outs = route.prog.fn(*args)
+                if route.primed:
+                    # Steady state: same out ids, same static shapes
+                    # — swap the array refs, books unchanged.
+                    if isinstance(outs, (list, tuple)):
+                        for oid, o in zip(route.out_ids, outs):
+                            arrs[oid] = o
+                    else:
+                        arrs[route.out_ids[0]] = outs
+                else:
+                    out_list = (outs if isinstance(outs, (list, tuple))
+                                else [outs])
+                    with t.mu:
+                        for k, o in enumerate(out_list):
+                            oid = route.out_ids[k] \
+                                if k < len(route.out_ids) else None
+                            if oid is None:
+                                t.anon_seq += 1
+                                oid = f"_anon{t.anon_seq}"
+                            m = route.prog.out_meta[k] \
+                                if route.prog.out_meta else None
+                            nb = (m["nbytes"] if m
+                                  else int(o.nbytes))
+                            _drop_array(state, t, oid)
+                            t.arrays[oid] = o
+                            t.nbytes[oid] = nb
+                            t.charge_array(oid, [(0, nb)], True)
+                        t.arrays_ver += 1
+                    arrays_ver = t.arrays_ver
+                    route.primed = True
+            except KeyError:
+                st_np[i] = EXEC_ENOTFOUND
+                errors += 1
+            except Exception as e:  # noqa: BLE001 - per-item isolation
+                st_np[i] = EXEC_EINTERNAL
+                errors += 1
+                log.warn("fastlane: %s route execute failed: %s",
+                         t.name, e)
+        # Measured actuals: the batch's observed wall window (capped
+        # at the estimates, the metering loop's over-billing rule)
+        # split evenly across its items — what the client's cost EMA
+        # learns from, exactly like brokered metering learns the
+        # dispatcher's estimates down.  Echoing the estimate here
+        # would freeze the EMA at its seed and rate-throttle fastlane
+        # tenants at 5ms/step forever.
+        wall_us = (time.monotonic() - t0) * 1e6
+        busy = int(min(wall_us, est_total))
+        per_actual = max(busy // n, 1)
+        if n < 8:
+            for i in range(n):
+                ac_np[i] = per_actual
+        else:
+            ac_np[:n] = per_actual
+        if errors:
+            ac_np[:n][st_np[:n] != EXEC_OK] = 0
+            lane.errors += errors
+        done_ns = time.time_ns()
+        if getattr(ring, "take_np", None) is not None:
+            ring.complete_np(st_np, ac_np, done_ns, n)
+        else:
+            ring.complete(st_np[:n].tolist(), ac_np[:n].tolist(),
+                          done_ns)
+        # Yield core + GIL for one beat: the futex wake just made the
+        # producer runnable, and holding the interpreter through the
+        # accounting below would serialize its wake-up behind ~30µs of
+        # bookkeeping — the sync-RTT tail on single-core cgroups.
+        os.sched_yield()
+        lane.ring_steps += n
+        self.ring_steps_total += n
+        t.executions += n
+        t.fastlane_depth = ring.depth
+        # -- per-batch accounting (never per item) --
+        # Busy billing (computed above with the actuals): never more
+        # than the observed wall window, never more than the estimates
+        # the client debited; the delta corrects the client's
+        # pre-debits through the shared bucket.
+        if busy > 0:
+            t.busy_add_all(busy)
+        delta = busy - int(est_total)
+        if delta:
+            t.rate_adjust_all(delta)
+        # SLO harvest (stage_batch's flat-row contract, vectorized):
+        # dt_enq = completion - submit stamp (wall ns, cross-process);
+        # device = the billed actuals; client-side bucket waits show
+        # up inside the queue phase.
+        if state.slo.enabled:
+            sched = t.chip.scheduler
+            snap = tuple(sched.slo_busy)
+            weights = None
+            prev = lane._busy_snap
+            if prev is not None:
+                weights = {}
+                for slot, (b0, b1) in enumerate(zip(prev, snap)):
+                    dv = b1 - b0
+                    name = sched.slo_names[slot] \
+                        if slot < len(sched.slo_names) else None
+                    if dv > 0.0 and name:
+                        weights[name] = weights.get(name, 0.0) + dv
+            lane._busy_snap = snap
+            if n < 8:
+                # Sync-cadence fast path: scalar math (the fixed cost
+                # of the vectorized pass would dominate the RTT).
+                flat2 = []
+                for i in range(n):
+                    dt_enq_i = (done_ns - int(tsub_c[i])) * 1e-9
+                    if dt_enq_i < 0.0:
+                        dt_enq_i = 0.0
+                    dt_disp_i = int(ac_np[i]) * 1e-6
+                    if dt_disp_i > dt_enq_i:
+                        dt_disp_i = dt_enq_i
+                    flat2.extend((dt_enq_i, 0.0, dt_disp_i, 1.0))
+                state.slo.stage_batch({t.name: flat2}, weights, n)
+            else:
+                dt_enq = (done_ns - tsub_c.astype(np.int64)) * 1e-9
+                np.clip(dt_enq, 0.0, None, out=dt_enq)
+                dt_disp = np.minimum(ac_np[:n] * 1e-6, dt_enq)
+                flat = np.empty((n, 4), dtype=np.float64)
+                flat[:, 0] = dt_enq
+                flat[:, 1] = 0.0
+                flat[:, 2] = dt_disp
+                flat[:, 3] = 1.0
+                state.slo.stage_batch({t.name: flat.ravel()}, weights,
+                                      n)
+        # Preemption/demand visibility: a fastlane tenant's load lives
+        # in its ring, not the scheduler queues — publish it so the
+        # preemption policy can pick (and protect) fastlane tenants
+        # exactly like brokered ones.
+        sched = t.chip.scheduler
+        now = time.monotonic()
+        with sched.mu:
+            sched.known[t.name] = t
+            t.last_active = now
+            sched.demand_since.setdefault(t.name, now)
+        if t.pending_journal:
+            from .server import flush_tenant_journal
+            flush_tenant_journal(state, t)
+        return n
+
+
+class ClientLane:
+    """Tenant-side half of a negotiated fastlane: the native ring
+    producer, the mmap'd arenas (fds received over the UDS at HELLO,
+    path fallback), and the region-atomics enforcement the client
+    burns DIRECTLY — a rate-lease quantum pre-debited from the same
+    native token bucket every co-tenant reads, burst credits spent
+    from the ring bank when the bucket refuses, blocking in the native
+    bucket otherwise (the LD_PRELOAD interposer's enforcement shape,
+    docs/PERF.md)."""
+
+    def __init__(self, info: Dict[str, Any],
+                 fds: Optional[List[int]] = None):
+        from ..shim import core as shim_core
+        self.ring = shim_core.ExecRing(str(info["ring"]))
+        self.info = dict(info)
+        self.slot = int(info.get("slot", 0))
+        self.priority = int(info.get("priority", 1))
+        self.quantum_us = float(info.get("quantum_us", 20000) or 0)
+        self.arena_nbytes = int(info.get("arena_bytes", 0) or 0)
+        self.tx = self.rx = None
+        try:
+            if fds and len(fds) >= 2:
+                self.tx = mmap.mmap(fds[0], self.arena_nbytes)
+                self.rx = mmap.mmap(fds[1], self.arena_nbytes)
+                for fd in fds[:2]:  # the mappings outlive the fds
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+            else:
+                for attr, key in (("tx", "arena_tx"),
+                                  ("rx", "arena_rx")):
+                    fd = os.open(str(info[key]), os.O_RDWR)
+                    try:
+                        setattr(self, attr,
+                                mmap.mmap(fd, self.arena_nbytes))
+                    finally:
+                        os.close(fd)
+        except (OSError, KeyError, ValueError):
+            self.tx = self.rx = None  # arena-less lane: ring only
+        # Enforcement region (the chip's accounting region, tenant
+        # slot = our HELLO index).  rate ops need no proc slot.
+        self.region = None
+        rp = info.get("region")
+        if rp and os.path.exists(str(rp)):
+            try:
+                self.region = shim_core.SharedRegion(str(rp))
+            except OSError:
+                self.region = None
+        # local lease mirror (burned with plain floats; re-synced
+        # through the shared bucket)
+        self._lease_us = 0.0
+        self._lease_exp = 0.0
+        self._lease_ttl = max(4.0 * self.quantum_us / 1e6, 0.05)
+        self.seq = self.ring.tail  # next submit seq (fresh ring: 0)
+        self._done: Dict[int, Any] = {}  # seq -> completion tuple
+        self._done_cursor = self.ring.headc
+        self.credit_spent_us = 0.0
+        self.ring_steps = 0
+        # Reused descriptor: the native submit copies it into the shm
+        # slot before returning, so one mutated instance serves every
+        # step (a ctypes Structure alloc per submit was measurable).
+        self._desc = shim_core.ExecDesc()
+        # Buffered producer batch (docs/PERF.md): sends stage as plain
+        # (route, cost) pairs and a numpy pass fills a ctypes ExecDesc
+        # array at flush — one vectorized fill + ONE native call
+        # publishes the whole burst.  Seqs are pre-assigned at buffer
+        # time (flush publishes strictly in order).
+        import ctypes as _ct
+        import numpy as _np
+        self._ct = _ct
+        self._np = _np
+        self._sub_cap = 64
+        self._sub_buf = (shim_core.ExecDesc * self._sub_cap)()
+        self._sub_np = _np.frombuffer(
+            self._sub_buf, dtype=_np.uint64).reshape(self._sub_cap, 10)
+        self._sub_items: List[Tuple[int, float]] = []
+        self._sub_cost = 0.0
+        self._desc_size = _ct.sizeof(shim_core.ExecDesc)
+
+    def close(self) -> None:
+        for m in (self.tx, self.rx):
+            try:
+                if m is not None:
+                    m.close()
+            except (OSError, ValueError):
+                pass
+        if self.region is not None:
+            try:
+                self.region.close()
+            except OSError:
+                pass
+            self.region = None
+        try:
+            self.ring.close()
+        except OSError:
+            pass
+
+    def usable(self) -> bool:
+        try:
+            return self.ring.gate() == GATE_OPEN
+        except (OSError, ValueError):
+            return False
+
+    # -- enforcement (client-burned region atomics) ------------------------
+
+    def admit(self, cost_us: float) -> None:
+        """Admit ``cost_us`` of device time BEFORE the ring submit:
+        lease balance -> fresh pre-debited quantum -> burst-credit
+        bank -> block in the shared bucket (the hard floor)."""
+        if self.region is None:
+            return
+        cost = max(int(cost_us), 0)
+        now = time.monotonic()
+        if self._lease_us > 0.0 and now >= self._lease_exp:
+            left = int(self._lease_us)
+            self._lease_us = 0.0
+            if left > 0:
+                self.region.rate_adjust(self.slot, -left)
+        if self._lease_us >= cost:
+            self._lease_us -= cost
+            return
+        q = int(self.quantum_us)
+        if q > 0 and self.region.rate_acquire(
+                self.slot, cost + q, self.priority) == 0:
+            self._lease_us += q
+            self._lease_exp = now + self._lease_ttl
+            return
+        # Bucket refused a quantum: burst credit may still admit —
+        # never past the hard floor (the broker zeroes the bank the
+        # moment a co-tenant floor demands).
+        if self.ring.credit_spend(cost):
+            self.credit_spent_us += cost
+            return
+        self.region.rate_block(self.slot, max(cost, 1), self.priority)
+
+    def release_lease(self) -> None:
+        """Refund the unburned lease remainder (teardown/fallback)."""
+        if self.region is None:
+            return
+        left = int(self._lease_us)
+        self._lease_us = 0.0
+        if left > 0:
+            self.region.rate_adjust(self.slot, -left)
+
+    # -- produce / complete ------------------------------------------------
+
+    def submit(self, route_id: int, cost_us: float,
+               arg_off: int = 0, arg_len: int = 0) -> Optional[int]:
+        """Admit + publish one descriptor; returns its seq, or None
+        when the ring gate refuses (full ring back-pressure — the
+        caller drains completions and retries, or falls back)."""
+        self.admit(cost_us)
+        d = self._desc
+        d.eseq = self.seq
+        d.route = int(route_id)
+        d.arg_off = int(arg_off)
+        d.arg_len = int(arg_len)
+        d.cost_us = int(cost_us)
+        d.t_sub_ns = time.time_ns()
+        d.status = 0
+        d.actual_us = 0
+        d.t_done_ns = 0
+        if not self.ring.submit(d):
+            return None
+        seq = self.seq
+        self.seq += 1
+        self.ring_steps += 1
+        return seq
+
+    def buffer(self, route_id: int, cost_us: float) -> int:
+        """Stage one descriptor in the producer batch (published by
+        ``flush``); returns its pre-assigned seq."""
+        seq = self.seq
+        self.seq = seq + 1
+        self._sub_items.append((route_id, cost_us))
+        self._sub_cost += cost_us
+        self.ring_steps += 1
+        return seq
+
+    @property
+    def buffered(self) -> int:
+        return len(self._sub_items)
+
+    def flush(self, alive_check=None) -> None:
+        """Admit + publish the staged batch: one vectorized descriptor
+        fill, one native submit_batch call (bounded full-ring retries
+        with the gate and the broker's pulse checked)."""
+        items = self._sub_items
+        if not items:
+            return
+        self._sub_items = []
+        total_cost, self._sub_cost = self._sub_cost, 0.0
+        self.admit(total_cost)
+        if len(items) == 1:
+            # Sync-cadence fast path: one descriptor, no numpy.
+            d = self._desc
+            d.eseq = self.seq - 1
+            d.route = int(items[0][0])
+            d.arg_off = 0
+            d.arg_len = 0
+            d.cost_us = int(items[0][1])
+            d.t_sub_ns = time.time_ns()
+            d.status = 0
+            d.actual_us = 0
+            d.t_done_ns = 0
+            stuck = 0
+            while not self.ring.submit(d):
+                g = self.ring.gate()
+                if g == GATE_CLOSED:
+                    raise ConnectionError(
+                        "fastlane: lane closed with staged submits")
+                if not self.ring.wait_headc(self.ring.headc + 1,
+                                            0.05, spin_us()):
+                    stuck += 1
+                    if alive_check is not None and not alive_check():
+                        raise ConnectionError(
+                            "fastlane: broker died with staged "
+                            "submits")
+                    if stuck > 2400:
+                        raise ConnectionError(
+                            "fastlane: ring wedged (no consumer "
+                            "progress)")
+            return
+        n = len(items)
+        view = self._sub_np[:n]
+        # eseq (col 0) is never read by the consumer (completion
+        # matching is positional via headc) — skip the fill.
+        view[:, 1] = [it[0] for it in items]
+        view[:, 2:4] = 0
+        view[:, 4] = [int(it[1]) for it in items]
+        view[:, 5] = time.time_ns()
+        view[:, 6:] = 0
+        done = 0
+        stuck = 0
+        while done < n:
+            if done:
+                ptr = self._ct.cast(
+                    self._ct.byref(self._sub_buf,
+                                   done * self._desc_size),
+                    self._ct.POINTER(type(self._sub_buf[0])))
+            else:
+                ptr = self._sub_buf
+            k = self.ring.submit_batch(ptr, n - done)
+            done += k
+            if done >= n:
+                break
+            # Full ring: wait for consumer progress, watch the gate
+            # and the broker's pulse (seqs are already handed out, so
+            # a dead lane surfaces as ConnectionError — the normal
+            # reconnect/degraded machinery).
+            g = self.ring.gate()
+            if g == GATE_CLOSED:
+                raise ConnectionError(
+                    "fastlane: lane closed with staged submits")
+            if not self.ring.wait_headc(self.ring.headc + 1, 0.05,
+                                        spin_us()):
+                stuck += 1
+                if alive_check is not None and not alive_check():
+                    raise ConnectionError(
+                        "fastlane: broker died with staged submits")
+                if stuck > 2400:  # ~2 min of zero progress
+                    raise ConnectionError(
+                        "fastlane: ring wedged (no consumer progress)")
+
+    def poll_completions(self) -> None:
+        """Drain published completions into the local map (batched:
+        one native call covers many seqs)."""
+        while self._done_cursor < self.ring.headc:
+            got = self.ring.completions(self._done_cursor)
+            if not got:
+                break
+            for c in got:
+                self._done[self._done_cursor] = (
+                    int(c.status), int(c.actual_us), int(c.t_done_ns))
+                self._done_cursor += 1
+
+    def try_result(self, seq: int):
+        """Non-blocking: (status, actual_us, t_done_ns) or None."""
+        if seq not in self._done:
+            self.poll_completions()
+        return self._done.pop(seq, None)
+
+    def wait_result(self, seq: int, timeout_s: float,
+                    alive_check=None):
+        """Block (native spin-then-nap, GIL released) until seq
+        completes; raises ConnectionError on timeout or when
+        ``alive_check`` says the broker died — the caller's normal
+        reconnect/degraded machinery takes over."""
+        res = self.try_result(seq)
+        if res is not None:
+            return res
+        # Not complete yet: push any staged submits out (the awaited
+        # seq may still be sitting in the producer batch) and wait.
+        if self._sub_items:
+            self.flush(alive_check)
+            res = self.try_result(seq)
+            if res is not None:
+                return res
+        deadline = time.monotonic() + max(timeout_s, 0.05)
+        spin = spin_us()
+        while True:
+            if self.ring.wait_headc(seq + 1, 0.05, spin):
+                res = self.try_result(seq)
+                if res is not None:
+                    return res
+                continue
+            if alive_check is not None and not alive_check():
+                raise ConnectionError(
+                    "fastlane: broker died with ring submits in "
+                    "flight")
+            if time.monotonic() >= deadline:
+                raise ConnectionError(
+                    f"fastlane: completion of seq {seq} timed out "
+                    f"after {timeout_s:.0f}s")
+
+
+class Drainer(threading.Thread):
+    """Per-chip fastlane drain loop: spins while lanes have work,
+    naps (VTPU_FASTLANE_SPIN_US native wait on the busiest lane's
+    tail) when idle — no scheduler wakes, no socket, no locks on the
+    empty path."""
+
+    def __init__(self, hub: FastlaneHub, chip):
+        super().__init__(daemon=True,
+                         name=f"vtpu-fastlane-{chip.index}")
+        self.hub = hub
+        self.chip = chip
+        # NOT named _stop: threading.Thread owns that name internally.
+        self._halt = threading.Event()
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=2.0)
+
+    def run(self) -> None:
+        spin = spin_us()
+        idle_streak = 0
+        while not self._halt.is_set():
+            self.hub.reap_dead(self.chip.index)
+            try:
+                done = self.hub.drain_once(self.chip)
+            except Exception as e:  # noqa: BLE001 - drainer must live
+                log.warn("fastlane drainer (chip %d): %s",
+                         self.chip.index, e)
+                done = 0
+                time.sleep(0.05)
+            if done:
+                idle_streak = 0
+                continue
+            idle_streak += 1
+            with self.hub.mu:
+                lanes = [ln for ln in self.hub.lanes.values()
+                         if ln.tenant.chip is self.chip
+                         and not ln.closed]
+            if not lanes:
+                self._halt.wait(0.05)
+                continue
+            # Native bounded wait on one ring's tail: wakes within the
+            # spin window of a submit, sleeps in 50µs naps otherwise.
+            lane = lanes[idle_streak % len(lanes)]
+            try:
+                lane.ring.wait_tail(lane.ring.headc + 1,
+                                    0.02, spin)
+            except (OSError, ValueError):
+                self._halt.wait(0.01)
+
+
+# ---------------------------------------------------------------------------
+# Smoke entry point (CI analyze job; also a handy local check)
+# ---------------------------------------------------------------------------
+
+def _smoke() -> int:
+    """End-to-end fastlane smoke on the CPU backend: a real broker +
+    client over a temp socket, lane negotiation (fd passing), ring
+    executes with value verification, arena PUT/GET byte integrity,
+    and the gate-forced brokered fallback.  Exit 0 on success."""
+    import tempfile
+
+    import numpy as np
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["VTPU_FASTLANE"] = "1"
+    from .client import RuntimeClient
+    from .server import make_server
+
+    tmp = tempfile.mkdtemp(prefix="fastlane-smoke-")
+    sock = os.path.join(tmp, "fl.sock")
+    srv = make_server(sock, hbm_limit=256 << 20, core_limit=50,
+                      region_path=os.path.join(tmp, "fl.shr"))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    c = RuntimeClient(sock, tenant="smoke-0")
+    try:
+        assert c._lane is not None, "lane not negotiated"
+        x = np.arange(256, dtype=np.float32)
+        c.put(x, "x0")                      # arena PUT
+        exe = c.compile(lambda a: a * 2.0 + 1.0, [x])
+        # One brokered step primes out_meta; then the ring.
+        c.execute_send_ids(exe.id, ["x0"], ["y0"])
+        assert c.recv_reply()["ok"]
+        for _ in range(200):
+            c.execute_send_ids(exe.id, ["x0"], ["y0"])
+        for _ in range(200):
+            assert c.recv_reply()["ok"]
+        got = c.get("y0")                   # arena GET
+        np.testing.assert_allclose(got, x * 2.0 + 1.0, rtol=1e-6)
+        st = c.stats()["smoke-0"].get("fastlane")
+        assert st and st["ring_steps"] >= 200, st
+        # Gate-forced fallback: flip the lane CLOSED broker-side.
+        # In-flight/racing ring descriptors surface as typed
+        # connection-loss errors ("never ran — resend"); after the
+        # first one the client re-checks the gate and every subsequent
+        # execute rides the brokered path.
+        srv.state.fastlane.gate_close("smoke-0")
+        served = 0
+        for _ in range(8):
+            try:
+                c.execute_send_ids(exe.id, ["x0"], ["y0"])
+                if c.recv_reply()["ok"]:
+                    served += 1
+            except Exception:  # noqa: BLE001 - canceled ring stragglers
+                pass
+        assert served >= 3, f"brokered fallback never engaged ({served})"
+        got = c.get("y0")
+        np.testing.assert_allclose(got, x * 2.0 + 1.0, rtol=1e-6)
+        print(f"fastlane smoke: OK (ring_steps={st['ring_steps']}, "
+              f"fallback after gate close verified)")
+        return 0
+    finally:
+        try:
+            c.close()
+        except Exception:  # noqa: BLE001
+            pass
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="end-to-end fastlane smoke (CPU broker)")
+    ns = ap.parse_args()
+    if ns.smoke:
+        sys.exit(_smoke())
+    ap.print_help()
+    sys.exit(2)

@@ -132,6 +132,18 @@ TRACE = "trace"          # {tenant?, limit?} -> {ok, enabled, tenants}
 # fairness report.
 SLO = "slo"              # {tenant?} -> {ok, enabled, tenants,
                          #  fairness?, matrix? (admin only)}
+# vtpu-fastlane (docs/PERF.md): prepare one execute ROUTE — a
+# (program, arg ids, out ids) triple resolved broker-side once — so
+# ring descriptors carry a single integer instead of id strings.  The
+# reply echoes the route index, the program's static output metadata
+# (shapes are static, so the client fabricates completion replies
+# locally) and a device-time cost hint for the client's region-atomics
+# rate burn.  ``route: -1`` means the program has not executed yet
+# (out_meta unknown): the client primes it with one brokered execute
+# and re-binds.  Only meaningful on a connection whose HELLO
+# negotiated a fastlane lane.
+FASTBIND = "fastbind"    # {exe, args, outs?} -> {ok, route, cost_us,
+                         #  outs?}
 
 # Admin verbs — served ONLY on the host-side admin socket
 # (<socket>.admin, never mounted into tenant containers: the tenant
@@ -178,7 +190,7 @@ RESIZE = "resize"        # {tenant, hbm_limit?|hbm_limits?, core_limit?}
 
 # Served on the tenant socket (mounted into containers).
 TENANT_VERBS = (HELLO, PUT_PART, PUT, GET, DELETE, COMPILE, EXECUTE,
-                EXEC_BATCH, STATS, TRACE, SLO)
+                EXEC_BATCH, STATS, TRACE, SLO, FASTBIND)
 # Served on the host-side admin socket (<socket>.admin, never mounted).
 ADMIN_VERBS = (STATS, TRACE, SLO, SUSPEND, RESUME, RESIZE, SHUTDOWN,
                DRAIN, HANDOVER)
@@ -205,8 +217,11 @@ BIND_FREE_VERBS = (STATS, TRACE, SLO)
 # per-connection staging died with the old socket).  RESIZE/SUSPEND/
 # RESUME set absolute state; DRAIN re-requested is already draining.
 # ---------------------------------------------------------------------------
+# FASTBIND is idempotent: re-binding the same (exe, args, outs) triple
+# yields a fresh route index with identical behavior — a duplicate
+# route entry is benign, a re-run never double-executes anything.
 IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, TRACE,
-                    SLO, SUSPEND, RESUME, RESIZE, DRAIN)
+                    SLO, SUSPEND, RESUME, RESIZE, DRAIN, FASTBIND)
 NONIDEMPOTENT_VERBS = (PUT_PART, EXECUTE, EXEC_BATCH, SHUTDOWN,
                        HANDOVER)
 
@@ -235,17 +250,21 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
         "optional": ("priority", "device", "devices", "hbm_limit",
                      "hbm_limits", "core_limit", "oversubscribe",
                      "spill_overshoot", "pid", "pidns", "resume_epoch",
-                     "slo_target_us", "slo_floor_steps", "trace"),
+                     "slo_target_us", "slo_floor_steps", "fastlane",
+                     "trace"),
     },
     PUT_PART: {"required": ("id", "data"), "optional": ("trace",)},
     PUT: {
         # ``data`` is required by the LEGACY framing (its branch may
         # subscript); ``nbytes`` is required whenever ``raw_parts``
-        # announced raw frames.
+        # announced raw frames OR ``arena_off`` named a fastlane
+        # shm-arena payload (no payload bytes on the socket at all).
         "required": ("id", "shape", "dtype", "data", "nbytes"),
-        "optional": ("staged", "raw_parts", "trace"),
+        "optional": ("staged", "raw_parts", "arena_off", "trace"),
     },
-    GET: {"required": ("id",), "optional": ("raw", "trace")},
+    GET: {"required": ("id",), "optional": ("raw", "arena", "trace")},
+    FASTBIND: {"required": ("exe", "args"),
+               "optional": ("outs", "trace")},
     DELETE: {"required": ("id",), "optional": ("ids", "trace")},
     COMPILE: {"required": ("id", "exported"), "optional": ("trace",)},
     EXECUTE: {
@@ -285,8 +304,12 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
 # simply lacks them.  ``lease``: the client-side rate-lease grant/
 # revoke rider on execute/EXEC_BATCH replies (docs/PERF.md);
 # ``retry_ms``: the backoff hint on OVERLOAD shed replies
-# (docs/SCHEDULING.md).
-REPLY_OPTIONAL_FIELDS = ("lease", "retry_ms")
+# (docs/SCHEDULING.md); ``fastlane``: the HELLO reply's negotiated
+# lane descriptor (ring/arena paths + slot; docs/PERF.md) — absent
+# from pre-fastlane brokers and from refusals; ``arena_off``: a GET
+# reply whose payload was written into the fastlane rx arena instead
+# of the socket.
+REPLY_OPTIONAL_FIELDS = ("lease", "retry_ms", "fastlane", "arena_off")
 
 
 class ProtocolError(RuntimeError):

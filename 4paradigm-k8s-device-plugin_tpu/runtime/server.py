@@ -127,6 +127,7 @@ from ..shim.core import SharedRegion
 from ..utils.dtypes import np_dtype as _np_dtype
 from ..utils import envspec
 from ..utils import logging as log
+from . import fastlane as fastlane_mod
 from . import faults
 from . import protocol as P
 from . import slo as slo_mod
@@ -374,6 +375,18 @@ class Tenant:
         # -- vtpu-elastic preemption / admission counters --
         self.preemptions = 0
         self.shed_total = 0
+        # -- vtpu-fastlane (docs/PERF.md) --
+        # Broker-side lane (ring + arenas + routes), None while this
+        # tenant rides the brokered path.  fastlane_depth is the ring's
+        # submitted-but-uncompleted count, published by the drainer so
+        # the preemption policy sees fastlane load exactly like queued
+        # brokered work (plain int write; advisory read).
+        self.fastlane = None
+        self.fastlane_depth = 0
+        # Array-table version: bumped by every mutation of
+        # arrays/host_arrays (PUT, DELETE, out-binds) — the fastlane
+        # drainer's resolved-args caches key on it.
+        self.arrays_ver = 0
 
     # -- chip-set accounting ------------------------------------------------
 
@@ -1151,7 +1164,7 @@ class DeviceScheduler:
                 del self.demand_since[name]
                 continue
             load = len(self.queues.get(name) or ()) \
-                + self.inflight.get(name, 0)
+                + self.inflight.get(name, 0) + t.fastlane_depth
             if load == 0 and now - t.last_active > cooldown_s:
                 del self.demand_since[name]
         # Un-park: preemptor's demand burst over, or max park time.
@@ -1186,7 +1199,8 @@ class DeviceScheduler:
             if pro is not None and pro[1] > now:
                 continue  # grace: not re-parkable yet
             q = self.queues.get(name)
-            load = (len(q) if q else 0) + self.inflight.get(name, 0)
+            load = (len(q) if q else 0) + self.inflight.get(name, 0) \
+                + t.fastlane_depth
             entries.append((name, t.priority,
                             self.demand_since.get(name, 0.0), load))
         pick = preempt_decision(entries, now)
@@ -1383,6 +1397,7 @@ class DeviceScheduler:
                                    else t.shard_charges(o), True)
                     metas.append({"id": oid, "shape": m["shape"],
                                   "dtype": m["dtype"]})
+                t.arrays_ver += 1
         except Exception as e:  # noqa: BLE001 - reply with error
             # Failed before reaching the device: credit the up-front
             # charge back and retire the item immediately.
@@ -2127,6 +2142,10 @@ class RuntimeState:
         # session's enqueue path; the elastic keeper feeds its SLO-burn
         # input.
         self.admission = AdmissionState()
+        # vtpu-fastlane (docs/PERF.md): the interposer-only data plane
+        # manager — per-tenant shm lanes, FASTBIND routes, per-chip
+        # drainer threads.  The broker stays the control plane.
+        self.fastlane = fastlane_mod.FastlaneHub(self)
         # Admin-suspended tenant names (reference suspend_all/resume_all
         # analogue, SURVEY §2.9d): their queues stop dispatching.  Set
         # only via the host-side admin socket; reads are racy-by-design
@@ -2800,6 +2819,11 @@ class RuntimeState:
             # reusing the name must not start silently frozen (the only
             # clue would be the admin-side STATS list).
             self.suspended.discard(t.name)
+        # The fastlane lane dies with the tenant (outside state.mu —
+        # lane close is file I/O): the gate flips CLOSED, ring/arena
+        # files unlink, zero region bytes leak (the array teardown
+        # below releases every charge exactly like the brokered path).
+        self.fastlane.close_lane(t.name)
         # The close record goes out AFTER state.mu is released (lock
         # discipline: journal file I/O never runs under fast locks) but
         # before this thread's _cleanup drops the arrays — replay order
@@ -3129,12 +3153,39 @@ class TenantSession(socketserver.BaseRequestHandler):
                     # the connection count this HELLO took.
                     tenant_box[0] = tenant
                     self._journal_bind(tenant, msg)
-                    self._send({"ok": True, "tenant_index": tenant.index,
-                                "chip": tenant.chip.index,
-                                "chips": [c.index for c in tenant.chips],
-                                "epoch": self.state.epoch,
-                                "created": created,
-                                "resumed": resumed})
+                    # vtpu-fastlane negotiation (docs/PERF.md): build
+                    # the shm lane when the client asked and the tenant
+                    # shape allows (single chip, single container);
+                    # a SECOND container joining a laned tenant forces
+                    # the first one back onto the brokered path (the
+                    # ring is strictly SPSC).
+                    fl_reply = fl_fds = None
+                    if tenant.connections > 1:
+                        self.state.fastlane.gate_close(tenant.name)
+                    elif msg.get("fastlane"):
+                        fl = self.state.fastlane.create_lane(tenant)
+                        if fl is not None:
+                            fl_reply, fl_fds = fl
+                    rep = {"ok": True, "tenant_index": tenant.index,
+                           "chip": tenant.chip.index,
+                           "chips": [c.index for c in tenant.chips],
+                           "epoch": self.state.epoch,
+                           "created": created,
+                           "resumed": resumed}
+                    if fl_reply is not None:
+                        # The arena fds ride the UDS ONCE, as
+                        # SCM_RIGHTS on a one-byte message right after
+                        # this reply (path fallback stays in the
+                        # descriptor for fd-less transports).
+                        fl_reply["fds"] = hasattr(socket, "send_fds")
+                        rep["fastlane"] = fl_reply
+                    self._send(rep)
+                    if fl_reply is not None and fl_reply["fds"]:
+                        try:
+                            with self.send_mu:
+                                socket.send_fds(sock, [b"F"], fl_fds)
+                        except OSError:
+                            pass
                     continue
                 if kind == P.STATS and tenant is None:
                     # BIND-FREE probe (ADVICE r5 #2): answers without a
@@ -3146,7 +3197,9 @@ class TenantSession(socketserver.BaseRequestHandler):
                                 "journal": self.state.journal_stats(),
                                 "pool": dict(self.state.pool_stats),
                                 "admission":
-                                    self.state.admission_stats()})
+                                    self.state.admission_stats(),
+                                "fastlane":
+                                    self.state.fastlane.stats()})
                     continue
                 if kind == P.TRACE:
                     # BIND-FREE like STATS (same no-chip-claim
@@ -3231,7 +3284,26 @@ class TenantSession(socketserver.BaseRequestHandler):
                     pool_buf = None
                     pool_adopted = False
                     raw_parts = int(msg.get("raw_parts", 0) or 0)
-                    if raw_parts:
+                    arena_off = msg.get("arena_off")
+                    fl_lane = tenant.fastlane
+                    if arena_off is not None and fl_lane is not None:
+                        # vtpu-fastlane shm-arena PUT (docs/PERF.md):
+                        # the payload bytes never crossed the socket —
+                        # the header names an offset/length in the tx
+                        # arena whose fd crossed once at HELLO.  Copied
+                        # out immediately: the client reuses the arena
+                        # the moment this ack lands, so the device
+                        # array must never alias it.
+                        want = int(msg["nbytes"])
+                        tx = fl_lane.tx_view()
+                        if tx is None or int(arena_off) < 0 or \
+                                int(arena_off) + want > len(tx):
+                            raise P.ProtocolError(
+                                f"arena PUT [{arena_off}, +{want}) "
+                                f"out of bounds")
+                        buf = bytes(tx[int(arena_off):
+                                       int(arena_off) + want])
+                    elif raw_parts:
                         # Zero-copy framing: the header announced
                         # raw_parts length-prefixed runs of naked
                         # tensor bytes — recv_into a pooled buffer at
@@ -3310,6 +3382,7 @@ class TenantSession(socketserver.BaseRequestHandler):
                             tenant.host_arrays[aid] = np.array(arr)
                             tenant.host_bytes += nbytes
                             tenant.nbytes[aid] = 0
+                            tenant.arrays_ver += 1
                     else:
                         dedup_key = None
                         dev_arr = None
@@ -3367,6 +3440,7 @@ class TenantSession(socketserver.BaseRequestHandler):
                         with tenant.mu:
                             tenant.arrays[aid] = dev_arr
                             tenant.nbytes[aid] = nbytes
+                            tenant.arrays_ver += 1
                             # PUT lands whole on the primary chip; the
                             # admission above already debited it.
                             tenant.charges[aid] = [(0, nbytes)]
@@ -3409,7 +3483,32 @@ class TenantSession(socketserver.BaseRequestHandler):
                         self._send_err("NOT_FOUND", aid)
                         continue
                     nbytes = int(host.nbytes)
-                    if msg.get("raw"):
+                    sent_arena = False
+                    if msg.get("arena"):
+                        # vtpu-fastlane shm-arena GET (docs/PERF.md):
+                        # one copy into the rx arena, a tiny header on
+                        # the socket, zero payload bytes on the wire.
+                        # Falls through to the raw/legacy framing when
+                        # the lane is gone or the tensor outgrows the
+                        # arena.
+                        fl_lane = tenant.fastlane
+                        rx = fl_lane.rx_view() \
+                            if fl_lane is not None else None
+                        if rx is not None and nbytes <= len(rx):
+                            if not host.flags["C_CONTIGUOUS"]:
+                                host = np.ascontiguousarray(host)
+                            flat = host.reshape(-1).view(np.uint8)
+                            np.frombuffer(rx, dtype=np.uint8,
+                                          count=nbytes)[:] = flat
+                            self._send({"ok": True,
+                                        "shape": list(host.shape),
+                                        "dtype": host.dtype.name,
+                                        "nbytes": nbytes,
+                                        "arena_off": 0})
+                            sent_arena = True
+                    if sent_arena:
+                        pass
+                    elif msg.get("raw"):
                         # Zero-copy reply (docs/PERF.md): header + every
                         # payload segment leave in ONE gather write,
                         # with the iovecs pointing straight into the
@@ -3455,6 +3554,15 @@ class TenantSession(socketserver.BaseRequestHandler):
                                 for a in ids)
                     self._send({"ok": True, "freed": freed})
 
+                elif kind == P.FASTBIND:
+                    # vtpu-fastlane route preparation (docs/PERF.md):
+                    # resolve (program, arg ids, out ids) once so ring
+                    # descriptors carry a single integer.
+                    self._send(self.state.fastlane.bind_route(
+                        tenant, str(msg["exe"]),
+                        [str(a) for a in msg["args"]],
+                        [str(o) for o in (msg.get("outs") or ())]))
+
                 elif kind == P.COMPILE:
                     blob = bytes(msg["exported"])
                     prog = self.state.cached_blob(blob)
@@ -3485,7 +3593,9 @@ class TenantSession(socketserver.BaseRequestHandler):
                                 "journal": self.state.journal_stats(),
                                 "pool": dict(self.state.pool_stats),
                                 "admission":
-                                    self.state.admission_stats()})
+                                    self.state.admission_stats(),
+                                "fastlane":
+                                    self.state.fastlane.stats()})
 
                 else:
                     self._send_err("BAD_KIND", str(kind))
@@ -3503,12 +3613,14 @@ class TenantSession(socketserver.BaseRequestHandler):
             t.drop_staged(aid)  # resident staged copy goes with it
             t.nbytes.pop(aid, None)
             t.host_bytes -= int(arr.nbytes)
+            t.arrays_ver += 1
             self._journal_drop(t, aid)
             return int(arr.nbytes)
         if aid in t.arrays:
             nbytes = t.nbytes.pop(aid, 0)
             del t.arrays[aid]
             t.release_array(aid, default_nbytes=nbytes)
+            t.arrays_ver += 1
             self._journal_drop(t, aid)
             return nbytes
         return 0
@@ -3635,6 +3747,10 @@ class TenantSession(socketserver.BaseRequestHandler):
             return
         self._reserve_pending(1)
         t.chip.scheduler.submit(item)
+        # Operator visibility: a brokered execute while a fastlane
+        # lane exists is a FALLBACK step (chained work, park, mixed
+        # pipelines) — `vtpu-smi top` shows which plane a tenant is on.
+        self.state.fastlane.note_fallback(t, 1)
 
     @staticmethod
     def _overload_result(t: Tenant, retry_ms: int) -> dict:
@@ -3697,6 +3813,7 @@ class TenantSession(socketserver.BaseRequestHandler):
             # ONE scheduler-lock acquisition + at most one wake for the
             # whole batch (docs/PERF.md).
             t.chip.scheduler.submit_many(items)
+            self.state.fastlane.note_fallback(t, len(items))
         elif done:
             # Every item failed validation: no scheduler involvement —
             # drain first so this reply cannot overtake in-flight
@@ -3832,6 +3949,12 @@ def collect_stats(state: RuntimeState):
             "preemptions": int(t.preemptions),
             "shed_total": int(t.shed_total),
         }
+        # vtpu-fastlane lane counters (ring depth, ring-admitted vs
+        # brokered-fallback steps, shm-arena bytes, gate state) — what
+        # tells an operator which data plane this tenant is on.
+        fl = state.fastlane.tenant_stats(name)
+        if fl is not None:
+            out[name]["fastlane"] = fl
         # Flight-recorder rollup (latency histogram, queue/bucket wait
         # totals): rides on STATS so the metrics server gets per-tenant
         # latency gauges from its existing admin scrape.
@@ -4046,7 +4169,9 @@ class AdminSession(socketserver.BaseRequestHandler):
                                 "journal": self.state.journal_stats(),
                                 "pool": dict(self.state.pool_stats),
                                 "admission":
-                                    self.state.admission_stats()})
+                                    self.state.admission_stats(),
+                                "fastlane":
+                                    self.state.fastlane.stats()})
                 elif kind == P.TRACE:
                     # Host-side flight-recorder read (vtpu-smi trace):
                     # same body as the tenant-socket verb.
@@ -4109,6 +4234,9 @@ class _Server(socketserver.ThreadingUnixStreamServer):
         st = getattr(self, "state", None)
         if st is not None:
             st._keeper_stop.set()  # noqa: SLF001 - lifecycle owner
+            # Fastlane drainers + lanes die with the server: gates flip
+            # CLOSED so laned clients fall back / reconnect cleanly.
+            st.fastlane.stop()
             # Clean lease release: only removes a sidecar THIS process
             # wrote, so a co-claimer's forensics stay intact.
             tracing.clear_lease_sidecar()

@@ -78,6 +78,38 @@ TEV_MEM_STALL = 2   # mem_acquire refused: value=bytes, arg=limit
 TEV_DISPATCH = 3
 TEV_USER = 16
 
+
+class ExecDesc(ctypes.Structure):
+    """Mirror of native ExecDesc (vtpu_core.h) — one vtpu-fastlane
+    execute descriptor; drift-checked like DeviceStats (the `mirror:`
+    row in the vtpu_core.h ground-truth block)."""
+
+    _fields_ = [
+        ("eseq", ctypes.c_uint64),
+        ("route", ctypes.c_uint64),
+        ("arg_off", ctypes.c_uint64),
+        ("arg_len", ctypes.c_uint64),
+        ("cost_us", ctypes.c_uint64),
+        ("t_sub_ns", ctypes.c_uint64),
+        ("eflags", ctypes.c_uint64),
+        ("status", ctypes.c_int64),
+        ("actual_us", ctypes.c_uint64),
+        ("t_done_ns", ctypes.c_uint64),
+    ]
+
+
+# ExecDesc.status values (vtpu_core.h VTPU_EXEC_*).
+EXEC_OK = 0
+EXEC_ENOTFOUND = -1
+EXEC_EINTERNAL = -2
+EXEC_ECANCELED = -3
+
+# ExecRing gate word (vtpu_core.h VTPU_EXEC_GATE_*): non-zero tells the
+# producer to fall back to the brokered socket path.
+GATE_OPEN = 0
+GATE_PARKED = 1
+GATE_CLOSED = 2
+
 TEV_NAMES = {TEV_RATE_WAIT: "rate_wait", TEV_MEM_STALL: "mem_stall",
              TEV_DISPATCH: "dispatch"}
 
@@ -174,6 +206,63 @@ def load() -> ctypes.CDLL:
         lib._vtpu_has_trace = True
     except AttributeError:
         lib._vtpu_has_trace = False
+    # -- vtpu-fastlane execute ring --
+    # Same upgrade-skew contract as the trace symbols: an old mounted
+    # libvtpucore.so degrades to fastlane-unavailable (the client stays
+    # on the brokered path), never breaks enforcement.
+    try:
+        lib.vtpu_exec_open.restype = ctypes.c_void_p
+        lib.vtpu_exec_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.vtpu_exec_close.argtypes = [ctypes.c_void_p]
+        lib.vtpu_exec_submit.restype = ctypes.c_int
+        lib.vtpu_exec_submit.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ExecDesc)]
+        lib.vtpu_exec_submit_batch.restype = ctypes.c_int
+        lib.vtpu_exec_submit_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ExecDesc), ctypes.c_int]
+        lib.vtpu_exec_take.restype = ctypes.c_int
+        lib.vtpu_exec_take.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ExecDesc),
+                                       ctypes.c_int]
+        lib.vtpu_exec_complete.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.c_int]
+        lib.vtpu_exec_completions.restype = ctypes.c_int
+        lib.vtpu_exec_completions.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ExecDesc),
+            ctypes.c_int]
+        lib.vtpu_exec_tail.restype = ctypes.c_uint64
+        lib.vtpu_exec_tail.argtypes = [ctypes.c_void_p]
+        lib.vtpu_exec_headc.restype = ctypes.c_uint64
+        lib.vtpu_exec_headc.argtypes = [ctypes.c_void_p]
+        lib.vtpu_exec_capacity.restype = ctypes.c_uint32
+        lib.vtpu_exec_capacity.argtypes = [ctypes.c_void_p]
+        lib.vtpu_exec_credits.restype = ctypes.c_int64
+        lib.vtpu_exec_credits.argtypes = [ctypes.c_void_p]
+        lib.vtpu_exec_wait_headc.restype = ctypes.c_int
+        lib.vtpu_exec_wait_headc.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64]
+        lib.vtpu_exec_wait_tail.restype = ctypes.c_int
+        lib.vtpu_exec_wait_tail.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64]
+        lib.vtpu_exec_gate_set.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint32]
+        lib.vtpu_exec_gate.restype = ctypes.c_uint32
+        lib.vtpu_exec_gate.argtypes = [ctypes.c_void_p]
+        lib.vtpu_exec_credit_mint.restype = ctypes.c_int
+        lib.vtpu_exec_credit_mint.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.vtpu_exec_credit_spend.restype = ctypes.c_int
+        lib.vtpu_exec_credit_spend.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int64]
+        lib.vtpu_exec_credit_level.restype = ctypes.c_int64
+        lib.vtpu_exec_credit_level.argtypes = [ctypes.c_void_p]
+        lib._vtpu_has_exec = True
+    except AttributeError:
+        lib._vtpu_has_exec = False
     lib.vtpu_region_active_procs.restype = ctypes.c_int
     lib.vtpu_region_active_procs.argtypes = [ctypes.c_void_p]
     lib.vtpu_core_version.restype = ctypes.c_char_p
@@ -215,6 +304,42 @@ def _load_fast() -> Optional[ctypes.PyDLL]:
                                       ctypes.c_int64]
     fast.vtpu_busy_add.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                    ctypes.c_uint64]
+    # vtpu-fastlane ring hot ops: submit/take/complete/completions
+    # never block (the wait helpers stay on the GIL-releasing CDLL),
+    # and the handle-local mutexes they take are uncontended
+    # nanosecond-scale sections — the PyDLL round-trip saving is the
+    # same sub-µs win the accounting atomics get.
+    try:
+        fast.vtpu_exec_submit.restype = ctypes.c_int
+        fast.vtpu_exec_submit.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ExecDesc)]
+        fast.vtpu_exec_take.restype = ctypes.c_int
+        fast.vtpu_exec_take.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ExecDesc),
+                                        ctypes.c_int]
+        fast.vtpu_exec_complete.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.c_int]
+        fast.vtpu_exec_completions.restype = ctypes.c_int
+        fast.vtpu_exec_completions.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ExecDesc),
+            ctypes.c_int]
+        fast.vtpu_exec_tail.restype = ctypes.c_uint64
+        fast.vtpu_exec_tail.argtypes = [ctypes.c_void_p]
+        fast.vtpu_exec_headc.restype = ctypes.c_uint64
+        fast.vtpu_exec_headc.argtypes = [ctypes.c_void_p]
+        fast.vtpu_exec_credits.restype = ctypes.c_int64
+        fast.vtpu_exec_credits.argtypes = [ctypes.c_void_p]
+        fast.vtpu_exec_gate.restype = ctypes.c_uint32
+        fast.vtpu_exec_gate.argtypes = [ctypes.c_void_p]
+        fast.vtpu_exec_credit_spend.restype = ctypes.c_int
+        fast.vtpu_exec_credit_spend.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int64]
+        fast.vtpu_exec_credit_level.restype = ctypes.c_int64
+        fast.vtpu_exec_credit_level.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        pass
     return fast
 
 
@@ -451,6 +576,185 @@ class RateLease:
         if left > 0:
             self.refunds += 1
             self.region.rate_adjust(self.dev, -left)
+
+
+class ExecRing:
+    """vtpu-fastlane SPSC execute ring (native/vtpucore): one producer
+    (the tenant client/interposer), one consumer (the broker's fastlane
+    drainer), a credit admission gate, a broker-published fallback gate
+    and the burst-credit bank words — all over the exact memory orders
+    the vtpu_core.h ground-truth block declares.  Ring files live next
+    to the accounting region (``<region>.lane<slot>.ring``)."""
+
+    def __init__(self, path: str, entries: int = 0):
+        self.lib = load()
+        if not getattr(self.lib, "_vtpu_has_exec", False):
+            raise OSError(
+                "libvtpucore.so predates vtpu-fastlane (no vtpu_exec_* "
+                "symbols); redeploy the matching daemonset")
+        self.handle = self.lib.vtpu_exec_open(path.encode(),
+                                              int(entries))
+        if not self.handle:
+            raise OSError(f"vtpu_exec_open({path!r}) failed")
+        self.path = path
+        fast = getattr(self.lib, "_vtpu_fast", None)
+        if fast is None or not hasattr(fast, "vtpu_exec_submit"):
+            fast = self.lib
+        self._c_submit = fast.vtpu_exec_submit
+        self._c_take = fast.vtpu_exec_take
+        self._c_complete = fast.vtpu_exec_complete
+        self._c_completions = fast.vtpu_exec_completions
+        self._c_tail = fast.vtpu_exec_tail
+        self._c_headc = fast.vtpu_exec_headc
+        self._c_credits = fast.vtpu_exec_credits
+        self._c_gate = fast.vtpu_exec_gate
+        self._c_credit_spend = fast.vtpu_exec_credit_spend
+        self._c_credit_level = fast.vtpu_exec_credit_level
+        # Reused scratch buffers (take/completions are hot-path calls;
+        # per-call ctypes array construction would dominate).
+        self._buf_n = 256
+        self._buf = (ExecDesc * self._buf_n)()
+        self._st = (ctypes.c_int64 * self._buf_n)()
+        self._ac = (ctypes.c_uint64 * self._buf_n)()
+        # numpy views over the scratch (vtpu-fastlane bulk paths: one
+        # vectorized pass instead of per-descriptor ctypes attribute
+        # walks).  Lazy import: shim.core itself must stay numpy-free
+        # for minimal consumers.
+        try:
+            import numpy as _np
+            self._buf_np = _np.frombuffer(
+                self._buf, dtype=_np.uint64).reshape(self._buf_n, 10)
+            self._st_np = _np.frombuffer(self._st, dtype=_np.int64)
+            self._ac_np = _np.frombuffer(self._ac, dtype=_np.uint64)
+        except ImportError:
+            self._buf_np = self._st_np = self._ac_np = None
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.vtpu_exec_close(self.handle)
+            self.handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- producer ----------------------------------------------------------
+
+    def submit(self, desc: ExecDesc) -> bool:
+        """Publish one descriptor; False = credit/slot gate refused
+        (back-pressure: drain completions, retry)."""
+        return self._c_submit(self.handle, ctypes.byref(desc)) == 0
+
+    def completions(self, from_seq: int, max_n: int = 0):
+        """Completed descriptors [from_seq, headc), up to max_n — the
+        returned list aliases an internal scratch buffer, consume it
+        before the next call."""
+        n = min(max_n or self._buf_n, self._buf_n)
+        got = self._c_completions(self.handle, int(from_seq),
+                                  self._buf, n)
+        return [self._buf[i] for i in range(max(got, 0))]
+
+    def wait_headc(self, seq: int, timeout_s: float,
+                   spin_us: int = 100) -> bool:
+        return self.lib.vtpu_exec_wait_headc(
+            self.handle, int(seq), int(max(timeout_s, 0.0) * 1e9),
+            int(spin_us) * 1000) == 1
+
+    # -- consumer ----------------------------------------------------------
+
+    def take(self, max_n: int = 0):
+        """Peek up to max_n submitted-but-untaken descriptors (headc
+        does NOT advance until complete()); aliases scratch."""
+        n = min(max_n or self._buf_n, self._buf_n)
+        got = self._c_take(self.handle, self._buf, n)
+        return [self._buf[i] for i in range(max(got, 0))]
+
+    def take_np(self, max_n: int = 0):
+        """Bulk peek: (count, uint64 ndarray view [count, 10] over the
+        scratch — columns are the ExecDesc fields in declaration
+        order).  Valid until the next take; None view when numpy is
+        unavailable."""
+        if self._buf_np is None:
+            return 0, None
+        n = min(max_n or self._buf_n, self._buf_n)
+        got = self._c_take(self.handle, self._buf, n)
+        if got <= 0:
+            return 0, None
+        return got, self._buf_np[:got]
+
+    def submit_batch(self, descs, n: int) -> int:
+        """Publish up to n descriptors from a ctypes ExecDesc array in
+        ONE native call; returns the count admitted (stops at the
+        first credit/slot refusal)."""
+        return int(self.lib.vtpu_exec_submit_batch(
+            self.handle, descs, int(n)))
+
+    def complete_np(self, st_np, ac_np, t_done_ns: int, n: int) -> None:
+        """Vectorized complete: caller filled the first n entries of
+        the scratch status/actual views (``scratch_views``)."""
+        self._c_complete(self.handle, self._st, self._ac,
+                         int(t_done_ns), int(n))
+
+    def scratch_views(self):
+        """(status int64 view, actual uint64 view) for complete_np."""
+        return self._st_np, self._ac_np
+
+    def complete(self, statuses, actuals, t_done_ns: int) -> None:
+        """Complete the n oldest taken descriptors (publishes headc
+        once, returns the credits with one RMW)."""
+        n = min(len(statuses), self._buf_n)
+        for i in range(n):
+            self._st[i] = int(statuses[i])
+            self._ac[i] = int(actuals[i])
+        self._c_complete(self.handle, self._st, self._ac,
+                         int(t_done_ns), n)
+
+    def wait_tail(self, seq: int, timeout_s: float,
+                  spin_us: int = 100) -> bool:
+        return self.lib.vtpu_exec_wait_tail(
+            self.handle, int(seq), int(max(timeout_s, 0.0) * 1e9),
+            int(spin_us) * 1000) == 1
+
+    # -- shared ------------------------------------------------------------
+
+    @property
+    def tail(self) -> int:
+        return int(self._c_tail(self.handle))
+
+    @property
+    def headc(self) -> int:
+        return int(self._c_headc(self.handle))
+
+    @property
+    def capacity(self) -> int:
+        return int(self.lib.vtpu_exec_capacity(self.handle))
+
+    @property
+    def credits(self) -> int:
+        return int(self._c_credits(self.handle))
+
+    @property
+    def depth(self) -> int:
+        """Submitted-but-uncompleted descriptors (ring depth)."""
+        return max(self.tail - self.headc, 0)
+
+    def gate(self) -> int:
+        return int(self._c_gate(self.handle))
+
+    def gate_set(self, v: int) -> None:
+        self.lib.vtpu_exec_gate_set(self.handle, int(v))
+
+    def credit_mint(self, us: int, cap_us: int) -> bool:
+        return self.lib.vtpu_exec_credit_mint(
+            self.handle, int(us), int(cap_us)) == 1
+
+    def credit_spend(self, us: int) -> bool:
+        return self._c_credit_spend(self.handle, int(us)) == 1
+
+    def credit_level(self) -> int:
+        return int(self._c_credit_level(self.handle))
 
 
 class TraceRing:

@@ -38,8 +38,22 @@ checker proves the code matches the declaration:
     total size), and the mirrored constants must agree — today that
     drift is a silent runtime corruption.
 
-``planned`` declarations (the ROADMAP item 2 exec ring) are parsed
-and recorded but exempt from code pairing: the spec leads the code.
+Beyond the original grammar, the vtpu-fastlane promotion added three
+directive kinds (the exec ring's ``planned`` rows made live):
+``rmw: <Struct.field> <order>`` fields admit ONLY read-modify-writes
+at exactly the declared order (observability loads must be acquire,
+plain stores are findings outside init); ``payload: <Struct.*>
+<order>`` fields admit only atomics at the declared order; and
+``ring <name>: tail=... headc=... credits=... helpers=... writer=...
+reader=... completer=...`` shape-checks the real producer/consumer
+functions — the writer must load the headc slot-reuse gate (acquire)
+BEFORE filling the payload and publish the tail (release) after it, the
+reader must consume the tail before copying, and the completer must
+fill the completion payload before the headc release publish and
+return the credit.  A skipped gate or a relaxed publish is a finding.
+
+``planned`` declarations are still parsed and recorded but exempt
+from code pairing: a future protocol's spec may lead its code.
 
 Stdlib-only (re + ctypes for authoritative mirror offsets); tests
 drive ``check_sources`` with seeded-violation fixture trees.
@@ -163,13 +177,32 @@ class SeqlockDecl:
 
 
 @dataclass
+class RingDecl:
+    """One ``ring <name>:`` declaration — the SPSC execute-ring shape
+    (vtpu-fastlane): named protocol fields, payload helpers and the
+    writer/reader/completer functions to shape-check."""
+
+    name: str
+    tail: str = ""        # Struct.field
+    headc: str = ""
+    credits: str = ""
+    helpers: Dict[str, str] = field(default_factory=dict)  # fn -> order
+    writer: str = ""
+    reader: str = ""
+    completer: str = ""
+
+
+@dataclass
 class GroundTruth:
     structs: List[str] = field(default_factory=list)
     # category per Struct.field ("mutex"|"lock"|"stable"|"crash-atomic"
-    # |"publish"|"seq"|"payload"); wildcards expanded later.
+    # |"publish"|"seq"|"payload"|"rmw"); wildcards expanded later.
     raw: Dict[str, List[str]] = field(default_factory=dict)
     publishes: Dict[str, Tuple[str, str]] = field(default_factory=dict)
     seqlocks: List[SeqlockDecl] = field(default_factory=list)
+    rmws: Dict[str, str] = field(default_factory=dict)      # spec -> order
+    payloads: Dict[str, str] = field(default_factory=dict)  # spec -> order
+    rings: List[RingDecl] = field(default_factory=list)
     init_writers: Set[str] = field(default_factory=set)
     locked_suffix: str = "_locked"
     mirrors: List[Tuple[str, str, str]] = field(default_factory=list)
@@ -179,8 +212,10 @@ class GroundTruth:
 
 _DIRECTIVE_RE = re.compile(
     r"^\s{1,4}(structs|mutex|lock|stable|crash-atomic|init-writers|"
-    r"locked-suffix|publish|seqlock\s+[\w-]+|mirror|mirror-const|"
+    r"locked-suffix|publish|rmw|payload|seqlock\s+[\w-]+|"
+    r"ring\s+[\w-]+|mirror|mirror-const|"
     r"planned\s+[\w-]+):\s*(.*)$")
+_ORDERED_FIELD_RE = re.compile(r"^(\S+)\s+(\w+)\s*$")
 _PUBLISH_RE = re.compile(
     r"^(\S+)\s+(\w+)\s*->\s*consume:\s*(\w+)\s*$")
 _MIRROR_RE = re.compile(r"^(\S+)\s*==\s*(\S+?):(\w+)\s*$")
@@ -222,6 +257,50 @@ def parse_ground_truth(header_src: str, path: str = HEADER
                 t.strip() for t in val.split(",") if t.strip())
         elif key == "locked-suffix":
             gt.locked_suffix = val.strip()
+        elif key == "rmw":
+            m = _ORDERED_FIELD_RE.match(val)
+            if not m or m.group(2) not in ORDERS:
+                findings.append(Finding(
+                    "atomics", path, ln,
+                    f"malformed rmw declaration: {val!r} (want "
+                    f"`<Struct.field> <order>`)"))
+                continue
+            gt.rmws[m.group(1)] = m.group(2)
+        elif key == "payload":
+            m = _ORDERED_FIELD_RE.match(val)
+            if not m or m.group(2) not in ORDERS:
+                findings.append(Finding(
+                    "atomics", path, ln,
+                    f"malformed payload declaration: {val!r} (want "
+                    f"`<Struct.field|Struct.*> <order>`)"))
+                continue
+            gt.payloads[m.group(1)] = m.group(2)
+        elif key.startswith("ring"):
+            decl = RingDecl(name=key.split(None, 1)[1])
+            for tok in re.finditer(r"(\w+)=([^=]+?)(?=\s+\w+=|$)", val):
+                k, v = tok.group(1), tok.group(2).strip()
+                if k in ("tail", "headc", "credits"):
+                    setattr(decl, k, v)
+                elif k == "helpers":
+                    for h in re.finditer(r"(\w+)\((\w+)\)", v):
+                        if h.group(2) not in ORDERS:
+                            findings.append(Finding(
+                                "atomics", path, ln,
+                                f"ring {decl.name}: helper "
+                                f"{h.group(1)} has unknown order "
+                                f"{h.group(2)!r}"))
+                        decl.helpers[h.group(1)] = h.group(2)
+                elif k in ("writer", "reader", "completer"):
+                    setattr(decl, k, v.split()[0])
+            if not (decl.tail and decl.headc and decl.credits
+                    and decl.helpers and decl.writer and decl.reader
+                    and decl.completer):
+                findings.append(Finding(
+                    "atomics", path, ln,
+                    f"ring {decl.name}: incomplete declaration (need "
+                    f"tail=, headc=, credits=, helpers=, writer=, "
+                    f"reader=, completer=)"))
+            gt.rings.append(decl)
         elif key == "publish":
             m = _PUBLISH_RE.match(val)
             if not m:
@@ -601,7 +680,11 @@ class _Checker:
         self.publish_by_field: Dict[str, Tuple[str, str]] = {}
         self.seq_fields: Set[str] = set()
         self.helper_names: Dict[str, str] = {}
-        # pairing evidence: field -> {"store": [...], "load": [...]}
+        # declared orders for rmw/payload fields (bare field name)
+        self.rmw_by_field: Dict[str, str] = {}
+        self.payload_by_field: Dict[str, str] = {}
+        # pairing evidence: field -> {"store": [...], "load": [...],
+        # "rmw": [...]}
         self.sites: Dict[str, Dict[str, List[str]]] = {}
 
     def finding(self, path: str, line: int, msg: str) -> None:
@@ -657,6 +740,19 @@ class _Checker:
         for fld, (sord, lord) in gt.publishes.items():
             add(fld, "publish")
             self.publish_by_field[fld.split(".", 1)[1]] = (sord, lord)
+        for fld, order in gt.rmws.items():
+            add(fld, "rmw")
+            self.rmw_by_field[fld.split(".", 1)[1]] = order
+        for fld, order in gt.payloads.items():
+            add(fld, "payload")
+            if "." in fld:
+                sname, fname = fld.split(".", 1)
+                names = ([f.name for f in self.structs.get(sname, ())]
+                         if fname == "*" else [fname])
+                for nm in names:
+                    self.payload_by_field[nm] = order
+        for rg in gt.rings:
+            self.helper_names.update(rg.helpers)
         for sl in gt.seqlocks:
             if sl.seq:
                 add(sl.seq, "seq")
@@ -725,7 +821,7 @@ class _Checker:
                         f"outside the declared init-writers "
                         f"({sorted(gt.init_writers)})")
                     continue
-                if cats & {"publish", "seq", "payload"}:
+                if cats & {"publish", "seq", "payload", "rmw"}:
                     self.finding(
                         fn.path, line,
                         f"{fn.name}: plain access to lock-free "
@@ -754,11 +850,45 @@ class _Checker:
         is_rmw = op.startswith(("fetch", "exchange", "compare", "add",
                                 "sub", "and", "or", "xor"))
         order = orders[0] if orders else ""
-        rec = self.sites.setdefault(fname, {"store": [], "load": []})
+        rec = self.sites.setdefault(fname, {"store": [], "load": [],
+                                            "rmw": []})
+        rec.setdefault("rmw", [])
         if is_store or is_rmw:
             rec["store"].append(order)
         if is_load or is_rmw:
             rec["load"].append(order)
+        if is_rmw:
+            rec["rmw"].append(order)
+        if "rmw" in cats and fname in self.rmw_by_field:
+            want = self.rmw_by_field[fname].upper()
+            if is_rmw and order != want:
+                self.finding(
+                    fn.path, line,
+                    f"{fn.name}: `{fname}` is a declared `rmw: ... "
+                    f"{want.lower()}` field but this RMW runs at "
+                    f"__ATOMIC_{order or '???'}")
+            elif is_load and not is_rmw and order != "ACQUIRE":
+                self.finding(
+                    fn.path, line,
+                    f"{fn.name}: observability load of rmw field "
+                    f"`{fname}` must be __ATOMIC_ACQUIRE (got "
+                    f"__ATOMIC_{order or '???'})")
+            elif is_store and not is_rmw:
+                self.finding(
+                    fn.path, line,
+                    f"{fn.name}: plain atomic STORE to rmw field "
+                    f"`{fname}` — only read-modify-writes at the "
+                    f"declared order may mutate it outside init")
+            return
+        if "payload" in cats and fname in self.payload_by_field:
+            want = self.payload_by_field[fname].upper()
+            if order != want:
+                self.finding(
+                    fn.path, line,
+                    f"{fn.name}: payload field `{fname}` accessed at "
+                    f"__ATOMIC_{order or '???'} but declared "
+                    f"`payload: ... {want.lower()}`")
+            return
         if "publish" in cats:
             want_store, want_load = self.publish_by_field[fname]
             if (is_store or is_rmw) and order != ORDERS[want_store] \
@@ -793,6 +923,144 @@ class _Checker:
                     path, 1,
                     f"declared `publish: {fld}` has no consume-side "
                     f"load site (declared `consume: {lord}`)")
+        for fld, order in self.gt.rmws.items():
+            fname = fld.split(".", 1)[1]
+            rec = self.sites.get(fname, {})
+            if not rec.get("rmw"):
+                self.finding(
+                    path, 1,
+                    f"declared `rmw: {fld} {order}` has no "
+                    f"read-modify-write site in the native sources "
+                    f"(pairing must hold in both directions)")
+
+    # -- exec-ring shape (vtpu-fastlane) -----------------------------------
+
+    def check_rings(self, funcs: Dict[str, CFunc]) -> None:
+        """The SPSC execute-ring writer/reader/completer must follow
+        the declared shape: the writer loads the headc slot-reuse gate
+        (acquire) BEFORE the payload helper and publishes the tail
+        after it; the reader consumes the tail before copying; the
+        completer fills the completion payload before the headc
+        release publish and returns the credit with an RMW.  A writer
+        that skips the headc gate overwrites unconsumed descriptors —
+        that is the seeded-violation class this check exists for."""
+        for rg in self.gt.rings:
+            if not (rg.tail and rg.headc and rg.credits and rg.writer
+                    and rg.reader and rg.completer):
+                continue
+            tail_f = rg.tail.split(".", 1)[1]
+            headc_f = rg.headc.split(".", 1)[1]
+            credits_f = rg.credits.split(".", 1)[1]
+            missing = [fn for fn in (rg.writer, rg.reader,
+                                     rg.completer)
+                       if fn not in funcs]
+            if missing:
+                self.findings.append(Finding(
+                    "atomics", HEADER, 1,
+                    f"ring {rg.name}: declared function(s) "
+                    f"{missing} not found in the native sources"))
+                continue
+
+            def idx(evs, kind, fld=None, first=True):
+                hits = [i for i, (k, f, _o) in enumerate(evs)
+                        if k == kind and (fld is None or f == fld)]
+                if not hits:
+                    return None
+                return hits[0] if first else hits[-1]
+
+            w = funcs[rg.writer]
+            evs = self._ring_events(w, tail_f, headc_f, credits_f,
+                                    rg.helpers)
+            helper_i = idx(evs, "helper")
+            gate_i = idx(evs, "load", headc_f)
+            pub_i = idx(evs, "store", tail_f, first=False)
+            if helper_i is None:
+                self.findings.append(Finding(
+                    "atomics", w.path, w.line,
+                    f"ring {rg.name}: writer {w.name} never fills the "
+                    f"payload through a declared helper"))
+            if gate_i is None or (helper_i is not None
+                                  and gate_i > helper_i):
+                self.findings.append(Finding(
+                    "atomics", w.path, w.line,
+                    f"ring {rg.name}: writer {w.name} SKIPS the "
+                    f"`{headc_f}` slot-reuse gate (an acquire load "
+                    f"before the payload fill) — a wrap can overwrite "
+                    f"a descriptor the consumer has not republished"))
+            if pub_i is None or (helper_i is not None
+                                 and pub_i < helper_i):
+                self.findings.append(Finding(
+                    "atomics", w.path, w.line,
+                    f"ring {rg.name}: writer {w.name} does not "
+                    f"publish `{tail_f}` after the payload fill"))
+            if idx(evs, "rmw", credits_f) is None:
+                self.findings.append(Finding(
+                    "atomics", w.path, w.line,
+                    f"ring {rg.name}: writer {w.name} skips the "
+                    f"`{credits_f}` admission gate RMW"))
+            r = funcs[rg.reader]
+            evs = self._ring_events(r, tail_f, headc_f, credits_f,
+                                    rg.helpers)
+            helper_i = idx(evs, "helper")
+            tail_i = idx(evs, "load", tail_f)
+            if helper_i is None or tail_i is None \
+                    or tail_i > helper_i:
+                self.findings.append(Finding(
+                    "atomics", r.path, r.line,
+                    f"ring {rg.name}: reader {r.name} must consume "
+                    f"`{tail_f}` (acquire) before copying the payload "
+                    f"through a declared helper"))
+            c = funcs[rg.completer]
+            evs = self._ring_events(c, tail_f, headc_f, credits_f,
+                                    rg.helpers)
+            helper_i = idx(evs, "helper")
+            pub_i = idx(evs, "store", headc_f, first=False)
+            if helper_i is None or pub_i is None \
+                    or pub_i < helper_i:
+                self.findings.append(Finding(
+                    "atomics", c.path, c.line,
+                    f"ring {rg.name}: completer {c.name} must fill "
+                    f"the completion payload BEFORE publishing "
+                    f"`{headc_f}` (the slot-reuse gate)"))
+            if idx(evs, "rmw", credits_f) is None:
+                self.findings.append(Finding(
+                    "atomics", c.path, c.line,
+                    f"ring {rg.name}: completer {c.name} never "
+                    f"returns the `{credits_f}` admission credit"))
+
+    def _ring_events(self, fn: CFunc, tail_f: str, headc_f: str,
+                     credits_f: str, helpers: Dict[str, str]
+                     ) -> List[Tuple[str, str, str]]:
+        """(kind, field, order) events of one ring function: atomic
+        ops on the three protocol fields, payload-helper calls and
+        fences, in statement order."""
+        events: List[Tuple[str, str, str]] = []
+        for _line, stmt in fn.statements:
+            if "__atomic_thread_fence" in stmt:
+                m = _ATOMIC_ORDER_RE.search(stmt)
+                events.append(("fence", "", m.group(1) if m else "?"))
+                continue
+            helper = next((h for h in helpers
+                           if re.search(rf"\b{h}\s*\(", stmt)), None)
+            if helper:
+                events.append(("helper", helper, helpers[helper]))
+                continue
+            if "__atomic_" not in stmt:
+                continue
+            for fld in (tail_f, headc_f, credits_f):
+                if not re.search(rf"(?:->|\.)\s*{fld}\b", stmt):
+                    continue
+                opm = _ATOMIC_OP_RE.search(stmt)
+                om = _ATOMIC_ORDER_RE.search(stmt)
+                op = opm.group(1) if opm else ""
+                if op.startswith("store"):
+                    kind = "store"
+                elif op.startswith("load"):
+                    kind = "load"
+                else:
+                    kind = "rmw"
+                events.append((kind, fld, om.group(1) if om else "?"))
+        return events
 
     # -- seqlock shape -----------------------------------------------------
 
@@ -1040,6 +1308,7 @@ def check_sources(native_sources: Dict[str, str], shim_src: str,
             checker.scan_function(fn)
     checker.check_pairing(HEADER)
     checker.check_seqlocks(funcs)
+    checker.check_rings(funcs)
     out = checker.findings
     for rel, src in sorted(stripped.items()):
         out.extend(banned_constructs(src, rel))

@@ -165,6 +165,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help=argparse.SUPPRESS)
     ap.add_argument("--core", type=int, default=0,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--child-fastlane", action="store_true",
+                    dest="fastlane", help=argparse.SUPPRESS)
     ns = ap.parse_args(argv)
 
     if ns.tenant_child:

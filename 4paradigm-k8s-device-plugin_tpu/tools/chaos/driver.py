@@ -172,6 +172,12 @@ class ChurnRun:
         # window), and the preemption counters' running max.
         self.parked_seen: List[float] = []
         self.max_preemptions = 0
+        # vtpu-fastlane churn coverage (docs/PERF.md): tenant 1 (when
+        # present) rides the interposer-only data plane; its lane's
+        # ring_steps counter is sampled live so the verdict can prove
+        # the ring was HOT at the kill and resumed after it.
+        self.fastlane_idx = 1 if sched.tenants > 1 else -1
+        self.fastlane_polls: List[Tuple[float, int]] = []
 
     # -- processes ---------------------------------------------------------
 
@@ -237,8 +243,18 @@ class ChurnRun:
                    "--child-priority",
                    str(self.sched.priorities[i]),
                    "--hbm", str(8 << 20), "--core", "50"]
+            tenv = env
+            if i == self.fastlane_idx:
+                # vtpu-fastlane under kill -9 (docs/PERF.md): tenant 1
+                # rides the interposer-only data plane; the crash must
+                # degrade exactly like degraded mode — fail closed,
+                # zero region leak, epoch resume builds a fresh lane
+                # and the ring makes progress again.
+                tenv = dict(env)
+                tenv["VTPU_FASTLANE"] = "1"
+                cmd.append("--child-fastlane")
             procs.append((subprocess.Popen(
-                cmd, cwd=REPO, env=env, stdout=subprocess.PIPE,
+                cmd, cwd=REPO, env=tenv, stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL, text=True), progress))
         return procs
 
@@ -273,6 +289,10 @@ class ChurnRun:
                 self.parked_seen.append(now)
             self.max_preemptions = max(
                 self.max_preemptions, int(st.get("preemptions", 0)))
+            if name.endswith(f"-{self.fastlane_idx}") \
+                    and st.get("fastlane"):
+                self.fastlane_polls.append(
+                    (now, int(st["fastlane"].get("ring_steps", 0))))
         self.polls.append({"t": now, "resp": resp})
         slo = _admin_slo(self.sock)
         if slo and slo.get("ok") and slo.get("enabled"):
@@ -408,6 +428,32 @@ class ChurnRun:
                 "[epoch-resume] some tenant never made progress after "
                 "the kill")
             result["recovery_ms"] = None
+        # vtpu-fastlane churn verdicts: the lane must have been HOT
+        # (ring-admitted steps observed) before the kill, and the
+        # respawned broker must serve a FRESH lane that progresses —
+        # killing the broker under fastlane load degrades exactly like
+        # degraded mode and the epoch resume drains/rebuilds the ring.
+        if self.fastlane_idx >= 0:
+            pre = [n for t, n in self.fastlane_polls if t <= t_kill]
+            post = [n for t, n in self.fastlane_polls
+                    if respawned_at is not None and t > respawned_at]
+            result["fastlane_pre_kill_ring_steps"] = max(pre, default=0)
+            result["fastlane_post_kill_ring_steps"] = max(post,
+                                                          default=0)
+            # The lane must have engaged at SOME point of the run (a
+            # loaded quick-mode host can pull the kill forward before
+            # the tenant's first route primes — the post-respawn lane
+            # then carries the proof); a run whose fastlane tenant
+            # NEVER admitted a ring step proves nothing.
+            if max(pre, default=0) <= 0 and max(post, default=0) <= 0 \
+                    and self.fastlane_polls:
+                self.violations.append(
+                    "[fastlane-churn] the fastlane tenant never "
+                    "admitted a ring step (pre or post kill)")
+            if post and max(post) <= 0 and max(pre, default=0) > 0:
+                self.violations.append(
+                    "[fastlane-churn] the respawned broker's fresh "
+                    "lane never admitted a ring step")
         # Throughput: aggregate across tenants, steady windows.
         pre_lo, pre_hi = t_kill - 2.0, t_kill - 0.1
         rec_edge = (max(rec_ts) if rec_ts else

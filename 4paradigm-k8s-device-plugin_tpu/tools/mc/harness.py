@@ -400,6 +400,13 @@ class Harness:
         # shed-precedence invariant judges the log).
         st.admission = S.AdmissionState()
         st.admission.shed_log = []
+        # vtpu-fastlane hub in MANUAL mode (no drainer threads — the
+        # fastlane scenario drives drain_once cooperatively over a
+        # PyRing) with the admission oracle armed for the ring
+        # park-gate invariant.
+        st.fastlane = S.fastlane_mod.FastlaneHub(st)
+        st.fastlane.manual = True
+        st.fastlane.admit_log = []
         st.suspended = set()
         st.blob_cache = collections.OrderedDict()
         st.chain_cache = collections.OrderedDict()

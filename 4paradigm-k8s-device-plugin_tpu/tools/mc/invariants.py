@@ -208,6 +208,23 @@ def _chk_shed_precedence(h: Any) -> List[str]:
     return out
 
 
+def _chk_fastlane_gate(h: Any) -> List[str]:
+    """No execute is admitted through a fastlane ring for a parked
+    (admin-suspended or auto-preempted) or released tenant: the
+    drainer's admit oracle records the park verdict taken under
+    scheduler.mu next to every batch it executed."""
+    hub = getattr(h.state, "fastlane", None)
+    log_ = getattr(hub, "admit_log", None) or []
+    out = []
+    for name, n, parked, closed in log_:
+        if n > 0 and (parked or closed):
+            out.append(
+                f"fastlane: {n} execute(s) admitted through tenant "
+                f"{name}'s ring while "
+                f"{'parked' if parked else 'released'}")
+    return out
+
+
 def _chk_lost_wake(h: Any) -> List[str]:
     out, h.lost_wakes = list(h.lost_wakes), []
     return out
@@ -347,6 +364,12 @@ INVARIANTS: Tuple[Invariant, ...] = (
         "overload shedding refuses lowest priority first; priority 0 "
         "is only ever shed at the hard backlog cap",
         _chk_shed_precedence),
+    Invariant(
+        "fastlane-park-gate", "interleave", "terminal",
+        "no execute is admitted through a fastlane ring for a parked "
+        "or released tenant (the ring honors SUSPEND/preemption/"
+        "teardown exactly like the brokered queues)",
+        _chk_fastlane_gate),
     Invariant(
         "no-lost-wake", "interleave", "step",
         "the dispatcher never idle-sleeps while dispatchable work is "

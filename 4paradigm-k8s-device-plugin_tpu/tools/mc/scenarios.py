@@ -359,6 +359,76 @@ def _setup_overload_shed(h: Harness, sched: mcsched.Scheduler) -> None:
     sched.spawn(lo, "clientD")
 
 
+def _setup_fastlane_gate(h: Harness, sched: mcsched.Scheduler) -> None:
+    """vtpu-fastlane park/RESIZE/release transitions: a tenant's shm
+    execute ring (PyRing stand-in, REAL FastlaneHub drain logic) is
+    driven through admin SUSPEND, RESUME and RESIZE while descriptors
+    sit in it, then the tenant is released and a straggler drain pass
+    runs.  The fastlane-park-gate invariant judges the hub's admit
+    oracle: no descriptor executes while the tenant is parked or after
+    the lane is released."""
+    from ...runtime import fastlane as FL
+    from ...runtime import protocol as P
+    sess = h.session()
+
+    def client() -> None:
+        t = h.tenant(sess, "A", core_limit=50)
+        prog = fake_program()
+        # FASTBIND needs the static out metadata a first brokered
+        # dispatch would have filled.
+        prog.out_meta = [{"shape": [16], "dtype": "float32",
+                          "nbytes": 64}]
+        t.executables["p"] = prog
+        hub = h.state.fastlane
+        ring = FL.PyRing(8)
+        lane = FL.BrokerLane(t, ring, None, None, {})
+        hub.lanes[t.name] = lane
+        t.fastlane = lane
+        rep = hub.bind_route(t, "p", [], ["o1"])
+        assert rep["ok"], rep
+        # Fill the ring FIRST (pre-debiting each estimate through the
+        # shared bucket exactly like ClientLane.admit — the drainer's
+        # completion-time correction refunds the unused remainder).
+        for _ in range(3):
+            t.chip.region.rate_acquire(t.index, 100, 1)
+            ring.submit(FL.PyDesc(route=0, cost_us=100, t_sub_ns=1))
+        # Deterministic park collision (the fastlane-park-ignored
+        # selfcheck seed must fire in the DEFAULT schedule, not only
+        # deep in the DFS): the client drives the REAL admin SUSPEND
+        # arm itself, then drains INTO the park with a loaded ring —
+        # the gate must admit nothing.
+        h.admin(_admin_frames(
+            {"kind": P.SUSPEND, "tenant": "A"},
+        )).handle()
+        hub.drain_once(t.chip)
+        # Operator RESUME + RESIZE through the real admin arm, then
+        # drain to empty; whatever a schedule leaves undrained is
+        # completed ECANCELED and refunded by close_lane at teardown
+        # (conservation balances without an unbounded spin).
+        h.admin(_admin_frames(
+            {"kind": P.RESUME, "tenant": "A"},
+            {"kind": P.RESIZE, "tenant": "A", "core_limit": 30},
+        )).handle()
+        for _ in range(3):
+            hub.drain_once(t.chip)
+        sess._drain()
+        _teardown(h, sess, t)
+        # Straggler pass after release: must admit nothing.
+        hub.drain_once(t.chip)
+
+    def admin() -> None:
+        # A concurrent operator racing its own SUSPEND/RESUME pair:
+        # the explorer interleaves it against the client's drains and
+        # the deterministic park above.
+        h.admin(_admin_frames(
+            {"kind": P.SUSPEND, "tenant": "A"},
+            {"kind": P.RESUME, "tenant": "A"},
+        )).handle()
+
+    sched.spawn(client, "clientA")
+    sched.spawn(admin, "admin")
+
+
 # ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
@@ -403,6 +473,10 @@ SCENARIOS: List[Scenario] = [
              "priority-1 batch shed at a tiny backlog cap; priority-0 "
              "admitted",
              _setup_overload_shed, with_journal=False),
+    Scenario("fastlane_gate",
+             "fastlane ring through SUSPEND/RESUME/RESIZE/release: no "
+             "ring admit for a parked or released tenant",
+             _setup_fastlane_gate, with_journal=False),
 ]
 
 

@@ -695,6 +695,11 @@ class patched_modules:
     TARGETS = {
         "vtpu.runtime.server": ("threading", "time", "queue"),
         "vtpu.runtime.journal": ("threading", "time"),
+        # vtpu-fastlane: the drain path stamps/mints off its module
+        # clock — real wall time here would branch the explored code
+        # paths nondeterministically across replays (mint thresholds,
+        # SLO dts) and trip the determinism oracle under load.
+        "vtpu.runtime.fastlane": ("threading", "time"),
     }
 
     def __init__(self, sched: "Scheduler | InertScheduler") -> None:

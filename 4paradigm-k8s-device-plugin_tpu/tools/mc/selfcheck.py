@@ -338,6 +338,32 @@ def _seed_corruption_swallowed() -> Iterator[None]:
         J.Journal._parse_lines = staticmethod(orig)
 
 
+@contextlib.contextmanager
+def _seed_fastlane_park_ignored() -> Iterator[None]:
+    """The fastlane drainer's park verdict is blinded: a suspended/
+    preempted tenant's ring keeps executing.  The admit oracle reads
+    ground truth independently, so the fastlane-park-gate row must
+    fire."""
+    from ...runtime import fastlane as FL
+    # Capture the staticmethod DESCRIPTOR (class __dict__), not the
+    # bound function: restoring a plain function would turn the
+    # attribute into an instance method and shift every later call by
+    # one argument.
+    orig_desc = FL.FastlaneHub.__dict__["_park_verdict"]
+    orig_fn = orig_desc.__func__
+
+    @staticmethod
+    def blind(state: Any, sched: Any, t: Any, now: float):
+        _parked, probation, contended = orig_fn(state, sched, t, now)
+        return False, probation, contended  # the park never bites
+
+    FL.FastlaneHub._park_verdict = blind
+    try:
+        yield
+    finally:
+        FL.FastlaneHub._park_verdict = orig_desc
+
+
 SEEDS: Tuple[Seed, ...] = (
     Seed("broken-lease-refund", "interleave", "token-conservation",
          "batch_pipeline", _seed_broken_refund),
@@ -357,6 +383,8 @@ SEEDS: Tuple[Seed, ...] = (
          "burst_credits", _seed_credit_mint_nothing),
     Seed("floor-violated-under-burst", "interleave", "floor-under-burst",
          "burst_floor", _seed_floor_violated),
+    Seed("fastlane-park-ignored", "interleave", "fastlane-park-gate",
+         "fastlane_gate", _seed_fastlane_park_ignored),
     Seed("shed-of-floor-demander", "interleave", "shed-precedence",
          "overload_shed", _seed_shed_floor_demander),
     Seed("skipped-replay-arm", "crash", "replay-ground-truth",

@@ -86,6 +86,7 @@ class MetricsState:
                     "tenants": resp.get("tenants", {}),
                     "suspended": resp.get("suspended", []),
                     "journal": resp.get("journal") or {},
+                    "fastlane": resp.get("fastlane") or {},
                     "slo": slo}
 
         if not self.brokers:
@@ -325,6 +326,27 @@ def broker_prometheus(brokers: List[Dict]) -> str:
         "# HELP vtpu_broker_draining 1 while the broker refuses new "
         "tenants for a handover.",
         "# TYPE vtpu_broker_draining gauge",
+        # vtpu-fastlane (docs/PERF.md): which data plane each tenant
+        # is on, how deep its execute ring runs, and the shm-arena
+        # footprint.
+        "# HELP vtpu_fastlane_ring_depth Submitted-but-uncompleted "
+        "descriptors in the tenant's fastlane execute ring.",
+        "# TYPE vtpu_fastlane_ring_depth gauge",
+        "# HELP vtpu_fastlane_ring_steps_total Executes admitted "
+        "through the fastlane ring per tenant.",
+        "# TYPE vtpu_fastlane_ring_steps_total counter",
+        "# HELP vtpu_fastlane_fallback_steps_total Brokered-fallback "
+        "executes while a fastlane lane existed, per tenant.",
+        "# TYPE vtpu_fastlane_fallback_steps_total counter",
+        "# HELP vtpu_fastlane_arena_bytes Total shm tensor-arena "
+        "bytes (tx+rx) mapped for the tenant's lane.",
+        "# TYPE vtpu_fastlane_arena_bytes gauge",
+        "# HELP vtpu_fastlane_gate Lane gate word (0 open, 1 parked, "
+        "2 closed).",
+        "# TYPE vtpu_fastlane_gate gauge",
+        "# HELP vtpu_broker_fastlane_lanes Active fastlane lanes on "
+        "the broker.",
+        "# TYPE vtpu_broker_fastlane_lanes gauge",
     ]
     for b in brokers:
         broker = _esc(os.path.basename(b["broker"]))
@@ -373,6 +395,19 @@ def broker_prometheus(brokers: List[Dict]) -> str:
             slo_rows = ((b.get("slo") or {}).get("tenants") or {})
             _emit_tenant_slo(lines, labels, name,
                              slo_rows.get(name))
+            fl = t.get("fastlane")
+            if fl:
+                lines.append(f'vtpu_fastlane_ring_depth{labels} '
+                             f'{fl.get("ring_depth", 0)}')
+                lines.append(f'vtpu_fastlane_ring_steps_total{labels} '
+                             f'{fl.get("ring_steps", 0)}')
+                lines.append(
+                    f'vtpu_fastlane_fallback_steps_total{labels} '
+                    f'{fl.get("fallback_steps", 0)}')
+                lines.append(f'vtpu_fastlane_arena_bytes{labels} '
+                             f'{fl.get("arena_bytes", 0)}')
+                lines.append(f'vtpu_fastlane_gate{labels} '
+                             f'{fl.get("gate", 0)}')
             tr = t.get("trace")
             if tr:
                 lines.append(
@@ -396,6 +431,11 @@ def broker_prometheus(brokers: List[Dict]) -> str:
             lines.append(f'vtpu_broker_fairness_jain'
                          f'{{broker="{broker}"}} '
                          f'{fair.get("jain", 1.0)}')
+        flb = b.get("fastlane") or {}
+        if flb:
+            lines.append(f'vtpu_broker_fastlane_lanes'
+                         f'{{broker="{broker}"}} '
+                         f'{flb.get("lanes", 0)}')
     return "\n".join(lines) + "\n" if brokers else ""
 
 

@@ -336,6 +336,10 @@ def _top_rows(slo_resp: dict, stats_resp: dict) -> List[Dict]:
             "preempted": st.get("preempted", False),
             "preemptions": st.get("preemptions", 0),
             "shed": st.get("shed_total", 0),
+            # vtpu-fastlane (docs/PERF.md): which data plane the
+            # tenant is on — ring-admitted vs brokered-fallback steps
+            # and the live ring depth.
+            "fastlane": st.get("fastlane"),
         })
     rows.sort(key=lambda r: -r["steps_per_s"])
     return rows
@@ -347,7 +351,7 @@ def render_top(rows: List[Dict], enabled: bool = True,
     hdr = (f"{'TENANT':<18} {'STEPS/S':>8} {'P50 E2E':>9} "
            f"{'P99 E2E':>9} {'P99 QUE':>9} {'P99 DEV':>9} "
            f"{'ATTAIN%':>8} {'BURN':>6} {'FAIR':>5} {'CREDIT':>8} "
-           f"{'SHED':>5} {'TOP BLAMER':<16}")
+           f"{'SHED':>5} {'PLANE':>6} {'TOP BLAMER':<16}")
     lines = ["vtpu-smi top — per-tenant SLO / fairness / blame"
              + (f"  (jain={jain})" if jain is not None else "")
              + ("" if enabled else "  [SLO PLANE DISABLED: VTPU_SLO=0]"),
@@ -361,13 +365,19 @@ def render_top(rows: List[Dict], enabled: bool = True,
         fair = (f"{r['fair_ratio']:.2f}" if r["fair_ratio"] is not None
                 else "-")
         credit = f"{r.get('credit_ms', 0):.0f}ms"
+        # Data plane: 'ring' when a fastlane lane exists and the
+        # gate is open ('held' while parked, 'sock' otherwise).
+        fl = r.get("fastlane")
+        plane = "sock"
+        if fl:
+            plane = "ring" if fl.get("gate", 2) == 0 else "held"
         lines.append(
             f"{r['tenant'][:17]:<17}{flag} {r['steps_per_s']:>8.1f} "
             f"{r['p50_e2e_us']:>9.0f} {r['p99_e2e_us']:>9.0f} "
             f"{r['p99_queue_us']:>9.0f} {r['p99_device_us']:>9.0f} "
             f"{r['attainment_pct']:>8.2f} {r['burn_rate']:>6.1f} "
             f"{fair:>5} {credit:>8} {r.get('shed', 0):>5} "
-            f"{(r['top_blamer'] or '-')[:16]:<16}")
+            f"{plane:>6} {(r['top_blamer'] or '-')[:16]:<16}")
     if not rows:
         lines.append("(no tenants with SLO history)")
     return "\n".join(lines)

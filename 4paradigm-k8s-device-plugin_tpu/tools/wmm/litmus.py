@@ -404,45 +404,58 @@ def make_degraded_quota(broken: str = "") -> Litmus:
 
 
 # ---------------------------------------------------------------------------
-# 6. PLANNED interposer-only shm execute ring (SPSC + credit gate)
+# 6. Interposer-only shm execute ring (SPSC + credit gate, vtpu-fastlane)
 # ---------------------------------------------------------------------------
 
 def make_exec_ring(broken: str = "") -> Litmus:
-    """The ROADMAP item 2 data plane, verified before it is built: a
-    capacity-2 SPSC descriptor ring.  Producer (the interposer) takes
-    one credit (CAS gate), writes the 2-word descriptor relaxed, then
-    publishes the new tail with a release store; consumer (the broker
-    drain loop) loads tail acquire, reads the descriptor, bumps head
-    release and returns the credit.  FIFO + no-torn-descriptor + the
-    gate never over-admitting must hold under every exploration — the
-    broken variant publishes tail relaxed, letting the consumer
-    execute a descriptor whose words were never made visible."""
+    """The vtpu-fastlane data plane — spec'd and verified here one PR
+    BEFORE ``vtpu_exec_submit``/``take``/``complete`` existed, now a
+    faithful miniature of the IMPLEMENTED writer/consumer shapes in
+    ``native/vtpucore/vtpu_core.cc`` (the static shape check in
+    tools/analyze/atomics.py proves the C follows the same event
+    order).  Producer: acq_rel fetch_sub credit gate (undo on refusal),
+    acquire load of headc (the slot-reuse gate), relaxed payload fill,
+    release tail publish.  Consumer: acquire tail, relaxed copy,
+    release headc publish, acq_rel credit return.  FIFO +
+    no-torn-descriptor + credit conservation must hold under every
+    exploration.  Broken variants: ``relaxed-tail`` publishes the tail
+    relaxed (the consumer can execute words never made visible);
+    ``skip-headc-gate`` drops the slot-reuse gate while the credit
+    counter is crash-torn one high — the wrap overwrites a descriptor
+    the consumer has not republished (exactly the bug class the gate
+    exists for)."""
     items, capacity = 3, 2
+    # The skip-gate variant models a crash-torn credit counter (one
+    # credit too many): with the gate present that is harmless — the
+    # gate refuses the early wrap — with it skipped, an unconsumed
+    # slot is overwritten.
+    init_credits = capacity + (1 if broken == "skip-headc-gate" else 0)
 
     def producer(out: Dict[str, Any]):
         produced = 0
         for i in range(items):
             got_credit = False
             for _ in range(6):  # bounded credit-gate spin
-                c = yield ("load", "credits", RLX)
-                if c <= 0:
-                    continue
-                ok = yield ("cas", "credits", c, c - 1, ACQ_REL)
-                if ok:
+                c = yield ("rmw", "credits", -1, ACQ_REL)
+                if c > 0:       # fetch_sub returns the OLD value
                     got_credit = True
                     break
+                yield ("rmw", "credits", 1, ACQ_REL)  # undo; refused
             if not got_credit:
                 break
-            ok_slot = False
-            for _ in range(6):  # bounded ring-full spin
-                h = yield ("load", "headc", ACQ)
-                if i - h < capacity:
-                    ok_slot = True
-                    break
+            if broken == "skip-headc-gate":
+                ok_slot = True
+            else:
+                ok_slot = False
+                for _ in range(6):  # bounded ring-full spin
+                    h = yield ("load", "headc", ACQ)
+                    if i - h < capacity:
+                        ok_slot = True
+                        break
             if not ok_slot:
-                # Abort: the gate credit goes back (the spec's abort
-                # path — a taken credit never strands).
-                yield ("rmw", "credits", 1, REL)
+                # Abort: the gate credit goes back (the implemented
+                # abort path — a taken credit never strands).
+                yield ("rmw", "credits", 1, ACQ_REL)
                 break
             s = i % capacity
             yield ("store", f"desc_a{s}", 200 + i, RLX)
@@ -470,7 +483,7 @@ def make_exec_ring(broken: str = "") -> Litmus:
             b = yield ("load", f"desc_b{s}", RLX)
             done.append((i, a, b))
             yield ("store", "headc", i + 1, REL)
-            yield ("rmw", "credits", 1, REL)
+            yield ("rmw", "credits", 1, ACQ_REL)
         out["done"] = done
 
     def check(ctx: WmmContext, out: Dict[str, Any],
@@ -488,22 +501,24 @@ def make_exec_ring(broken: str = "") -> Litmus:
                     "wmm-ring-fifo",
                     f"exec_ring: consumer EXECUTED descriptor {i} "
                     f"with words ({a},{b}) != ({want},{want}) — "
-                    f"unpublished/torn descriptor crossed the ring")
+                    f"unpublished/torn/overwritten descriptor crossed "
+                    f"the ring")
         inflight = out.get("produced", 0) - len(done)
-        if final["credits"] + inflight != capacity:
+        if final["credits"] + inflight != init_credits:
             ctx.report(
                 "wmm-ring-fifo",
                 f"exec_ring: credit gate leaked — {final['credits']} "
-                f"credits + {inflight} in flight != capacity "
-                f"{capacity}")
+                f"credits + {inflight} in flight != the seeded "
+                f"{init_credits}")
 
-    init = {"tail": 0, "headc": 0, "credits": capacity}
+    init = {"tail": 0, "headc": 0, "credits": init_credits}
     for s in range(capacity):
         init.update({f"desc_a{s}": 0, f"desc_b{s}": 0})
     return Litmus(
         "exec_ring",
-        "PLANNED interposer-only SPSC execute ring + credit gate "
-        "(ROADMAP item 2, pre-verified)",
+        "interposer-only SPSC execute ring + credit gate "
+        "(vtpu-fastlane; shape-matched to vtpu_exec_submit/take/"
+        "complete)",
         "exec-ring", init, (producer, consumer), check,
         ("wmm-ring-fifo", "wmm-no-torn-payload"))
 

@@ -73,8 +73,14 @@ SEEDS: Tuple[Seed, ...] = (
     Seed("exec-ring-relaxed-tail",
          lt.make_exec_ring(broken="relaxed-tail"),
          "wmm-ring-fifo",
-         "planned exec ring publishes tail relaxed: the consumer "
-         "executes a descriptor whose words were never published"),
+         "exec ring publishes tail relaxed: the consumer executes a "
+         "descriptor whose words were never published"),
+    Seed("exec-ring-skipped-headc-gate",
+         lt.make_exec_ring(broken="skip-headc-gate"),
+         "wmm-ring-fifo",
+         "producer skips the headc slot-reuse gate with a crash-torn "
+         "credit counter: the wrap overwrites a descriptor the "
+         "consumer has not republished"),
 )
 
 

@@ -237,6 +237,14 @@ ENV_FLAGS = {
     "VTPU_TRACE_RING_KB": ("trace", True),
     "VTPU_SLOW_OP_FACTOR": ("trace", True),
     "VTPU_LEASE_SIDECAR": ("trace", True),
+    # vtpu-fastlane (docs/PERF.md): the interposer-only data plane.
+    # VTPU_FASTLANE is role-sensitive: broker 0 = refuse lanes
+    # (default serve), client 1 = opt the tenant in (default off).
+    "VTPU_FASTLANE": ("shim", True),
+    "VTPU_FASTLANE_RING": ("broker", True),
+    "VTPU_FASTLANE_ARENA_MB": ("broker", True),
+    "VTPU_FASTLANE_SPIN_US": ("shim", True),
+    "VTPU_FASTLANE_BATCH": ("broker", False),
     # vtpu-wmm (docs/ANALYSIS.md "Weak memory model"): exploration
     # budgets of the weak-memory litmus engine.  Not operator-facing —
     # CI and developers tune them per run.

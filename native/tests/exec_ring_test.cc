@@ -1,0 +1,207 @@
+/* exec_ring_test — standalone test for the vtpu-fastlane SPSC execute
+ * ring (vtpu_exec_*): FIFO + payload integrity under a concurrent
+ * producer/consumer pair, credit-gate conservation, the headc
+ * slot-reuse gate, completion readback, gate word and the burst-credit
+ * bank words, plus a multi-writer-ATTEMPT stress proving the SPSC
+ * discipline holds when several threads (mis)use one producer handle
+ * concurrently (run under ASan+UBSan and TSan in CI).
+ *
+ * Usage: exec_ring_test <scratch-dir>
+ */
+#include <assert.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include "../vtpucore/vtpu_core.h"
+
+static char g_path[512];
+
+static void test_basic_fifo(void) {
+  char path[560];
+  snprintf(path, sizeof(path), "%s.basic", g_path);
+  unlink(path);
+  vtpu_exec_ring* p = vtpu_exec_open(path, 64);
+  vtpu_exec_ring* c = vtpu_exec_open(path, 64);
+  assert(p && c);
+  assert(vtpu_exec_capacity(p) == 64);
+  assert(vtpu_exec_credits(p) == 64);
+  /* Fill the ring: exactly capacity submits admit, the next refuses
+   * (credit gate), credits drop to zero. */
+  for (uint64_t i = 0; i < 64; i++) {
+    ExecDesc d;
+    memset(&d, 0, sizeof(d));
+    d.eseq = i;
+    d.route = 7;
+    d.cost_us = 100 + i;
+    d.t_sub_ns = 1000 + i;
+    assert(vtpu_exec_submit(p, &d) == 0);
+  }
+  ExecDesc over;
+  memset(&over, 0, sizeof(over));
+  assert(vtpu_exec_submit(p, &over) == -1);
+  assert(vtpu_exec_credits(p) == 0);
+  assert(vtpu_exec_tail(p) == 64);
+  /* Consumer: take a batch, complete it, credits return. */
+  ExecDesc batch[32];
+  int n = vtpu_exec_take(c, batch, 32);
+  assert(n == 32);
+  for (int i = 0; i < n; i++) {
+    assert(batch[i].eseq == (uint64_t)i);
+    assert(batch[i].route == 7);
+    assert(batch[i].cost_us == 100 + (uint64_t)i);
+  }
+  int64_t status[32];
+  uint64_t actual[32];
+  for (int i = 0; i < n; i++) {
+    status[i] = 0;
+    actual[i] = 55 + (uint64_t)i;
+  }
+  vtpu_exec_complete(c, status, actual, 999, n);
+  assert(vtpu_exec_headc(c) == 32);
+  assert(vtpu_exec_credits(c) == 32);
+  /* Producer reads the completions back. */
+  ExecDesc done[32];
+  int k = vtpu_exec_completions(p, 0, done, 32);
+  assert(k == 32);
+  for (int i = 0; i < k; i++) {
+    assert(done[i].status == 0);
+    assert(done[i].actual_us == 55 + (uint64_t)i);
+    assert(done[i].t_done_ns == 999);
+  }
+  /* Drain the rest; ring usable again. */
+  while ((n = vtpu_exec_take(c, batch, 32)) > 0)
+    vtpu_exec_complete(c, NULL, NULL, 1000, n);
+  assert(vtpu_exec_headc(c) == 64);
+  assert(vtpu_exec_credits(c) == 64);
+  vtpu_exec_close(p);
+  vtpu_exec_close(c);
+}
+
+static void test_gate_and_credit_bank(void) {
+  char path[560];
+  snprintf(path, sizeof(path), "%s.gate", g_path);
+  unlink(path);
+  vtpu_exec_ring* x = vtpu_exec_open(path, 0);
+  assert(x && vtpu_exec_capacity(x) == 1024);
+  assert(vtpu_exec_gate(x) == VTPU_EXEC_GATE_OPEN);
+  vtpu_exec_gate_set(x, VTPU_EXEC_GATE_PARKED);
+  assert(vtpu_exec_gate(x) == VTPU_EXEC_GATE_PARKED);
+  vtpu_exec_gate_set(x, VTPU_EXEC_GATE_OPEN);
+  /* Credit bank: capped mint, bounded spend, never negative. */
+  assert(vtpu_exec_credit_level(x) == 0);
+  assert(vtpu_exec_credit_spend(x, 1) == 0);
+  assert(vtpu_exec_credit_mint(x, 30, 50) == 1);
+  assert(vtpu_exec_credit_mint(x, 30, 50) == 1); /* clamped at cap */
+  assert(vtpu_exec_credit_level(x) == 50);
+  assert(vtpu_exec_credit_mint(x, 30, 50) == 0); /* already at cap */
+  assert(vtpu_exec_credit_spend(x, 20) == 1);
+  assert(vtpu_exec_credit_spend(x, 40) == 0); /* insufficient */
+  assert(vtpu_exec_credit_level(x) == 30);
+  vtpu_exec_close(x);
+}
+
+typedef struct {
+  vtpu_exec_ring* ring;
+  uint64_t items;
+  int writers;
+} StressArgs;
+
+static void* producer_main(void* arg) {
+  StressArgs* a = (StressArgs*)arg;
+  /* Each writer thread submits with a writer-tagged route; eseq is
+   * claimed under the handle's submit serialisation, so FIFO payload
+   * integrity must hold even though several threads ATTEMPT to write
+   * through the one SPSC producer handle concurrently. */
+  static uint64_t next_seq = 0; /* claimed under submit_mu via retry */
+  for (;;) {
+    uint64_t mine = __atomic_fetch_add(&next_seq, 1, __ATOMIC_ACQ_REL);
+    if (mine >= a->items) break;
+    ExecDesc d;
+    memset(&d, 0, sizeof(d));
+    d.eseq = mine;
+    d.route = mine * 3 + 1;
+    d.cost_us = mine * 3 + 2;
+    while (vtpu_exec_submit(a->ring, &d) != 0)
+      usleep(50);
+  }
+  return NULL;
+}
+
+static void test_multiwriter_stress(void) {
+  char path[560];
+  snprintf(path, sizeof(path), "%s.stress", g_path);
+  unlink(path);
+  vtpu_exec_ring* prod = vtpu_exec_open(path, 128);
+  vtpu_exec_ring* cons = vtpu_exec_open(path, 128);
+  assert(prod && cons);
+  StressArgs a = {prod, 20000, 4};
+  pthread_t th[4];
+  for (int i = 0; i < a.writers; i++)
+    pthread_create(&th[i], NULL, producer_main, &a);
+  /* Consumer: every descriptor arrives exactly once, intact (route
+   * and cost derive from eseq), and ring order equals publish order.
+   * SPSC discipline under multi-writer attempts == no torn payloads,
+   * no skipped/duplicated seqs, credit conservation at the end. */
+  unsigned char* seen = (unsigned char*)calloc(a.items, 1);
+  uint64_t got = 0;
+  ExecDesc buf[64];
+  while (got < a.items) {
+    int n = vtpu_exec_take(cons, buf, 64);
+    if (n == 0) {
+      usleep(100);
+      continue;
+    }
+    for (int i = 0; i < n; i++) {
+      assert(buf[i].eseq < a.items);
+      assert(buf[i].route == buf[i].eseq * 3 + 1); /* never torn */
+      assert(buf[i].cost_us == buf[i].eseq * 3 + 2);
+      assert(!seen[buf[i].eseq]); /* exactly once */
+      seen[buf[i].eseq] = 1;
+    }
+    vtpu_exec_complete(cons, NULL, NULL, 42, n);
+    got += (uint64_t)n;
+  }
+  for (int i = 0; i < a.writers; i++)
+    pthread_join(th[i], NULL);
+  for (uint64_t i = 0; i < a.items; i++)
+    assert(seen[i]);
+  free(seen);
+  assert(vtpu_exec_tail(cons) == a.items);
+  assert(vtpu_exec_headc(cons) == a.items);
+  assert(vtpu_exec_credits(cons) == 128); /* gate never leaked */
+  vtpu_exec_close(prod);
+  vtpu_exec_close(cons);
+}
+
+static void test_wait_helpers(void) {
+  char path[560];
+  snprintf(path, sizeof(path), "%s.wait", g_path);
+  unlink(path);
+  vtpu_exec_ring* x = vtpu_exec_open(path, 64);
+  assert(x);
+  /* Timeout path: nothing published. */
+  assert(vtpu_exec_wait_tail(x, 1, 2 * 1000 * 1000, 100 * 1000) == 0);
+  ExecDesc d;
+  memset(&d, 0, sizeof(d));
+  assert(vtpu_exec_submit(x, &d) == 0);
+  assert(vtpu_exec_wait_tail(x, 1, 2 * 1000 * 1000, 100 * 1000) == 1);
+  assert(vtpu_exec_take(x, &d, 1) == 1);
+  vtpu_exec_complete(x, NULL, NULL, 0, 1);
+  assert(vtpu_exec_wait_headc(x, 1, 2 * 1000 * 1000, 100 * 1000) == 1);
+  vtpu_exec_close(x);
+}
+
+int main(int argc, char** argv) {
+  const char* dir = argc > 1 ? argv[1] : "/tmp";
+  snprintf(g_path, sizeof(g_path), "%s/exec_ring_test.%d", dir,
+           (int)getpid());
+  test_basic_fifo();
+  test_gate_and_credit_bank();
+  test_wait_helpers();
+  test_multiwriter_stress();
+  printf("exec_ring_test: OK\n");
+  return 0;
+}

@@ -283,6 +283,127 @@ int vtpu_test_lock_region(vtpu_region* r);
  * Never called by product code paths. */
 void vtpu_test_set_proc_root(const char* root);
 
+/* ---- interposer-only shm execute ring (vtpu-fastlane) -------------------
+ *
+ * The steady-state data plane that takes the broker out of the execute
+ * path (ROADMAP item 2, docs/PERF.md): one SPSC descriptor ring per
+ * fastlane tenant, produced by the client/interposer, drained by the
+ * broker's fastlane drainer thread.  Admission rides a credit gate so
+ * a dead/slow consumer back-pressures the producer instead of wedging
+ * it.  The protocol was DECLARED and litmus-verified (tools/wmm
+ * exec_ring) one PR before this implementation existed; the orders
+ * below are the pre-verified ones, now live rows in the ground-truth
+ * block and shape-checked against this very code by
+ * tools/analyze/atomics.py.
+ *
+ * The ring file lives next to the accounting region (never part of
+ * the Region layout, so the region version is untouched).  The header
+ * also carries the fastlane enforcement words the client burns
+ * directly: a burst-credit bank (acq_rel RMW, the credit_bank litmus
+ * shape) and a broker-published gate word (park/probation/teardown
+ * forces the client back onto the brokered path). */
+
+typedef struct vtpu_exec_ring vtpu_exec_ring;
+
+/* One execute descriptor.  Producer-written fields are relaxed stores
+ * published by the tail; consumer completion fields (status/actual_us/
+ * t_done_ns) are relaxed stores published by headc.  Mirrored
+ * field-for-field by shim/core.py:ExecDesc (drift machine-checked). */
+typedef struct {
+  uint64_t eseq;      /* producer submit sequence (== ring index) */
+  uint64_t route;     /* FASTBIND route index (program + arg/out ids) */
+  uint64_t arg_off;   /* optional inline arg blob: tx-arena offset */
+  uint64_t arg_len;   /* ... byte length (0 = none) */
+  uint64_t cost_us;   /* producer's device-time estimate */
+  uint64_t t_sub_ns;  /* CLOCK_REALTIME ns at submit (SLO queue phase) */
+  uint64_t eflags;    /* reserved */
+  int64_t status;     /* consumer: 0 ok, else VTPU_EXEC_E* (negative) */
+  uint64_t actual_us; /* consumer: metered device time */
+  uint64_t t_done_ns; /* consumer: completion stamp (SLO harvest) */
+} ExecDesc;
+
+enum {
+  VTPU_EXEC_OK = 0,
+  VTPU_EXEC_ENOTFOUND = -1, /* route/array id unresolvable */
+  VTPU_EXEC_EINTERNAL = -2, /* broker-side execution failure */
+  VTPU_EXEC_ECANCELED = -3, /* lane torn down / epoch drained */
+};
+
+/* Gate word values (broker-published; the client falls back to the
+ * brokered socket path on anything non-zero). */
+enum {
+  VTPU_EXEC_GATE_OPEN = 0,
+  VTPU_EXEC_GATE_PARKED = 1, /* suspended/preempted: queues hold */
+  VTPU_EXEC_GATE_CLOSED = 2, /* lane released / epoch over */
+};
+
+/* Open (create if absent) a ring at `path` with `entries` descriptor
+ * slots (rounded up to a power of two, min 64; 0 -> 1024).  First
+ * creator initialises under an flock; an existing compatible file is
+ * adopted, a foreign/corrupt one refused (EPROTO).  Returns NULL on
+ * error (errno set). */
+vtpu_exec_ring* vtpu_exec_open(const char* path, uint32_t entries);
+void vtpu_exec_close(vtpu_exec_ring* x);
+
+/* Producer: submit one descriptor.  Returns 0 when published, -1 when
+ * the credit gate refuses or the slot-reuse gate finds the ring full
+ * (back-pressure: retry after draining completions).  Thread-safe per
+ * handle (a process-local mutex serialises accidental multi-writer
+ * attempts; the cross-process protocol stays strictly SPSC). */
+int vtpu_exec_submit(vtpu_exec_ring* x, const ExecDesc* d);
+
+/* Producer: submit up to n descriptors in one call (stops at the
+ * first gate refusal); returns the count published. */
+int vtpu_exec_submit_batch(vtpu_exec_ring* x, const ExecDesc* d,
+                           int n);
+
+/* Consumer: peek up to `max` submitted-but-untaken descriptors (does
+ * NOT advance headc — slots stay owned by the consumer until the
+ * matching vtpu_exec_complete).  Returns the count copied. */
+int vtpu_exec_take(vtpu_exec_ring* x, ExecDesc* out, int max);
+
+/* Consumer: complete the `n` oldest taken descriptors — writes each
+ * slot's status/actual_us/t_done_ns, publishes headc once (slot-reuse
+ * gate) and returns the credits with one RMW. */
+void vtpu_exec_complete(vtpu_exec_ring* x, const int64_t* status,
+                        const uint64_t* actual_us, uint64_t t_done_ns,
+                        int n);
+
+/* Producer: copy completions [from_seq, headc) into `out` (at most
+ * `max`).  Valid while the producer has not reused the slots, which
+ * the submit-side gate guarantees for any seq >= tail - capacity. */
+int vtpu_exec_completions(vtpu_exec_ring* x, uint64_t from_seq,
+                          ExecDesc* out, int max);
+
+uint64_t vtpu_exec_tail(vtpu_exec_ring* x);   /* published submits */
+uint64_t vtpu_exec_headc(vtpu_exec_ring* x);  /* published completions */
+uint32_t vtpu_exec_capacity(vtpu_exec_ring* x);
+int64_t vtpu_exec_credits(vtpu_exec_ring* x);
+
+/* Bounded wait helpers (spin `spin_ns`, then 50us naps): the producer
+ * waits for a completion, the consumer for a submission, without
+ * holding the Python GIL or burning a syscall per poll.  Returns 1
+ * when the condition held, 0 on timeout. */
+int vtpu_exec_wait_headc(vtpu_exec_ring* x, uint64_t seq,
+                         uint64_t timeout_ns, uint64_t spin_ns);
+int vtpu_exec_wait_tail(vtpu_exec_ring* x, uint64_t seq,
+                        uint64_t timeout_ns, uint64_t spin_ns);
+
+/* Broker-published fallback gate (VTPU_EXEC_GATE_*). */
+void vtpu_exec_gate_set(vtpu_exec_ring* x, uint32_t v);
+uint32_t vtpu_exec_gate(vtpu_exec_ring* x);
+
+/* Burst-credit bank over shared atomics (the credit_bank litmus
+ * shape, docs/SCHEDULING.md): the broker's collector mints idle
+ * accrual (capped), the client spends when its token bucket refuses —
+ * never past the published hard-floor signal (the broker stops
+ * minting and zeroes the bank while floors demand).  Returns 1 on a
+ * successful mint/spend, 0 otherwise. */
+int vtpu_exec_credit_mint(vtpu_exec_ring* x, int64_t us,
+                          int64_t cap_us);
+int vtpu_exec_credit_spend(vtpu_exec_ring* x, int64_t us);
+int64_t vtpu_exec_credit_level(vtpu_exec_ring* x);
+
 /* ---- shared-memory protocol ground truth (vtpu-wmm) ---------------------
  *
  * The declared atomics discipline of every mmap'd shared-region field,
@@ -311,7 +432,7 @@ void vtpu_test_set_proc_root(const char* root);
  * hoped.
  *
  *   structs: Region, DeviceState, ProcSlot, TraceShm, TraceSlot,
- *            vtpu_trace_event
+ *            vtpu_trace_event, ExecRing, ExecDesc
  *   mutex: Region.mu
  *   lock: Region.wc_mode, Region.dev, Region.proc, DeviceState.*,
  *         ProcSlot.*
@@ -319,8 +440,10 @@ void vtpu_test_set_proc_root(const char* root);
  *   stable: Region.magic, Region.version, Region.initialized,
  *           Region.ndevices, Region.pad0_, TraceShm.magic,
  *           TraceShm.version, TraceShm.capacity, TraceShm.pad_,
- *           TraceShm.slots
- *   init-writers: vtpu_region_open_versioned, vtpu_trace_open
+ *           TraceShm.slots, ExecRing.magic, ExecRing.version,
+ *           ExecRing.capacity, ExecRing.pad_, ExecRing.slots
+ *   init-writers: vtpu_region_open_versioned, vtpu_trace_open,
+ *           vtpu_exec_open
  *   locked-suffix: _locked
  *   publish: TraceShm.head acq_rel -> consume: acquire
  *   seqlock trace-slot: seq=TraceSlot.seq
@@ -333,28 +456,35 @@ void vtpu_test_set_proc_root(const char* root);
  *   mirror-const: VTPU_MAX_DEVICES == utils/envspec.py:MAX_DEVICES_PER_NODE
  *   mirror-const: VTPU_MAX_PROCS == shim/core.py:MAX_PROCS
  *
- * ---- PLANNED: interposer-only shm execute ring (ROADMAP item 2) ---------
+ * Interposer-only shm execute ring (vtpu-fastlane; ROADMAP item 2).
+ * These rows were declared as `planned exec-ring:` one PR ahead of
+ * the implementation and litmus-verified by tools/wmm's exec_ring
+ * program; now the code exists they are LIVE protocol rows — every
+ * access in vtpu_core.cc must conform, publish/consume pairing is
+ * proved in both directions, `rmw:` fields admit only RMWs at the
+ * declared order (observability loads must be acquire), `payload:`
+ * fields admit only the declared order, and the `ring` declaration
+ * shape-checks the real writer/consumer functions (credit gate, the
+ * headc slot-reuse gate BEFORE the payload fill, release tail
+ * publish; completion fill before the headc release publish):
  *
- * The steady-state data plane that takes the broker out of the execute
- * path: one SPSC descriptor ring per (tenant process, chip) in the
- * shared region, produced by the interposer, drained by the broker's
- * completion loop; admission rides a credit gate so a dead/slow
- * consumer back-pressures the producer instead of wedging it.  The
- * protocol is DECLARED (and litmus-verified by tools/wmm's exec_ring
- * program, including its seeded-broken selfcheck variant) before the
- * structs exist, so the data-plane PR lands on pre-verified orders:
+ *   publish: ExecRing.tail release -> consume: acquire
+ *   publish: ExecRing.headc release -> consume: acquire
+ *   publish: ExecRing.gate release -> consume: acquire
+ *   rmw: ExecRing.credits acq_rel
+ *   rmw: ExecRing.credit_us acq_rel
+ *   payload: ExecDesc.* relaxed
+ *   ring exec-ring: tail=ExecRing.tail headc=ExecRing.headc
+ *       credits=ExecRing.credits
+ *       helpers=desc_store(relaxed), desc_load(relaxed),
+ *       desc_done_store(relaxed)
+ *       writer=vtpu_exec_submit reader=vtpu_exec_take
+ *       completer=vtpu_exec_complete
+ *   mirror: ExecDesc == shim/core.py:ExecDesc
  *
- *   planned exec-ring: publish: ExecRing.tail release -> consume: acquire
- *   planned exec-ring: publish: ExecRing.headc release -> consume: acquire
- *   planned exec-ring: rmw: ExecRing.credits acq_rel
- *   planned exec-ring: payload: ExecDesc.* relaxed
- *
- * Shape: ExecDesc { program id, arg blob offset/len, seq } written
- * relaxed into slot tail%capacity, published by a release store of
- * tail+1; the consumer loads tail acquire, executes, publishes headc
- * release (slot reuse gate) and returns the credit with an acq_rel
- * RMW.  FIFO, no-torn-descriptor and credit conservation are the
- * wmm-ring-fifo invariant row.
+ * FIFO, no-torn-descriptor and credit conservation are the
+ * wmm-ring-fifo invariant row (tools/mc/invariants.py); the burst-
+ * credit bank words follow the credit_bank litmus (wmm-credit-bounds).
  */
 
 #ifdef __cplusplus

@@ -542,9 +542,10 @@ def test_analyze_catches_unclassified_verb():
     src = _read("runtime/protocol.py").replace(
         "IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, "
         "TRACE,\n                    SLO, SUSPEND, RESUME, RESIZE, "
-        "DRAIN)",
+        "DRAIN, FASTBIND)",
         "IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, "
-        "TRACE,\n                    SLO, SUSPEND, RESUME, DRAIN)")
+        "TRACE,\n                    SLO, SUSPEND, RESUME, DRAIN, "
+        "FASTBIND)")
     assert any("RESIZE is served but unclassified" in str(f)
                for f in _verb_findings(src))
 

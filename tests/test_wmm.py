@@ -126,17 +126,28 @@ def test_wmm_rows_are_registered():
         assert seed.invariant in rows, seed.name
 
 
-def test_exec_ring_models_the_planned_spec():
-    """The planned interposer-only data plane (ROADMAP item 2) must be
-    litmus-covered ahead of the build — and its spec declared in the
-    vtpu_core.h grammar."""
+def test_exec_ring_spec_promoted_to_live_rows():
+    """The interposer-only data plane was spec'd as `planned
+    exec-ring:` rows one PR ahead of the build (ROADMAP item 2); with
+    vtpu-fastlane landed those are now LIVE protocol rows — publish
+    orders, rmw fields, payload order, and a ring shape declaration
+    naming the real implemented functions."""
     assert lt.get("exec_ring").protocol == "exec-ring"
     header = read_text(REPO_ROOT, atomics.HEADER)
     gt, findings = atomics.parse_ground_truth(header)
     assert findings == []
-    assert "exec-ring" in gt.planned
-    assert any("ExecRing.tail release" in d
-               for d in gt.planned["exec-ring"])
+    # Promotion: no planned rows remain; the declared orders moved
+    # verbatim into the live grammar.
+    assert "exec-ring" not in gt.planned
+    assert gt.publishes.get("ExecRing.tail") == ("release", "acquire")
+    assert gt.publishes.get("ExecRing.headc") == ("release", "acquire")
+    assert gt.rmws.get("ExecRing.credits") == "acq_rel"
+    assert gt.payloads.get("ExecDesc.*") == "relaxed"
+    ring = next(r for r in gt.rings if r.name == "exec-ring")
+    assert ring.writer == "vtpu_exec_submit"
+    assert ring.reader == "vtpu_exec_take"
+    assert ring.completer == "vtpu_exec_complete"
+    assert "ExecRing" in gt.structs and "ExecDesc" in gt.structs
 
 
 # ---------------------------------------------------------------------------
