@@ -19,6 +19,7 @@ import collections
 import itertools
 import os
 import random
+import select
 import socket
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -444,6 +445,11 @@ class RuntimeClient:
         self._tok_wire = 0
         self._tok_ring = 0
         self._routes.clear()
+        # Route ids are scoped to the broker-side lane that issued
+        # them: the memoized last-route (and the decimated gate
+        # counter) must not survive into the new epoch.
+        self._fl_last = None
+        self._fl_gate_in = 0
         if self._lane is not None:
             self._lane.close()
             self._lane = None
@@ -808,11 +814,31 @@ class RuntimeClient:
 
     def _broker_alive(self) -> bool:
         """Cheap peer-liveness probe for ring completion waits: a
-        SIGKILLed broker's kernel closes the UDS, so a zero-byte peek
-        reads EOF within one poll.  The socket is flipped
-        non-blocking for the peek — on a timeout-mode socket a plain
-        MSG_DONTWAIT recv retries internally and a quiet-but-alive
-        broker would misread as dead."""
+        SIGKILLed broker's kernel closes the UDS.  POLLRDHUP surfaces
+        that even while unconsumed pipelined reply bytes still sit in
+        the receive buffer — a MSG_PEEK-only probe reads those bytes
+        as 'alive' and strands the ring waiter for its full
+        completion timeout (the awaited completion died with the
+        broker; the buffered wire replies are the documented
+        in-flight-replies-lost loss).  Platforms without POLLRDHUP
+        fall back to the zero-byte peek (EOF only once the buffer
+        drains).  The peek flips the socket non-blocking — on a
+        timeout-mode socket a plain MSG_DONTWAIT recv retries
+        internally and a quiet-but-alive broker would misread as
+        dead."""
+        rdhup = getattr(select, "POLLRDHUP", 0)
+        if rdhup:
+            try:
+                p = select.poll()
+                p.register(self.sock.fileno(), select.POLLIN | rdhup)
+                ev = p.poll(0)
+            except (OSError, ValueError):
+                return False
+            if not ev:
+                return True  # quiet but open
+            flags = ev[0][1]
+            return not (flags & (rdhup | select.POLLHUP
+                                 | select.POLLERR | select.POLLNVAL))
         try:
             self.sock.setblocking(False)
             try:
@@ -925,6 +951,22 @@ class RuntimeClient:
                 rep = self._rpc({"kind": P.FASTBIND, "exe": eid,
                                  "args": list(arg_ids),
                                  "outs": list(out_ids)})
+                if self._lane is not lane:
+                    # The round-trip rode a disconnect/reconnect: the
+                    # lane was replaced (or dropped) with the epoch,
+                    # and the stale ring's closed handle would only
+                    # spin the flush path.  The retried FASTBIND bound
+                    # against the FRESH broker lane, so cache it and
+                    # send brokered this once — the next send rides
+                    # the new lane.
+                    if self._lane is not None \
+                            and int(rep.get("route", -1)) >= 0:
+                        self._routes[key] = {
+                            "id": int(rep["route"]),
+                            "cost": float(rep.get("cost_us", 5000.0)
+                                          or 1.0),
+                            "metas": rep.get("outs") or []}
+                    return False
                 if int(rep.get("route", -1)) < 0:
                     # Program never executed broker-side: one brokered
                     # step fills its static out metadata, then

@@ -218,8 +218,8 @@ class Route:
     integer."""
 
     __slots__ = ("exe_key", "prog", "arg_ids", "out_ids", "metas",
-                 "cost_us", "primed", "cacheable", "args_cache",
-                 "args_ver")
+                 "cost_us", "primed", "primed_ver", "cacheable",
+                 "args_cache", "args_ver")
 
     def __init__(self, exe_key: str, prog, arg_ids, out_ids, metas,
                  cost_us: float):
@@ -231,8 +231,13 @@ class Route:
         self.cost_us = cost_us
         # First ring execution binds outputs through the full
         # drop/charge path; steady state (same ids, same static
-        # shapes) swaps array refs only.
+        # shapes) swaps array refs only.  The swap is valid ONLY while
+        # the tenant's array table is exactly as this route left it —
+        # version-keyed like the args cache (a DELETE of a ring output
+        # pops its charge; a blind swap would resurrect the id
+        # uncharged).
         self.primed = False
+        self.primed_ver = -1
         # Resolved-args cache: valid while the tenant's array table
         # version is unchanged.  Only when the route's args never name
         # its own outs — a self-feeding route re-resolves every item.
@@ -288,8 +293,17 @@ class BrokerLane:
             if ent:
                 try:
                     ent[1].close()
-                    os.close(ent[0])
+                except BufferError:
+                    # An exported view over the arena (a GET reply's
+                    # numpy window not yet GC'd) pins the mapping:
+                    # leave it to interpreter reclamation — the fd
+                    # close and unlink below still run.
+                    pass
                 except (OSError, ValueError):
+                    pass
+                try:
+                    os.close(ent[0])
+                except OSError:
                     pass
         try:
             self.ring.close()
@@ -434,7 +448,10 @@ class FastlaneHub:
             old = self.lanes.pop(tenant.name, None)
             self.lanes[tenant.name] = lane
         if old is not None:
-            old.close()
+            # A re-HELLO replaced a live lane: its native teardown
+            # MUST ride the drainer-owned graveyard (never inline —
+            # the chip drainer may be mid-drain on it right now).
+            self._retire_lane(old)
         tenant.fastlane = lane
         self._ensure_drainer(tenant.chip)
         reply = {
@@ -501,49 +518,107 @@ class FastlaneHub:
         """Force permanent fallback (e.g. a second container joined
         the tenant): the client sees GATE_CLOSED and re-routes; any
         descriptor already in the ring cancels (never ran) so producer
-        waits terminate and the pre-debits refund."""
+        waits terminate and the pre-debits refund.  The cancel itself
+        runs on the OWNING drainer (its closed-check path) — take/
+        complete are strictly single-consumer, so a control-plane
+        cancel interleaved with a live drain would mislabel
+        completions (ECANCELED on items mid-execute, EXEC_OK on items
+        that never ran).  Inline only when no drainer exists."""
         with self.mu:
             lane = self.lanes.get(name)
-        if lane is not None:
-            lane.closed = True
-            try:
-                lane.ring.gate_set(GATE_CLOSED)
-            except OSError:
-                pass
-            self._cancel_drain(lane)
-
-    def close_lane(self, name: str) -> None:
-        with self.mu:
-            lane = self.lanes.pop(name, None)
         if lane is None:
             return
-        lane.tenant.fastlane = None
+        lane.closed = True
+        try:
+            lane.ring.gate_set(GATE_CLOSED)
+        except OSError:
+            pass
+        with self.mu:
+            has_drainer = lane.tenant.chip.index in self.drainers
+        if not has_drainer:
+            self._cancel_drain(lane)
+
+    def quiesce_lane(self, name: str, timeout_s: float = 2.0) -> None:
+        """Teardown ordering helper (the same release-before-recycle
+        rule release_tenant applies to rate leases): gate the lane
+        CLOSED and wait — bounded — for the owning drainer's
+        closed-check pass to cancel every in-flight descriptor, so
+        the pre-debit refunds land BEFORE the caller frees the
+        tenant's slot.  A refund landing after a concurrent HELLO
+        re-seeds the recycled slot would over-credit the NEW tenant.
+        Inline cancel when no drainer exists (mc manual mode)."""
+        with self.mu:
+            lane = self.lanes.get(name)
+            has_drainer = (lane is not None
+                           and lane.tenant.chip.index in self.drainers)
+        if lane is None:
+            return
         lane.closed = True
         try:
             lane.ring.gate_set(GATE_CLOSED)
         except (OSError, ValueError):
             pass
-        # Drain the ring: submitted-but-unexecuted descriptors are
-        # completed ECANCELED (their replies died with the lane, like
-        # in-flight wire executes at teardown) and their pre-debited
-        # estimates REFUND through the shared bucket — a released
-        # tenant must leave the books exactly balanced (the mc
-        # token-conservation row checks this).
-        self._cancel_drain(lane)
+        if not has_drainer:
+            self._cancel_drain(lane)
+            return
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                if lane.ring.depth == 0:
+                    return
+            except (OSError, ValueError):
+                return
+            time.sleep(0.002)
+        log.warn("fastlane: lane %s did not quiesce in %.1fs; "
+                 "stragglers cancel at reap (refunds are then "
+                 "registration-gated)", name, timeout_s)
+
+    def _retire_lane(self, lane: BrokerLane) -> None:
+        """Retire a lane that left the registry: gate it CLOSED and
+        hand it to its chip's drainer graveyard, where reap_dead()
+        cancel-drains it (ECANCELED + pre-debit refunds) and runs the
+        native teardown — both must happen on the consumer thread,
+        never concurrently with a live drain.  Inline only when no
+        drainer exists (mc manual mode, or fastlane never served this
+        chip)."""
+        lane.closed = True
+        try:
+            lane.ring.gate_set(GATE_CLOSED)
+        except (OSError, ValueError):
+            pass
         chip_idx = lane.tenant.chip.index
         with self.mu:
             has_drainer = chip_idx in self.drainers
             if has_drainer:
                 self._dead.setdefault(chip_idx, []).append(lane)
         if not has_drainer:
+            self._cancel_drain(lane)
             lane.close()
 
+    def close_lane(self, name: str) -> None:
+        """Teardown: submitted-but-unexecuted descriptors complete
+        ECANCELED (their replies died with the lane, like in-flight
+        wire executes at teardown) and their pre-debited estimates
+        REFUND through the shared bucket — a released tenant must
+        leave the books exactly balanced (the mc token-conservation
+        row checks this).  Cancel and native close both happen in
+        reap_dead() on the owning drainer."""
+        with self.mu:
+            lane = self.lanes.pop(name, None)
+        if lane is None:
+            return
+        lane.tenant.fastlane = None
+        self._retire_lane(lane)
+
     def reap_dead(self, chip_index: int) -> None:
-        """Native teardown of retired lanes — called ONLY from the
-        owning drainer thread (or after it is joined)."""
+        """Cancel-drain + native teardown of retired lanes — called
+        ONLY from the owning drainer thread (or after it is joined),
+        so the cancel never interleaves with a live drain and the
+        munmap never races one."""
         with self.mu:
             dead = self._dead.pop(chip_index, None)
         for lane in dead or ():
+            self._cancel_drain(lane)
             lane.close()
 
     def note_fallback(self, tenant, n: int = 1) -> None:
@@ -565,6 +640,7 @@ class FastlaneHub:
         for d in drainers:
             d.stop()  # joined: no drain pass can touch a mapping now
         for lane in lanes + dead:
+            self._cancel_drain(lane)  # safe post-join: sole consumer
             lane.close()
 
     # -- stats -------------------------------------------------------------
@@ -608,7 +684,19 @@ class FastlaneHub:
                 lane.ring.complete([EXEC_ECANCELED] * len(descs),
                                    [0] * len(descs), time.time_ns())
                 if costs:
-                    lane.tenant.rate_adjust_all(-costs)
+                    # Refund ONLY while the tenant still owns its
+                    # slot: after release_tenant pops it, a
+                    # concurrent HELLO may have re-seeded the
+                    # recycled slot's bucket and the refund would
+                    # over-credit the new tenant (the release/refund
+                    # ordering rule).  A dead slot's stale debit is
+                    # harmless — reset_slot wipes it at the next
+                    # claim; teardown refunds happen pre-pop via
+                    # quiesce_lane.
+                    t = lane.tenant
+                    reg = getattr(self.state, "tenants", None)
+                    if reg is None or reg.get(t.name) is t:
+                        t.rate_adjust_all(-costs)
         except (OSError, ValueError):
             pass
 
@@ -786,18 +874,28 @@ class FastlaneHub:
                     args[0] = np.frombuffer(
                         blob, dtype=a0.dtype).reshape(a0.shape)
                 outs = route.prog.fn(*args)
+                out_list = (outs if isinstance(outs, (list, tuple))
+                            else [outs])
+                swapped = False
                 if route.primed:
                     # Steady state: same out ids, same static shapes
-                    # — swap the array refs, books unchanged.
-                    if isinstance(outs, (list, tuple)):
-                        for oid, o in zip(route.out_ids, outs):
-                            arrs[oid] = o
-                    else:
-                        arrs[route.out_ids[0]] = outs
-                else:
-                    out_list = (outs if isinstance(outs, (list, tuple))
-                                else [outs])
+                    # — swap the array refs under t.mu, books
+                    # unchanged.  Valid only while the array table is
+                    # exactly as this route primed it: a PUT/DELETE/
+                    # brokered out-bind bumped arrays_ver, and e.g. a
+                    # DELETE of a ring output released its HBM charge
+                    # — a blind swap would resurrect the id uncharged
+                    # (quota bypass).  Mismatch falls through to the
+                    # full rebind below.
                     with t.mu:
+                        if route.primed_ver == t.arrays_ver:
+                            for oid, o in zip(route.out_ids,
+                                              out_list):
+                                arrs[oid] = o
+                            swapped = True
+                if not swapped:
+                    with t.mu:
+                        changed = False
                         for k, o in enumerate(out_list):
                             oid = route.out_ids[k] \
                                 if k < len(route.out_ids) else None
@@ -808,13 +906,24 @@ class FastlaneHub:
                                 if route.prog.out_meta else None
                             nb = (m["nbytes"] if m
                                   else int(o.nbytes))
+                            if oid in t.arrays \
+                                    and t.nbytes.get(oid) == nb:
+                                # Still device-bound at the static
+                                # size (a co-route's rebind bumped
+                                # the version, not this id): ref
+                                # swap, charge already right.
+                                t.arrays[oid] = o
+                                continue
                             _drop_array(state, t, oid)
                             t.arrays[oid] = o
                             t.nbytes[oid] = nb
                             t.charge_array(oid, [(0, nb)], True)
-                        t.arrays_ver += 1
-                    arrays_ver = t.arrays_ver
-                    route.primed = True
+                            changed = True
+                        if changed:
+                            t.arrays_ver += 1
+                        route.primed = True
+                        route.primed_ver = t.arrays_ver
+                        arrays_ver = t.arrays_ver
             except KeyError:
                 st_np[i] = EXEC_ENOTFOUND
                 errors += 1

@@ -2786,6 +2786,15 @@ class RuntimeState:
         # slot next.  (All items are dispatched by now — the session
         # drained its replies — so inflight-only quiesce suffices.)
         t.chip.scheduler.quiesce(t.name)
+        # Same ordering rule for the fastlane ring: gate the lane
+        # CLOSED and let its drainer cancel the in-flight descriptors
+        # (ECANCELED + pre-debit refunds) BEFORE the pop below frees
+        # the slot — a refund landing after a concurrent HELLO's
+        # reset_slot would over-credit the new tenant.  If the
+        # teardown aborts below (reconnect won the race), the live
+        # session falls back brokered and re-negotiates a lane on its
+        # next rebind.
+        self.fastlane.quiesce_lane(t.name)
         # Reclaim the unburned rate lease BEFORE the slot can recycle:
         # the pop below frees the slot index, and a concurrent HELLO
         # that claims it resets the bucket — a refund landing after

@@ -640,26 +640,38 @@ class ExecRing:
     def __exit__(self, *exc):
         self.close()
 
+    def _h(self):
+        """Live handle, or raise.  The native entry points tolerate a
+        NULL handle with benign defaults (gate() reads 0 = GATE_OPEN,
+        submit refuses) — exactly the combination that silently spins
+        a producer on a stale closed lane, so closed-ring operations
+        fail loudly instead (ConnectionError: the lane is gone; the
+        caller's normal reconnect/fallback machinery applies)."""
+        h = self.handle
+        if not h:
+            raise ConnectionError("ExecRing is closed")
+        return h
+
     # -- producer ----------------------------------------------------------
 
     def submit(self, desc: ExecDesc) -> bool:
         """Publish one descriptor; False = credit/slot gate refused
         (back-pressure: drain completions, retry)."""
-        return self._c_submit(self.handle, ctypes.byref(desc)) == 0
+        return self._c_submit(self._h(), ctypes.byref(desc)) == 0
 
     def completions(self, from_seq: int, max_n: int = 0):
         """Completed descriptors [from_seq, headc), up to max_n — the
         returned list aliases an internal scratch buffer, consume it
         before the next call."""
         n = min(max_n or self._buf_n, self._buf_n)
-        got = self._c_completions(self.handle, int(from_seq),
+        got = self._c_completions(self._h(), int(from_seq),
                                   self._buf, n)
         return [self._buf[i] for i in range(max(got, 0))]
 
     def wait_headc(self, seq: int, timeout_s: float,
                    spin_us: int = 100) -> bool:
         return self.lib.vtpu_exec_wait_headc(
-            self.handle, int(seq), int(max(timeout_s, 0.0) * 1e9),
+            self._h(), int(seq), int(max(timeout_s, 0.0) * 1e9),
             int(spin_us) * 1000) == 1
 
     # -- consumer ----------------------------------------------------------
@@ -668,7 +680,7 @@ class ExecRing:
         """Peek up to max_n submitted-but-untaken descriptors (headc
         does NOT advance until complete()); aliases scratch."""
         n = min(max_n or self._buf_n, self._buf_n)
-        got = self._c_take(self.handle, self._buf, n)
+        got = self._c_take(self._h(), self._buf, n)
         return [self._buf[i] for i in range(max(got, 0))]
 
     def take_np(self, max_n: int = 0):
@@ -679,7 +691,7 @@ class ExecRing:
         if self._buf_np is None:
             return 0, None
         n = min(max_n or self._buf_n, self._buf_n)
-        got = self._c_take(self.handle, self._buf, n)
+        got = self._c_take(self._h(), self._buf, n)
         if got <= 0:
             return 0, None
         return got, self._buf_np[:got]
@@ -689,12 +701,12 @@ class ExecRing:
         ONE native call; returns the count admitted (stops at the
         first credit/slot refusal)."""
         return int(self.lib.vtpu_exec_submit_batch(
-            self.handle, descs, int(n)))
+            self._h(), descs, int(n)))
 
     def complete_np(self, st_np, ac_np, t_done_ns: int, n: int) -> None:
         """Vectorized complete: caller filled the first n entries of
         the scratch status/actual views (``scratch_views``)."""
-        self._c_complete(self.handle, self._st, self._ac,
+        self._c_complete(self._h(), self._st, self._ac,
                          int(t_done_ns), int(n))
 
     def scratch_views(self):
@@ -708,32 +720,32 @@ class ExecRing:
         for i in range(n):
             self._st[i] = int(statuses[i])
             self._ac[i] = int(actuals[i])
-        self._c_complete(self.handle, self._st, self._ac,
+        self._c_complete(self._h(), self._st, self._ac,
                          int(t_done_ns), n)
 
     def wait_tail(self, seq: int, timeout_s: float,
                   spin_us: int = 100) -> bool:
         return self.lib.vtpu_exec_wait_tail(
-            self.handle, int(seq), int(max(timeout_s, 0.0) * 1e9),
+            self._h(), int(seq), int(max(timeout_s, 0.0) * 1e9),
             int(spin_us) * 1000) == 1
 
     # -- shared ------------------------------------------------------------
 
     @property
     def tail(self) -> int:
-        return int(self._c_tail(self.handle))
+        return int(self._c_tail(self._h()))
 
     @property
     def headc(self) -> int:
-        return int(self._c_headc(self.handle))
+        return int(self._c_headc(self._h()))
 
     @property
     def capacity(self) -> int:
-        return int(self.lib.vtpu_exec_capacity(self.handle))
+        return int(self.lib.vtpu_exec_capacity(self._h()))
 
     @property
     def credits(self) -> int:
-        return int(self._c_credits(self.handle))
+        return int(self._c_credits(self._h()))
 
     @property
     def depth(self) -> int:
@@ -741,20 +753,20 @@ class ExecRing:
         return max(self.tail - self.headc, 0)
 
     def gate(self) -> int:
-        return int(self._c_gate(self.handle))
+        return int(self._c_gate(self._h()))
 
     def gate_set(self, v: int) -> None:
-        self.lib.vtpu_exec_gate_set(self.handle, int(v))
+        self.lib.vtpu_exec_gate_set(self._h(), int(v))
 
     def credit_mint(self, us: int, cap_us: int) -> bool:
         return self.lib.vtpu_exec_credit_mint(
-            self.handle, int(us), int(cap_us)) == 1
+            self._h(), int(us), int(cap_us)) == 1
 
     def credit_spend(self, us: int) -> bool:
-        return self._c_credit_spend(self.handle, int(us)) == 1
+        return self._c_credit_spend(self._h(), int(us)) == 1
 
     def credit_level(self) -> int:
-        return int(self._c_credit_level(self.handle))
+        return int(self._c_credit_level(self._h()))
 
 
 class TraceRing:

@@ -403,8 +403,10 @@ def _setup_fastlane_gate(h: Harness, sched: mcsched.Scheduler) -> None:
         hub.drain_once(t.chip)
         # Operator RESUME + RESIZE through the real admin arm, then
         # drain to empty; whatever a schedule leaves undrained is
-        # completed ECANCELED and refunded by close_lane at teardown
-        # (conservation balances without an unbounded spin).
+        # completed ECANCELED and refunded by release_tenant's
+        # quiesce_lane BEFORE the slot frees (conservation balances
+        # without an unbounded spin, and the refund can never land on
+        # a recycled slot).
         h.admin(_admin_frames(
             {"kind": P.RESUME, "tenant": "A"},
             {"kind": P.RESIZE, "tenant": "A", "core_limit": 30},

@@ -19,6 +19,7 @@ Layers under test:
 from __future__ import annotations
 
 import os
+import socket
 import sys
 import tempfile
 import threading
@@ -346,6 +347,272 @@ def test_multi_container_second_hello_forces_fallback(fl_broker):
     finally:
         c1.close()
         c2.close()
+
+
+# ---------------------------------------------------------------------------
+# Lane retirement: cancel + native teardown belong to the owning drainer
+# ---------------------------------------------------------------------------
+
+class _FakeChip:
+    index = 0
+
+
+class _FakeTenant:
+    def __init__(self):
+        self.name = "ft"
+        self.chip = _FakeChip()
+        self.chips = [self.chip]
+        self.connections = 1
+        self.fastlane = None
+        self.refunds = []
+
+    def rate_adjust_all(self, delta):
+        self.refunds.append(int(delta))
+
+
+def _hub_with_lane(drainer: bool):
+    import types
+    hub = FL.FastlaneHub(types.SimpleNamespace())
+    t = _FakeTenant()
+    lane = FL.BrokerLane(t, FL.PyRing(16), None, None, {})
+    t.fastlane = lane
+    hub.lanes[t.name] = lane
+    if drainer:
+        hub.drainers[0] = object()  # marker: a drainer owns chip 0
+    return hub, t, lane
+
+
+def test_retired_lane_rides_graveyard_not_inline_close():
+    """close_lane (and a re-HELLO replacement in create_lane) must
+    never run the cancel or the native teardown from the control-plane
+    thread while a drainer owns the chip: the drainer may be mid-drain
+    on this very ring.  Both belong to reap_dead() on the drainer."""
+    hub, t, lane = _hub_with_lane(drainer=True)
+    for i in range(3):
+        assert lane.ring.submit(FL.PyDesc(route=i, cost_us=100))
+    hub.close_lane("ft")
+    # Control plane: gate published, lane handed to the graveyard —
+    # but NEITHER the cancel nor the native close ran yet.
+    assert lane.closed and lane.ring.gate() == FL.GATE_CLOSED
+    assert not getattr(lane, "_freed", False)
+    assert lane.ring.depth == 3 and t.refunds == []
+    assert lane in hub._dead[0] and "ft" not in hub.lanes
+    assert t.fastlane is None
+    # The owning drainer reaps: ECANCELED completions, pre-debit
+    # refunds, then the native teardown.
+    hub.reap_dead(0)
+    assert getattr(lane, "_freed", False)
+    assert t.refunds == [-300]
+    comps = lane.ring.completions(0, 4)
+    assert [c.status for c in comps] == [FL.EXEC_ECANCELED] * 3
+
+
+def test_close_lane_without_drainer_cancels_inline():
+    """mc manual mode / drainer-less chips keep the old inline path:
+    there is no consumer to race."""
+    hub, t, lane = _hub_with_lane(drainer=False)
+    assert lane.ring.submit(FL.PyDesc(route=0, cost_us=40))
+    hub.close_lane("ft")
+    assert getattr(lane, "_freed", False)
+    assert t.refunds == [-40]
+    assert hub._dead == {}
+
+
+def test_gate_close_defers_cancel_to_owning_drainer():
+    """take/complete are strictly single-consumer: a control-plane
+    cancel interleaved with a live drain would mislabel completions
+    (ECANCELED on items mid-execute, EXEC_OK on items that never
+    ran).  gate_close only flips the gate; the drainer's closed-check
+    path cancels."""
+    hub, t, lane = _hub_with_lane(drainer=True)
+    for i in range(2):
+        assert lane.ring.submit(FL.PyDesc(route=i, cost_us=50))
+    hub.gate_close("ft")
+    assert lane.closed and lane.ring.gate() == FL.GATE_CLOSED
+    assert lane.ring.depth == 2 and t.refunds == []
+    # One drainer pass over the chip: the closed lane cancels there.
+    hub.drain_once(t.chip)
+    assert t.refunds == [-100]
+    comps = lane.ring.completions(0, 2)
+    assert [c.status for c in comps] == [FL.EXEC_ECANCELED] * 2
+
+
+def test_gate_close_without_drainer_cancels_inline():
+    hub, t, lane = _hub_with_lane(drainer=False)
+    assert lane.ring.submit(FL.PyDesc(route=0, cost_us=70))
+    hub.gate_close("ft")
+    assert t.refunds == [-70]
+    assert lane.ring.depth == 0
+
+
+def test_quiesce_lane_refunds_before_slot_frees():
+    """release_tenant calls quiesce_lane BEFORE popping the tenant:
+    the cancel refunds must land while the tenant still owns its slot
+    (a refund after a concurrent HELLO's reset_slot would over-credit
+    the new tenant)."""
+    hub, t, lane = _hub_with_lane(drainer=False)
+    for i in range(2):
+        assert lane.ring.submit(FL.PyDesc(route=i, cost_us=30))
+    hub.quiesce_lane("ft")
+    assert t.refunds == [-60]
+    assert lane.closed and lane.ring.gate() == FL.GATE_CLOSED
+    # The lane is still registered (close_lane retires it later) and
+    # its subsequent cancel finds an empty ring — no double refund.
+    assert "ft" in hub.lanes
+    hub.close_lane("ft")
+    assert t.refunds == [-60]
+
+
+def test_cancel_refund_gated_on_slot_ownership():
+    """Straggler descriptors reaped AFTER release_tenant popped the
+    tenant must NOT refund: the recycled slot's bucket may already
+    belong to a new tenant (reset_slot wipes the stale debit at the
+    next claim instead)."""
+    hub, t, lane = _hub_with_lane(drainer=True)
+    hub.state.tenants = {}          # tenant already released
+    assert lane.ring.submit(FL.PyDesc(route=0, cost_us=90))
+    hub.close_lane("ft")
+    hub.reap_dead(0)
+    assert t.refunds == []          # canceled, not refunded
+    comps = lane.ring.completions(0, 1)
+    assert comps[0].status == FL.EXEC_ECANCELED
+    # ... while a still-registered tenant (re-HELLO lane replacement)
+    # does refund.
+    hub2, t2, lane2 = _hub_with_lane(drainer=True)
+    hub2.state.tenants = {"ft": t2}
+    assert lane2.ring.submit(FL.PyDesc(route=0, cost_us=90))
+    hub2.close_lane("ft")
+    hub2.reap_dead(0)
+    assert t2.refunds == [-90]
+
+
+def test_closed_ring_operations_raise(tmp_path):
+    """A closed ExecRing fails loudly: the native NULL-handle defaults
+    (gate() reads 0 = GATE_OPEN, submit refuses) silently spun a
+    producer holding a stale closed lane through the full ring-wedge
+    budget."""
+    prod, cons = _ring_pair(tmp_path)
+    cons.close()
+    prod.close()
+    for op in (prod.gate,
+               lambda: prod.submit(shim_core.ExecDesc()),
+               lambda: prod.tail,
+               lambda: prod.wait_headc(1, 0.01),
+               lambda: cons.take(1),
+               lambda: cons.complete([0], [0], 1)):
+        with pytest.raises(ConnectionError):
+            op()
+
+
+# ---------------------------------------------------------------------------
+# Primed-route rebind + reconnect staleness
+# ---------------------------------------------------------------------------
+
+def test_delete_of_ring_output_recharges_on_next_step(fl_broker):
+    """DELETE of a primed ring-route output releases its HBM charge;
+    the next ring step must re-bind it through the FULL charge path —
+    a blind ref swap would resurrect the id uncharged (quota bypass /
+    ledger drift)."""
+    sock, srv = fl_broker
+    from vtpu.runtime.client import RuntimeClient
+
+    c = RuntimeClient(sock, tenant="t-del")
+    try:
+        x = np.arange(1024, dtype=np.float32)
+        c.put(x, "x0")
+        exe = c.compile(lambda a: a * 2.0, [x])
+        _prime(c, exe.id)
+        for _ in range(5):
+            c.execute_send_ids(exe.id, ["x0"], ["y0"])
+        for _ in range(5):
+            assert c.recv_reply()["ok"]
+        t = srv.state.tenants["t-del"]
+        nb = t.nbytes["y0"]
+        region = srv.state.chip(0).region
+
+        def used():
+            return sum(int(region.device_stats(d).used_bytes)
+                       for d in range(region.ndevices))
+
+        u_full = used()
+        c.delete("y0")
+        assert "y0" not in t.nbytes
+        assert used() == u_full - nb
+        # Next ring step: the route's primed version is stale, so the
+        # drainer re-binds y0 under t.mu with a fresh charge.
+        c.execute_send_ids(exe.id, ["x0"], ["y0"])
+        assert c.recv_reply()["ok"]
+        assert t.nbytes.get("y0") == nb, "ring output resurrected uncharged"
+        assert used() == u_full, "HBM ledger drifted across delete+rebind"
+        got = c.get("y0")
+        np.testing.assert_allclose(got, x * 2.0, rtol=1e-6)
+    finally:
+        c.close()
+
+
+def test_broker_alive_probe_sees_dead_peer_past_buffered_bytes():
+    """The ring-wait liveness probe must report a dead peer even when
+    unconsumed pipelined reply bytes still sit in the receive buffer
+    (a PUT reply airborne at the kill): a peek-only probe reads those
+    bytes as 'alive' and strands the waiter for the full completion
+    timeout."""
+    import select as _select
+    import types
+    from vtpu.runtime.client import RuntimeClient
+
+    if not getattr(_select, "POLLRDHUP", 0):
+        pytest.skip("no POLLRDHUP on this platform")
+    a, b = socket.socketpair()
+    try:
+        stub = types.SimpleNamespace(sock=a, _rpc_timeout=0)
+        probe = RuntimeClient._broker_alive
+        assert probe(stub) is True              # quiet but open
+        b.sendall(b"pipelined-reply-bytes")
+        assert probe(stub) is True              # busy and open
+        b.close()                               # SIGKILL'd peer
+        assert probe(stub) is False, \
+            "buffered bytes masked the dead peer"
+    finally:
+        a.close()
+
+
+def test_fastbind_reconnect_drops_stale_lane(fl_broker, monkeypatch):
+    """A disconnect/reconnect inside the FASTBIND round-trip replaces
+    self._lane; the send must not continue on the stale lane (its
+    closed ring would only wedge the flush path) — it stays brokered
+    for this step and rides the fresh lane next time."""
+    sock, srv = fl_broker
+    from vtpu.runtime import protocol as P
+    from vtpu.runtime.client import RuntimeClient
+
+    c = RuntimeClient(sock, tenant="t-stale")
+    try:
+        x = np.arange(64, dtype=np.float32)
+        c.put(x, "x0")
+        exe = c.compile(lambda a: a + 1.0, [x])
+        _prime(c, exe.id)
+        real_rpc = c._rpc
+        stash = {}
+
+        def swapping_rpc(msg, **kw):
+            rep = real_rpc(msg, **kw)
+            if msg.get("kind") == P.FASTBIND and "lane" not in stash:
+                # What _connect does when the round-trip rode a
+                # reconnect: the old lane object is gone.
+                stash["lane"] = c._lane
+                c._lane = None
+            return rep
+
+        monkeypatch.setattr(c, "_rpc", swapping_rpc)
+        assert c._fastlane_send(exe.id, ["x0"], ["y1"]) is False
+        monkeypatch.setattr(c, "_rpc", real_rpc)
+        c._lane = stash["lane"]
+        # The send that fell back still works brokered end-to-end.
+        c.execute_send_ids(exe.id, ["x0"], ["y1"])
+        assert c.recv_reply()["ok"]
+        np.testing.assert_allclose(c.get("y1"), x + 1.0, rtol=1e-6)
+    finally:
+        c.close()
 
 
 # ---------------------------------------------------------------------------
