@@ -214,6 +214,12 @@ class RuntimeClient:
         # client's check only needs sub-100-step latency).
         self._fl_last: Optional[tuple] = None
         self._fl_gate_in = 0
+        # CANCELED-resubmit (docs/FAILOVER.md): a gate-close (park,
+        # migration quiesce, lane retirement) cancels in-flight ring
+        # descriptors — they never ran.  The client absorbs the resend
+        # itself (brokered, in order), so a gate-close is NEVER
+        # caller-visible; this counts the absorbed resubmits.
+        self.fl_resubmits = 0
         # Pipelined logical-reply tokens, in send order, ONLY while a
         # lane is active: ("w",) = one wire reply, ("r", seq, route)
         # (+ resolved result) = one ring completion.  recv_reply
@@ -343,6 +349,12 @@ class RuntimeClient:
             self._backoff_base)
         self._backoff_rng = random.Random(
             f"{self.tenant}\x00{os.getpid()}")
+        # Fast-reconnect window (docs/FAILOVER.md): how long after a
+        # connection loss the backoff stays flat (full-jitter, exponent
+        # clamped) — sized to cover a standby takeover / supervisor
+        # respawn so the blackout is the takeover, not jitter luck.
+        self._fast_reconnect_s = _env_float("VTPU_RECONNECT_FAST_S",
+                                            2.0)
         # Overload shedding (docs/SCHEDULING.md): synchronous requests
         # answered OVERLOAD retry this many times with full-jitter
         # backoff around the broker's retry_ms hint before surfacing
@@ -520,7 +532,8 @@ class RuntimeClient:
         budget = self._reconnect_timeout
         if self._grace_s > 0:
             budget = max(budget, self._grace_s)
-        deadline = time.monotonic() + budget
+        t_lost = time.monotonic()
+        deadline = t_lost + budget
         attempt = 0
         last: Optional[BaseException] = None
         while time.monotonic() < deadline:
@@ -528,13 +541,24 @@ class RuntimeClient:
                 self.sock.close()
             except OSError:
                 pass
+            # Fast-reconnect window (docs/FAILOVER.md): a dead broker
+            # refuses dials INSTANTLY, so the exponential backoff
+            # would outgrow a sub-second standby takeover (or daemon
+            # respawn) within a few attempts and turn a ~0.5s blackout
+            # into seconds of unlucky jitter.  For the first
+            # VTPU_RECONNECT_FAST_S the attempt counter is clamped —
+            # still FULL-jitter desynchronized (the stampede
+            # protection), just not yet exponential; a real outage
+            # grows past it exactly as before.
+            fast = (time.monotonic() - t_lost
+                    < self._fast_reconnect_s)
             try:
                 new_epoch, created, resumed = self._connect()
             except (ConnectionError, FileNotFoundError, OSError,
                     P.ProtocolError) as e:
                 last = e
                 attempt += 1
-                self._backoff_sleep(attempt, deadline)
+                self._backoff_sleep(attempt, deadline, fast=fast)
                 continue
             except RuntimeError_ as e:
                 # HELLO itself rejected (e.g. slots exhausted while the
@@ -542,7 +566,7 @@ class RuntimeClient:
                 # mid-handover): retryable.
                 last = e
                 attempt += 1
-                self._backoff_sleep(attempt, deadline)
+                self._backoff_sleep(attempt, deadline, fast=fast)
                 continue
             if resumed:
                 self.epoch = new_epoch
@@ -584,9 +608,14 @@ class RuntimeClient:
             f"broker unreachable for {budget:.0f}s "
             f"on {self._socket_path}: {last}")
 
-    def _backoff_sleep(self, attempt: int, deadline: float) -> None:
+    def _backoff_sleep(self, attempt: int, deadline: float,
+                       fast: bool = False) -> None:
         """One jittered backoff pause, clipped to the reconnect
-        deadline (the last attempt must not oversleep its budget)."""
+        deadline (the last attempt must not oversleep its budget).
+        ``fast`` clamps the exponent during the fast-reconnect window
+        — full jitter (the stampede desync) still applies."""
+        if fast:
+            attempt = min(attempt, 2)
         delay = full_jitter_delay(self._backoff_rng, self._backoff_base,
                                   self._backoff_cap, attempt)
         time.sleep(max(min(delay, deadline - time.monotonic()), 0.0))
@@ -863,11 +892,12 @@ class RuntimeClient:
             return {"ok": True, "outs": route["metas"],
                     "device_time_us": float(actual)}
         if status == fastlane_mod.EXEC_ECANCELED:
-            # The lane closed under this descriptor (teardown, forced
-            # fallback): the execute NEVER RAN — surface it like a
-            # connection loss so pipelined callers reset their pairing
-            # and resend, and force an immediate gate re-check so the
-            # very next send takes the brokered path.
+            # Safety net only: ECANCELED is normally absorbed BEFORE
+            # this point (_resubmit_canceled / _ring_pending_resolve
+            # re-run the never-executed item brokered, so a gate-close
+            # is not caller-visible).  A route without a resubmit key
+            # (never built since the key field shipped) still gets the
+            # legacy surface: reset pairing and let the caller resend.
             self._fl_gate_in = 0
             return {"ok": False, "code": "CONNECTION_LOST",
                     "error": "fastlane lane closed; this execute was "
@@ -876,6 +906,45 @@ class RuntimeClient:
             status, "INTERNAL")
         return {"ok": False, "code": code,
                 "error": f"fastlane execute failed (status {status})"}
+
+    def _resubmit_msg(self, route) -> Dict[str, Any]:
+        """The brokered EXECUTE frame that re-runs a gate-canceled
+        ring descriptor (the item never ran; resubmission is safe)."""
+        eid, arg_ids, out_ids = route["key"]
+        return {"kind": P.EXECUTE, "exe": eid, "args": list(arg_ids),
+                "outs": list(out_ids)}
+
+    def _resubmit_canceled(self, route) -> Dict[str, Any]:
+        """Absorb one gate-close cancel SYNCHRONOUSLY: re-run the
+        never-executed item brokered and hand its reply to the caller
+        in the canceled item's reply slot.  Only reached for an
+        UNRESOLVED head ring token — the resolve barrier guarantees no
+        later wire sends exist then, so a direct send/recv pair keeps
+        the FIFO reply contract."""
+        self.fl_resubmits += 1
+        self._fl_gate_in = 0
+        try:
+            P.send_msg(self.sock, self._maybe_stamp(
+                self._resubmit_msg(route)))
+            resp = self._recv()
+        except (ConnectionError, P.ProtocolError, OSError):
+            self._on_disconnect()
+            raise AssertionError("unreachable")
+        self._absorb_lease(resp)
+        return resp
+
+    def _resubmit_send(self, route) -> None:
+        """Absorb one gate-close cancel PIPELINED (the resolve
+        barrier's arm): ship the brokered re-run now — before any
+        later brokered send — so reply order matches token order."""
+        self.fl_resubmits += 1
+        self._fl_gate_in = 0
+        try:
+            P.send_msg(self.sock, self._maybe_stamp(
+                self._resubmit_msg(route)))
+        except (ConnectionError, P.ProtocolError, OSError):
+            self._on_disconnect()
+            raise AssertionError("unreachable")
 
     def _next_pending_reply(self) -> Dict[str, Any]:
         """Materialise the oldest pipelined logical reply, whichever
@@ -912,12 +981,24 @@ class RuntimeClient:
             except ConnectionError:
                 self._on_disconnect()
                 raise AssertionError("unreachable")
+        if res[0] == fastlane_mod.EXEC_ECANCELED \
+                and isinstance(route, dict) and route.get("key"):
+            # Gate-close (park, migration quiesce, lane retirement)
+            # canceled this descriptor before it ran: absorb the
+            # resend here — the caller sees a normal brokered reply,
+            # never a CONNECTION_LOST (docs/FAILOVER.md).
+            return self._resubmit_canceled(route)
         return self._ring_resp(route, res)
 
     def _ring_pending_resolve(self) -> None:
         """Resolve every outstanding ring token IN PLACE (order kept):
         the barrier before any brokered send that could observe ring
-        outputs — once resolved, the drainer has bound them."""
+        outputs — once resolved, the drainer has bound them.  A token
+        that resolved ECANCELED (gate-close: the item never ran) is
+        resubmitted brokered RIGHT HERE — before any later brokered
+        send — and its token converts to a wire token, so reply order
+        still matches send order and the cancel is never
+        caller-visible."""
         lane = self._lane
         if lane is None or not self._tok_ring:
             return
@@ -931,7 +1012,17 @@ class RuntimeClient:
                 except ConnectionError:
                     self._on_disconnect()
                     raise AssertionError("unreachable")
-                self._pending[i] = (tok[0], tok[1], tok[2], res)
+                route = tok[2]
+                if res[0] == fastlane_mod.EXEC_ECANCELED \
+                        and isinstance(route, dict) \
+                        and route.get("key"):
+                    self._resubmit_send(route)
+                    self._pending[i] = ("w",)
+                    self._tok_ring -= 1
+                    self._tok_wire += 1
+                    self._wire_out += 1
+                else:
+                    self._pending[i] = (tok[0], tok[1], tok[2], res)
 
     def _fastlane_send(self, eid: str, arg_ids, out_ids) -> bool:
         """Try to ship one unchained execute through the ring; False
@@ -965,7 +1056,8 @@ class RuntimeClient:
                             "id": int(rep["route"]),
                             "cost": float(rep.get("cost_us", 5000.0)
                                           or 1.0),
-                            "metas": rep.get("outs") or []}
+                            "metas": rep.get("outs") or [],
+                            "key": key}
                     return False
                 if int(rep.get("route", -1)) < 0:
                     # Program never executed broker-side: one brokered
@@ -976,7 +1068,8 @@ class RuntimeClient:
                 route = {"id": int(rep["route"]),
                          "cost": float(rep.get("cost_us", 5000.0)
                                        or 1.0),
-                         "metas": rep.get("outs") or []}
+                         "metas": rep.get("outs") or [],
+                         "key": key}
                 self._routes[key] = route
             self._fl_last = (eid, list(arg_ids), list(out_ids), route)
         self._fl_gate_in -= 1

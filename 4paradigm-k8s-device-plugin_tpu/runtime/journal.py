@@ -116,6 +116,19 @@ def _apply_record(state: Dict[str, Any], rec: Dict[str, Any]) -> None:
                 t["hbm"] = rec["hbm"]
             if rec.get("core") is not None:
                 t["core"] = rec["core"]
+    elif op == "migrate":
+        # Live tenant migration (admin MIGRATE, docs/FAILOVER.md): the
+        # post-migrate placement is what recovery must re-seed.  Array
+        # charges are POSITIONAL (chip-list index), so they stay valid
+        # across the device swap — only devices/slots move.
+        t = tenants.get(rec.get("name"))
+        if t is not None:
+            if rec.get("devices") is not None:
+                t["devices"] = rec["devices"]
+            if rec.get("slots") is not None:
+                t["slots"] = rec["slots"]
+            if rec.get("hbm") is not None:
+                t["hbm"] = rec["hbm"]
     elif op == "ema":
         t = tenants.get(rec.get("name"))
         if t is not None:
@@ -196,6 +209,15 @@ class Journal:
         # quarantined and disabled — fail closed, never guess.
         self._write_errors = 0
         self._broken = False
+        # vtpu-failover (docs/FAILOVER.md): optional epoch fence — a
+        # callable raising FencedEpoch when a standby has taken over.
+        # Checked BEFORE every write, so a fenced (stale) primary can
+        # never journal — and therefore never ack — a state change.
+        self.fence: Optional[Callable[[], None]] = None
+        # Replication tap (runtime/replication.py ReplicationHub): fed
+        # the raw framed bytes of every DURABLE append, in log order,
+        # under self.mu (the hub only queues — no I/O, no locks).
+        self.repl_tap: Optional[Any] = None
         self._last_snapshot_ts: Optional[float] = None
         try:
             st = os.stat(self.snap_path)
@@ -271,6 +293,13 @@ class Journal:
         if self._broken:
             raise OSError("journal is disabled after an unrecoverable "
                           "write failure (quarantined)")
+        # Epoch fence (docs/FAILOVER.md): once a standby has bumped the
+        # fence generation, this instance may never journal again — and
+        # since every mutating ack is journal-before-reply, a fenced
+        # stale primary can never ack.  Raises FencedEpoch (an OSError)
+        # so callers fail the request typed, never silently.
+        if self.fence is not None:
+            self.fence()
         # flush() reaches the OS page cache: enough to survive the
         # broker's own death (SIGKILL, os._exit).  fsync covers
         # machine death, at a per-record syscall cost.
@@ -291,6 +320,11 @@ class Journal:
             raise
         self._records_since += n
         self._appended_total += n
+        # Fan out AFTER the durable write: a record that failed (and
+        # was truncated back) must never reach a follower.
+        tap = self.repl_tap
+        if tap is not None:
+            tap.feed(data, n)
 
     def _repair_locked(self, off: Optional[int]) -> None:
         """Truncate the log back to the last good boundary after a
@@ -311,6 +345,39 @@ class Journal:
     def journal_broken(self) -> bool:
         return self._broken
 
+    def appended_total(self) -> int:
+        """Monotonic count of records ever appended by THIS instance —
+        the replication stream's sequence base."""
+        with self.mu:
+            return self._appended_total
+
+    def bootstrap_payload(self, attach: Optional[Callable[[], None]]
+                          = None) -> Tuple[bytes, bytes, int]:
+        """(snapshot bytes, log bytes incl. a crashed compaction's
+        rotated segment, sequence) — one consistent cut for a standby's
+        REPL_SYNC bootstrap.  ``attach`` (the hub registering the
+        follower's stream queue; pure in-memory work) runs under the
+        SAME self.mu critical section as the file read, so no append
+        can land between the bootstrap cut and the first streamed
+        record: the stream resumes exactly where the bootstrap ends."""
+        with self.mu:
+            snap = b""
+            try:
+                with open(self.snap_path, "rb") as f:
+                    snap = f.read()
+            except OSError:
+                pass
+            log = b""
+            for name in (LOG_NAME + ".old", LOG_NAME):
+                try:
+                    with open(os.path.join(self.dir, name), "rb") as f:
+                        log += f.read()
+                except OSError:
+                    pass
+            if attach is not None:
+                attach()
+            return snap, log, self._appended_total
+
     def snapshot_due(self) -> bool:
         with self.mu:
             return self._records_since >= self.snapshot_every
@@ -328,7 +395,24 @@ class Journal:
                 if self.fsync:
                     os.fsync(f.fileno())
             os.replace(tmp, path)
+            # Replicate the blob content too (docs/FAILOVER.md): the
+            # WAL records only carry the sha — a standby restoring
+            # arrays/programs at takeover needs the bytes.  Written
+            # blobs always precede their journal record, so the
+            # follower has the content by the time the record lands.
+            tap = self.repl_tap
+            if tap is not None:
+                tap.feed_blob(sha, data)
         return sha
+
+    def blob_names(self) -> List[str]:
+        """Names in the content-addressed store (bootstrap shipping)."""
+        try:
+            return [n for n in os.listdir(os.path.join(self.dir,
+                                                       BLOBS_DIR))
+                    if ".tmp." not in n]
+        except OSError:
+            return []
 
     def get_blob(self, sha: str) -> Optional[bytes]:
         if not sha or "/" in sha:

@@ -175,6 +175,28 @@ HANDOVER = "handover"    # {timeout?} -> {ok, tenants, snapshotted}
 # admissions until freed.
 RESIZE = "resize"        # {tenant, hbm_limit?|hbm_limits?, core_limit?}
                          # -> {ok, tenant, hbm, core}
+# MIGRATE (vtpu-failover, docs/FAILOVER.md): live tenant migration —
+# quiesce the tenant (queue hold + fastlane gate-close + in-flight
+# drain), move its device arrays, HBM charges and park/credit state
+# onto another chip, and resume, all without the tenant's sessions
+# noticing anything but a bounded latency blip (blackout_ms in the
+# reply).  Journaled (op "migrate" + replay arm) so the post-migrate
+# placement survives a broker crash at ANY journal cut.  Absolute-
+# target semantics like RESIZE: re-running a MIGRATE to the same chip
+# is a no-op, so the verb classifies idempotent.
+MIGRATE = "migrate"      # {tenant, device | devices, timeout?}
+                         # -> {ok, tenant, from, to, blackout_ms,
+                         #     moved_bytes}
+# REPL_SYNC (vtpu-failover, docs/FAILOVER.md): the hot-standby broker's
+# subscription verb.  With {status: true} it answers one frame — the
+# replication block (role, followers, lag, fence generation) — and the
+# connection stays usable.  Without it the reply is a snapshot
+# BOOTSTRAP ({ok, epoch, seq, snapshot, log}) followed by a continuous
+# stream of {records, seq} frames (raw CRC-framed journal lines, the
+# exact bytes the primary's WAL carries) and {hb} heartbeats until the
+# connection dies; the standby applies records through the existing
+# _apply_record arms and takes over on primary death.
+REPL_SYNC = "repl_sync"  # {status?} -> {ok, ...} (then a stream)
 
 # ---------------------------------------------------------------------------
 # Verb registries — the machine-checked protocol contract.
@@ -192,8 +214,8 @@ RESIZE = "resize"        # {tenant, hbm_limit?|hbm_limits?, core_limit?}
 TENANT_VERBS = (HELLO, PUT_PART, PUT, GET, DELETE, COMPILE, EXECUTE,
                 EXEC_BATCH, STATS, TRACE, SLO, FASTBIND)
 # Served on the host-side admin socket (<socket>.admin, never mounted).
-ADMIN_VERBS = (STATS, TRACE, SLO, SUSPEND, RESUME, RESIZE, SHUTDOWN,
-               DRAIN, HANDOVER)
+ADMIN_VERBS = (STATS, TRACE, SLO, SUSPEND, RESUME, RESIZE, MIGRATE,
+               REPL_SYNC, SHUTDOWN, DRAIN, HANDOVER)
 # Answer WITHOUT a HELLO binding — no tenant slot, no lazy chip claim,
 # so a read-only probe can never wedge a chip claim (ADVICE r5 #2).
 BIND_FREE_VERBS = (STATS, TRACE, SLO)
@@ -220,8 +242,12 @@ BIND_FREE_VERBS = (STATS, TRACE, SLO)
 # FASTBIND is idempotent: re-binding the same (exe, args, outs) triple
 # yields a fresh route index with identical behavior — a duplicate
 # route entry is benign, a re-run never double-executes anything.
+# MIGRATE sets an absolute placement (a re-run toward the same chip is
+# a no-op) and REPL_SYNC re-subscribes with a fresh bootstrap — both
+# safe to retry.
 IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, TRACE,
-                    SLO, SUSPEND, RESUME, RESIZE, DRAIN, FASTBIND)
+                    SLO, SUSPEND, RESUME, RESIZE, MIGRATE, REPL_SYNC,
+                    DRAIN, FASTBIND)
 NONIDEMPOTENT_VERBS = (PUT_PART, EXECUTE, EXEC_BATCH, SHUTDOWN,
                        HANDOVER)
 
@@ -281,6 +307,9 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
     RESUME: {"required": ("tenant",), "optional": ()},
     RESIZE: {"required": ("tenant",),
              "optional": ("hbm_limit", "hbm_limits", "core_limit")},
+    MIGRATE: {"required": ("tenant",),
+              "optional": ("device", "devices", "timeout")},
+    REPL_SYNC: {"required": (), "optional": ("status",)},
     SHUTDOWN: {"required": (), "optional": ()},
     DRAIN: {"required": (), "optional": ("timeout",)},
     HANDOVER: {"required": (), "optional": ("timeout",)},

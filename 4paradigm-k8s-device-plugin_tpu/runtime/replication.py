@@ -1,0 +1,761 @@
+"""vtpu-failover: streaming journal replication + hot-standby takeover.
+
+The broker's crash story so far is RESPAWN: a SIGKILLed broker's
+successor re-imports jax, re-reads the whole journal, replays it and
+only then binds the socket — ~1.4s best case plus the degraded-mode
+grace the clients ride out (docs/BROKER_RECOVERY.md, docs/CHAOS.md).
+This module removes the replay from the blackout path:
+
+  - **Streaming replication** (primary side, ``ReplicationHub``): a
+    standby subscribes over the host-side ADMIN socket (REPL_SYNC).
+    The bootstrap reply carries the journal's snapshot + log bytes cut
+    consistently under ``journal.mu``; from then on every durable
+    append fans its raw CRC-framed bytes into the follower's bounded
+    queue (``Journal.repl_tap``) and the admin-session thread streams
+    them out.  Backpressure is fail-fast: a follower whose queue
+    overflows (slow link, wedged standby) is dropped and must
+    re-bootstrap — the primary's write path never blocks on a
+    follower.
+
+  - **The standby** (``Standby``): applies the bootstrap through the
+    real ``Journal._parse_lines`` + ``_apply_record`` arms, mirrors
+    every streamed record into its OWN journal directory (so its disk
+    is always a valid journal), and keeps the applied state dict in
+    memory — always within a bounded lag of the primary.  Torn or
+    CRC-damaged stream data is NEVER applied: the frame is rejected
+    whole and the standby re-syncs via a fresh snapshot bootstrap
+    (mirroring the WAL's own torn-tail contract, machine-checked by
+    the mc crash engine's stream cuts).
+
+  - **Takeover**: on stream loss the standby probes the primary for
+    ``VTPU_REPL_CONFIRM_S``; if it stays dead (kill -9) — or it
+    explicitly drained — the standby FENCES the old epoch (bumps the
+    fence generation next to the listen socket; the old journal's
+    pre-write check then refuses every append, so a half-alive stale
+    primary can never ack again), claims the listen socket and chip
+    leases via the normal ``make_server`` path seeded with the
+    ALREADY-APPLIED state dict (no journal re-read, no replay), and
+    serves HELLO ``resume_epoch`` immediately.  Clients reattach
+    through the existing reconnect/epoch-resume machinery; fastlane
+    lanes are swept and renegotiated like any epoch change.
+
+Run a standby:  python -m vtpu.runtime.replication \
+                    --socket /run/vtpu/rt.sock --journal-dir /run/vtpu/standby
+
+docs/FAILOVER.md has the topology, the takeover state machine and the
+fencing rules; tools/chaos ``--failover`` chaos-verifies the blackout
+budget with the zero-leak/no-double-count invariants held ACROSS the
+takeover.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import socket as socketmod
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils import logging as log
+from . import journal as journal_mod
+from . import protocol as P
+
+# Follower stream-queue cap: past this many buffered bytes the
+# follower is dropped (it re-bootstraps) — the primary never blocks.
+REPL_BUFFER_BYTES = int(float(os.environ.get(
+    "VTPU_REPL_BUFFER_MB", "64")) * (1 << 20))
+# Idle heartbeat period on the stream: a silent-but-alive primary
+# still proves liveness, and the standby's lag clock stays honest.
+REPL_HB_S = float(os.environ.get("VTPU_REPL_HB_S", "0.5"))
+# How long the standby probes a lost primary before taking over: long
+# enough to ride out an admin-socket hiccup, short enough to keep the
+# blackout budget (total takeover stays sub-second on a kill -9, where
+# the dead socket refuses instantly).
+REPL_CONFIRM_S = float(os.environ.get("VTPU_REPL_CONFIRM_S", "0.75"))
+
+
+class FencedEpoch(OSError):
+    """This broker's epoch has been fenced by a standby takeover: it
+    may never journal (and therefore never ack) again."""
+
+
+class Fence:
+    """Epoch fence: a tiny generation file next to the listen socket,
+    shared by the primary and every standby of that socket.
+
+    The primary ``claim()``s a generation at boot and ``check()``s it
+    before every journal write; a standby's takeover ``claim()`` bumps
+    the generation, after which the old primary's next check raises
+    ``FencedEpoch`` — it can no longer journal, so (journal-before-ack)
+    it can no longer acknowledge state changes.  ``VTPU_REPL_FENCE=0``
+    disables checks (single-broker deployments skip the per-append
+    stat)."""
+
+    def __init__(self, path: str, enabled: Optional[bool] = None):
+        self.path = path
+        if enabled is None:
+            enabled = os.environ.get("VTPU_REPL_FENCE", "1") != "0"
+        self.enabled = bool(enabled)
+        self.generation = 0
+
+    def read(self) -> int:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                return int(json.loads(f.read()).get("generation", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def claim(self, epoch: Optional[str] = None) -> int:
+        """Bump + adopt the fence generation (boot or takeover).
+        tmp+rename so a racing reader never sees a torn file."""
+        gen = self.read() + 1
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"generation": gen, "epoch": epoch,
+                                "pid": os.getpid(),
+                                "ts": time.time()}))
+        os.replace(tmp, self.path)
+        self.generation = gen
+        return gen
+
+    def check(self) -> None:
+        """Raise FencedEpoch when another instance has claimed a newer
+        generation.  Called from the journal's pre-write hook."""
+        if not self.enabled:
+            return
+        cur = self.read()
+        if cur > self.generation:
+            raise FencedEpoch(
+                f"epoch fenced: generation {self.generation} was "
+                f"superseded by {cur} (standby takeover) — this "
+                f"instance may not journal or ack")
+
+
+# ---------------------------------------------------------------------------
+# Stream application (pure helpers — shared by the standby and the mc
+# crash engine's replication-stream cuts)
+# ---------------------------------------------------------------------------
+
+class StreamCorrupt(ValueError):
+    """The replication stream carried a damaged record: nothing past
+    the damage may be applied — the standby must re-bootstrap."""
+
+
+def split_complete(data: bytes) -> Tuple[List[Dict[str, Any]], bytes,
+                                         bytes]:
+    """(records, complete_bytes, leftover) of a stream chunk: only
+    COMPLETE, CRC-good framed lines are decoded; a trailing partial
+    line is returned as leftover for the next chunk to extend.  CRC or
+    framing damage in a COMPLETE line raises StreamCorrupt — a torn
+    record is never applied, and nothing after the damage is either."""
+    end = data.rfind(b"\n")
+    if end < 0:
+        return [], b"", data
+    complete, leftover = data[:end + 1], data[end + 1:]
+    try:
+        recs = journal_mod.Journal._parse_lines(complete,
+                                                tail_tolerant=False)
+    except journal_mod.JournalCorrupt as e:
+        raise StreamCorrupt(str(e)) from e
+    return recs, complete, leftover
+
+
+def apply_stream(state: Dict[str, Any], data: bytes,
+                 leftover: bytes = b"") -> Tuple[int, bytes]:
+    """Apply one stream chunk onto a snapshot-shaped state dict through
+    the real ``_apply_record`` arms.  Returns (records applied, new
+    leftover).  Raises StreamCorrupt on damage — the caller's state is
+    then only advanced to the last good record boundary."""
+    recs, _complete, rest = split_complete(leftover + data)
+    for rec in recs:
+        journal_mod._apply_record(state, rec)
+    return len(recs), rest
+
+
+def bootstrap_state(snapshot: bytes, logdata: bytes) -> Dict[str, Any]:
+    """Rebuild the snapshot-shaped state dict from a REPL_SYNC
+    bootstrap payload — the same snapshot+replay the real recovery
+    performs, minus the disk.  A torn FINAL log line is tolerated
+    exactly like recovery tolerates the kill -9 artifact."""
+    state: Dict[str, Any] = {}
+    if snapshot:
+        try:
+            state = json.loads(snapshot)
+            if not isinstance(state, dict):
+                raise ValueError("snapshot is not a map")
+        except (ValueError, json.JSONDecodeError) as e:
+            raise StreamCorrupt(f"unreadable bootstrap snapshot: {e}") \
+                from e
+    state.setdefault("tenants", {})
+    state.setdefault("chips", {})
+    if logdata:
+        recs = journal_mod.Journal._parse_lines(logdata,
+                                                tail_tolerant=True)
+        for rec in recs:
+            journal_mod._apply_record(state, rec)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Primary side
+# ---------------------------------------------------------------------------
+
+class _Follower:
+    """One subscribed standby: a bounded tagged queue (("rec", bytes)
+    journal frames / ("blob", sha, bytes) blob contents) fed under
+    journal.mu and drained by the admin-session thread serving it."""
+
+    __slots__ = ("queue", "queued_bytes", "seq", "dropped", "wake",
+                 "since")
+
+    def __init__(self, seq: int):
+        self.queue: "collections.deque[tuple]" = collections.deque()
+        self.queued_bytes = 0
+        self.seq = seq          # records streamed (or queued) so far
+        self.dropped = False    # overflow: must re-bootstrap
+        self.wake = threading.Event()
+        self.since = time.time()
+
+    def push(self, item: tuple, nbytes: int, n_records: int) -> None:
+        if self.dropped:
+            return
+        if self.queued_bytes + nbytes > REPL_BUFFER_BYTES:
+            # Fail fast, never block the write path: the follower
+            # re-syncs via a fresh snapshot bootstrap.
+            self.dropped = True
+            self.queue.clear()
+            self.queued_bytes = 0
+        else:
+            self.queue.append(item)
+            self.queued_bytes += nbytes
+            self.seq += n_records
+        self.wake.set()
+
+
+class ReplicationHub:
+    """The primary's replication state: follower registry + the
+    journal tap.  Cheap when no follower is subscribed (one None check
+    per append)."""
+
+    def __init__(self, state: Any):
+        self.state = state
+        self.followers: List[_Follower] = []
+        self.fence: Optional[Fence] = None
+        self.role = "primary"
+        self.takeovers = 0
+        # Monotonic count of records ever fanned out (lag arithmetic).
+        self.fed_records = 0
+
+    # -- journal tap (called under journal.mu; queue-only, no I/O) ----------
+
+    def feed(self, data: bytes, n: int) -> None:
+        self.fed_records += n
+        for f in self.followers:
+            f.push(("rec", data), len(data), n)
+
+    def feed_blob(self, sha: str, data: bytes) -> None:
+        """Blob content for the followers (put_blob; the WAL record
+        carries only the sha).  Not sequence-counted — blobs are
+        unordered content-addressed side data."""
+        for f in self.followers:
+            f.push(("blob", sha, data), len(data), 0)
+
+    # -- the REPL_SYNC admin arm --------------------------------------------
+
+    def serve_follower(self, sock, msg: Dict[str, Any]) -> None:
+        """Serve one standby on its (dedicated) admin connection:
+        bootstrap + stream until the connection dies or the follower
+        overflows.  Runs in the admin-session thread."""
+        journal = self.state.journal
+        if journal is None:
+            P.reply_err(sock, "NO_JOURNAL",
+                        "replication needs a journaled broker "
+                        "(VTPU_JOURNAL_DIR)")
+            return
+        follower = None
+
+        def attach() -> None:
+            # Runs INSIDE journal.mu (bootstrap_payload): the seq read
+            # here is exactly the bootstrap's cut, so no append can
+            # land between the payload and the follower's first
+            # streamed record.
+            nonlocal follower
+            follower = _Follower(journal._appended_total)  # noqa: SLF001
+            self.followers.append(follower)
+            journal.repl_tap = self
+
+        snap, logdata, seq = journal.bootstrap_payload(attach=attach)
+        try:
+            P.send_msg(sock, {"ok": True, "epoch": self.state.epoch,
+                              "seq": seq, "snapshot": snap,
+                              "log": logdata,
+                              "fence_generation":
+                                  (self.fence.generation
+                                   if self.fence else 0)})
+            # Bootstrap the content-addressed blob store too: the WAL
+            # carries only shas, and the standby's takeover restore
+            # needs the bytes.  Read OUTSIDE journal.mu (blobs are
+            # immutable once written; one racing GC'd blob is skipped
+            # and its array drops at restore — graceful, never torn).
+            for name in journal.blob_names():
+                data = journal.get_blob(name)
+                if data is not None:
+                    P.send_msg(sock, {"blob": name, "data": data})
+            while True:
+                if follower.dropped:
+                    P.send_msg(sock, {"ok": False, "code": "REPL_LAG",
+                                      "error": "stream buffer "
+                                               "overflowed; "
+                                               "re-bootstrap"})
+                    return
+                recs: List[bytes] = []
+                blobs: List[tuple] = []
+                while follower.queue:
+                    item = follower.queue.popleft()
+                    if item[0] == "rec":
+                        follower.queued_bytes -= len(item[1])
+                        recs.append(item[1])
+                    else:
+                        follower.queued_bytes -= len(item[2])
+                        blobs.append(item)
+                for _kind, sha, data in blobs:
+                    P.send_msg(sock, {"blob": sha, "data": data})
+                if recs:
+                    P.send_msg(sock, {"records": b"".join(recs),
+                                      "seq": follower.seq})
+                else:
+                    P.send_msg(sock, {"hb": True, "seq": follower.seq})
+                follower.wake.clear()
+                follower.wake.wait(REPL_HB_S)
+        except OSError:
+            pass  # follower gone — normal
+        finally:
+            try:
+                self.followers.remove(follower)
+            except ValueError:
+                pass
+
+    # -- observability ------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The STATS/vtpu-smi replication block (docs/FAILOVER.md): a
+        silently-stalled standby is visible BEFORE it matters."""
+        jr = getattr(self.state, "journal", None)
+        seq = jr.appended_total() if jr is not None else 0
+        return {
+            "role": self.role,
+            "followers": [
+                {"lag_records": max(seq - f.seq, 0),
+                 "lag_bytes": f.queued_bytes,
+                 "dropped": f.dropped,
+                 "since": round(f.since, 3)}
+                for f in list(self.followers)],
+            "seq": seq,
+            "fence_generation": (self.fence.generation
+                                 if self.fence else 0),
+            "takeovers": self.takeovers,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Standby side
+# ---------------------------------------------------------------------------
+
+class Standby:
+    """A hot-standby broker process: follows the primary's WAL into an
+    in-memory state dict + a local journal copy, and takes over on
+    primary death or explicit handover."""
+
+    def __init__(self, socket_path: str, journal_dir: str,
+                 hbm_limit: int = 0, core_limit: int = 0,
+                 confirm_s: Optional[float] = None):
+        self.socket_path = socket_path
+        self.admin_path = socket_path + ".admin"
+        self.journal_dir = journal_dir
+        self.hbm_limit = hbm_limit
+        self.core_limit = core_limit
+        self.confirm_s = (REPL_CONFIRM_S if confirm_s is None
+                          else confirm_s)
+        self.state: Dict[str, Any] = {"tenants": {}, "chips": {}}
+        self.seq = 0
+        self.applied_records = 0
+        self.resyncs = 0
+        self.last_hb = 0.0
+        self.primary_epoch: Optional[str] = None
+        self._leftover = b""
+        self._stop = threading.Event()
+        self._srv = None  # post-takeover broker server
+
+    # -- wire ---------------------------------------------------------------
+
+    def _dial(self, timeout: float = 5.0) -> socketmod.socket:
+        s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        s.settimeout(timeout)
+        s.connect(self.admin_path)
+        return s
+
+    def _bootstrap(self, sock) -> None:
+        P.send_msg(sock, {"kind": P.REPL_SYNC})
+        rep = P.recv_msg(sock)
+        if not rep.get("ok"):
+            raise ConnectionError(
+                f"bootstrap refused: {rep.get('code')} "
+                f"{rep.get('error')}")
+        self.primary_epoch = rep.get("epoch")
+        snap = bytes(rep.get("snapshot") or b"")
+        logdata = bytes(rep.get("log") or b"")
+        self.state = bootstrap_state(snap, logdata)
+        self.seq = int(rep.get("seq", 0))
+        self._leftover = b""
+        # Mirror to disk: the standby's journal dir is always a valid
+        # journal — takeover (or a standby restart) recovers from it.
+        os.makedirs(os.path.join(self.journal_dir,
+                                 journal_mod.BLOBS_DIR), exist_ok=True)
+        snap_path = os.path.join(self.journal_dir,
+                                 journal_mod.SNAP_NAME)
+        tmp = snap_path + ".tmp"
+        if snap:
+            with open(tmp, "wb") as f:
+                f.write(snap)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, snap_path)
+        else:
+            try:
+                os.unlink(snap_path)
+            except OSError:
+                pass
+        with open(os.path.join(self.journal_dir,
+                               journal_mod.LOG_NAME), "wb") as f:
+            f.write(logdata)
+            f.flush()
+        try:
+            os.unlink(os.path.join(self.journal_dir,
+                                   journal_mod.LOG_NAME + ".old"))
+        except OSError:
+            pass
+
+    def _store_blob(self, sha: str, data: bytes) -> None:
+        """Mirror one content-addressed blob (tensor/program bytes the
+        takeover restore needs).  Verified against its sha — a damaged
+        blob is refused, and the restore path then drops that array
+        with its ledger released (fail graceful, never torn)."""
+        import hashlib
+        if not sha or "/" in sha:
+            return
+        if len(sha) == 64 and hashlib.sha256(data).hexdigest() != sha:
+            log.warn("replication: blob %s content hash mismatch; "
+                     "refusing it", sha[:12])
+            return
+        path = os.path.join(self.journal_dir, journal_mod.BLOBS_DIR,
+                            sha)
+        if os.path.exists(path):
+            return
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def _apply_chunk(self, data: bytes, seq: int) -> None:
+        """Validate + apply one streamed chunk; mirror ONLY the
+        complete, CRC-good bytes to the local log (a torn or damaged
+        record never lands on the standby's disk OR in its state)."""
+        recs, complete, self._leftover = split_complete(
+            self._leftover + data)
+        for rec in recs:
+            journal_mod._apply_record(self.state, rec)
+        self.applied_records += len(recs)
+        self.seq = seq
+        if complete:
+            with open(os.path.join(self.journal_dir,
+                                   journal_mod.LOG_NAME), "ab") as f:
+                f.write(complete)
+                f.flush()
+
+    # -- the follow loop ----------------------------------------------------
+
+    def follow_once(self) -> str:
+        """One bootstrap + stream session; returns why it ended:
+        'eof' (primary gone), 'lag' (dropped — re-bootstrap),
+        'corrupt' (stream damage — re-bootstrap), 'stopped'."""
+        sock = self._dial()
+        try:
+            self._bootstrap(sock)
+            log.info("replication: bootstrapped from epoch %s at "
+                     "seq %d (%d tenants)", self.primary_epoch,
+                     self.seq, len(self.state.get("tenants", {})))
+            sock.settimeout(max(4.0 * REPL_HB_S, 2.0))
+            while not self._stop.is_set():
+                try:
+                    msg = P.recv_msg(sock)
+                except socketmod.timeout:
+                    return "eof"  # heartbeats stopped: primary wedged
+                if msg.get("records") is not None:
+                    try:
+                        self._apply_chunk(bytes(msg["records"]),
+                                          int(msg.get("seq", self.seq)))
+                    except StreamCorrupt as e:
+                        log.warn("replication: corrupt stream chunk "
+                                 "(%s); re-syncing via bootstrap", e)
+                        self.resyncs += 1
+                        return "corrupt"
+                elif msg.get("blob") is not None:
+                    self._store_blob(str(msg["blob"]),
+                                     bytes(msg.get("data") or b""))
+                elif msg.get("hb"):
+                    self.last_hb = time.monotonic()
+                elif msg.get("code") == "REPL_LAG":
+                    log.warn("replication: dropped for lag; "
+                             "re-bootstrapping")
+                    self.resyncs += 1
+                    return "lag"
+            return "stopped"
+        except (ConnectionError, P.ProtocolError, OSError):
+            return "eof"
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def primary_alive(self) -> bool:
+        """Probe the primary's admin socket with a status REPL_SYNC."""
+        try:
+            s = self._dial(timeout=0.5)
+        except OSError:
+            return False
+        try:
+            P.send_msg(s, {"kind": P.REPL_SYNC, "status": True})
+            rep = P.recv_msg(s)
+            return bool(rep.get("ok"))
+        except (OSError, P.ProtocolError):
+            return False
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def confirm_dead(self) -> bool:
+        """Probe for confirm_s; True when the primary stayed gone."""
+        deadline = time.monotonic() + max(self.confirm_s, 0.0)
+        while True:
+            if self.primary_alive():
+                return False
+            if time.monotonic() >= deadline:
+                return True
+            if self._stop.wait(0.05):
+                return False
+
+    # -- takeover -----------------------------------------------------------
+
+    def takeover(self):
+        """Fence the old epoch and become the serving broker: the
+        already-applied state dict seeds recovery directly (no journal
+        re-read, no replay) and the listen socket + chip leases are
+        claimed through the normal ``make_server`` path.  Returns the
+        serving _Server."""
+        from .server import make_server
+        fence = Fence(self.socket_path + ".fence")
+        gen = fence.claim()
+        log.info("replication: TAKEOVER — fenced old epoch at "
+                 "generation %d, claiming %s (seq %d, %d tenants)",
+                 gen, self.socket_path, self.seq,
+                 len(self.state.get("tenants", {})))
+        srv = make_server(self.socket_path, self.hbm_limit,
+                          self.core_limit,
+                          journal_dir=self.journal_dir,
+                          preloaded_state=self.state,
+                          fence=fence)
+        srv.state.replication.role = "primary(took-over)"
+        srv.state.replication.takeovers += 1
+        self._srv = srv
+        return srv
+
+    def run(self) -> int:
+        """Follow until the primary dies (or drains away), then take
+        over and serve.  The standby's whole job is this loop."""
+        backoff = 0.05
+        while not self._stop.is_set():
+            try:
+                why = self.follow_once()
+            except OSError:
+                why = "eof"
+            if self._stop.is_set():
+                return 0
+            if why in ("lag", "corrupt"):
+                time.sleep(backoff)
+                continue
+            # Stream lost: primary dead, wedged, or drained.
+            if self.confirm_dead():
+                srv = self.takeover()
+                try:
+                    srv.serve_forever()
+                except KeyboardInterrupt:
+                    pass
+                return 0
+            time.sleep(backoff)
+        return 0
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            self._srv.shutdown()
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "role": "standby",
+            "seq": self.seq,
+            "applied_records": self.applied_records,
+            "resyncs": self.resyncs,
+            "tenants": len(self.state.get("tenants", {})),
+            "primary_epoch": self.primary_epoch,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Smoke + CLI
+# ---------------------------------------------------------------------------
+
+def _smoke() -> List[str]:
+    """Dependency-light wiring check (no jax, no broker): stream
+    framing + torn-record refusal, bootstrap equivalence with recovery,
+    and fence claim/check semantics.  Runs in the analyze CI job."""
+    import tempfile
+    errs: List[str] = []
+    frames = [journal_mod.Journal._frame(r) for r in (
+        {"op": "epoch", "epoch": "e1"},
+        {"op": "bind", "name": "t", "devices": [0], "slots": [2],
+         "priority": 1, "over": False, "hbm": [1024], "core": 50},
+        {"op": "put", "name": "t", "id": "x", "sha": "s", "shape": [4],
+         "dtype": "float32", "nbytes": 16, "charges": [[0, 16]],
+         "spilled": False},
+        {"op": "migrate", "name": "t", "devices": [1], "slots": [5],
+         "hbm": [1024]},
+        {"op": "del", "name": "t", "id": "x"},
+    )]
+    blob = b"".join(frames)
+
+    # Whole stream applies; state reflects every arm incl. migrate.
+    st: Dict[str, Any] = {"tenants": {}, "chips": {}}
+    n, left = apply_stream(st, blob)
+    if n != 5 or left:
+        errs.append(f"apply_stream applied {n} records, {len(left)}B "
+                    f"leftover (want 5, 0)")
+    t = st["tenants"].get("t", {})
+    if t.get("devices") != [1] or t.get("slots") != [5]:
+        errs.append(f"migrate arm not applied: {t.get('devices')}/"
+                    f"{t.get('slots')}")
+    if "x" in t.get("arrays", {}):
+        errs.append("del arm not applied through the stream")
+
+    # A chunk cut mid-record defers the partial line; nothing torn is
+    # ever applied, and the continuation completes it.
+    st2: Dict[str, Any] = {"tenants": {}, "chips": {}}
+    cut = len(frames[0]) + len(frames[1]) // 2
+    n1, left1 = apply_stream(st2, blob[:cut])
+    if n1 != 1 or "t" in st2["tenants"]:
+        errs.append(f"mid-record cut applied a torn record "
+                    f"(n={n1}, tenants={sorted(st2['tenants'])})")
+    n2, left2 = apply_stream(st2, blob[cut:], left1)
+    if n2 != 4 or left2 or "t" not in st2["tenants"]:
+        errs.append(f"continuation did not complete the deferred "
+                    f"record (n={n2})")
+
+    # A flipped byte in a COMPLETE record refuses the whole chunk.
+    dmg = bytearray(blob)
+    dmg[len(frames[0]) + 10] ^= 0x5A
+    st3: Dict[str, Any] = {"tenants": {}, "chips": {}}
+    try:
+        apply_stream(st3, bytes(dmg))
+        errs.append("flipped byte in a complete record was applied "
+                    "instead of refused")
+    except StreamCorrupt:
+        pass
+    if st3["tenants"]:
+        errs.append("damaged stream still mutated standby state")
+
+    # Bootstrap == recovery's snapshot+replay (torn tail tolerated).
+    bs = bootstrap_state(b"", blob + b"deadbeef {torn")
+    if "t" not in bs["tenants"]:
+        errs.append("bootstrap_state lost the replayed tenant")
+
+    # Fence: claim bumps, stale generation is refused.
+    with tempfile.TemporaryDirectory() as tmp:
+        fpath = os.path.join(tmp, "sock.fence")
+        primary = Fence(fpath, enabled=True)
+        primary.claim("e1")
+        try:
+            primary.check()
+        except FencedEpoch:
+            errs.append("fresh fence claim refused its own generation")
+        standby = Fence(fpath, enabled=True)
+        standby.claim("e2")
+        try:
+            primary.check()
+            errs.append("stale primary passed the fence check after a "
+                        "takeover claim (fenced-epoch-never-acks "
+                        "broken)")
+        except FencedEpoch:
+            pass
+        try:
+            standby.check()
+        except FencedEpoch:
+            errs.append("the taking-over standby fenced itself")
+    return errs
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from ..utils import envspec
+    ap = argparse.ArgumentParser(
+        prog="vtpu-replication",
+        description="hot-standby broker: follow a primary's journal "
+                    "stream and take over on its death "
+                    "(docs/FAILOVER.md)")
+    ap.add_argument("--socket", default=os.environ.get(
+        "VTPU_RUNTIME_SOCKET", "/usr/local/vtpu/vtpu-runtime.sock"),
+        help="the PRIMARY's main socket (admin = <socket>.admin; the "
+             "takeover claims this exact path)")
+    ap.add_argument("--journal-dir", required=False, default=None,
+                    help="the STANDBY's own journal dir (mirror of "
+                         "the stream; must differ from the primary's)")
+    ap.add_argument("--hbm-limit", default="0",
+                    help="post-takeover default per-tenant HBM quota")
+    ap.add_argument("--core-limit", type=int, default=0)
+    ap.add_argument("--confirm-s", type=float, default=None,
+                    help="how long to probe a lost primary before "
+                         "taking over (VTPU_REPL_CONFIRM_S)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="dependency-light wiring check (CI)")
+    ns = ap.parse_args(argv)
+    if ns.smoke:
+        errs = _smoke()
+        print(json.dumps({"smoke": "vtpu-replication", "ok": not errs,
+                          "errors": errs}, indent=2))
+        return 0 if not errs else 1
+    if not ns.journal_dir:
+        ap.error("--journal-dir is required (the standby's own "
+                 "journal mirror)")
+    hbm = envspec.parse_quantity(ns.hbm_limit) \
+        if ns.hbm_limit != "0" else 0
+    # Pre-warm the import graph while the primary is healthy: jax's
+    # import (NOT its platform init — the chip stays the primary's
+    # until takeover claims it) dominates a cold broker boot, so
+    # paying it here keeps the takeover blackout sub-second.
+    try:
+        import jax  # noqa: F401
+        import jax.export  # noqa: F401
+    except Exception as e:  # noqa: BLE001 - takeover will retry
+        log.warn("replication: jax pre-warm failed (%s)", e)
+    sb = Standby(ns.socket, ns.journal_dir, hbm_limit=hbm,
+                 core_limit=ns.core_limit, confirm_s=ns.confirm_s)
+    log.info("vtpu-replication: standby following %s -> %s",
+             ns.socket, ns.journal_dir)
+    return sb.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

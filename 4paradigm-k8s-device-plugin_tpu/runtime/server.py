@@ -130,6 +130,7 @@ from ..utils import logging as log
 from . import fastlane as fastlane_mod
 from . import faults
 from . import protocol as P
+from . import replication as repl_mod
 from . import slo as slo_mod
 from . import trace as tracing
 from .journal import Journal, JournalCorrupt
@@ -2008,7 +2009,8 @@ class RuntimeState:
     def __init__(self, region_path: str, hbm_limit: int, core_limit: int,
                  min_exec_cost_us: int = 0,
                  work_conserving: Optional[bool] = None,
-                 journal: Optional[Journal] = None):
+                 journal: Optional[Journal] = None,
+                 preloaded_state: Optional[dict] = None):
         import jax
         # jax lazy-loads public submodules: without this explicit import
         # the broker's first `jax.export.deserialize` dies with
@@ -2052,8 +2054,18 @@ class RuntimeState:
         # journal carries one: surfaced at recovery so an os._exit(3)
         # restart is attributable (ISSUE 2 satellite).
         self.last_wedge: Optional[dict] = None
+        # vtpu-failover (docs/FAILOVER.md): follower registry + the
+        # journal replication tap the REPL_SYNC admin arm streams from.
+        # Costs one None check per append until a standby subscribes.
+        self.replication = repl_mod.ReplicationHub(self)
         self._journal_state = None
-        if journal is not None:
+        if journal is not None and preloaded_state is not None:
+            # Hot-standby takeover: the standby followed the journal
+            # stream into this state dict already — recovery seeds
+            # from it directly, no re-read, no replay (the blackout
+            # path skips straight to socket/chip claim).
+            self._journal_state = preloaded_state
+        elif journal is not None:
             try:
                 self._journal_state = journal.load_state()
             except JournalCorrupt as e:
@@ -2063,6 +2075,7 @@ class RuntimeState:
                           "booting a fresh epoch", e)
                 journal.quarantine()
                 self.recovery["corrupt_recoveries"] += 1
+        if journal is not None:
             if self._journal_state is not None:
                 self.prev_epoch = self._journal_state.get("epoch")
                 self.recovery["recoveries_total"] = int(
@@ -3208,7 +3221,9 @@ class TenantSession(socketserver.BaseRequestHandler):
                                 "admission":
                                     self.state.admission_stats(),
                                 "fastlane":
-                                    self.state.fastlane.stats()})
+                                    self.state.fastlane.stats(),
+                                "replication":
+                                    self.state.replication.status()})
                     continue
                 if kind == P.TRACE:
                     # BIND-FREE like STATS (same no-chip-claim
@@ -3604,7 +3619,9 @@ class TenantSession(socketserver.BaseRequestHandler):
                                 "admission":
                                     self.state.admission_stats(),
                                 "fastlane":
-                                    self.state.fastlane.stats()})
+                                    self.state.fastlane.stats(),
+                                "replication":
+                                    self.state.replication.status()})
 
                 else:
                     self._send_err("BAD_KIND", str(kind))
@@ -4029,12 +4046,201 @@ def resize_tenant(state: RuntimeState, t: Tenant,
     return resize_rec
 
 
+def migrate_tenant(state: RuntimeState, t: Tenant,
+                   devices: List[int],
+                   timeout: Optional[float] = None
+                   ) -> Tuple[dict, Optional[dict]]:
+    """Live tenant migration (admin MIGRATE, docs/FAILOVER.md): move a
+    tenant — device arrays, HBM charges, queued work, park state —
+    onto another chip without its sessions noticing anything but a
+    bounded latency blip.
+
+    The move is quiesce / transfer / resume:
+
+      1. QUIESCE (blackout begins): hold the queue exactly like an
+         admin SUSPEND, revoke the rate lease (pre-debited budget
+         priced for the old chip's bucket must not outlive it), close
+         the fastlane lane (in-flight ring descriptors cancel and the
+         client's CANCELED-resubmit absorbs them brokered — the
+         gate-close is never caller-visible), and drain dispatched
+         work.
+      2. TRANSFER: host-copy the device arrays, claim + seed a slot on
+         the target chip from the SAME grant, force-admit the
+         positional charge books there (these bytes were already
+         admitted), then release the old chip's ledger — exact
+         conservation, machine-checked by the mc
+         ``migrate-conserves-ledger`` row.  Queued (not-yet-
+         dispatched) items and an auto-park entry move schedulers with
+         the tenant.
+      3. RESUME (blackout ends): swap chips/slots under state.mu,
+         re-place the arrays on the target device, release the hold.
+
+    Returns (reply, journal record); the CALLER appends the record
+    once it holds no fast lock, then acks — the post-migrate placement
+    survives a broker crash at ANY journal cut (crash engine covers
+    the canned migrate).  Multi-chip grants are refused (their sharded
+    programs are mesh-bound; ROADMAP item 3 extends this cross-node)."""
+    import numpy as np
+    if timeout is None:
+        timeout = float(os.environ.get("VTPU_MIGRATE_TIMEOUT_S", "30"))
+    t0 = time.monotonic()
+    targets = [int(d) for d in devices]
+    if len(targets) != len(t.chips) or len(set(targets)) != len(targets):
+        raise ValueError(
+            f"MIGRATE_UNSUPPORTED: target chips {targets} do not match "
+            f"the grant width {len(t.chips)}")
+    if len(t.chips) != 1:
+        raise ValueError(
+            "MIGRATE_UNSUPPORTED: multi-chip grants are mesh-bound "
+            "and cannot migrate yet")
+    src = [c.index for c in t.chips]
+    if targets == src:
+        return ({"ok": True, "tenant": t.name, "from": src,
+                 "to": targets, "noop": True, "blackout_ms": 0.0,
+                 "moved_bytes": 0}, None)
+    new_chips = [state.chip(d) for d in targets]
+    old_chips, old_slots = list(t.chips), list(t.slots)
+    old_sched = old_chips[0].scheduler
+    jax = state.jax
+    # -- 1. quiesce (blackout begins) --
+    hold = t.name not in state.suspended
+    if hold:
+        with state.mu:
+            state.suspended.add(t.name)
+    try:
+        with old_sched.mu:
+            t.lease_release()
+            t.lease_revoked = True
+        state.fastlane.quiesce_lane(t.name)
+        state.fastlane.close_lane(t.name)
+        old_sched.quiesce(t.name, timeout=max(timeout, 0.0))
+        # Host copies while the old placement is still live (device ->
+        # host sync; the authoritative bytes for the re-place below).
+        with t.mu:
+            arrays = list(t.arrays.items())
+            charge_items = {aid: list(ch)
+                            for aid, ch in t.charges.items()}
+            # Staged spill copies are pure cache on the OLD chip:
+            # drop them (releases their old-chip ledger bytes).
+            for aid in list(t.staged):
+                t.drop_staged(aid)
+        host_copies: Dict[str, Any] = {}
+        for aid, arr in arrays:
+            try:
+                host_copies[aid] = np.asarray(arr)
+            except Exception:  # noqa: BLE001 - fake/foreign arrays
+                host_copies[aid] = arr
+        # -- 2. transfer --
+        # Slot claim on the target chip(s), seeded from the SAME grant.
+        grant = t.grant or {}
+        g_hbm = grant.get("hbm") or []
+        g_core = grant.get("core")
+        with state.mu:
+            new_slots: List[int] = []
+            parked = [e[0] for e in state.recovered.values()]
+            for chip in new_chips:
+                used = {x.slots[k]
+                        for x in list(state.tenants.values()) + parked
+                        for k, c in enumerate(x.chips) if c is chip}
+                used.update(s for c, s in zip(new_chips[:len(new_slots)],
+                                              new_slots) if c is chip)
+                index = next((i for i in range(MAX_TENANTS)
+                              if i not in used), None)
+                if index is None:
+                    raise SlotsExhausted(
+                        f"no free tenant slot on target chip "
+                        f"{chip.index}")
+                new_slots.append(index)
+        new_hbm: List[int] = []
+        for k, (chip, slot) in enumerate(zip(new_chips, new_slots)):
+            chip.region.reset_slot(slot)
+            h = (int(g_hbm[k]) if k < len(g_hbm)
+                 and g_hbm[k] is not None else state.default_hbm)
+            chip.region.set_mem_limit(slot, h)
+            chip.region.set_core_limit(
+                slot, int(g_core) if g_core is not None
+                else state.default_core)
+            new_hbm.append(h)
+        # Force-admit the positional charge books on the target (these
+        # bytes were already admitted by the source placement); the
+        # applied list hands them back if anything below fails, so an
+        # aborted migration can never leak target-chip quota.
+        moved = 0
+        applied: List[Tuple[ChipState, int, int]] = []
+        try:
+            for aid, ch in charge_items.items():
+                for pos, nb in ch:
+                    new_chips[pos].region.mem_acquire(new_slots[pos],
+                                                      nb, True)
+                    applied.append((new_chips[pos], new_slots[pos], nb))
+                    moved += nb
+            # Queued work and park state move schedulers with the
+            # tenant (dispatched work already drained above).
+            with old_sched.mu:
+                q = old_sched.queues.get(t.name)
+                queued = list(q) if q else []
+                if q:
+                    q.clear()
+                    old_sched.total_backlog -= len(queued)
+                park = old_sched.preempted.pop(t.name, None)
+            old_sched.forget_tenant(t.name)
+            # -- 3. resume --
+            with state.mu:
+                t.chips = new_chips
+                t.slots = new_slots
+                t.chip = new_chips[0]
+                t.index = new_slots[0]
+                t._metered_cache = None
+            # Old-chip ledger released only after the swap: a crash
+            # between acquire and release double-books transiently in
+            # RAM only — the journal record (appended by the caller)
+            # carries the NEW placement, so recovery re-applies
+            # exactly once.
+            for aid, ch in charge_items.items():
+                for pos, nb in ch:
+                    old_chips[pos].region.mem_release(old_slots[pos],
+                                                      nb)
+        except Exception:
+            for chip, slot, nb in applied:
+                chip.region.mem_release(slot, nb)
+            raise
+        # Re-place the arrays on the target device.
+        for aid, _old in arrays:
+            dev = jax.device_put(host_copies[aid], t.chip.device)
+            with t.mu:
+                t.arrays[aid] = dev
+                t.arrays_ver += 1
+        new_sched = new_chips[0].scheduler
+        if park is not None:
+            with new_sched.mu:
+                new_sched.preempted[t.name] = park
+        if queued:
+            new_sched.submit_many(queued)
+    finally:
+        if hold:
+            with state.mu:
+                state.suspended.discard(t.name)
+    for chip in (old_chips[0], new_chips[0]):
+        chip.scheduler.kick()
+    t.grant = {"hbm": new_hbm, "core": g_core}
+    blackout_ms = (time.monotonic() - t0) * 1e3
+    migrate_rec = {"op": "migrate", "name": t.name,
+                   "devices": [c.index for c in new_chips],
+                   "slots": list(new_slots), "hbm": new_hbm}
+    reply = {"ok": True, "tenant": t.name, "from": src, "to": targets,
+             "blackout_ms": round(blackout_ms, 2),
+             "moved_bytes": moved}
+    return reply, migrate_rec
+
+
 class AdminSession(socketserver.BaseRequestHandler):
     """Host-side admin surface (<socket>.admin — NOT mounted into
     tenant containers, which is what keeps a hostile tenant from
     suspending or killing its neighbours).  Verbs: SUSPEND / RESUME
     (reference suspend_all/resume_all, SURVEY §2.9d), RESIZE (live
-    quota resize, ROADMAP item 4), STATS, SHUTDOWN."""
+    quota resize, ROADMAP item 4), MIGRATE / REPL_SYNC (live tenant
+    migration + hot-standby replication, docs/FAILOVER.md), STATS,
+    SHUTDOWN."""
 
     state: RuntimeState  # injected by make_server
 
@@ -4168,6 +4374,49 @@ class AdminSession(socketserver.BaseRequestHandler):
                                    {"ok": True, "tenant": name,
                                     "hbm": resize_rec["hbm"],
                                     "core": resize_rec["core"]})
+                elif kind == P.MIGRATE:
+                    name = str(msg["tenant"])
+                    devs = msg.get("devices")
+                    dev = msg.get("device")
+                    tmo = msg.get("timeout")
+                    with self.state.mu:
+                        t_obj = self.state.tenants.get(name)
+                    if t_obj is None:
+                        P.reply_err(self.request, "NOT_FOUND",
+                                    f"tenant {name!r} is not bound")
+                    else:
+                        targets = ([int(d) for d in devs] if devs
+                                   else [int(dev) if dev is not None
+                                         else 0])
+                        reply, migrate_rec = migrate_tenant(
+                            self.state, t_obj, targets,
+                            timeout=float(tmo) if tmo is not None
+                            else None)
+                        # Journal BEFORE the ack, like RESIZE: once
+                        # the operator sees ok, the new placement
+                        # survives a crash at any cut.
+                        jr = self.state.journal
+                        if migrate_rec is not None and jr is not None:
+                            jr.append(migrate_rec)
+                        log.info("admin: MIGRATE tenant %r %s -> %s "
+                                 "blackout=%.1fms moved=%dB", name,
+                                 reply.get("from"), reply.get("to"),
+                                 reply.get("blackout_ms", 0.0),
+                                 reply.get("moved_bytes", 0))
+                        P.send_msg(self.request, reply)
+                elif kind == P.REPL_SYNC:
+                    if msg.get("status"):
+                        P.send_msg(self.request, {
+                            "ok": True,
+                            "replication":
+                                self.state.replication.status()})
+                    else:
+                        # The connection becomes a dedicated stream:
+                        # bootstrap + follow until the standby (or
+                        # this broker) dies (docs/FAILOVER.md).
+                        self.state.replication.serve_follower(
+                            self.request, msg)
+                        return
                 elif kind == P.STATS:
                     with self.state.mu:
                         suspended = sorted(self.state.suspended)
@@ -4180,7 +4429,9 @@ class AdminSession(socketserver.BaseRequestHandler):
                                 "admission":
                                     self.state.admission_stats(),
                                 "fastlane":
-                                    self.state.fastlane.stats()})
+                                    self.state.fastlane.stats(),
+                                "replication":
+                                    self.state.replication.status()})
                 elif kind == P.TRACE:
                     # Host-side flight-recorder read (vtpu-smi trace):
                     # same body as the tenant-socket verb.
@@ -4316,7 +4567,9 @@ def make_server(socket_path: str, hbm_limit: int, core_limit: int,
                 region_path: Optional[str] = None,
                 min_exec_cost_us: int = 0,
                 work_conserving: Optional[bool] = None,
-                journal_dir: Optional[str] = None) -> _Server:
+                journal_dir: Optional[str] = None,
+                preloaded_state: Optional[dict] = None,
+                fence: Optional[repl_mod.Fence] = None) -> _Server:
     if os.path.exists(socket_path):
         os.unlink(socket_path)
     os.makedirs(os.path.dirname(socket_path) or ".", exist_ok=True)
@@ -4344,8 +4597,21 @@ def make_server(socket_path: str, hbm_limit: int, core_limit: int,
             # node's broker from booting at all.
             log.error("journal dir %s unusable (%s); running WITHOUT "
                       "crash recovery", jdir, e)
+    if jr is not None:
+        # Epoch fence (docs/FAILOVER.md): claim a generation at boot
+        # and check it before every journal write — after a standby
+        # takeover bumps it, THIS instance can never journal (and so
+        # never ack) again.  A takeover passes its already-claimed
+        # fence in; a plain boot claims fresh.
+        if fence is None:
+            fence = repl_mod.Fence(socket_path + ".fence")
+            fence.claim()
+        jr.fence = fence.check
     state = RuntimeState(rpath, hbm_limit, core_limit, min_exec_cost_us,
-                         work_conserving, journal=jr)
+                         work_conserving, journal=jr,
+                         preloaded_state=preloaded_state)
+    if fence is not None:
+        state.replication.fence = fence
     if jr is not None:
         threading.Thread(target=_journal_keeper, args=(state,),
                          daemon=True, name="vtpu-rt-journal").start()

@@ -76,6 +76,10 @@ NAME_LOCKS: Dict[str, str] = {
 # Non-self attribute tails: (previous chain element, attr) -> canonical.
 CHAIN_LOCKS: Dict[Tuple[str, str], str] = {
     ("scheduler", "mu"): "scheduler.mu",
+    # migrate_tenant's source/target scheduler handles (same class,
+    # same canonical lock — never both held at once).
+    ("old_sched", "mu"): "scheduler.mu",
+    ("new_sched", "mu"): "scheduler.mu",
     ("state", "mu"): "state.mu",
     ("state", "chips_mu"): "chips_mu",
     ("tenant", "mu"): "tenant.mu",

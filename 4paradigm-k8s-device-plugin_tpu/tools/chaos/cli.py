@@ -147,6 +147,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--tenants", type=int, default=4)
     ap.add_argument("--quick", action="store_true",
                     help="short windows (CI)")
+    ap.add_argument("--failover", action="store_true",
+                    help="failover cells instead of respawn churn: "
+                         "kill -9 the PRIMARY with a live hot standby "
+                         "and gate per-tenant blackout p99 against "
+                         "the load-scaled 1s budget + the respawn "
+                         "baseline measured in the same run "
+                         "(docs/FAILOVER.md)")
+    ap.add_argument("--no-control", action="store_true",
+                    help="skip the no-fault control cell (strict "
+                         "fixed thresholds; the default scales them "
+                         "by the machine's measured load factor)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--out", default=None, metavar="FILE")
     # tenant child plumbing (spawned by the driver)
@@ -179,29 +190,52 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps(out, indent=2 if not ns.json else None))
         return 0 if not errs else 1
 
-    from .driver import run_schedule
+    from .driver import measure_control, run_schedule
     seeds = [int(s) for s in ns.seeds.split(",") if s.strip()]
     if ns.random_extra:
         extra = random.SystemRandom().randrange(1, 10**6)
         print(f"[chaos] randomized extra seed: {extra} "
               f"(replay with --seeds {extra})", file=sys.stderr)
         seeds.append(extra)
-    report = {"suite": "vtpu-chaos churn", "tenants": ns.tenants,
+    suite = ("vtpu-chaos failover" if ns.failover
+             else "vtpu-chaos churn")
+    report = {"suite": suite, "tenants": ns.tenants,
               "quick": bool(ns.quick), "schedules": []}
     ok = True
     for seed in seeds:
         t0 = time.monotonic()
         print(f"[chaos] schedule seed={seed} ...", file=sys.stderr)
-        res = run_schedule(seed, tenants=ns.tenants, quick=ns.quick,
-                           log=lambda m: print(m, file=sys.stderr))
+        slog = lambda m: print(m, file=sys.stderr)  # noqa: E731
+        if ns.failover:
+            from .failover import run_failover
+            factor = 1.0
+            ctl = None
+            if not ns.no_control:
+                ctl = measure_control(seed, tenants=ns.tenants,
+                                      quick=ns.quick, log=slog)
+                factor = float(ctl.get("factor", 1.0))
+            res = run_failover(seed, tenants=ns.tenants,
+                               quick=ns.quick, log=slog,
+                               load_factor=factor)
+            if ctl is not None:
+                res["control"] = ctl
+            print(f"[chaos]   seed={seed} ok={res['ok']} "
+                  f"blackout_p99={res.get('blackout_p99_ms')}ms "
+                  f"respawn={res.get('respawn_baseline_ms')}ms "
+                  f"leak={res.get('region_leak_bytes')}B",
+                  file=sys.stderr)
+        else:
+            res = run_schedule(seed, tenants=ns.tenants,
+                               quick=ns.quick, log=slog,
+                               control=not ns.no_control)
+            print(f"[chaos]   seed={seed} ok={res['ok']} "
+                  f"recovery_ms={res.get('recovery_ms')} "
+                  f"ratio={res.get('recovery_ratio')} "
+                  f"leak={res.get('region_leak_bytes')}B",
+                  file=sys.stderr)
         res["wall_s"] = round(time.monotonic() - t0, 1)
         report["schedules"].append(res)
         ok = ok and res["ok"]
-        print(f"[chaos]   seed={seed} ok={res['ok']} "
-              f"recovery_ms={res.get('recovery_ms')} "
-              f"ratio={res.get('recovery_ratio')} "
-              f"leak={res.get('region_leak_bytes')}B",
-              file=sys.stderr)
         for v in res["violations"]:
             print(f"[chaos]   VIOLATION {v}", file=sys.stderr)
     report["ok"] = ok
@@ -210,7 +244,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(ns.out, "w") as f:
             f.write(text + "\n")
     print(text if ns.json else
-          json.dumps({"suite": "vtpu-chaos churn", "ok": ok,
+          json.dumps({"suite": suite, "ok": ok,
                       "schedules": len(report["schedules"])}))
     return 0 if ok else 1
 

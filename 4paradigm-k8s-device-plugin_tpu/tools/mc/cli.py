@@ -51,6 +51,8 @@ def _run_crash(ns: argparse.Namespace) -> Dict[str, Any]:
         "boundary_cuts": stats.boundary_cuts,
         "torn_cuts": stats.torn_cuts,
         "corrupt_checks": stats.corrupt_checks,
+        "repl_cuts": stats.repl_cuts,
+        "fence_checks": stats.fence_checks,
         "violations": stats.violations,
     }
 
@@ -94,6 +96,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="fail unless the interleaving engine explored "
                          "at least this many schedules in total (CI "
                          "floor gate)")
+    ap.add_argument("--min-cuts", type=int, default=0,
+                    help="fail unless the crash engine explored at "
+                         "least this many cuts in total (boundary + "
+                         "torn + corruption + replication-stream + "
+                         "fence; the CI floor covering the "
+                         "vtpu-failover crash-cut space)")
     ap.add_argument("--selfcheck", action="store_true",
                     help="run the seeded-violation matrix instead: "
                          "every invariant's checker must catch its "
@@ -154,7 +162,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"  crash: {cr['records']} records, "
                   f"{cr['boundary_cuts']} boundary cuts, "
                   f"{cr['torn_cuts']} torn cuts, "
-                  f"{cr['corrupt_checks']} corruption checks")
+                  f"{cr['corrupt_checks']} corruption checks, "
+                  f"{cr['repl_cuts']} replication-stream cuts, "
+                  f"{cr['fence_checks']} fence checks")
         for v in violations:
             print(f"VIOLATION: {v}")
         print(f"vtpu-mc: {len(violations)} violation(s)")
@@ -172,4 +182,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("vtpu-mc: crash engine did not cover every record "
               "boundary", file=sys.stderr)
         return 1
+    if ns.min_cuts and ns.engine in ("crash", "all"):
+        cr = report["crash"]
+        total = (cr["boundary_cuts"] + cr["torn_cuts"]
+                 + cr["corrupt_checks"] + cr["repl_cuts"]
+                 + cr["fence_checks"])
+        if total < ns.min_cuts:
+            print(f"vtpu-mc: crash-cut FLOOR MISSED: {total} < "
+                  f"--min-cuts {ns.min_cuts} — the crash-cut space "
+                  f"silently shrank", file=sys.stderr)
+            return 1
     return 1 if violations else 0

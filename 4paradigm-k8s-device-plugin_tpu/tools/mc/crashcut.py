@@ -96,11 +96,26 @@ def _canned_frames_b() -> List[bytes]:
     ]
 
 
+def _canned_frames_c() -> List[bytes]:
+    import numpy as np
+    from ...runtime import protocol as P
+    wc = np.full(16, 3.0, dtype=np.float32)      # 64 B
+    return [
+        P.frame_header({"kind": P.HELLO, "tenant": "C", "priority": 1,
+                        "device": 0, "hbm_limit": 4096,
+                        "core_limit": 40, "pid": os.getpid()}),
+        P.frame_header({"kind": P.PUT, "id": "wc", "shape": [16],
+                        "dtype": "float32", "data": wc.tobytes()}),
+    ]
+
+
 def _setup_canned(h: Harness, sched: mcsched.Scheduler) -> None:
     """One sequential driver task: session A runs its full life through
     the REAL handle() loop (incl. the teardown close record), then
-    session B binds a two-chip grant and is left LIVE — so every cut
-    prefix recovers a mix of closed and open tenants."""
+    session B binds a two-chip grant and is left LIVE, and session C
+    binds single-chip and is live-MIGRATED chip0 -> chip1 through the
+    real admin arm — so every cut prefix recovers a mix of closed,
+    open, resized and migrated tenants."""
     def driver() -> None:
         from ...runtime import protocol as P
         jr = h.state.journal
@@ -124,6 +139,18 @@ def _setup_canned(h: Harness, sched: mcsched.Scheduler) -> None:
             {"kind": P.RESIZE, "tenant": "B", "hbm_limit": 8192,
              "core_limit": 20})])
         adm.handle()
+        # Session C: single-chip tenant with one charged array, then a
+        # LIVE MIGRATION chip0 -> chip1 through the real MIGRATE arm
+        # (ISSUE 13): every cut past the migrate record must recover C
+        # on the NEW chip with the charge books conserved exactly —
+        # the migrate-conserves-ledger row.
+        sock_c = ScriptSock(_canned_frames_c())
+        sess_c = h.session(sock_c)
+        box_c: List[Any] = [None]
+        sess_c._serve(sock_c, box_c)    # no teardown: C stays live
+        adm2 = h.admin([P.frame_header(
+            {"kind": P.MIGRATE, "tenant": "C", "device": 1})])
+        adm2.handle()
         # A claim-watchdog wedge record (runtime/server.py
         # wedge_report's dying words) closes the log.
         jr.append({"op": "wedge", "stage": "mc-canned",
@@ -223,6 +250,18 @@ def _predict(records: List[Dict[str, Any]],
                 tenants[rec["name"]]["hbm"] = rec["hbm"]
             if rec.get("core") is not None:
                 tenants[rec["name"]]["core"] = rec["core"]
+        elif op == "migrate" and rec.get("name") in tenants:
+            # Live migration (docs/FAILOVER.md): the post-migrate
+            # placement is what recovery must re-seed; the arrays (and
+            # their positional charges) are CONSERVED by construction
+            # in this independent reading — a replay arm that loses or
+            # re-books them diverges.
+            if rec.get("devices") is not None:
+                tenants[rec["name"]]["devices"] = rec["devices"]
+            if rec.get("slots") is not None:
+                tenants[rec["name"]]["slots"] = rec["slots"]
+            if rec.get("hbm") is not None:
+                tenants[rec["name"]]["hbm"] = rec["hbm"]
         elif op == "ema" and rec.get("name") in tenants:
             tenants[rec["name"]]["ema"][rec["key"]] = rec.get("ema")
             if rec.get("execs") is not None:
@@ -252,6 +291,43 @@ def _predict(records: List[Dict[str, Any]],
             "lease_us": 0.0,
         }
     return {"epoch": epoch, "tenants": out}
+
+
+def _stream_digest(state: Dict[str, Any], default_hbm: int,
+                   default_core: int) -> Dict[str, Any]:
+    """A standby's applied state dict (snapshot shape) rendered into
+    the SAME digest shape ``_predict`` emits, so the replication-stream
+    cuts are judged against the independent interpreter exactly like
+    recovery is."""
+    out: Dict[str, Any] = {}
+    for name, t in (state.get("tenants") or {}).items():
+        hbm = t.get("hbm") or []
+        ndev = len(t.get("devices") or [0])
+        arrays = t.get("arrays") or {}
+        out[name] = {
+            "devices": [int(d) for d in t.get("devices") or [0]],
+            "slots": [int(s) for s in t.get("slots") or []],
+            "priority": int(t.get("priority", 1)),
+            "over": bool(t.get("over", False)),
+            "grant": {
+                "hbm": [int(hbm[k]) if k < len(hbm) and hbm[k] is not None
+                        else default_hbm for k in range(ndev)],
+                "core": int(t["core"]) if t.get("core") is not None
+                else default_core,
+            },
+            "charges": {aid: sorted(tuple(c)
+                                    for c in am.get("charges") or [])
+                        for aid, am in arrays.items()},
+            "nbytes": {aid: (0 if am.get("spilled")
+                             else int(am.get("nbytes", 0)))
+                       for aid, am in arrays.items()},
+            "exes": dict(t.get("exes") or {}),
+            "ema": {k: float(v)
+                    for k, v in (t.get("ema") or {}).items()},
+            "execs": int(t.get("execs", 0)),
+            "lease_us": 0.0,
+        }
+    return {"epoch": state.get("epoch"), "tenants": out}
 
 
 # ---------------------------------------------------------------------------
@@ -405,6 +481,12 @@ class CutContext:
     reresume_violations: List[str] = field(default_factory=list)
     torn_violations: List[str] = field(default_factory=list)
     corrupt_violations: List[str] = field(default_factory=list)
+    # vtpu-failover rows (docs/FAILOVER.md): replication-stream cuts,
+    # migrate conservation, epoch fencing.
+    repl_violations: List[str] = field(default_factory=list)
+    repl_torn_violations: List[str] = field(default_factory=list)
+    migrate_violations: List[str] = field(default_factory=list)
+    fence_violations: List[str] = field(default_factory=list)
 
     @staticmethod
     def tenant_digest(state: Dict[str, Any]) -> Dict[str, Any]:
@@ -417,6 +499,8 @@ class CrashStats:
     boundary_cuts: int = 0
     torn_cuts: int = 0
     corrupt_checks: int = 0
+    repl_cuts: int = 0
+    fence_checks: int = 0
     violations: List[str] = field(default_factory=list)
 
 
@@ -450,6 +534,44 @@ def explore(record_dir: Optional[str] = None,
         records = split_records(log)
         stats.records = len(records)
         boundaries = [0] + [end for _s, end, _r in records]
+        migrate_idx = next((k for k, (_s, _e, r) in enumerate(records)
+                            if r.get("op") == "migrate"), None)
+
+        def _migrate_checks(ctx: "CutContext", i: int) -> None:
+            """migrate-conserves-ledger: every cut PAST the migrate
+            record must recover the tenant on the journaled target
+            placement with its charge books conserved exactly (judged
+            against the independent interpreter, whose migrate arm
+            conserves by construction)."""
+            if migrate_idx is None or i <= migrate_idx:
+                return
+            mrec = records[migrate_idx][2]
+            mname = mrec.get("name")
+            got = ctx.state_a["tenants"].get(mname)
+            want = (ctx.expected or {}).get(mname)
+            if got is None or want is None:
+                ctx.migrate_violations.append(
+                    f"cut {ctx.label}: migrated tenant {mname!r} lost "
+                    f"at recovery")
+                return
+            if got.get("devices") != mrec.get("devices") or \
+                    got.get("slots") != mrec.get("slots"):
+                ctx.migrate_violations.append(
+                    f"cut {ctx.label}: migrated tenant {mname!r} "
+                    f"recovered on {got.get('devices')}/"
+                    f"{got.get('slots')} instead of the journaled "
+                    f"post-migrate placement {mrec.get('devices')}/"
+                    f"{mrec.get('slots')}")
+            got_total = sum(nb for ch in got.get("charges", {}).values()
+                            for _p, nb in ch)
+            want_total = sum(nb for ch in want.get("charges",
+                                                   {}).values()
+                             for _p, nb in ch)
+            if got_total != want_total:
+                ctx.migrate_violations.append(
+                    f"cut {ctx.label}: migration did not conserve the "
+                    f"ledger: recovered {got_total}B of charges vs "
+                    f"the independent reading's {want_total}B")
 
         def _labels(i: int) -> str:
             if i == 0:
@@ -473,6 +595,7 @@ def explore(record_dir: Optional[str] = None,
                 [r for _s, _e, r in records[:i]],
                 rec_a.h.state.default_hbm,
                 rec_a.h.state.default_core)["tenants"]
+            _migrate_checks(ctx, i)
             # Resume-safety checks mutate rec_a (try_resume) — digest
             # was taken first.
             ctx.resume_violations = _resume_checks(rec_a)
@@ -581,6 +704,146 @@ def explore(record_dir: Optional[str] = None,
             inv_registry.run_checks("crash", "cut", ctx))
         stats.corrupt_checks += 1
         shutil.rmtree(cut, ignore_errors=True)
+
+        # -- replication-stream cuts (docs/FAILOVER.md): the recorded
+        # WAL doubles as the REPL_SYNC stream.  Cut it at every record
+        # boundary (the standby's applied state must equal the
+        # independent interpreter's reading), mid-record (the torn
+        # fragment defers, is NEVER applied, and the continuation
+        # completes it), and with a flipped byte (the whole chunk is
+        # refused and nothing past the damage mutates standby state —
+        # the re-bootstrap signal, mirroring the WAL's own fail-closed
+        # contract) ---------------------------------------------------
+        from ...runtime import replication as repl
+        d_hbm, d_core = 1 << 20, 50
+        for i, off in enumerate(boundaries):
+            ctx = CutContext(label=f"repl-{_labels(i)}", state_a={},
+                             state_b={})
+            st: Dict[str, Any] = {"tenants": {}, "chips": {}}
+            try:
+                n, left = repl.apply_stream(st, log[:off])
+            except repl.StreamCorrupt as e:
+                ctx.repl_violations.append(
+                    f"cut {ctx.label}: clean boundary prefix refused "
+                    f"as corrupt ({e})")
+                n, left = 0, b""
+            got = _stream_digest(st, d_hbm, d_core)["tenants"]
+            want = _predict([r for _s, _e, r in records[:i]],
+                            d_hbm, d_core)["tenants"]
+            if got != want:
+                ctx.repl_violations.append(
+                    f"cut {ctx.label}: standby state after {n} "
+                    f"streamed records diverges from the independent "
+                    f"reading")
+            if left:
+                ctx.repl_violations.append(
+                    f"cut {ctx.label}: a boundary-aligned prefix left "
+                    f"{len(left)}B of deferred partial record")
+            stats.violations.extend(
+                inv_registry.run_checks("crash", "cut", ctx))
+            stats.repl_cuts += 1
+        for i, (start, end, r) in enumerate(records):
+            frag = start + max((end - start) // 2, 1)
+            ctx = CutContext(label=f"repl-torn[{i}]=mid-{r.get('op')}",
+                             state_a={}, state_b={})
+            st2: Dict[str, Any] = {"tenants": {}, "chips": {}}
+            try:
+                _n, left = repl.apply_stream(st2, log[:frag])
+            except repl.StreamCorrupt as e:
+                ctx.repl_torn_violations.append(
+                    f"cut {ctx.label}: a mid-record chunk boundary "
+                    f"must DEFER the fragment, not refuse the stream "
+                    f"({e})")
+                left = b""
+            got = _stream_digest(st2, d_hbm, d_core)["tenants"]
+            want = _predict([x for _s, _e, x in records[:i]],
+                            d_hbm, d_core)["tenants"]
+            if got != want:
+                ctx.repl_torn_violations.append(
+                    f"cut {ctx.label}: a torn stream record was "
+                    f"applied (state diverges from the last complete "
+                    f"boundary)")
+            # The continuation must complete the deferred fragment.
+            try:
+                repl.apply_stream(st2, log[frag:end], left)
+            except repl.StreamCorrupt as e:
+                ctx.repl_torn_violations.append(
+                    f"cut {ctx.label}: the continuation of a deferred "
+                    f"fragment was refused ({e})")
+            else:
+                got2 = _stream_digest(st2, d_hbm, d_core)["tenants"]
+                want2 = _predict([x for _s, _e, x in records[:i + 1]],
+                                 d_hbm, d_core)["tenants"]
+                if got2 != want2:
+                    ctx.repl_torn_violations.append(
+                        f"cut {ctx.label}: the continuation did not "
+                        f"complete the deferred record")
+            stats.violations.extend(
+                inv_registry.run_checks("crash", "cut", ctx))
+            stats.repl_cuts += 1
+        ctx = CutContext(label="repl-corrupt[flip-mid-log]",
+                         state_a={}, state_b={})
+        st4: Dict[str, Any] = {"tenants": {}, "chips": {}}
+        try:
+            repl.apply_stream(st4, _flip_byte(log, records))
+            ctx.repl_torn_violations.append(
+                "repl-corrupt: a flipped byte in a complete stream "
+                "record was applied instead of refused (the standby "
+                "must re-bootstrap)")
+        except repl.StreamCorrupt:
+            pass
+        if st4["tenants"]:
+            ctx.repl_torn_violations.append(
+                "repl-corrupt: a refused stream chunk still mutated "
+                "standby state")
+        stats.violations.extend(
+            inv_registry.run_checks("crash", "cut", ctx))
+        stats.repl_cuts += 1
+
+        # -- epoch fencing (docs/FAILOVER.md): after a takeover claims
+        # a newer fence generation, the stale primary's check must
+        # refuse — and a journal wired to that fence must refuse
+        # appends (journal-before-ack means it can never ack) ---------
+        from ...runtime.journal import Journal
+        ctx = CutContext(label="fence[takeover]", state_a={},
+                         state_b={})
+        fdir = os.path.join(tmp, "fence")
+        os.makedirs(fdir, exist_ok=True)
+        fpath = os.path.join(fdir, "sock.fence")
+        stale = repl.Fence(fpath, enabled=True)
+        stale.claim("old-epoch")
+        taker = repl.Fence(fpath, enabled=True)
+        taker.claim("new-epoch")
+        fired = False
+        try:
+            stale.check()
+        except OSError:
+            fired = True
+        if not fired:
+            ctx.fence_violations.append(
+                "a stale primary's fence check passed after a "
+                "takeover claimed a newer generation")
+        fenced_jr = Journal(os.path.join(fdir, "j"),
+                            snapshot_every=100_000, fsync=False)
+        fenced_jr.fence = stale.check
+        try:
+            fenced_jr.append({"op": "chip", "index": 0,
+                              "lat_us": 1.0})
+            ctx.fence_violations.append(
+                "a journal wired to a fenced epoch still accepted an "
+                "append (a stale primary could journal — and ack)")
+        except OSError:
+            pass
+        fenced_jr.close()
+        try:
+            taker.check()
+        except OSError:
+            ctx.fence_violations.append(
+                "the taking-over standby's own fence check refused "
+                "its freshly claimed generation")
+        stats.violations.extend(
+            inv_registry.run_checks("crash", "cut", ctx))
+        stats.fence_checks += 1
     finally:
         if own_tmp:
             shutil.rmtree(tmp, ignore_errors=True)

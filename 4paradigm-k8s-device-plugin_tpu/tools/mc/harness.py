@@ -407,6 +407,10 @@ class Harness:
         st.fastlane = S.fastlane_mod.FastlaneHub(st)
         st.fastlane.manual = True
         st.fastlane.admit_log = []
+        # vtpu-failover replication hub (docs/FAILOVER.md): inert with
+        # no follower; the STATS arms read its status block, and the
+        # crash engine's canned session drives the real MIGRATE arm.
+        st.replication = S.repl_mod.ReplicationHub(st)
         st.suspended = set()
         st.blob_cache = collections.OrderedDict()
         st.chain_cache = collections.OrderedDict()

@@ -329,6 +329,39 @@ def _chk_fail_closed(c: Any) -> List[str]:
     return c.corrupt_violations
 
 
+def _chk_repl_stream(c: Any) -> List[str]:
+    """vtpu-failover (docs/FAILOVER.md): a standby applying the
+    replication stream through the real _apply_record arms must land
+    on exactly the independent interpreter's reading at every record
+    boundary — bounded lag, no divergence."""
+    return getattr(c, "repl_violations", [])
+
+
+def _chk_repl_torn(c: Any) -> List[str]:
+    """A torn or CRC-damaged stream record is NEVER applied: a
+    mid-record chunk defers the fragment (the continuation completes
+    it), and a flipped byte refuses the whole chunk so the standby
+    re-syncs via snapshot bootstrap — mirroring the WAL's own
+    fail-closed contract."""
+    return getattr(c, "repl_torn_violations", [])
+
+
+def _chk_migrate_ledger(c: Any) -> List[str]:
+    """Live migration conserves the ledger exactly: every journal cut
+    past the migrate record recovers the tenant on the journaled
+    target placement with its charge books byte-identical to the
+    independent reading (no lost, duplicated or re-booked charges)."""
+    return getattr(c, "migrate_violations", [])
+
+
+def _chk_fenced_epoch(c: Any) -> List[str]:
+    """fenced-epoch-never-acks: once a takeover claims a newer fence
+    generation, the stale primary's fence check — and therefore every
+    journal append, and therefore every journal-before-reply ack —
+    must refuse."""
+    return getattr(c, "fence_violations", [])
+
+
 INVARIANTS: Tuple[Invariant, ...] = (
     Invariant(
         "token-conservation", "interleave", "terminal",
@@ -408,6 +441,27 @@ INVARIANTS: Tuple[Invariant, ...] = (
         "corruption-fails-closed", "crash", "cut",
         "non-tail journal damage raises JournalCorrupt (no guessed "
         "quota state)", _chk_fail_closed),
+    Invariant(
+        "replication-lag-bounded", "crash", "cut",
+        "a standby applying the replication stream through the real "
+        "_apply_record arms equals the independent reading at every "
+        "record boundary (no divergence, bounded lag)",
+        _chk_repl_stream),
+    Invariant(
+        "repl-torn-never-applied", "crash", "cut",
+        "a torn/CRC-damaged stream record is never applied: fragments "
+        "defer, damage refuses the chunk and forces a snapshot "
+        "re-bootstrap", _chk_repl_torn),
+    Invariant(
+        "migrate-conserves-ledger", "crash", "cut",
+        "live migration recovers on the journaled target placement "
+        "with charge books conserved exactly at every cut",
+        _chk_migrate_ledger),
+    Invariant(
+        "fenced-epoch-never-acks", "crash", "cut",
+        "after a takeover bumps the fence generation, the stale "
+        "primary can never journal (and so never ack) again",
+        _chk_fenced_epoch),
     Invariant(
         "wmm-no-torn-payload", "wmm", "litmus",
         "no seqlock/ring reader ever ACCEPTS a torn or stale payload "

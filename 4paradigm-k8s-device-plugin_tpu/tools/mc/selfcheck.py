@@ -339,6 +339,96 @@ def _seed_corruption_swallowed() -> Iterator[None]:
 
 
 @contextlib.contextmanager
+def _seed_lossy_migration() -> Iterator[None]:
+    """The migrate replay arm silently drops one of the tenant's
+    arrays: the recovered charge books fall short of the independent
+    reading — migration stopped conserving the ledger."""
+    from ...runtime import journal as J
+    orig = J._apply_record
+
+    def lossy(state: Any, rec: Any) -> None:
+        orig(state, rec)
+        if rec.get("op") == "migrate":
+            t = state.get("tenants", {}).get(rec.get("name"))
+            if t and t.get("arrays"):
+                t["arrays"].pop(sorted(t["arrays"])[0])
+
+    J._apply_record = lossy
+    try:
+        yield
+    finally:
+        J._apply_record = orig
+
+
+@contextlib.contextmanager
+def _seed_diverging_stream_apply() -> Iterator[None]:
+    """The standby's stream applier silently skips EMA records: its
+    applied state diverges from the independent reading — the bounded
+    lag is a lie (the takeover would serve stale cost models)."""
+    from ...runtime import journal as J
+    from ...runtime import replication as R
+    orig = R.apply_stream
+
+    def skipping(state: Any, data: bytes, leftover: bytes = b""):
+        recs, _complete, rest = R.split_complete(leftover + data)
+        for rec in recs:
+            if rec.get("op") == "ema":
+                continue
+            J._apply_record(state, rec)
+        return len(recs), rest
+
+    R.apply_stream = skipping
+    try:
+        yield
+    finally:
+        R.apply_stream = orig
+
+
+@contextlib.contextmanager
+def _seed_torn_stream_applied() -> Iterator[None]:
+    """The stream framing swallows CRC damage 'best effort' (parse
+    whatever still frames, skip the rest): a corrupted chunk mutates
+    standby state instead of forcing the snapshot re-bootstrap."""
+    from ...runtime import journal as J
+    from ...runtime import replication as R
+    orig = R.split_complete
+
+    def swallow(data: bytes):
+        try:
+            return orig(data)
+        except R.StreamCorrupt:
+            out = []
+            for line in data.split(b"\n"):
+                try:
+                    out.extend(J.Journal._parse_lines(line + b"\n",
+                                                      False))
+                except (J.JournalCorrupt, ValueError):
+                    continue
+            return out, data, b""
+
+    R.split_complete = swallow
+    try:
+        yield
+    finally:
+        R.split_complete = orig
+
+
+@contextlib.contextmanager
+def _seed_unfenced_stale_primary() -> Iterator[None]:
+    """The fence check is blinded: a stale primary whose epoch a
+    takeover superseded keeps passing — it could still journal, and
+    therefore still ack (the exact split-brain the fence exists to
+    ban)."""
+    from ...runtime import replication as R
+    orig = R.Fence.check
+    R.Fence.check = lambda self: None
+    try:
+        yield
+    finally:
+        R.Fence.check = orig
+
+
+@contextlib.contextmanager
 def _seed_fastlane_park_ignored() -> Iterator[None]:
     """The fastlane drainer's park verdict is blinded: a suspended/
     preempted tenant's ring keeps executing.  The admit oracle reads
@@ -399,6 +489,14 @@ SEEDS: Tuple[Seed, ...] = (
          "", _seed_overdropped_tail),
     Seed("corruption-swallowed", "crash", "corruption-fails-closed",
          "", _seed_corruption_swallowed),
+    Seed("lossy-migration", "crash", "migrate-conserves-ledger",
+         "", _seed_lossy_migration),
+    Seed("diverging-stream-apply", "crash", "replication-lag-bounded",
+         "", _seed_diverging_stream_apply),
+    Seed("torn-stream-applied", "crash", "repl-torn-never-applied",
+         "", _seed_torn_stream_applied),
+    Seed("unfenced-stale-primary", "crash", "fenced-epoch-never-acks",
+         "", _seed_unfenced_stale_primary),
 )
 
 

@@ -87,6 +87,7 @@ class MetricsState:
                     "suspended": resp.get("suspended", []),
                     "journal": resp.get("journal") or {},
                     "fastlane": resp.get("fastlane") or {},
+                    "replication": resp.get("replication") or {},
                     "slo": slo}
 
         if not self.brokers:
@@ -347,6 +348,27 @@ def broker_prometheus(brokers: List[Dict]) -> str:
         "# HELP vtpu_broker_fastlane_lanes Active fastlane lanes on "
         "the broker.",
         "# TYPE vtpu_broker_fastlane_lanes gauge",
+        # vtpu-failover (docs/FAILOVER.md): a silently-stalled standby
+        # must be visible BEFORE the primary dies — follower count,
+        # worst lag, fence generation and takeover count per broker.
+        "# HELP vtpu_repl_followers Subscribed replication followers "
+        "(hot standbys) on this broker.",
+        "# TYPE vtpu_repl_followers gauge",
+        "# HELP vtpu_repl_lag_records Worst follower lag in journal "
+        "records (0 with no followers).",
+        "# TYPE vtpu_repl_lag_records gauge",
+        "# HELP vtpu_repl_lag_bytes Worst follower stream-buffer "
+        "backlog in bytes.",
+        "# TYPE vtpu_repl_lag_bytes gauge",
+        "# HELP vtpu_repl_seq Journal records appended by this "
+        "instance (the replication stream sequence).",
+        "# TYPE vtpu_repl_seq counter",
+        "# HELP vtpu_repl_fence_generation Epoch-fence generation this "
+        "broker claimed (a bump elsewhere fences it).",
+        "# TYPE vtpu_repl_fence_generation gauge",
+        "# HELP vtpu_repl_takeovers_total Standby takeovers this "
+        "serving instance performed.",
+        "# TYPE vtpu_repl_takeovers_total counter",
     ]
     for b in brokers:
         broker = _esc(os.path.basename(b["broker"]))
@@ -372,6 +394,22 @@ def broker_prometheus(brokers: List[Dict]) -> str:
                 f'{dropped}')
             lines.append(f'vtpu_broker_draining{bl} '
                          f'{1 if j.get("draining") else 0}')
+        repl = b.get("replication") or {}
+        if repl:
+            bl = f'{{broker="{broker}",role="{_esc(str(repl.get("role", "primary")))}"}}'
+            followers = repl.get("followers") or []
+            lines.append(f'vtpu_repl_followers{bl} {len(followers)}')
+            lines.append(
+                f'vtpu_repl_lag_records{bl} '
+                f'{max((f.get("lag_records", 0) for f in followers), default=0)}')
+            lines.append(
+                f'vtpu_repl_lag_bytes{bl} '
+                f'{max((f.get("lag_bytes", 0) for f in followers), default=0)}')
+            lines.append(f'vtpu_repl_seq{bl} {repl.get("seq", 0)}')
+            lines.append(f'vtpu_repl_fence_generation{bl} '
+                         f'{repl.get("fence_generation", 0)}')
+            lines.append(f'vtpu_repl_takeovers_total{bl} '
+                         f'{repl.get("takeovers", 0)}')
         for name, t in sorted(b["tenants"].items()):
             labels = (f'{{broker="{broker}",tenant="{_esc(name)}",'
                       f'chip="{t["chip"]}"}}')

@@ -533,6 +533,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--core", type=int, default=None, metavar="PCT",
                     help="with --resize: new device-time share "
                          "(0-100; 0 = unmetered)")
+    ap.add_argument("--migrate", default=None, metavar="TENANT",
+                    help="live-migrate TENANT onto another chip "
+                         "(MIGRATE verb, journaled; combine with "
+                         "--device — docs/FAILOVER.md)")
+    ap.add_argument("--device", type=int, default=None, metavar="CHIP",
+                    help="with --migrate: the target chip index")
+    ap.add_argument("--repl-status", action="store_true",
+                    help="replication block: role, follower lag, "
+                         "fence generation, takeover count "
+                         "(REPL_SYNC status probe — docs/FAILOVER.md)")
     ap.add_argument("--broker-stats", action="store_true",
                     help="per-tenant broker stats (quota, spill, "
                          "residency, suspension, journal/recovery)")
@@ -606,12 +616,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.extend(["--litmus", ns.cmd_arg])
         return wmm_main(args)
 
-    admin_verbs = (ns.suspend or ns.resume or ns.resize
-                   or ns.broker_stats or ns.drain or ns.handover
-                   or ns.shutdown)
+    admin_verbs = (ns.suspend or ns.resume or ns.resize or ns.migrate
+                   or ns.repl_status or ns.broker_stats or ns.drain
+                   or ns.handover or ns.shutdown)
     if admin_verbs and not ns.broker:
-        ap.error("--suspend/--resume/--resize/--broker-stats/--drain/"
-                 "--handover/--shutdown need --broker <main socket>")
+        ap.error("--suspend/--resume/--resize/--migrate/--repl-status/"
+                 "--broker-stats/--drain/--handover/--shutdown need "
+                 "--broker <main socket>")
     if ns.broker:
         from ..runtime import protocol as P
         if ns.suspend:
@@ -627,6 +638,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             if ns.core is not None:
                 msg["core_limit"] = int(ns.core)
             resp = _admin_request(ns.broker, msg)
+        elif ns.migrate:
+            msg = {"kind": P.MIGRATE, "tenant": ns.migrate}
+            if ns.device is not None:
+                msg["device"] = int(ns.device)
+            resp = _admin_request(ns.broker, msg, timeout=90.0)
+        elif ns.repl_status:
+            resp = _admin_request(ns.broker,
+                                  {"kind": P.REPL_SYNC, "status": True})
         elif ns.broker_stats:
             resp = _admin_request(ns.broker, {"kind": P.STATS})
         elif ns.drain:
@@ -639,7 +658,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             resp = _admin_request(ns.broker, {"kind": P.SHUTDOWN})
         else:
             ap.error("--broker needs --suspend/--resume/--resize/"
-                     "--broker-stats/--drain/--handover/--shutdown")
+                     "--migrate/--repl-status/--broker-stats/--drain/"
+                     "--handover/--shutdown")
         print(json.dumps(resp, indent=2))
         return 0 if resp.get("ok") else 1
 

@@ -196,6 +196,7 @@ ENV_FLAGS = {
     "VTPU_CONNECT_TIMEOUT_S": ("shim", True),
     "VTPU_RECONNECT_BACKOFF_MS": ("shim", True),
     "VTPU_RECONNECT_BACKOFF_CAP_MS": ("shim", True),
+    "VTPU_RECONNECT_FAST_S": ("shim", False),
     "VTPU_BROKER_GRACE_S": ("shim", True),
     "VTPU_DEGRADED_QUEUE": ("shim", True),
     # In-container shim / client / bridge / native interposer.
@@ -245,6 +246,13 @@ ENV_FLAGS = {
     "VTPU_FASTLANE_ARENA_MB": ("broker", True),
     "VTPU_FASTLANE_SPIN_US": ("shim", True),
     "VTPU_FASTLANE_BATCH": ("broker", False),
+    # vtpu-failover (docs/FAILOVER.md): streaming journal replication,
+    # hot-standby takeover fencing, live tenant migration.
+    "VTPU_REPL_BUFFER_MB": ("broker", True),
+    "VTPU_REPL_HB_S": ("broker", False),
+    "VTPU_REPL_CONFIRM_S": ("broker", True),
+    "VTPU_REPL_FENCE": ("broker", True),
+    "VTPU_MIGRATE_TIMEOUT_S": ("broker", True),
     # vtpu-wmm (docs/ANALYSIS.md "Weak memory model"): exploration
     # budgets of the weak-memory litmus engine.  Not operator-facing —
     # CI and developers tune them per run.

@@ -28,9 +28,21 @@ a real broker subprocess on the CPU backend:
             throughout.  Gates: every floor tenant's attainment >= 99%
             at saturation, RTT p99 bounded (no unbounded queue
             growth), shedding typed (client VtpuOverload counters).
+  failover  hot-standby takeover (docs/FAILOVER.md): a journal-enabled
+            primary + a replication standby; the primary is SIGKILLed
+            under live synchronous traffic and every worker must
+            resume on the standby with state intact — gated on
+            per-tenant blackout-ms p99 and zero state loss, with the
+            SLO attainment-through-failover recorded.
+  migrate   live tenant migration: a steadily-executing tenant is
+            MIGRATE'd chip0 -> chip1 mid-traffic; gated on the
+            broker-reported blackout-ms, exact ledger conservation
+            (used bytes identical across the move) and the client
+            never seeing an error.
 
 Usage:
-  python benchmarks/traffic_sim.py [--quick] [--cell all|burst|preempt|overload]
+  python benchmarks/traffic_sim.py [--quick]
+      [--cell all|burst|preempt|overload|failover|migrate]
       [--tenants N] [--seed K] [--out BENCH_TRAFFIC_r01.json]
   python benchmarks/traffic_sim.py --smoke --check BENCH_TRAFFIC_r01.json
 
@@ -622,8 +634,197 @@ def cell_overload(tenants: int, quick: bool,
 
 
 # ---------------------------------------------------------------------------
+# Cell 4: hot-standby failover (docs/FAILOVER.md)
+# ---------------------------------------------------------------------------
+
+def _sync_worker(b: "Broker", name: str, stop: threading.Event,
+                 out: Dict[str, Any]) -> None:
+    """One synchronous execute loop that SURVIVES the primary's death:
+    a resumed reconnect continues with state intact; a fresh epoch
+    re-puts/re-compiles (counted as state loss)."""
+    from vtpu.runtime.client import (RuntimeError_, VtpuConnectionLost,
+                                     VtpuStateLost)
+    marks: List[float] = []
+    out.update({"marks": marks, "resumes": 0, "state_lost": 0,
+                "errors": 0, "steps": 0})
+    deadline = time.monotonic() + 30.0
+    c = None
+    while c is None:
+        try:
+            c = _client(b, name)
+        except (OSError, RuntimeError_):
+            if time.monotonic() > deadline:
+                out["errors"] += 1
+                return
+            time.sleep(0.1)
+    exe, _hx = _setup(c)
+    while not stop.is_set():
+        try:
+            c.execute_send_ids(exe, ["x"], ["o"])
+            c.recv_reply()
+            out["steps"] += 1
+            marks.append(time.time())
+        except VtpuConnectionLost as e:
+            if getattr(e, "resumed", False):
+                out["resumes"] += 1
+            continue
+        except VtpuStateLost:
+            out["state_lost"] += 1
+            try:
+                exe, _hx = _setup(c)
+            except (OSError, RuntimeError_):
+                out["errors"] += 1
+                time.sleep(0.2)
+        except (OSError, RuntimeError_):
+            out["errors"] += 1
+            time.sleep(0.05)
+    try:
+        c.close()
+    except Exception:  # noqa: BLE001 - teardown best effort
+        pass
+
+
+def cell_failover(quick: bool) -> Dict[str, Any]:
+    """Kill -9 the journal-enabled primary under live synchronous
+    traffic with a replication standby attached: every worker resumes
+    on the standby; the per-tenant blackout (largest inter-reply gap
+    spanning the kill) is the headline."""
+    workers = 4
+    warm_s = 3.0 if quick else 5.0
+    post_s = 4.0 if quick else 6.0
+    tmp = tempfile.mkdtemp(prefix="ts-failover-")
+    jdir = os.path.join(tmp, "journal")
+    sdir = os.path.join(tmp, "journal-standby")
+    b = Broker(tmp, {"VTPU_JOURNAL_DIR": jdir})
+    standby = subprocess.Popen(
+        [sys.executable, "-m", "vtpu.runtime.replication",
+         "--socket", b.sock, "--journal-dir", sdir,
+         "--hbm-limit", "64Mi", "--core-limit", "40",
+         "--confirm-s", "0.3"],
+        cwd=REPO, env=_broker_env({}, 1),
+        stdout=open(os.path.join(tmp, "standby.log"), "ab"),
+        stderr=subprocess.STDOUT)
+    stop = threading.Event()
+    outs: List[Dict[str, Any]] = [{} for _ in range(workers)]
+    threads = [threading.Thread(target=_sync_worker,
+                                args=(b, f"fo-{i}", stop, outs[i]),
+                                daemon=True)
+               for i in range(workers)]
+    for t in threads:
+        t.start()
+    # Wait until the standby is attached AND traffic flows.
+    deadline = time.monotonic() + 30.0
+    attached = False
+    while time.monotonic() < deadline and not attached:
+        resp = b.stats()
+        repl = (resp or {}).get("replication") or {}
+        attached = any(not f.get("dropped")
+                       for f in repl.get("followers") or [])
+        time.sleep(0.2)
+    time.sleep(warm_s)
+    pre_slo = b.slo()
+    t_kill = time.time()
+    b.proc.send_signal(signal.SIGKILL)
+    b.proc.wait(timeout=10)
+    time.sleep(post_s)
+    post_slo = b.slo()  # served by the standby now (same socket path)
+    post_repl = (b.stats() or {}).get("replication") or {}
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    standby.terminate()
+    try:
+        standby.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        standby.kill()
+    blackouts: List[float] = []
+    for o in outs:
+        marks = o.get("marks") or []
+        before = [m for m in marks if m <= t_kill]
+        after = [m for m in marks if m > t_kill]
+        if before and after:
+            blackouts.append((after[0] - t_kill) * 1e3)
+    blackouts.sort()
+
+    def _attain(slo: Optional[dict]) -> Optional[float]:
+        rows = (slo or {}).get("tenants") or {}
+        vals = []
+        for row in rows.values():
+            wins = row.get("windows") or {}
+            short = wins[min(wins, key=float)] if wins else {}
+            if short.get("attainment_pct") is not None:
+                vals.append(float(short["attainment_pct"]))
+        return round(min(vals), 1) if vals else None
+
+    return {
+        "workers": workers,
+        "resumed": sum(1 for o in outs if o.get("resumes")),
+        "state_lost": sum(o.get("state_lost", 0) for o in outs),
+        "steps": sum(o.get("steps", 0) for o in outs),
+        "blackout_ms": [round(x, 1) for x in blackouts],
+        "blackout_p99_ms": round(_pct(blackouts, 0.99), 1)
+        if blackouts else None,
+        "takeover_role": post_repl.get("role"),
+        "takeovers": post_repl.get("takeovers"),
+        "attainment_pre_pct": _attain(pre_slo),
+        "attainment_post_pct": _attain(post_slo),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Cell 5: live tenant migration (docs/FAILOVER.md)
+# ---------------------------------------------------------------------------
+
+def cell_migrate(quick: bool) -> Dict[str, Any]:
+    """MIGRATE a steadily-executing tenant chip0 -> chip1 mid-traffic:
+    the broker-reported blackout-ms is the headline; the ledger must
+    conserve exactly and the client must never see an error."""
+    from vtpu.runtime import protocol as P
+    warm_s = 2.0 if quick else 4.0
+    post_s = 2.0 if quick else 4.0
+    tmp = tempfile.mkdtemp(prefix="ts-migrate-")
+    b = Broker(tmp, {"VTPU_JOURNAL_DIR": os.path.join(tmp, "journal")},
+               chips=2)
+    stop = threading.Event()
+    out: Dict[str, Any] = {}
+    th = threading.Thread(target=_sync_worker,
+                          args=(b, "mig-0", stop, out), daemon=True)
+    th.start()
+    time.sleep(warm_s)
+    pre = ((b.stats() or {}).get("tenants") or {}).get("mig-0") or {}
+    rep = b.admin({"kind": P.MIGRATE, "tenant": "mig-0", "device": 1})
+    time.sleep(post_s)
+    post = ((b.stats() or {}).get("tenants") or {}).get("mig-0") or {}
+    stop.set()
+    th.join(timeout=30)
+    b.close()
+    marks = out.get("marks") or []
+    gaps = [(b2 - a) * 1e3 for a, b2 in zip(marks, marks[1:])]
+    return {
+        "migrate_ok": bool(rep and rep.get("ok")),
+        "from": (rep or {}).get("from"),
+        "to": (rep or {}).get("to"),
+        "blackout_ms": (rep or {}).get("blackout_ms"),
+        "moved_bytes": (rep or {}).get("moved_bytes"),
+        "pre_used_bytes": pre.get("used_bytes"),
+        "post_used_bytes": post.get("used_bytes"),
+        "post_chip": post.get("chip"),
+        "steps": out.get("steps", 0),
+        "client_errors": out.get("errors", 0),
+        "client_state_lost": out.get("state_lost", 0),
+        "max_client_gap_ms": round(max(gaps), 1) if gaps else None,
+    }
+
+
+# ---------------------------------------------------------------------------
 # Gates
 # ---------------------------------------------------------------------------
+
+GATE_FAILOVER_BLACKOUT_MS = 1500.0  # CI-runner budget; the chaos
+#                                     failover cell gates the strict
+#                                     1s budget with load scaling
+GATE_MIGRATE_BLACKOUT_MS = 1000.0
+
 
 def check(result: Dict[str, Any],
           committed: Optional[Dict[str, Any]]) -> List[str]:
@@ -686,13 +887,58 @@ def check(result: Dict[str, Any],
                 errs.append(
                     f"overload: Jain fairness {jain} fell below half "
                     f"the committed recording ({ref})")
+    fo = result.get("failover")
+    if fo:
+        if fo["resumed"] < fo["workers"]:
+            errs.append(
+                f"failover: only {fo['resumed']} of {fo['workers']} "
+                f"workers resumed on the standby")
+        if fo["state_lost"] > 0:
+            errs.append(
+                f"failover: {fo['state_lost']} state loss(es) across "
+                f"the takeover (journal resume failed)")
+        p99 = fo.get("blackout_p99_ms")
+        if p99 is None or p99 > GATE_FAILOVER_BLACKOUT_MS:
+            errs.append(
+                f"failover: blackout p99 {p99}ms exceeds the "
+                f"{GATE_FAILOVER_BLACKOUT_MS:.0f}ms bench bound")
+        if (fo.get("takeovers") or 0) < 1:
+            errs.append("failover: the serving broker reports zero "
+                        "takeovers (the standby never took over)")
+    mig = result.get("migrate")
+    if mig:
+        if not mig.get("migrate_ok"):
+            errs.append("migrate: the MIGRATE verb failed")
+        else:
+            if mig.get("blackout_ms") is None or \
+                    mig["blackout_ms"] > GATE_MIGRATE_BLACKOUT_MS:
+                errs.append(
+                    f"migrate: blackout {mig.get('blackout_ms')}ms "
+                    f"exceeds the {GATE_MIGRATE_BLACKOUT_MS:.0f}ms "
+                    f"bound")
+            if mig.get("pre_used_bytes") != mig.get("post_used_bytes"):
+                errs.append(
+                    f"migrate: ledger not conserved across the move "
+                    f"({mig.get('pre_used_bytes')}B -> "
+                    f"{mig.get('post_used_bytes')}B)")
+            if mig.get("post_chip") != 1:
+                errs.append(
+                    f"migrate: tenant landed on chip "
+                    f"{mig.get('post_chip')}, not the target chip 1")
+        if mig.get("client_errors") or mig.get("client_state_lost"):
+            errs.append(
+                f"migrate: the client saw "
+                f"{mig.get('client_errors')} error(s) / "
+                f"{mig.get('client_state_lost')} state loss(es) — a "
+                f"live migration must be tenant-invisible")
     return errs
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(prog="traffic_sim", description=__doc__)
     ap.add_argument("--cell", default="all",
-                    choices=("all", "burst", "preempt", "overload"))
+                    choices=("all", "burst", "preempt", "overload",
+                             "failover", "migrate"))
     ap.add_argument("--tenants", type=int, default=512,
                     help="distinct churn tenants in the overload cell")
     ap.add_argument("--quick", action="store_true",
@@ -730,6 +976,14 @@ def main() -> int:
         result["overload"] = cell_overload(ns.tenants, ns.quick,
                                            ns.seed)
         print(f"[traffic_sim]   {result['overload']}", file=sys.stderr)
+    if ns.cell in ("all", "failover"):
+        print("[traffic_sim] failover cell ...", file=sys.stderr)
+        result["failover"] = cell_failover(ns.quick)
+        print(f"[traffic_sim]   {result['failover']}", file=sys.stderr)
+    if ns.cell in ("all", "migrate"):
+        print("[traffic_sim] migrate cell ...", file=sys.stderr)
+        result["migrate"] = cell_migrate(ns.quick)
+        print(f"[traffic_sim]   {result['migrate']}", file=sys.stderr)
     result["wall_s"] = round(time.monotonic() - t0, 1)
     committed = None
     if ns.check:

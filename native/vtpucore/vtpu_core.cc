@@ -866,8 +866,15 @@ static int sweep_locked(Region* g, int host_mode) {
 /* Fork handling (the reference's child_reinit machinery, §2.9g): a forked
  * child inherits the mapping but NOT the parent's proc slot — it must
  * re-register under its own pid so its allocations are attributable and
- * reclaimable.  Tracked via a registry of open regions + pthread_atfork. */
-#define VTPU_MAX_OPEN_REGIONS 64
+ * reclaimable.  Tracked via a registry of open regions + pthread_atfork.
+ * The registry is a pointer array (8 B/slot): size it WELL past any real
+ * per-process open count — a region opened past the cap would silently
+ * skip the child re-registration, and the child's allocations would then
+ * book under the PARENT's slot (unreclaimable after the child dies).
+ * Long-lived test/tool processes that open-and-leak many broker regions
+ * (every in-process broker holds one per chip until exit) overflowed the
+ * old 64-slot table and produced exactly that silent mis-attribution. */
+#define VTPU_MAX_OPEN_REGIONS 1024
 static vtpu_region* g_open_regions[VTPU_MAX_OPEN_REGIONS];
 static pthread_mutex_t g_open_mu = PTHREAD_MUTEX_INITIALIZER;
 

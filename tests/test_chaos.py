@@ -540,12 +540,9 @@ def test_analyze_retry_safety_clean_tree():
 
 def test_analyze_catches_unclassified_verb():
     src = _read("runtime/protocol.py").replace(
-        "IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, "
-        "TRACE,\n                    SLO, SUSPEND, RESUME, RESIZE, "
-        "DRAIN, FASTBIND)",
-        "IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, "
-        "TRACE,\n                    SLO, SUSPEND, RESUME, DRAIN, "
-        "FASTBIND)")
+        "SLO, SUSPEND, RESUME, RESIZE, MIGRATE, REPL_SYNC,",
+        "SLO, SUSPEND, RESUME, MIGRATE, REPL_SYNC,")
+    assert src != _read("runtime/protocol.py")
     assert any("RESIZE is served but unclassified" in str(f)
                for f in _verb_findings(src))
 

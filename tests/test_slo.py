@@ -661,8 +661,8 @@ def test_verbs_analyzer_slo_must_stay_idempotent_classified():
     from vtpu.tools.analyze import verbs as verbs_mod
     src = _tree_sources()
     proto = src[verbs_mod.PROTOCOL].replace(
-        "SLO, SUSPEND, RESUME, RESIZE, DRAIN, FASTBIND)",
-        "SUSPEND, RESUME, RESIZE, DRAIN, FASTBIND)")
+        "SLO, SUSPEND, RESUME, RESIZE, MIGRATE, REPL_SYNC,",
+        "SUSPEND, RESUME, RESIZE, MIGRATE, REPL_SYNC,")
     assert proto != src[verbs_mod.PROTOCOL]
     msgs = [f.message for f in verbs_mod.check_texts(
         proto, src[verbs_mod.SERVER], src[verbs_mod.CLIENT],
