@@ -220,6 +220,16 @@ class RuntimeClient:
         # itself (brokered, in order), so a gate-close is NEVER
         # caller-visible; this counts the absorbed resubmits.
         self.fl_resubmits = 0
+        # Arena arg-feed tracking (docs/PERF.md): ring seqs whose
+        # descriptor carries a feed region (released when the
+        # completion is consumed) and the count of regions owned by
+        # still-outstanding WIRE replies (released together once the
+        # pipeline drains to zero).
+        self._fl_feed_seqs: set = set()
+        self._fl_feed_wire = 0
+        # Route keys whose fed position is known broker-bound (a wire
+        # feed charged it) — only then may the RING byte-replace it.
+        self._fed_routes: set = set()
         # Pipelined logical-reply tokens, in send order, ONLY while a
         # lane is active: ("w",) = one wire reply, ("r", seq, route)
         # (+ resolved result) = one ring completion.  recv_reply
@@ -462,6 +472,9 @@ class RuntimeClient:
         # counter) must not survive into the new epoch.
         self._fl_last = None
         self._fl_gate_in = 0
+        self._fl_feed_seqs.clear()
+        self._fl_feed_wire = 0
+        self._fed_routes.clear()
         if self._lane is not None:
             self._lane.close()
             self._lane = None
@@ -799,6 +812,7 @@ class RuntimeClient:
         µs budget + wall-clock expiry, or a broker revoke.  Advisory —
         enforcement stays broker-owned; pipelined callers use it to
         pace sends without a round trip."""
+        self._maybe_release_wire_feeds()
         lease = resp.get("lease")
         if not isinstance(lease, dict):
             return
@@ -838,6 +852,28 @@ class RuntimeClient:
             self._tok_wire += n
             for _ in range(n):
                 self._pending.append(("w",))
+
+    # -- arena arg-feed bookkeeping (docs/PERF.md) --------------------------
+
+    def _feed_done(self, seq: int) -> None:
+        """A ring completion carrying a feed region was consumed: the
+        drainer copied the bytes out before completing, so the region
+        recycles."""
+        if self._fl_feed_seqs and seq in self._fl_feed_seqs:
+            self._fl_feed_seqs.discard(seq)
+            if self._lane is not None:
+                self._lane.feed_release()
+
+    def _maybe_release_wire_feeds(self) -> None:
+        """Wire-path feed regions release in bulk once every
+        outstanding pipelined wire reply has been consumed (the
+        broker copies feed bytes out at dispatch, which precedes the
+        reply)."""
+        if self._fl_feed_wire and self._wire_out == 0 \
+                and self._tok_wire == 0 and not self._pending_batch:
+            n, self._fl_feed_wire = self._fl_feed_wire, 0
+            if self._lane is not None:
+                self._lane.feed_release(n)
 
     # -- vtpu-fastlane (docs/PERF.md) ---------------------------------------
 
@@ -981,6 +1017,7 @@ class RuntimeClient:
             except ConnectionError:
                 self._on_disconnect()
                 raise AssertionError("unreachable")
+        self._feed_done(seq)
         if res[0] == fastlane_mod.EXEC_ECANCELED \
                 and isinstance(route, dict) and route.get("key"):
             # Gate-close (park, migration quiesce, lane retirement)
@@ -1012,6 +1049,7 @@ class RuntimeClient:
                 except ConnectionError:
                     self._on_disconnect()
                     raise AssertionError("unreachable")
+                self._feed_done(tok[1])
                 route = tok[2]
                 if res[0] == fastlane_mod.EXEC_ECANCELED \
                         and isinstance(route, dict) \
@@ -1024,10 +1062,14 @@ class RuntimeClient:
                 else:
                     self._pending[i] = (tok[0], tok[1], tok[2], res)
 
-    def _fastlane_send(self, eid: str, arg_ids, out_ids) -> bool:
+    def _fastlane_send(self, eid: str, arg_ids, out_ids,
+                       feed=None, feed_arg: int = 0) -> bool:
         """Try to ship one unchained execute through the ring; False
         falls back to the brokered path (unprimed program, closed
-        gate, ring pressure with a dead drainer...)."""
+        gate, ring pressure with a dead drainer...).  ``feed`` rides
+        the tx arena as the descriptor's arg-blob (offset/len +
+        argpos in eflags): a fresh host batch per step with zero
+        payload bytes anywhere on the socket."""
         lane = self._lane
         last = self._fl_last
         if last is not None and last[0] == eid \
@@ -1094,7 +1136,22 @@ class RuntimeClient:
         # Stage in the producer batch (one vectorized fill + one
         # native call per burst); the flush happens when the batch
         # fills or the first completion is awaited.
-        seq = lane.buffer(route["id"], route["cost"])
+        f_off = f_len = 0
+        if feed is not None:
+            f_len = int(feed.nbytes)
+            f_off = lane.feed_alloc(f_len)
+            if f_off is None:
+                # Feed window full (outstanding completions own it):
+                # stay brokered this step; the window recycles as the
+                # caller consumes replies.
+                return False
+            np.frombuffer(lane.tx, dtype=np.uint8, count=f_len,
+                          offset=f_off)[:] = \
+                feed.reshape(-1).view(np.uint8)
+        seq = lane.buffer(route["id"], route["cost"], f_off, f_len,
+                          feed_arg if feed is not None else 0)
+        if feed is not None:
+            self._fl_feed_seqs.add(seq)
         if len(lane._sub_items) >= 32:
             try:
                 lane.flush(self._broker_alive)
@@ -1663,6 +1720,112 @@ class RuntimeClient:
         except (ConnectionError, P.ProtocolError, OSError):
             self._on_disconnect()
         self._note_wire(1)
+
+    # -- arena arg-feed execution (docs/PERF.md) ----------------------------
+
+    def feed_capable(self) -> bool:
+        """True when per-step host batches can ride the tx arena
+        (negotiated lane with an arena, VTPU_ARENA_FEED on)."""
+        lane = self._lane
+        return (lane is not None and lane.tx is not None
+                and fastlane_mod.arena_feed_enabled())
+
+    def _feed_write(self, arrs) -> Optional[List[int]]:
+        """Copy host batches into the tx arena's feed window; returns
+        their offsets or None when even a drained window cannot hold
+        them (caller falls back to socket framing)."""
+        lane = self._lane
+        offs: List[int] = []
+        for a in arrs:
+            nb = int(a.nbytes)
+            off = lane.feed_alloc(nb)
+            if off is None:
+                # Window full: drain the pipeline (consuming replies
+                # releases every outstanding region) and retry once.
+                self._sync_prelude()
+                if self._lane is not lane:
+                    return None  # reconnect replaced the lane
+                lane.feed_reset()
+                off = lane.feed_alloc(nb)
+                if off is None:
+                    lane.feed_release(len(offs))
+                    return None
+            np.frombuffer(lane.tx, dtype=np.uint8, count=nb,
+                          offset=off)[:] = a.reshape(-1).view(np.uint8)
+            offs.append(off)
+        return offs
+
+    def execute_send_feed(self, eid: str, arg_ids: Sequence[str],
+                          out_ids: Sequence[str], feeds,
+                          feed_arg: int = 0, repeats: int = 1,
+                          carry: Sequence[Sequence[int]] = ((0, 0),),
+                          free: Sequence[str] = ()) -> bool:
+        """Pipelined execute whose per-step host batch(es) ride the
+        tx arena instead of socket PUTs (docs/PERF.md): ``feeds`` is
+        one array (unchained) or a per-step list (chained — ONE
+        broker entry runs the whole K-step loop off the arena
+        descriptors, where the socket-PUT feed re-entered the broker
+        per step).  The fed argument position re-binds broker-side
+        under ``arg_ids[feed_arg]`` with PUT replacement semantics,
+        so the HBM ledger keeps biting exactly as before.  Returns
+        False when the arena path is unavailable (no lane,
+        VTPU_ARENA_FEED=0, batch larger than the feed window) — the
+        caller sends its legacy socket-PUT feed instead.  Consumes
+        one pipelined logical reply, exactly like execute_send_ids."""
+        lane = self._lane
+        if not self.feed_capable() or not lane.usable():
+            return False
+        arrs = list(feeds) if isinstance(feeds, (list, tuple)) \
+            else [feeds]
+        if not arrs:
+            return False
+        arrs = [np.ascontiguousarray(a) for a in arrs]
+        if repeats > 1 and len(arrs) not in (1, repeats):
+            return False
+        fid = str(arg_ids[feed_arg])
+        # Keyed by (program, fed id, position) — NOT the out ids: the
+        # bridge mints fresh out ids per step, and what the ring path
+        # actually needs is "the fed id is broker-bound and charged",
+        # which only these three determine.
+        key = (eid, fid, int(feed_arg))
+        if repeats <= 1 and len(arrs) == 1 and not free \
+                and key in self._fed_routes:
+            # Steady state: the fed position is broker-bound (a prior
+            # wire feed charged it), so the RING can byte-replace it
+            # from the arena — no socket frame at all.
+            if self._fastlane_send(eid, arg_ids, out_ids,
+                                   feed=arrs[0], feed_arg=feed_arg):
+                return True
+            self._ring_pending_resolve()
+        offs = self._feed_write(arrs)
+        if offs is None:
+            return False
+        entries = [[fid, int(feed_arg), int(off), int(a.nbytes),
+                    list(a.shape), a.dtype.name]
+                   for a, off in zip(arrs, offs)]
+        item: Dict[str, Any] = {"exe": eid, "args": list(arg_ids),
+                                "outs": list(out_ids),
+                                "feeds": entries}
+        if repeats > 1:
+            item["repeats"] = int(repeats)
+            item["carry"] = [list(p) for p in carry]
+        if free:
+            item["free"] = list(free)
+        self._fl_feed_wire += len(entries)
+        self._fed_routes.add(key)
+        if self._batch_max > 1:
+            self._pending_batch.append(item)
+            if len(self._pending_batch) >= self._batch_max:
+                self._flush_batch()
+            return True
+        msg = dict(item)
+        msg["kind"] = P.EXECUTE
+        try:
+            P.send_msg(self.sock, self._maybe_stamp(msg))
+        except (ConnectionError, P.ProtocolError, OSError):
+            self._on_disconnect()
+        self._note_wire(1)
+        return True
 
     def execute_recv(self) -> List[RemoteArray]:
         resp = self.recv_reply()

@@ -294,8 +294,14 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
     DELETE: {"required": ("id",), "optional": ("ids", "trace")},
     COMPILE: {"required": ("id", "exported"), "optional": ("trace",)},
     EXECUTE: {
+        # ``feeds``: arena arg-blob descriptors ([fid, argpos, off,
+        # nbytes, shape, dtype] each) — per-step host batches read
+        # from the fastlane tx arena at dispatch instead of riding a
+        # socket PUT; chained (repeats>1) items carry one entry per
+        # step (docs/PERF.md, vtpu-fastlane-everywhere).
         "required": ("exe", "args"),
-        "optional": ("outs", "repeats", "carry", "free", "trace"),
+        "optional": ("outs", "repeats", "carry", "free", "feeds",
+                     "trace"),
     },
     EXEC_BATCH: {"required": (), "optional": ("items", "trace")},
     STATS: {"required": (), "optional": ("trace",)},

@@ -132,6 +132,7 @@ from . import faults
 from . import protocol as P
 from . import replication as repl_mod
 from . import slo as slo_mod
+from . import timers as timers_mod
 from . import trace as tracing
 from .journal import Journal, JournalCorrupt
 
@@ -567,7 +568,7 @@ class WorkItem:
 
     __slots__ = ("tenant", "session", "exe", "key", "arg_ids", "out_ids",
                  "steps", "carry", "metered", "est_us", "first_run",
-                 "free_ids", "t_enq", "t_enq_wall", "t_bucket0",
+                 "free_ids", "feeds", "t_enq", "t_enq_wall", "t_bucket0",
                  "bucket_wait_us", "trace_id", "trace_ts", "batch",
                  "batch_idx", "slo_busy0", "credit_funded")
 
@@ -591,6 +592,12 @@ class WorkItem:
         # frees are skipped; the owning connection is dying and its
         # teardown reclaims everything anyway.)
         self.free_ids = tuple(free_ids)
+        # Arena arg feeds (docs/PERF.md): (fid, argpos, off, nbytes,
+        # shape, dtype) tuples naming host-batch bytes in the
+        # tenant's fastlane tx arena — bound (and charged, exactly
+        # like the PUT they replace) at DISPATCH, zero payload bytes
+        # on the socket.  Chained items carry one entry per step.
+        self.feeds: tuple = ()
         # -- vtpu-trace span timestamps (runtime/trace.py) --
         # t_enq: monotonic enqueue time (submit); t_bucket0: first
         # moment the item sat at queue head throttled by the token
@@ -822,6 +829,15 @@ class DeviceScheduler:
         # nobody is waiting — on a hot queue every submit/retire used
         # to signal a condition no one was sleeping on.
         self._waiting = 0
+        # Involuntary idle wakeups (timeout expiries with nothing to
+        # do) — the vtpu-timers consolidation's observable: STATS
+        # exposes the rate and the broker-bench idle cell gates it.
+        self.idle_wakeups = 0
+        self.completer_wakeups = 0
+        # Long idle sleeps are safe only when a timer wheel exists to
+        # kick precise deadlines (make_server); the legacy 0.5s poll
+        # stays for wheel-less builds (tests, mc harness).
+        self._idle_wait_s = 0.5
         self._stop = False
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
@@ -1241,10 +1257,27 @@ class DeviceScheduler:
                     # lock (the no-blocking-under discipline).
                     recs, self.preempt_recs = self.preempt_recs, []
                 if not items and recs is None:
-                    timeout = 0.5
+                    wheel = getattr(self.state, "timers", None)
                     if soonest is not None:
-                        timeout = max(min(soonest - time.monotonic(), 0.5),
-                                      0.001)
+                        # A known deadline (token-bucket not-ready):
+                        # precise short wait, exactly as before.
+                        timeout = max(min(soonest - time.monotonic(),
+                                          0.5), 0.001)
+                    elif wheel is not None and not self.preempted \
+                            and not self.probation:
+                        # TRULY idle (no deadline, no park state that
+                        # needs the periodic un-park poll): sleep long
+                        # — submits notify, admin paths kick() — so
+                        # an idle chip stops paying 2 involuntary
+                        # wakeups/s (the vtpu-timers consolidation;
+                        # docs/PERF.md p99-tail rationale).
+                        timeout = self._idle_wait_s if \
+                            self._idle_wait_s > 0.5 else 5.0
+                        self.idle_wakeups += 1
+                    else:
+                        timeout = 0.5
+                        if wheel is None:
+                            self.idle_wakeups += 1
                     self._waiting += 1
                     try:
                         self.mu.wait(timeout=timeout)
@@ -1302,10 +1335,56 @@ class DeviceScheduler:
         metas = []
         try:
             args = []
+            feed_np: List[Any] = []
+            if item.feeds:
+                # Arena arg feeds (docs/PERF.md): copy the host-batch
+                # bytes OUT of the lane's tx arena now — once this
+                # item's reply lands the client may reuse the region.
+                import numpy as np
+                lane = getattr(t, "fastlane", None)
+                tx = lane.tx_view() if lane is not None else None
+                if tx is None:
+                    raise KeyError("NOT_FOUND: feed arena (fastlane "
+                                   "lane is gone)")
+                for _fid, _ap, off, nb, shape, dtype in item.feeds:
+                    feed_np.append(np.frombuffer(
+                        bytes(tx[off:off + nb]),
+                        dtype=_np_dtype(dtype)).reshape(shape))
             with t.mu:
                 for fid in item.free_ids:
                     item.session.drop_array(t, fid)
-                for aid in item.arg_ids:
+                if item.feeds and item.steps == 1:
+                    # Unchained feeds bind (and charge) like the PUT
+                    # they replace: replacement semantics under the
+                    # same id, so the tenant's standing footprint is
+                    # byte-identical to the socket-PUT feed loop —
+                    # the HBM ledger keeps biting.
+                    for k_f, (fid, _ap, _off, nb, _sh, _dt) in \
+                            enumerate(item.feeds):
+                        a = jax.device_put(feed_np[k_f],
+                                           self.chip.device)
+                        if fid is not None:
+                            item.session.drop_array(t, fid)
+                            if not self.chip.region.mem_acquire(
+                                    t.index, nb, t.oversubscribe):
+                                raise MemoryError(
+                                    f"RESOURCE_EXHAUSTED: feed of "
+                                    f"{nb} bytes over HBM quota")
+                            t.arrays[fid] = a
+                            t.nbytes[fid] = nb
+                            t.charges[fid] = [(0, nb)]
+                            t.arrays_ver += 1
+                        feed_np[k_f] = a
+                feed_pos = ({f[1]: k for k, f in
+                             enumerate(item.feeds)}
+                            if item.feeds and item.steps == 1 else {})
+                for pos_a, aid in enumerate(item.arg_ids):
+                    if pos_a in feed_pos:
+                        # Fed position: the arena blob IS the
+                        # argument (bound above when it carries an
+                        # id); never resolved from the table.
+                        args.append(feed_np[feed_pos[pos_a]])
+                        continue
                     a = t.arrays.get(aid)
                     if a is None and aid in t.host_arrays:
                         # Spilled operand: reuse the resident staged
@@ -1363,7 +1442,33 @@ class DeviceScheduler:
                             getattr(args[k], "sharding", None) != s:
                         args[k] = jax.device_put(args[k], s)
             fn = item.exe.fn
-            if item.steps > 1:
+            if item.steps > 1 and item.feeds:
+                # Feed-bound chain (docs/PERF.md): every step needs a
+                # FRESH host batch, so the single fused chain program
+                # cannot serve it — but the whole K-step loop still
+                # runs broker-side off the arena descriptors, where
+                # the legacy client re-entered the broker (socket
+                # PUT + drain + execute) for every feed.
+                base_fn = item.exe.fn
+                steps_n = item.steps
+                carry_map = item.carry
+                feeds = item.feeds
+
+                def fn(*a0):  # noqa: ANN001 - dispatcher-local
+                    cur = list(a0)
+                    outs_l: Any = None
+                    for s in range(steps_n):
+                        f = feeds[s if len(feeds) > 1 else 0]
+                        cur[f[1]] = jax.device_put(
+                            feed_np[s if len(feed_np) > 1 else 0],
+                            self.chip.device)
+                        outs_l = base_fn(*cur)
+                        o_list = (outs_l if isinstance(
+                            outs_l, (list, tuple)) else [outs_l])
+                        for oi, ai in carry_map:
+                            cur[ai] = o_list[oi]
+                    return outs_l
+            elif item.steps > 1:
                 fn = self.state.chain_fn(item.exe.fn, item.steps,
                                          item.carry)
             outs = fn(*args)
@@ -1465,12 +1570,20 @@ class DeviceScheduler:
         the tunnel transport)."""
         while not self._stop:
             try:
-                first = self._completion_q.get(timeout=0.5)
+                # With the timer wheel installed the idle timeout
+                # stretches (5s): the 0.5s poll existed only to reset
+                # a stale pool, and the continuity check below zeroes
+                # it on any sparse restart anyway — two involuntary
+                # wakeups/s per chip bought nothing (docs/PERF.md).
+                idle_s = (5.0 if getattr(self.state, "timers",
+                                         None) is not None else 0.5)
+                first = self._completion_q.get(timeout=idle_s)
             except queue.Empty:
                 # Idle: whatever is left in the pool is stale (compile
                 # residue, measurement slack) — never bill it to future
                 # work.
                 self._pool_us = 0.0
+                self.completer_wakeups += 1
                 continue
             # Batch-drain: everything dispatched since the last
             # observation retires on ONE readiness wait (the last
@@ -2039,6 +2152,12 @@ class RuntimeState:
         self.chip_latency_hints: Dict[int, float] = {}
         self.draining = False
         self._keeper_stop = threading.Event()
+        # vtpu-timers (runtime/timers.py): the ONE deadline-heap
+        # timer thread every housekeeping cadence rides — journal
+        # tick, lease heartbeat, elastic watchdog, dispatcher idle
+        # kicks.  make_server installs it; None (tests, mc harness)
+        # keeps the legacy per-loop idle timeouts.
+        self.timers: Optional[Any] = None
         # vtpu-trace flight recorder (runtime/trace.py): per-tenant span
         # rings, latency histograms, slow-op captures.  Enabled by
         # VTPU_TRACE=1; a disabled recorder records nothing and the
@@ -2667,6 +2786,24 @@ class RuntimeState:
         return self.slo.report(tenant=tenant, admin=admin,
                                quota_pcts=quota)
 
+    def timer_stats(self) -> Dict[str, Any]:
+        """vtpu-timers observability (STATS "timers" block): the
+        wheel's coalesced-wakeup counters plus the per-chip
+        dispatcher/completer involuntary idle wakeups — what the
+        broker-bench idle cell rates against its <=2/s gate."""
+        with self.chips_mu:
+            chips = list(self.chips.values())
+        out: Dict[str, Any] = {
+            "enabled": self.timers is not None,
+            "dispatch_idle_wakeups": sum(
+                c.scheduler.idle_wakeups for c in chips),
+            "completer_wakeups": sum(
+                c.scheduler.completer_wakeups for c in chips),
+        }
+        if self.timers is not None:
+            out["wheel"] = self.timers.stats()
+        return out
+
     def drain(self, timeout: float = 30.0) -> int:
         """Prepare a zero-downtime handover: refuse new HELLOs
         (DRAINING — clients retry against the successor), quiesce
@@ -3222,6 +3359,8 @@ class TenantSession(socketserver.BaseRequestHandler):
                                     self.state.admission_stats(),
                                 "fastlane":
                                     self.state.fastlane.stats(),
+                                "timers":
+                                    self.state.timer_stats(),
                                 "replication":
                                     self.state.replication.status()})
                     continue
@@ -3620,6 +3759,8 @@ class TenantSession(socketserver.BaseRequestHandler):
                                     self.state.admission_stats(),
                                 "fastlane":
                                     self.state.fastlane.stats(),
+                                "timers":
+                                    self.state.timer_stats(),
                                 "replication":
                                     self.state.replication.status()})
 
@@ -3720,12 +3861,17 @@ class TenantSession(socketserver.BaseRequestHandler):
                 raise _ItemError("BAD_CARRY", f"invalid carry map {bad}")
             # Build (and AOT-compile) the chain wrapper HERE, in the
             # session thread, so the dispatcher never head-of-line
-            # blocks every tenant on an XLA compile.
-            try:
-                self.state.chain_fn(prog.fn, steps, carry,
-                                    avals=prog.avals, compile_now=True)
-            except Exception as e:  # noqa: BLE001 - dispatch will retry
-                log.warn("chain precompile failed (%s); deferring", e)
+            # blocks every tenant on an XLA compile.  Feed-bound
+            # chains run the per-step loop instead (fresh host batch
+            # every step) — no fused wrapper to build.
+            if not spec.get("feeds"):
+                try:
+                    self.state.chain_fn(prog.fn, steps, carry,
+                                        avals=prog.avals,
+                                        compile_now=True)
+                except Exception as e:  # noqa: BLE001 - retried at dispatch
+                    log.warn("chain precompile failed (%s); deferring",
+                             e)
         # Argument ids resolve at DISPATCH (scheduler), so a pipelined
         # step may name the previous step's not-yet-completed output.
         item = WorkItem(t, self, prog, str(spec["exe"]),
@@ -3733,6 +3879,39 @@ class TenantSession(socketserver.BaseRequestHandler):
                         [str(x) for x in spec.get("outs", [])],
                         steps=steps, carry=carry,
                         free_ids=[str(f) for f in spec.get("free", ())])
+        feeds = spec.get("feeds")
+        if feeds:
+            # Arena arg-blob streaming (docs/PERF.md): validate every
+            # descriptor against the tenant's lane arena NOW (a bad
+            # offset must fail this request, not kill the dispatcher).
+            lane = getattr(t, "fastlane", None)
+            tx = lane.tx_view() if lane is not None else None
+            if tx is None:
+                raise _ItemError("FEED_UNAVAILABLE",
+                                 "feeds need a negotiated fastlane "
+                                 "lane (tx arena)")
+            alen = len(tx)
+            parsed = []
+            for f in feeds:
+                fid, ap, off, nb, shape, dtype = f
+                ap, off, nb = int(ap), int(off), int(nb)
+                if not 0 <= ap < n_args:
+                    raise _ItemError("BAD_FEED",
+                                     f"feed argpos {ap} out of range")
+                if off < 0 or nb <= 0 or off + nb > alen:
+                    raise _ItemError(
+                        "BAD_FEED",
+                        f"feed [{off}, +{nb}) outside the {alen}-byte "
+                        f"tx arena")
+                parsed.append((str(fid) if fid else None, ap, off, nb,
+                               tuple(int(s) for s in shape),
+                               str(dtype)))
+            if steps > 1 and len(parsed) not in (1, steps):
+                raise _ItemError(
+                    "BAD_FEED",
+                    f"chained feeds want 1 or {steps} entries, "
+                    f"got {len(parsed)}")
+            item.feeds = tuple(parsed)
         if isinstance(trace, dict):
             # Client-stamped trace context (VTPU_TRACE): threads this
             # request's id through the scheduler into the recorder.
@@ -4430,6 +4609,8 @@ class AdminSession(socketserver.BaseRequestHandler):
                                     self.state.admission_stats(),
                                 "fastlane":
                                     self.state.fastlane.stats(),
+                                "timers":
+                                    self.state.timer_stats(),
                                 "replication":
                                     self.state.replication.status()})
                 elif kind == P.TRACE:
@@ -4494,6 +4675,8 @@ class _Server(socketserver.ThreadingUnixStreamServer):
         st = getattr(self, "state", None)
         if st is not None:
             st._keeper_stop.set()  # noqa: SLF001 - lifecycle owner
+            if st.timers is not None:
+                st.timers.stop()
             # Fastlane drainers + lanes die with the server: gates flip
             # CLOSED so laned clients fall back / reconnect cleanly.
             st.fastlane.stop()
@@ -4510,56 +4693,65 @@ class _Server(socketserver.ThreadingUnixStreamServer):
         super().server_close()
 
 
-def _journal_keeper(state: RuntimeState) -> None:
-    """Background journal upkeep: snapshot compaction + resume-grace
-    expiry.  Dies with the server (keeper_stop) or the process."""
-    while not state._keeper_stop.wait(1.0):  # noqa: SLF001
-        try:
-            state.journal_tick()
-        except Exception as e:  # noqa: BLE001 - upkeep must survive
-            log.warn("journal keeper: %s", e)
+def _journal_tick(state: RuntimeState) -> None:
+    """Journal upkeep tick (1s grid on the timer wheel): snapshot
+    compaction + resume-grace expiry."""
+    if not state._keeper_stop.is_set():  # noqa: SLF001
+        state.journal_tick()
 
 
-def _elastic_keeper(state: RuntimeState) -> None:
-    """The broker's overload self-watchdog (docs/SCHEDULING.md): runs
-    OUTSIDE the dispatch loop so a saturated dispatcher cannot starve
-    the very machinery that sheds its load.  Each tick it (1) feeds the
-    SLO-burn signal into admission — while any priority-0 tenant's
-    short-window burn alert fires, lower priorities shed at half their
-    normal backlog threshold — and (2) screams when a chip's backlog
-    has reached the hard cap (every new request is already being shed
-    by then; the log line is the operator's saturation evidence)."""
-    while not state._keeper_stop.wait(0.5):  # noqa: SLF001
-        try:
-            hot = False
-            if state.slo.enabled and state.admission.shed_burn:
-                alerts = state.slo.burn_alerts()
-                if alerts:
-                    with state.mu:
-                        pris = {n: t.priority
-                                for n, t in state.tenants.items()}
-                    hot = any(pris.get(n, 1) <= 0 for n in alerts)
-            state.admission.burn_hot = hot
-            with state.chips_mu:
-                chips = list(state.chips.values())
-            for chip in chips:
-                bl = chip.scheduler.total_backlog
-                if bl >= state.admission.max_backlog:
-                    log.warn(
-                        "admission: chip %d backlog %d at the hard cap "
-                        "%d — shedding ALL new work until it drains",
-                        chip.index, bl, state.admission.max_backlog)
-        except Exception as e:  # noqa: BLE001 - watchdog must survive
-            log.warn("elastic keeper: %s", e)
+def _elastic_tick(state: RuntimeState) -> None:
+    """The broker's overload self-watchdog tick (docs/SCHEDULING.md):
+    runs OFF the dispatch loop (the timer wheel) so a saturated
+    dispatcher cannot starve the very machinery that sheds its load.
+    Each tick it (1) feeds the SLO-burn signal into admission — while
+    any priority-0 tenant's short-window burn alert fires, lower
+    priorities shed at half their normal backlog threshold — and (2)
+    screams when a chip's backlog has reached the hard cap (every new
+    request is already being shed by then; the log line is the
+    operator's saturation evidence).
+
+    Cadence is ADAPTIVE (the idle-wakeup budget, docs/PERF.md): the
+    wheel runs it on the 1s grid shared with the journal tick; while
+    any chip shows backlog — or a burn alert is live — it re-arms a
+    half-grid catch-up tick so loaded brokers keep the legacy 0.5s
+    responsiveness.  An idle broker therefore pays ~1 coalesced
+    wakeup/s for ALL its housekeeping instead of 4+."""
+    if state._keeper_stop.is_set():  # noqa: SLF001
+        return
+    hot = False
+    if state.slo.enabled and state.admission.shed_burn:
+        alerts = state.slo.burn_alerts()
+        if alerts:
+            with state.mu:
+                pris = {n: t.priority
+                        for n, t in state.tenants.items()}
+            hot = any(pris.get(n, 1) <= 0 for n in alerts)
+    state.admission.burn_hot = hot
+    with state.chips_mu:
+        chips = list(state.chips.values())
+    loaded = hot
+    for chip in chips:
+        bl = chip.scheduler.total_backlog
+        loaded = loaded or bl > 0
+        if bl >= state.admission.max_backlog:
+            log.warn(
+                "admission: chip %d backlog %d at the hard cap "
+                "%d — shedding ALL new work until it drains",
+                chip.index, bl, state.admission.max_backlog)
+    wheel = state.timers
+    if loaded and wheel is not None:
+        wheel.arm("elastic-catchup", wheel.clock() + 0.5,
+                  lambda: _elastic_tick(state))
 
 
-def _lease_keeper(state: RuntimeState) -> None:
+def _lease_tick(state: RuntimeState) -> None:
     """Heartbeat the chip-lease sidecar while the broker holds the
-    chip: its mtime is the liveness signal the staleness judgment
-    (vtpu-smi leases, bench gate, co-claimer watchdogs) reads.  A
-    SIGKILLed broker stops beating and its sidecar goes stale — exactly
-    the evidence the forensics need."""
-    while not state._keeper_stop.wait(5.0):  # noqa: SLF001
+    chip (5s grid): its mtime is the liveness signal the staleness
+    judgment (vtpu-smi leases, bench gate, co-claimer watchdogs)
+    reads.  A SIGKILLed broker stops beating and its sidecar goes
+    stale — exactly the evidence the forensics need."""
+    if not state._keeper_stop.is_set():  # noqa: SLF001
         tracing.heartbeat_lease_sidecar()
 
 
@@ -4612,13 +4804,19 @@ def make_server(socket_path: str, hbm_limit: int, core_limit: int,
                          preloaded_state=preloaded_state)
     if fence is not None:
         state.replication.fence = fence
+    # vtpu-timers (runtime/timers.py): ONE deadline-heap timer thread
+    # replaces the per-keeper sleeper threads — the keeper grids are
+    # harmonics (1s/1s/5s) anchored to one epoch, so an idle broker's
+    # whole housekeeping coalesces into ~1 wakeup/s (the fastlane
+    # sync-RTT p99 tail on shared single-core cgroups, docs/PERF.md).
+    state.timers = timers_mod.TimerWheel()
     if jr is not None:
-        threading.Thread(target=_journal_keeper, args=(state,),
-                         daemon=True, name="vtpu-rt-journal").start()
-    threading.Thread(target=_lease_keeper, args=(state,),
-                     daemon=True, name="vtpu-rt-lease").start()
-    threading.Thread(target=_elastic_keeper, args=(state,),
-                     daemon=True, name="vtpu-rt-elastic").start()
+        state.timers.add_periodic("journal", 1.0,
+                                  lambda: _journal_tick(state))
+    state.timers.add_periodic("lease-heartbeat", 5.0,
+                              lambda: _lease_tick(state))
+    state.timers.add_periodic("elastic", 1.0,
+                              lambda: _elastic_tick(state))
     handler = type("BoundSession", (TenantSession,), {"state": state})
     srv = _Server(socket_path, handler)
     srv.state = state  # type: ignore[attr-defined]

@@ -391,6 +391,37 @@ class Bridge:
                 time.sleep(min(hold, 2.0))
             while len(self._outstanding) >= _MAX_OUTSTANDING:
                 self._recv_one_locked()
+            # Arena arg-feed fast path (docs/PERF.md): the dominant
+            # bridged-train-loop shape — resident params + ONE fresh
+            # host batch per step — streams the batch through the
+            # fastlane tx arena as an offset/len descriptor instead
+            # of a socket PUT: no payload bytes on the wire, no
+            # per-feed broker re-entry, and the broker-side bind
+            # still charges the HBM ledger exactly like the PUT it
+            # replaces.  Anything else (multiple transients, no
+            # lane, VTPU_ARENA_FEED=0, feed window full) keeps the
+            # legacy pipelined-PUT framing below.
+            transients = [i for i, it in enumerate(arg_items)
+                          if it[0] != "id"]
+            if len(transients) == 1 and self.client.feed_capable():
+                import weakref
+                ti = transients[0]
+                _, fid, f_arr = arg_items[ti]
+                arg_ids = [it[1] if it[0] == "id" else it[1]
+                           for it in arg_items]
+                out_ids = [f"bo{next(self._ids)}" for _ in out_avals]
+                outs = [BridgeArray(self, oid, av.shape, av.dtype)
+                        for oid, av in zip(out_ids, out_avals)]
+                frees = self._take_frees()
+                if self.client.execute_send_feed(
+                        eid, arg_ids, out_ids, np.asarray(f_arr),
+                        feed_arg=ti, free=frees):
+                    self._outstanding.append(
+                        ("exe", [weakref.ref(a) for a in outs]))
+                    return outs
+                # Feed path refused: restore the frees for the
+                # legacy send below (they must not be lost).
+                self._free = frees + self._free
             arg_ids = []
             for item in arg_items:
                 if item[0] == "id":

@@ -263,6 +263,27 @@ def load() -> ctypes.CDLL:
         lib._vtpu_has_exec = True
     except AttributeError:
         lib._vtpu_has_exec = False
+    # -- multi-chip completion vector (vtpu-fastlane-everywhere) --
+    # Newer than the base exec-ring symbols: a mounted libvtpucore.so
+    # with rings but no cvec degrades multi-chip lanes to the brokered
+    # path (single-chip fastlane keeps working).
+    try:
+        lib.vtpu_exec_cvec_set.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint32,
+                                           ctypes.c_uint64]
+        lib.vtpu_exec_cvec_get.restype = ctypes.c_uint64
+        lib.vtpu_exec_cvec_get.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint32]
+        lib.vtpu_exec_cvec_min.restype = ctypes.c_uint64
+        lib.vtpu_exec_cvec_min.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint32]
+        lib.vtpu_exec_cvec_wait.restype = ctypes.c_int
+        lib.vtpu_exec_cvec_wait.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64]
+        lib._vtpu_has_cvec = True
+    except AttributeError:
+        lib._vtpu_has_cvec = False
     lib.vtpu_region_active_procs.restype = ctypes.c_int
     lib.vtpu_region_active_procs.argtypes = [ctypes.c_void_p]
     lib.vtpu_core_version.restype = ctypes.c_char_p
@@ -767,6 +788,32 @@ class ExecRing:
 
     def credit_level(self) -> int:
         return int(self._c_credit_level(self._h()))
+
+    # -- multi-chip completion vector (lead ring only) ---------------------
+
+    @property
+    def has_cvec(self) -> bool:
+        return bool(getattr(self.lib, "_vtpu_has_cvec", False))
+
+    def cvec_set(self, idx: int, seq: int) -> None:
+        """Release-publish ordinal ``idx``'s completed sequence count
+        (each chip's completer, after its own headc publish)."""
+        self.lib.vtpu_exec_cvec_set(self._h(), int(idx), int(seq))
+
+    def cvec_get(self, idx: int) -> int:
+        return int(self.lib.vtpu_exec_cvec_get(self._h(), int(idx)))
+
+    def cvec_min(self, n: int) -> int:
+        """The join point: min completed sequence over ordinals
+        [0, n) — acquire loads, so a joined sequence's side effects
+        are visible."""
+        return int(self.lib.vtpu_exec_cvec_min(self._h(), int(n)))
+
+    def cvec_wait(self, n: int, seq: int, timeout_s: float,
+                  spin_us: int = 100) -> bool:
+        return self.lib.vtpu_exec_cvec_wait(
+            self._h(), int(n), int(seq),
+            int(max(timeout_s, 0.0) * 1e9), int(spin_us) * 1000) == 1
 
 
 class TraceRing:

@@ -178,6 +178,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help=argparse.SUPPRESS)
     ap.add_argument("--child-fastlane", action="store_true",
                     dest="fastlane", help=argparse.SUPPRESS)
+    ap.add_argument("--child-devices", default="", dest="devices",
+                    help=argparse.SUPPRESS)
     ns = ap.parse_args(argv)
 
     if ns.tenant_child:

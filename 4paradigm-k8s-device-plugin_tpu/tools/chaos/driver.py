@@ -256,10 +256,14 @@ class ChurnRun:
                 # rides the interposer-only data plane; the crash must
                 # degrade exactly like degraded mode — fail closed,
                 # zero region leak, epoch resume builds a fresh lane
-                # and the ring makes progress again.
+                # and the ring makes progress again.  With 2+ tenants
+                # the lane is SHARDED over chips 0,1 (per-chip rings +
+                # completion-vector join), so the kill -9 lands
+                # mid-sharded-flight (vtpu-fastlane-everywhere).
                 tenv = dict(env)
                 tenv["VTPU_FASTLANE"] = "1"
                 cmd.append("--child-fastlane")
+                cmd.extend(["--child-devices", "0,1"])
             procs.append((subprocess.Popen(
                 cmd, cwd=REPO, env=tenv, stdout=subprocess.PIPE,
                 stderr=subprocess.DEVNULL, text=True), progress))

@@ -41,10 +41,16 @@ def tenant_main(ns) -> int:
     # loop, jittered like the client's own backoff.
     deadline = time.monotonic() + 30.0
     client = None
+    # Multi-chip fastlane churn (vtpu-fastlane-everywhere): the driver
+    # may grant this child several chips so the kill -9 lands
+    # mid-SHARDED-flight (per-chip rings + completion-vector join).
+    devices = [int(d) for d in ns.devices.split(",") if d.strip()] \
+        if getattr(ns, "devices", "") else None
     while client is None:
         try:
             client = RuntimeClient(ns.socket, tenant=ns.name,
                                    priority=ns.priority,
+                                   devices=devices,
                                    hbm_limit=ns.hbm or None,
                                    core_limit=ns.core or None)
         except (OSError, RuntimeError_):

@@ -404,6 +404,11 @@ class Harness:
         # fastlane scenario drives drain_once cooperatively over a
         # PyRing) with the admission oracle armed for the ring
         # park-gate invariant.
+        # vtpu-timers: NO wheel under mc — the schedulers take their
+        # legacy bounded idle timeouts, which the cooperative clock
+        # model understands (a wheel thread would add an opaque
+        # wall-clock actor to every schedule).
+        st.timers = None
         st.fastlane = S.fastlane_mod.FastlaneHub(st)
         st.fastlane.manual = True
         st.fastlane.admit_log = []

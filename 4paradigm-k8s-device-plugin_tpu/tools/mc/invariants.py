@@ -212,7 +212,11 @@ def _chk_fastlane_gate(h: Any) -> List[str]:
     """No execute is admitted through a fastlane ring for a parked
     (admin-suspended or auto-preempted) or released tenant: the
     drainer's admit oracle records the park verdict taken under
-    scheduler.mu next to every batch it executed."""
+    scheduler.mu next to every batch it executed.  Additionally,
+    every lane that went through a close transition must have
+    published GATE_CLOSED on EVERY chip's ring — a sharded lane
+    whose follower ring stays open leaves the producer submitting
+    into a ring nobody will ever drain (vtpu-fastlane-everywhere)."""
     hub = getattr(h.state, "fastlane", None)
     log_ = getattr(hub, "admit_log", None) or []
     out = []
@@ -222,6 +226,18 @@ def _chk_fastlane_gate(h: Any) -> List[str]:
                 f"fastlane: {n} execute(s) admitted through tenant "
                 f"{name}'s ring while "
                 f"{'parked' if parked else 'released'}")
+    for lane in getattr(hub, "mc_closed", None) or []:
+        for k, ring in enumerate(lane.rings):
+            try:
+                g = ring.gate()
+            except Exception:  # noqa: BLE001 - closed native handle
+                continue
+            if g != 2:  # GATE_CLOSED
+                out.append(
+                    f"fastlane: closed lane of tenant "
+                    f"{lane.tenant.name!r} left chip-ordinal {k}'s "
+                    f"ring gate at {g} (want GATE_CLOSED on EVERY "
+                    f"chip's ring)")
     return out
 
 

@@ -431,6 +431,74 @@ def _setup_fastlane_gate(h: Harness, sched: mcsched.Scheduler) -> None:
     sched.spawn(admin, "admin")
 
 
+def _setup_fastlane_multichip(h: Harness,
+                              sched: mcsched.Scheduler) -> None:
+    """vtpu-fastlane-everywhere: a TWO-CHIP grant's sharded lane (one
+    PyRing per chip, REAL FastlaneHub drain logic — lead executes,
+    follower joins the completion vector) driven through admin
+    SUSPEND/RESUME/RESIZE and release while descriptors sit in both
+    rings.  The fastlane-park-gate invariant judges the admit oracle
+    AND — via the hub's closed-lane oracle — that every close
+    transition published GATE_CLOSED on EVERY chip's ring, not just
+    the lead's."""
+    from ...runtime import fastlane as FL
+    from ...runtime import protocol as P
+    sess = h.session()
+
+    def client() -> None:
+        t = h.tenant(sess, "A", core_limit=50, devices=[0, 1])
+        prog = fake_program()
+        prog.out_meta = [{"shape": [16], "dtype": "float32",
+                          "nbytes": 64}]
+        t.executables["p"] = prog
+        hub = h.state.fastlane
+        rings = [FL.PyRing(8), FL.PyRing(8)]
+        lane = FL.BrokerLane(t, rings, None, None, {})
+        hub.lanes[t.name] = lane
+        t.fastlane = lane
+        rep = hub.bind_route(t, "p", [], ["o1"])
+        assert rep["ok"], rep
+        # One descriptor per chip ring, same seq stream (the
+        # ClientLane sharded-submit shape), pre-debiting the estimate
+        # on EVERY chip like rate_acquire_all.
+        for _ in range(3):
+            for k in range(2):
+                t.chips[k].region.rate_acquire(t.slots[k], 100, 1)
+            for r in rings:
+                r.submit(FL.PyDesc(route=0, cost_us=100, t_sub_ns=1))
+        # Park collision: drain INTO the park on both chips — the
+        # gate must admit nothing on either ordinal.
+        h.admin(_admin_frames(
+            {"kind": P.SUSPEND, "tenant": "A"},
+        )).handle()
+        hub.drain_once(t.chips[0])
+        hub.drain_once(t.chips[1])
+        h.admin(_admin_frames(
+            {"kind": P.RESUME, "tenant": "A"},
+            {"kind": P.RESIZE, "tenant": "A", "core_limit": 30},
+        )).handle()
+        for _ in range(3):
+            hub.drain_once(t.chips[0])
+            hub.drain_once(t.chips[1])
+        # The follower may still lag the lead's cvec by one pass.
+        hub.drain_once(t.chips[1])
+        sess._drain()
+        _teardown(h, sess, t)
+        # Straggler passes after release: must admit nothing, and the
+        # closed-lane oracle must find BOTH rings gated CLOSED.
+        hub.drain_once(t.chips[0])
+        hub.drain_once(t.chips[1])
+
+    def admin() -> None:
+        h.admin(_admin_frames(
+            {"kind": P.SUSPEND, "tenant": "A"},
+            {"kind": P.RESUME, "tenant": "A"},
+        )).handle()
+
+    sched.spawn(client, "clientA")
+    sched.spawn(admin, "admin")
+
+
 # ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
@@ -479,6 +547,12 @@ SCENARIOS: List[Scenario] = [
              "fastlane ring through SUSPEND/RESUME/RESIZE/release: no "
              "ring admit for a parked or released tenant",
              _setup_fastlane_gate, with_journal=False),
+    Scenario("fastlane_multichip",
+             "2-chip sharded lane (per-chip rings + completion "
+             "vector) through park/RESIZE/release: no parked admit, "
+             "gate closes on EVERY chip's ring",
+             _setup_fastlane_multichip,
+             harness_kw={"n_chips": 2}, with_journal=False),
 ]
 
 

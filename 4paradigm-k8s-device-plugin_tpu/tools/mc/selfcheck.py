@@ -454,6 +454,29 @@ def _seed_fastlane_park_ignored() -> Iterator[None]:
         FL.FastlaneHub._park_verdict = orig_desc
 
 
+@contextlib.contextmanager
+def _seed_gate_close_lead_only() -> Iterator[None]:
+    """A sharded lane's close transition gates only the LEAD ring:
+    the follower ordinals stay GATE_OPEN, so the producer keeps
+    submitting into rings nobody will ever drain.  The extended
+    fastlane-park-gate row reads every closed lane's rings directly
+    and must fire."""
+    from ...runtime import fastlane as FL
+    orig = FL.BrokerLane.gate_all
+
+    def lead_only(self, v):
+        try:
+            self.rings[0].gate_set(v)
+        except (OSError, ValueError, ConnectionError):
+            pass
+
+    FL.BrokerLane.gate_all = lead_only
+    try:
+        yield
+    finally:
+        FL.BrokerLane.gate_all = orig
+
+
 SEEDS: Tuple[Seed, ...] = (
     Seed("broken-lease-refund", "interleave", "token-conservation",
          "batch_pipeline", _seed_broken_refund),
@@ -475,6 +498,9 @@ SEEDS: Tuple[Seed, ...] = (
          "burst_floor", _seed_floor_violated),
     Seed("fastlane-park-ignored", "interleave", "fastlane-park-gate",
          "fastlane_gate", _seed_fastlane_park_ignored),
+    Seed("fastlane-chip1-gate-skipped", "interleave",
+         "fastlane-park-gate", "fastlane_multichip",
+         _seed_gate_close_lead_only),
     Seed("shed-of-floor-demander", "interleave", "shed-precedence",
          "overload_shed", _seed_shed_floor_demander),
     Seed("skipped-replay-arm", "crash", "replay-ground-truth",

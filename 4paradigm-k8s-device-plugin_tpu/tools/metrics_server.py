@@ -87,6 +87,7 @@ class MetricsState:
                     "suspended": resp.get("suspended", []),
                     "journal": resp.get("journal") or {},
                     "fastlane": resp.get("fastlane") or {},
+                    "timers": resp.get("timers") or {},
                     "replication": resp.get("replication") or {},
                     "slo": slo}
 
@@ -348,6 +349,30 @@ def broker_prometheus(brokers: List[Dict]) -> str:
         "# HELP vtpu_broker_fastlane_lanes Active fastlane lanes on "
         "the broker.",
         "# TYPE vtpu_broker_fastlane_lanes gauge",
+        # vtpu-fastlane-everywhere: sharded (multi-chip) lanes expose
+        # each chip ordinal's ring separately — a lane hot on chip 1
+        # but idle on chip 0 must be visible per chip, not averaged.
+        "# HELP vtpu_fastlane_chip_ring_depth Per-chip-ordinal ring "
+        "depth of a sharded fastlane lane.",
+        "# TYPE vtpu_fastlane_chip_ring_depth gauge",
+        "# HELP vtpu_fastlane_chip_ring_steps_total Per-chip-ordinal "
+        "ring admissions of a sharded fastlane lane.",
+        "# TYPE vtpu_fastlane_chip_ring_steps_total counter",
+        "# HELP vtpu_fastlane_chip_gate Per-chip-ordinal lane gate "
+        "word (0 open, 1 parked, 2 closed).",
+        "# TYPE vtpu_fastlane_chip_gate gauge",
+        # vtpu-timers (docs/PERF.md): the consolidated timer thread's
+        # coalesced wakeups + the dispatcher/completer idle wakeups —
+        # the idle broker's wakeup budget, CI-gated by broker-bench.
+        "# HELP vtpu_broker_timer_wakeups_total Coalesced timer-wheel "
+        "wakeups on the broker.",
+        "# TYPE vtpu_broker_timer_wakeups_total counter",
+        "# HELP vtpu_broker_dispatch_idle_wakeups_total Involuntary "
+        "dispatcher idle wakeups summed over chips.",
+        "# TYPE vtpu_broker_dispatch_idle_wakeups_total counter",
+        "# HELP vtpu_broker_completer_wakeups_total Involuntary "
+        "completion-loop idle wakeups summed over chips.",
+        "# TYPE vtpu_broker_completer_wakeups_total counter",
         # vtpu-failover (docs/FAILOVER.md): a silently-stalled standby
         # must be visible BEFORE the primary dies — follower count,
         # worst lag, fence generation and takeover count per broker.
@@ -446,6 +471,19 @@ def broker_prometheus(brokers: List[Dict]) -> str:
                              f'{fl.get("arena_bytes", 0)}')
                 lines.append(f'vtpu_fastlane_gate{labels} '
                              f'{fl.get("gate", 0)}')
+                for ordv, ch in enumerate(fl.get("chips") or ()):
+                    clab = (f'{{broker="{broker}",'
+                            f'tenant="{_esc(name)}",'
+                            f'chip_ordinal="{ordv}"}}')
+                    lines.append(
+                        f'vtpu_fastlane_chip_ring_depth{clab} '
+                        f'{ch.get("ring_depth", 0)}')
+                    lines.append(
+                        f'vtpu_fastlane_chip_ring_steps_total{clab} '
+                        f'{ch.get("ring_steps", 0)}')
+                    lines.append(
+                        f'vtpu_fastlane_chip_gate{clab} '
+                        f'{ch.get("gate", 0)}')
             tr = t.get("trace")
             if tr:
                 lines.append(
@@ -474,6 +512,17 @@ def broker_prometheus(brokers: List[Dict]) -> str:
             lines.append(f'vtpu_broker_fastlane_lanes'
                          f'{{broker="{broker}"}} '
                          f'{flb.get("lanes", 0)}')
+        tm = b.get("timers") or {}
+        if tm:
+            bl = f'{{broker="{broker}"}}'
+            lines.append(f'vtpu_broker_timer_wakeups_total{bl} '
+                         f'{(tm.get("wheel") or {}).get("wakeups", 0)}')
+            lines.append(
+                f'vtpu_broker_dispatch_idle_wakeups_total{bl} '
+                f'{tm.get("dispatch_idle_wakeups", 0)}')
+            lines.append(
+                f'vtpu_broker_completer_wakeups_total{bl} '
+                f'{tm.get("completer_wakeups", 0)}')
     return "\n".join(lines) + "\n" if brokers else ""
 
 

@@ -365,12 +365,22 @@ def render_top(rows: List[Dict], enabled: bool = True,
         fair = (f"{r['fair_ratio']:.2f}" if r["fair_ratio"] is not None
                 else "-")
         credit = f"{r.get('credit_ms', 0):.0f}ms"
-        # Data plane: 'ring' when a fastlane lane exists and the
-        # gate is open ('held' while parked, 'sock' otherwise).
+        # Data plane: 'ring' when a fastlane lane exists and EVERY
+        # chip ring's gate is open (the stats rollup reports the
+        # worst gate and the max depth over a sharded lane's chips —
+        # a lane hot on chip 1 but idle on chip 0 is still 'ring',
+        # never 'sock'); 'held' while any ordinal is parked; 'sock'
+        # with no lane or a closed one.  Sharded lanes show their
+        # chip count ('ring2').
         fl = r.get("fastlane")
         plane = "sock"
         if fl:
-            plane = "ring" if fl.get("gate", 2) == 0 else "held"
+            g = fl.get("gate", 2)
+            if g == 0:
+                nch = len(fl.get("chips") or ())
+                plane = f"ring{nch}" if nch > 1 else "ring"
+            elif g == 1:
+                plane = "held"
         lines.append(
             f"{r['tenant'][:17]:<17}{flag} {r['steps_per_s']:>8.1f} "
             f"{r['p50_e2e_us']:>9.0f} {r['p99_e2e_us']:>9.0f} "

@@ -523,9 +523,118 @@ def make_exec_ring(broken: str = "") -> Litmus:
         ("wmm-ring-fifo", "wmm-no-torn-payload"))
 
 
+# ---------------------------------------------------------------------------
+# 7. Multi-chip completion vector (per-chip rings + completion join,
+#    vtpu-fastlane-everywhere)
+# ---------------------------------------------------------------------------
+
+def make_multi_ring(broken: str = "") -> Litmus:
+    """A sharded lane's completion-join shape (vtpu_core.h
+    ``publish: ExecRing.cvec release -> consume: acquire``): the
+    producer submits one descriptor PER CHIP RING; the LEAD chip's
+    consumer executes (binds the outputs — modeled as the ``res``
+    words), publishes its headc (release) and then its completion-
+    vector slot ``cvec0`` (release); the FOLLOWER chip's consumer
+    completes its ring only after an acquire read of ``cvec0`` and
+    publishes ``cvec1`` (release); the JOINER (the client's
+    ``cvec_wait``) acquire-sweeps the vector and must then observe
+    every output the lead bound — a join can never release a result
+    whose binds are not yet visible.  Broken variant:
+    ``relaxed-cvec`` publishes the lead's vector slot relaxed — the
+    joiner can join a completion whose output words it cannot see
+    (exactly the bug class the declared release order exists for)."""
+    items = 2
+    cvec_pub = RLX if broken == "relaxed-cvec" else REL
+
+    def producer(out: Dict[str, Any]):
+        for i in range(items):
+            # One descriptor per chip ring, same seq stream (payload
+            # relaxed, tail release — the exec_ring litmus already
+            # polices the full gate shape; this one isolates the
+            # join).
+            yield ("store", f"descL{i}", 100 + i, RLX)
+            yield ("store", "tailL", i + 1, REL)
+            yield ("store", f"descF{i}", 300 + i, RLX)
+            yield ("store", "tailF", i + 1, REL)
+
+    def lead(out: Dict[str, Any]):
+        done = 0
+        for i in range(items):
+            ready = False
+            for _ in range(6):
+                t = yield ("load", "tailL", ACQ)
+                if t > i:
+                    ready = True
+                    break
+            if not ready:
+                break
+            v = yield ("load", f"descL{i}", RLX)
+            # The output bind the joiner must observe.
+            yield ("store", f"res{i}", v, RLX)
+            yield ("store", "headcL", i + 1, REL)
+            yield ("store", "cvec0", i + 1, cvec_pub)
+            done += 1
+        out["lead_done"] = done
+
+    def follower(out: Dict[str, Any]):
+        done = 0
+        for i in range(items):
+            ready = False
+            for _ in range(6):
+                c = yield ("load", "cvec0", ACQ)
+                if c > i:
+                    ready = True
+                    break
+            if not ready:
+                break
+            yield ("store", "headcF", i + 1, REL)
+            yield ("store", "cvec1", i + 1, REL)
+            done += 1
+        out["follower_done"] = done
+
+    def joiner(out: Dict[str, Any]):
+        joined = []
+        for i in range(items):
+            ready = False
+            for _ in range(8):
+                c1 = yield ("load", "cvec1", ACQ)
+                if c1 > i:
+                    ready = True
+                    break
+            if not ready:
+                break
+            r = yield ("load", f"res{i}", RLX)
+            joined.append((i, r))
+        out["joined"] = joined
+
+    def check(ctx: WmmContext, out: Dict[str, Any],
+              final: Dict[str, int]) -> None:
+        for i, r in out.get("joined", ()):
+            if r != 100 + i:
+                ctx.report(
+                    "wmm-no-torn-payload",
+                    f"multi_ring: joiner released seq {i} with the "
+                    f"lead's output bind invisible (res={r} != "
+                    f"{100 + i}) — the completion-vector join is "
+                    f"not a synchronization point")
+
+    init = {"tailL": 0, "tailF": 0, "headcL": 0, "headcF": 0,
+            "cvec0": 0, "cvec1": 0}
+    for i in range(items):
+        init.update({f"descL{i}": 0, f"descF{i}": 0, f"res{i}": 0})
+    return Litmus(
+        "multi_ring",
+        "multi-chip per-chip rings: sharded submit + completion-"
+        "vector join (lead publishes cvec release, follower and "
+        "client consume acquire)",
+        "exec-ring", init, (producer, lead, follower, joiner), check,
+        ("wmm-no-torn-payload", "wmm-ring-fifo"))
+
+
 FACTORIES: Tuple[Callable[..., Litmus], ...] = (
     make_trace_ring, make_ledger_cas, make_rate_lease,
-    make_credit_bank, make_degraded_quota, make_exec_ring)
+    make_credit_bank, make_degraded_quota, make_exec_ring,
+    make_multi_ring)
 
 LITMUS: Tuple[Litmus, ...] = tuple(f() for f in FACTORIES)
 

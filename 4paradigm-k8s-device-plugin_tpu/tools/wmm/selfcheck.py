@@ -81,6 +81,12 @@ SEEDS: Tuple[Seed, ...] = (
          "producer skips the headc slot-reuse gate with a crash-torn "
          "credit counter: the wrap overwrites a descriptor the "
          "consumer has not republished"),
+    Seed("multi-ring-relaxed-cvec",
+         lt.make_multi_ring(broken="relaxed-cvec"),
+         "wmm-no-torn-payload",
+         "lead publishes its completion-vector slot relaxed: the "
+         "multi-chip join can release a completion whose lead-side "
+         "output binds are not yet visible"),
 )
 
 

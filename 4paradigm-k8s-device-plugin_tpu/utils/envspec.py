@@ -246,6 +246,12 @@ ENV_FLAGS = {
     "VTPU_FASTLANE_ARENA_MB": ("broker", True),
     "VTPU_FASTLANE_SPIN_US": ("shim", True),
     "VTPU_FASTLANE_BATCH": ("broker", False),
+    # vtpu-fastlane-everywhere (docs/PERF.md): sharded multi-chip
+    # lanes, arena arg-blob streaming, and the consolidated broker
+    # timer thread.
+    "VTPU_FASTLANE_MULTICHIP": ("broker", True),
+    "VTPU_ARENA_FEED": ("shim", True),
+    "VTPU_TIMER_COALESCE_MS": ("broker", True),
     # vtpu-failover (docs/FAILOVER.md): streaming journal replication,
     # hot-standby takeover fencing, live tenant migration.
     "VTPU_REPL_BUFFER_MB": ("broker", True),

@@ -374,12 +374,16 @@ typedef struct {
   uint64_t headc;    /* consumer-published completion count (publish) */
   int64_t credits;   /* admission credit gate (acq_rel RMW) */
   int64_t credit_us; /* burst-credit bank (acq_rel RMW) */
+  /* Multi-chip completion vector (lead ring only, see vtpu_core.h):
+   * per-ordinal completed sequence counts, release-published by each
+   * chip's completer, acquire-consumed by the join. */
+  uint64_t cvec[VTPU_MAX_DEVICES];
   uint64_t pad_[2];
   ExecDesc slots[]; /* capacity entries */
 } ExecRing;
 
 #define VTPU_EXEC_MAGIC 0x76455852u /* "vEXR" */
-#define VTPU_EXEC_VERSION 1u
+#define VTPU_EXEC_VERSION 2u
 
 struct vtpu_exec_ring {
   ExecRing* shm;
@@ -746,6 +750,53 @@ int vtpu_exec_credit_spend(vtpu_exec_ring* x, int64_t us) {
 
 int64_t vtpu_exec_credit_level(vtpu_exec_ring* x) {
   return x ? __atomic_load_n(&x->shm->credit_us, __ATOMIC_ACQUIRE) : 0;
+}
+
+/* ---- multi-chip completion vector (vtpu-fastlane-everywhere) ----
+ * Release-published per-ordinal completed-sequence slots in the LEAD
+ * ring's header; acquire-consumed by the join (client) and by the
+ * follower drainers watching the lead's progress.  Orders are the
+ * declared `publish: ExecRing.cvec release -> consume: acquire` row
+ * (litmus-verified by tools/wmm multi_ring). */
+void vtpu_exec_cvec_set(vtpu_exec_ring* x, uint32_t idx, uint64_t seq) {
+  if (!x || idx >= VTPU_MAX_DEVICES) return;
+  __atomic_store_n(&x->shm->cvec[idx], seq, __ATOMIC_RELEASE);
+}
+
+uint64_t vtpu_exec_cvec_get(vtpu_exec_ring* x, uint32_t idx) {
+  if (!x || idx >= VTPU_MAX_DEVICES) return 0;
+  return __atomic_load_n(&x->shm->cvec[idx], __ATOMIC_ACQUIRE);
+}
+
+uint64_t vtpu_exec_cvec_min(vtpu_exec_ring* x, uint32_t n) {
+  if (!x || n == 0) return 0;
+  if (n > VTPU_MAX_DEVICES) n = VTPU_MAX_DEVICES;
+  uint64_t mn = (uint64_t)-1;
+  for (uint32_t i = 0; i < n; i++) {
+    uint64_t v = __atomic_load_n(&x->shm->cvec[i], __ATOMIC_ACQUIRE);
+    if (v < mn) mn = v;
+  }
+  return mn;
+}
+
+int vtpu_exec_cvec_wait(vtpu_exec_ring* x, uint32_t n, uint64_t seq,
+                        uint64_t timeout_ns, uint64_t spin_ns) {
+  if (!x || n == 0) return 0;
+  uint64_t t0 = now_ns();
+  for (;;) {
+    if (vtpu_exec_cvec_min(x, n) >= seq) return 1;
+    uint64_t waited = now_ns() - t0;
+    if (timeout_ns && waited >= timeout_ns) return 0;
+    if (waited >= spin_ns) {
+      /* No dedicated futex word for the vector (the per-ring headc
+       * wakes cover the common single-chip path); a bounded 50us nap
+       * keeps the join cheap without a per-publish syscall. */
+      struct timespec ts = {0, 50 * 1000};
+      nanosleep(&ts, NULL);
+    } else {
+      sched_yield();
+    }
+  }
 }
 
 /* Lock with robust-mutex recovery: on EOWNERDEAD adopt the state and sweep

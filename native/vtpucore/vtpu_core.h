@@ -393,6 +393,28 @@ int vtpu_exec_wait_tail(vtpu_exec_ring* x, uint64_t seq,
 void vtpu_exec_gate_set(vtpu_exec_ring* x, uint32_t v);
 uint32_t vtpu_exec_gate(vtpu_exec_ring* x);
 
+/* ---- multi-chip completion vector (vtpu-fastlane-everywhere) ----
+ *
+ * A multi-chip grant's lane carries ONE SPSC ring PER CHIP under one
+ * tx/rx arena pair; a sharded execute submits one descriptor per chip
+ * ring and the caller JOINS the per-chip completions through this
+ * vector, which lives in the LEAD (ordinal-0) ring's header.  Each
+ * chip's completer publishes its completed sequence count into its
+ * ordinal slot with RELEASE order after its headc publish; readers
+ * (the joining client, the follower drainers watching the lead's
+ * progress) consume with ACQUIRE — so observing cvec[k] >= s implies
+ * every side effect of chip k's completion of sequence s-1 (output
+ * binds, status words) is visible.  vtpu_exec_cvec_min is the join
+ * point: min over the first n ordinals. */
+void vtpu_exec_cvec_set(vtpu_exec_ring* x, uint32_t idx, uint64_t seq);
+uint64_t vtpu_exec_cvec_get(vtpu_exec_ring* x, uint32_t idx);
+uint64_t vtpu_exec_cvec_min(vtpu_exec_ring* x, uint32_t n);
+
+/* Bounded join wait: spin `spin_ns`, then 50us naps, until
+ * min(cvec[0..n)) >= seq or timeout.  Returns 1 when joined. */
+int vtpu_exec_cvec_wait(vtpu_exec_ring* x, uint32_t n, uint64_t seq,
+                        uint64_t timeout_ns, uint64_t spin_ns);
+
 /* Burst-credit bank over shared atomics (the credit_bank litmus
  * shape, docs/SCHEDULING.md): the broker's collector mints idle
  * accrual (capped), the client spends when its token bucket refuses —
@@ -471,6 +493,7 @@ int64_t vtpu_exec_credit_level(vtpu_exec_ring* x);
  *   publish: ExecRing.tail release -> consume: acquire
  *   publish: ExecRing.headc release -> consume: acquire
  *   publish: ExecRing.gate release -> consume: acquire
+ *   publish: ExecRing.cvec release -> consume: acquire
  *   rmw: ExecRing.credits acq_rel
  *   rmw: ExecRing.credit_us acq_rel
  *   payload: ExecDesc.* relaxed
@@ -485,6 +508,16 @@ int64_t vtpu_exec_credit_level(vtpu_exec_ring* x);
  * FIFO, no-torn-descriptor and credit conservation are the
  * wmm-ring-fifo invariant row (tools/mc/invariants.py); the burst-
  * credit bank words follow the credit_bank litmus (wmm-credit-bounds).
+ *
+ * Multi-chip completion vector (vtpu-fastlane-everywhere): a sharded
+ * lane's per-chip completers publish their completed sequence counts
+ * into the lead ring's ExecRing.cvec slots with release order (AFTER
+ * their own headc release publish), and both the joining client and
+ * the follower drainers consume them acquire — the multi_ring litmus
+ * (tools/wmm) proves the join can never observe a completion whose
+ * lead-side output binds are not yet visible, and the seeded
+ * relaxed-cvec selfcheck variant proves the simulator would catch a
+ * demoted publish.
  */
 
 #ifdef __cplusplus
