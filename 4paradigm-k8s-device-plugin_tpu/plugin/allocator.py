@@ -140,6 +140,86 @@ def preferred_allocation(
     return out
 
 
+# -- vtpu-cluster: two-level cross-node placement -----------------------
+#
+# The federation coordinator (runtime/cluster.py) extends the same
+# pack|spread policy across nodes: level 1 picks the node (pack =
+# tightest fit — fewest free chips that still satisfy the request,
+# keeping empty nodes whole for future wide grants; spread = emptiest
+# node, minimizing co-tenancy), level 2 picks the chip set WITHIN the
+# node by ICI ring distance, exactly the intra-node scoring above but
+# on the plain chip-index inventory the cluster wire carries (nodes
+# report a ring topology of ``total`` chips; the single-host 8-chip
+# ICI ring is the canonical case).
+
+
+def _ring_cost(chips: Sequence[int], n: int) -> int:
+    """Total pairwise ring distance of a chip-index set on an n-chip
+    ICI ring (min of the two arc lengths per pair)."""
+    if n <= 1:
+        return 0
+    cost = 0
+    for a, b in itertools.combinations(chips, 2):
+        d = abs(a - b) % n
+        cost += min(d, n - d)
+    return cost
+
+
+def _intra_node_chips(free: Sequence[int], total: int, size: int,
+                      policy: str) -> Optional[List[int]]:
+    """Best ``size``-chip subset of a node's free chips: pack
+    minimizes ring distance (ICI-compact), spread maximizes it."""
+    free = sorted(set(int(c) for c in free))
+    if size <= 0 or len(free) < size:
+        return None
+    best: Optional[List[int]] = None
+    best_cost = None
+    n_combos = 0
+    for combo in itertools.combinations(free, size):
+        n_combos += 1
+        if n_combos > _MAX_ENUMERATION:
+            break
+        cost = _ring_cost(combo, total)
+        if policy == "spread":
+            cost = -cost
+        if best_cost is None or cost < best_cost:
+            best_cost = cost
+            best = list(combo)
+    return best
+
+
+def cluster_choose_placement(
+    inventory: Dict[str, Dict[str, object]],
+    size: int,
+    policy: str = "pack",
+) -> tuple:
+    """Two-level placement over ``{node: {"free": [chip...],
+    "total": n}}``: returns ``(node, chips, standby_node)`` or
+    ``(None, [], None)`` when no live node can satisfy the request.
+    ``standby_node`` is the runner-up — the cluster plane's suggested
+    hot-standby placement (chosen from live inventory instead of
+    operator config, docs/FEDERATION.md)."""
+    scored = []
+    for node, inv in sorted(inventory.items()):
+        free = list(inv.get("free") or [])  # type: ignore[arg-type]
+        total = int(inv.get("total") or 0)  # type: ignore[arg-type]
+        chips = _intra_node_chips(free, total, size, policy)
+        if chips is None:
+            continue
+        intra = _ring_cost(chips, total)
+        if policy == "spread":
+            key = (-len(free), -intra, node)
+        else:
+            key = (len(free), intra, node)
+        scored.append((key, node, chips))
+    if not scored:
+        return None, [], None
+    scored.sort(key=lambda e: e[0])
+    _key, node, chips = scored[0]
+    standby = scored[1][1] if len(scored) > 1 else None
+    return node, chips, standby
+
+
 def _first_n(available: Sequence[VDevice], must_include: Sequence[VDevice],
              size: int) -> List[VDevice]:
     out = list(must_include[:size])

@@ -158,7 +158,8 @@ class RuntimeClient:
                  core_limit: Optional[int] = None,
                  oversubscribe: Optional[bool] = None,
                  reconnect_timeout: Optional[float] = None,
-                 trace: Optional[bool] = None):
+                 trace: Optional[bool] = None,
+                 resume_epoch: Optional[str] = None):
         self._socket_path = socket_path
         # vtpu-trace (docs/TRACING.md): when on, every request is
         # stamped with a trace id + send time so the broker's flight
@@ -388,7 +389,12 @@ class RuntimeClient:
         self._used_mirror: Dict[str, int] = {}
         self._granted_hbm = int(hello.get("hbm_limit") or 0)
         self._granted_core = int(hello.get("core_limit") or 0)
-        self.epoch: Optional[str] = None
+        # vtpu-cluster (docs/FEDERATION.md): a caller reattaching to
+        # a tenant that moved brokers (cross-node MIGRATE) passes the
+        # SOURCE broker's epoch here, so the very first HELLO on the
+        # target socket offers it and adopts the parked migrated-in
+        # tenant instead of binding a fresh empty one.
+        self.epoch: Optional[str] = resume_epoch
         # First dial: an OVERLOAD HELLO refusal (slot exhaustion under
         # join churn) retries with jittered backoff inside the
         # reconnect budget — the thousand-tenant join storm backs off

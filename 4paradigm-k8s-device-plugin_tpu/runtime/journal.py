@@ -185,8 +185,18 @@ class Journal:
 
     def __init__(self, dirpath: str,
                  snapshot_every: Optional[int] = None,
-                 fsync: Optional[bool] = None):
+                 fsync: Optional[bool] = None,
+                 apply_fn: Optional[Callable[[Dict[str, Any],
+                                              Dict[str, Any]],
+                                             None]] = None):
         self.dir = dirpath
+        # Record interpreter used by load_state: the broker ledger's
+        # _apply_record by default, but other journaled state machines
+        # (the cluster coordinator's placement ledger) supply their own
+        # and inherit the framing/snapshot/fence/replication machinery
+        # unchanged.
+        self.apply_fn = apply_fn if apply_fn is not None \
+            else _apply_record
         os.makedirs(os.path.join(dirpath, BLOBS_DIR), exist_ok=True)
         if snapshot_every is None:
             snapshot_every = int(os.environ.get(
@@ -533,7 +543,7 @@ class Journal:
                                      tail_tolerant=(path == segments[-1][0]))
             any_records = any_records or bool(recs)
             for rec in recs:
-                _apply_record(state, rec)
+                self.apply_fn(state, rec)
         return state if any_records else None
 
     def quarantine(self) -> None:

@@ -187,6 +187,31 @@ RESIZE = "resize"        # {tenant, hbm_limit?|hbm_limits?, core_limit?}
 MIGRATE = "migrate"      # {tenant, device | devices, timeout?}
                          # -> {ok, tenant, from, to, blackout_ms,
                          #     moved_bytes}
+# Cross-NODE migration (vtpu-cluster, docs/FEDERATION.md): the
+# source-broker half.  ``phase`` selects the step of the two-broker
+# dance the cluster coordinator (or vtpu-smi --migrate-to) drives:
+# "begin" quiesces the tenant exactly like MIGRATE, host-copies its
+# arrays, and answers the serialized tenant state plus its
+# content-addressed blobs (sha256-keyed — the transfer channel's
+# integrity contract); "commit" tears the source copy down and
+# releases its ledger (ONLY after the target acked MIGRATE_IN — the
+# cluster never holds less than one full copy); "abort" un-quiesces
+# back to serving.  Every phase is safe to re-run (begin re-snapshots
+# the held tenant, commit/abort of a gone tenant no-op), so the verb
+# classifies idempotent.
+MIGRATE_OUT = "migrate_out"  # {tenant, phase?} -> {ok, state, blobs,
+                             #     epoch, moved_bytes}
+# The target-broker half: verify + store the blobs, rebuild the
+# tenant through the journal-recovery machinery and PARK it exactly
+# like a crash-recovered tenant — the client's next HELLO with
+# resume_epoch = the SOURCE broker's epoch adopts it with arrays,
+# programs, grant and credit intact (byte-identical, the shas prove
+# it).  Same-topology sharded grants land chip-for-chip on the target
+# ``devices``; a mismatched topology refuses typed BEFORE any state
+# mutates.  Re-running a lost ack re-parks the same state, so the
+# verb classifies idempotent.
+MIGRATE_IN = "migrate_in"    # {tenant, state?, blobs?, devices?}
+                             # -> {ok, tenant, devices, epoch}
 # REPL_SYNC (vtpu-failover, docs/FAILOVER.md): the hot-standby broker's
 # subscription verb.  With {status: true} it answers one frame — the
 # replication block (role, followers, lag, fence generation) — and the
@@ -215,7 +240,8 @@ TENANT_VERBS = (HELLO, PUT_PART, PUT, GET, DELETE, COMPILE, EXECUTE,
                 EXEC_BATCH, STATS, TRACE, SLO, FASTBIND)
 # Served on the host-side admin socket (<socket>.admin, never mounted).
 ADMIN_VERBS = (STATS, TRACE, SLO, SUSPEND, RESUME, RESIZE, MIGRATE,
-               REPL_SYNC, SHUTDOWN, DRAIN, HANDOVER)
+               MIGRATE_OUT, MIGRATE_IN, REPL_SYNC, SHUTDOWN, DRAIN,
+               HANDOVER)
 # Answer WITHOUT a HELLO binding — no tenant slot, no lazy chip claim,
 # so a read-only probe can never wedge a chip claim (ADVICE r5 #2).
 BIND_FREE_VERBS = (STATS, TRACE, SLO)
@@ -247,7 +273,7 @@ BIND_FREE_VERBS = (STATS, TRACE, SLO)
 # safe to retry.
 IDEMPOTENT_VERBS = (HELLO, PUT, GET, DELETE, COMPILE, STATS, TRACE,
                     SLO, SUSPEND, RESUME, RESIZE, MIGRATE, REPL_SYNC,
-                    DRAIN, FASTBIND)
+                    MIGRATE_OUT, MIGRATE_IN, DRAIN, FASTBIND)
 NONIDEMPOTENT_VERBS = (PUT_PART, EXECUTE, EXEC_BATCH, SHUTDOWN,
                        HANDOVER)
 
@@ -315,6 +341,10 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
              "optional": ("hbm_limit", "hbm_limits", "core_limit")},
     MIGRATE: {"required": ("tenant",),
               "optional": ("device", "devices", "timeout")},
+    MIGRATE_OUT: {"required": ("tenant",),
+                  "optional": ("phase", "timeout")},
+    MIGRATE_IN: {"required": ("tenant",),
+                 "optional": ("state", "blobs", "devices")},
     REPL_SYNC: {"required": (), "optional": ("status",)},
     SHUTDOWN: {"required": (), "optional": ()},
     DRAIN: {"required": (), "optional": ("timeout",)},

@@ -300,6 +300,12 @@ class Tenant:
         # Live connections sharing this tenant (a pod may open several);
         # state is torn down when the last one closes.
         self.connections = 0
+        # vtpu-cluster (docs/FEDERATION.md): a migrated-IN tenant is
+        # parked under the SOURCE broker's epoch — the one its client
+        # still holds — so the resume HELLO can adopt it even though
+        # this broker's prev_epoch never matched.  None for every
+        # locally-created or crash-recovered tenant.
+        self.accept_epoch: Optional[str] = None
         # Sequence for server-assigned output ids (when the client sent
         # fewer out-ids than the program has outputs) — must be unique
         # per tenant or successive executes would clobber each other.
@@ -2139,6 +2145,11 @@ class RuntimeState:
         self.recovered: Dict[str, Tuple[Tenant, float]] = {}
         self.resume_grace = float(os.environ.get(
             "VTPU_RESUME_GRACE_S", "120"))
+        # vtpu-cluster (docs/FEDERATION.md): tenants mid cross-node
+        # MIGRATE_OUT — quiesced by "begin", torn down at "commit",
+        # un-frozen by "abort".  Value records whether "begin" took
+        # the suspend hold (so abort only releases what it took).
+        self.migrating_out: Dict[str, dict] = {}
         self.recovery = {
             "recoveries_total": 0,
             "tenants_recovered": 0,
@@ -2541,7 +2552,15 @@ class RuntimeState:
         if self.journal is None or resume_epoch is None:
             return None
         with self.mu:
-            if resume_epoch != self.prev_epoch:
+            ent = self.recovered.get(name)
+            # Two sanctioned epochs adopt a parked tenant: the
+            # PREVIOUS broker epoch (crash/handover recovery) and a
+            # per-tenant accept epoch — the SOURCE broker's epoch a
+            # cross-node MIGRATE_IN parked it under (the client still
+            # holds that one; docs/FEDERATION.md).
+            accept = ent[0].accept_epoch if ent is not None else None
+            if resume_epoch != self.prev_epoch and \
+                    resume_epoch != accept:
                 return None
             ent = self.recovered.pop(name, None)
             if ent is None:
@@ -4263,6 +4282,12 @@ def migrate_tenant(state: RuntimeState, t: Tenant,
     if timeout is None:
         timeout = float(os.environ.get("VTPU_MIGRATE_TIMEOUT_S", "30"))
     t0 = time.monotonic()
+    # -- 0. validate (BEFORE any mutation) --
+    # Every refusal below must leave the tenant a true no-op: no
+    # suspend hold taken, no lease revoked, no fastlane gate touched.
+    # A refused MIGRATE that had already quiesced would charge the
+    # tenant a blackout for nothing — the regression test pins the
+    # lease and ring gate untouched across a refusal.
     targets = [int(d) for d in devices]
     if len(targets) != len(t.chips) or len(set(targets)) != len(targets):
         raise ValueError(
@@ -4271,7 +4296,9 @@ def migrate_tenant(state: RuntimeState, t: Tenant,
     if len(t.chips) != 1:
         raise ValueError(
             "MIGRATE_UNSUPPORTED: multi-chip grants are mesh-bound "
-            "and cannot migrate yet")
+            "within a node — use the cross-node MIGRATE_OUT/"
+            "MIGRATE_IN verbs (same-topology targets only, "
+            "docs/FEDERATION.md)")
     src = [c.index for c in t.chips]
     if targets == src:
         return ({"ok": True, "tenant": t.name, "from": src,
@@ -4410,6 +4437,362 @@ def migrate_tenant(state: RuntimeState, t: Tenant,
              "blackout_ms": round(blackout_ms, 2),
              "moved_bytes": moved}
     return reply, migrate_rec
+
+
+def migrate_out_begin(state: RuntimeState, t: Tenant,
+                      timeout: Optional[float] = None) -> dict:
+    """Cross-node MIGRATE, source side, phase "begin"
+    (docs/FEDERATION.md): quiesce the tenant exactly like the
+    single-node verb — suspend hold, lease revoke, fastlane
+    gate-close, in-flight drain — then host-copy its arrays and
+    answer the serialized tenant (the _snapshot_dict per-tenant
+    shape, plus the source EPOCH the target parks it under) with
+    every blob content-addressed by sha256.  The hold is KEPT until
+    "commit" or "abort": between begin and commit the cluster holds
+    two copies and serves from neither — never less than one.
+
+    Unlike single-node MIGRATE this supports multi-chip grants: the
+    serialized charges are positional (chip-list index), so a
+    same-topology target lands them chip-for-chip; the topology
+    match itself is validated by MIGRATE_IN before it mutates
+    anything."""
+    import hashlib
+    import numpy as np
+    if timeout is None:
+        timeout = float(os.environ.get("VTPU_MIGRATE_TIMEOUT_S", "30"))
+    # -- 0. validate (BEFORE any mutation; refusal = true no-op) --
+    if state.journal is None:
+        raise ValueError(
+            "MIGRATE_UNSUPPORTED: cross-node migration requires the "
+            "journal (program blobs ride it; set VTPU_JOURNAL_DIR)")
+    # -- 1. quiesce (kept held until commit/abort) --
+    hold = t.name not in state.suspended
+    if hold:
+        with state.mu:
+            state.suspended.add(t.name)
+    try:
+        with t.chip.scheduler.mu:
+            t.lease_release()
+            t.lease_revoked = True
+        state.fastlane.quiesce_lane(t.name)
+        state.fastlane.close_lane(t.name)
+        t.chip.scheduler.quiesce(t.name, timeout=max(timeout, 0.0))
+        with t.mu:
+            arrays = list(t.arrays.items())
+            host_arrays = list(t.host_arrays.items())
+            charge_items = {aid: list(ch)
+                            for aid, ch in t.charges.items()}
+            # Staged spill copies are pure cache: the host copy is
+            # authoritative and travels; drop the device cache here
+            # (releases its ledger bytes on THIS node).
+            for aid in list(t.staged):
+                t.drop_staged(aid)
+        # -- 2. serialize: arrays as content-addressed blobs --
+        blobs: Dict[str, bytes] = {}
+        arrays_meta: Dict[str, dict] = {}
+        for aid, arr in arrays:
+            data = np.asarray(arr)
+            raw = data.tobytes()
+            sha = hashlib.sha256(raw).hexdigest()
+            blobs[sha] = raw
+            arrays_meta[aid] = {
+                "sha": sha, "nbytes": len(raw),
+                "dtype": str(data.dtype), "shape": list(data.shape),
+                "charges": charge_items.get(aid) or [],
+                "spilled": False}
+        for aid, arr in host_arrays:
+            data = np.asarray(arr)
+            raw = data.tobytes()
+            sha = hashlib.sha256(raw).hexdigest()
+            blobs[sha] = raw
+            arrays_meta[aid] = {
+                "sha": sha, "nbytes": len(raw),
+                "dtype": str(data.dtype), "shape": list(data.shape),
+                "charges": charge_items.get(aid) or [],
+                "spilled": True}
+        # Program blobs come off the journal's content-addressed
+        # store (the compile path journaled them); a GC'd blob just
+        # means the client re-registers on its next epoch check,
+        # exactly like crash recovery.
+        for _eid, sha in t.exe_shas.items():
+            if sha in blobs:
+                continue
+            raw = state.journal.get_blob(sha)
+            if raw is not None:
+                blobs[sha] = bytes(raw)
+        grant = t.grant or {}
+        rec: Dict[str, Any] = {
+            "devices": [c.index for c in t.chips],
+            "slots": list(t.slots),
+            "priority": t.priority,
+            "over": t.oversubscribe,
+            "hbm": grant.get("hbm"),
+            "core": grant.get("core"),
+            "spill": t.spill_overshoot,
+            "pid": t.client_pid,
+            "pidns": t.client_pidns,
+            "arrays": arrays_meta,
+            "exes": dict(t.exe_shas),
+            "ema": {k: float(v) for k, v in t.cost_ema.items()},
+            "execs": t.executions,
+            # The epoch the target parks the tenant under: the
+            # client's resume HELLO still carries THIS broker's
+            # epoch, not the target's prev_epoch.
+            "epoch": state.epoch,
+        }
+        if t.credit_minted_us > 0.0:
+            rec["credit"] = {"us": round(t.credit_us, 1),
+                             "minted": round(t.credit_minted_us, 1),
+                             "spent": round(t.credit_spent_us, 1)}
+        if not hold:
+            # The tenant was ADMIN-suspended before the migration
+            # began: that freeze travels (the migration's own hold
+            # does not — commit/abort releases it).
+            rec["suspended"] = {"auto": False}
+        slo_state = state.slo.export_state(t.name)
+        if slo_state is not None:
+            rec["slo"] = slo_state
+        with state.mu:
+            state.migrating_out[t.name] = {"hold": hold}
+        return {"ok": True, "tenant": t.name, "state": rec,
+                "blobs": blobs, "epoch": state.epoch,
+                "moved_bytes": sum(len(b) for b in blobs.values())}
+    except Exception:
+        # A failed begin un-quiesces: the tenant keeps serving here.
+        if hold:
+            with state.mu:
+                state.suspended.discard(t.name)
+        t.chip.scheduler.kick()
+        raise
+
+
+def migrate_out_finish(state: RuntimeState, t: Optional[Tenant],
+                       name: str, phase: str
+                       ) -> Tuple[dict, Optional[dict]]:
+    """Cross-node MIGRATE, source side, phases "commit" / "abort".
+
+    commit: tear the source copy down — release every HBM charge,
+    drop the slot, forget scheduler/SLO/flight state — ONLY now that
+    the target acked MIGRATE_IN (exact ledger conservation: the
+    chips free here in the same dance step the cluster ledger moves
+    the placement).  Returns the "close" journal record for the
+    CALLER to append before acking.  abort: release the begin hold
+    and kick — the tenant resumes serving here as if nothing
+    happened.  Both phases no-op on an already-gone tenant (a
+    re-driven dance after a lost ack must not error)."""
+    with state.mu:
+        ent = state.migrating_out.pop(name, None)
+    if t is None:
+        return ({"ok": True, "tenant": name, "phase": phase,
+                 "noop": True}, None)
+    if phase == "abort":
+        if ent is None or ent.get("hold"):
+            with state.mu:
+                state.suspended.discard(name)
+        t.chip.scheduler.kick()
+        return ({"ok": True, "tenant": name, "phase": "abort"}, None)
+    # -- commit --
+    with state.mu:
+        if state.tenants.get(name) is t:
+            state.tenants.pop(name, None)
+        state.suspended.discard(name)
+        t.chip.scheduler.forget_tenant(name)
+        state.flight.forget(name)
+        state.slo.forget(name)
+    state.fastlane.close_lane(name)
+    # Ledger release LAST (after the tenant is unreachable): the
+    # books drop to zero exactly once, machine-checked by the mc
+    # migrate-conserves-ledger rows on both nodes.
+    with t.mu:
+        charge_items = {aid: list(ch) for aid, ch in t.charges.items()}
+        t.charges.clear()
+        t.blob_meta.clear()
+        t.arrays.clear()
+        t.host_arrays.clear()
+        t.host_bytes = 0
+    for _aid, charges in charge_items.items():
+        for pos, nb in charges:
+            t.chips[pos].region.mem_release(t.slots[pos], nb)
+    rec = {"op": "close", "name": name} \
+        if state.journal is not None else None
+    return ({"ok": True, "tenant": name, "phase": "commit"}, rec)
+
+
+def migrate_in_tenant(state: RuntimeState, msg: dict
+                      ) -> Tuple[dict, List[dict]]:
+    """Cross-node MIGRATE, target side (docs/FEDERATION.md): verify
+    the content-addressed blobs, store them in THIS journal's blob
+    store, rebuild the tenant through the same machinery
+    _recover_from_journal uses (region seed + forced charge
+    admission with rollback), and PARK it like a crash-recovered
+    tenant — under the SOURCE broker's epoch, which is the one the
+    reconnecting client offers.  Returns (reply, journal records):
+    the caller appends the records BEFORE acking, so a target crash
+    after the ack recovers the migrated-in tenant like any other.
+
+    Every refusal happens BEFORE any mutation (typed, true no-op):
+    topology mismatch, blob hash mismatch, name conflict."""
+    import hashlib
+    name = str(msg["tenant"])
+    rec = dict(msg.get("state") or {})
+    blobs = dict(msg.get("blobs") or {})
+    # -- 0. validate (typed refusals; nothing has mutated yet) --
+    if state.journal is None:
+        raise ValueError(
+            "MIGRATE_UNSUPPORTED: target broker has no journal (the "
+            "migrated state must survive a crash; set "
+            "VTPU_JOURNAL_DIR)")
+    src_devices = [int(d) for d in rec.get("devices") or [0]]
+    devs = msg.get("devices")
+    devices = [int(d) for d in devs] if devs else list(src_devices)
+    if len(devices) != len(src_devices) \
+            or len(set(devices)) != len(devices):
+        raise ValueError(
+            f"MIGRATE_UNSUPPORTED: target chips {devices} do not "
+            f"match the source topology (width "
+            f"{len(src_devices)}) — mismatched topologies refuse, "
+            f"they never reshape")
+    ndev = len(state.jax.devices())
+    if any(d < 0 or d >= ndev for d in devices):
+        raise ValueError(
+            f"MIGRATE_UNSUPPORTED: target chips {devices} exceed "
+            f"this node's {ndev}-chip topology")
+    for sha, raw in blobs.items():
+        if hashlib.sha256(bytes(raw)).hexdigest() != str(sha):
+            raise ValueError(
+                f"MIGRATE_CORRUPT: blob {str(sha)[:12]} failed its "
+                f"content-address check — refusing the transfer")
+    with state.mu:
+        if name in state.tenants:
+            raise ValueError(
+                f"MIGRATE_CONFLICT: tenant {name!r} is already bound "
+                f"on this node")
+        if name in state.recovered:
+            # Idempotent re-drive after a lost ack: the park already
+            # happened; answer the same acceptance.
+            t0 = state.recovered[name][0]
+            return ({"ok": True, "tenant": name,
+                     "devices": [c.index for c in t0.chips],
+                     "epoch": state.epoch, "existing": True}, [])
+    # -- 1. blobs into THIS journal's content-addressed store --
+    for sha, raw in blobs.items():
+        state.journal.put_blob(bytes(raw), str(sha))
+    # -- 2. rebuild + park (mirrors _recover_from_journal) --
+    chips = [state.chip(d) for d in devices]
+    hbm = rec.get("hbm") or []
+    core = rec.get("core")
+    applied: List[Tuple[ChipState, int, int]] = []
+    with state.mu:
+        slots: List[int] = []
+        parked = [e[0] for e in state.recovered.values()]
+        for chip in chips:
+            used = {x.slots[k]
+                    for x in list(state.tenants.values()) + parked
+                    for k, c in enumerate(x.chips) if c is chip}
+            used.update(s for c, s in zip(chips[:len(slots)], slots)
+                        if c is chip)
+            index = next((i for i in range(MAX_TENANTS)
+                          if i not in used), None)
+            if index is None:
+                raise SlotsExhausted(
+                    f"no free tenant slot on target chip "
+                    f"{chip.index}")
+            slots.append(index)
+    try:
+        for k, (chip, slot) in enumerate(zip(chips, slots)):
+            chip.region.reset_slot(slot)
+            if k < len(hbm) and hbm[k] is not None:
+                chip.region.set_mem_limit(slot, int(hbm[k]))
+            else:
+                chip.region.set_mem_limit(slot, state.default_hbm)
+            chip.region.set_core_limit(
+                slot, int(core) if core is not None
+                else state.default_core)
+        t = Tenant(name, slots[0], int(rec.get("priority", 1)),
+                   bool(rec.get("over", False)),
+                   chips=chips, slots=slots)
+        t.core_pct = int(core) if core is not None \
+            else state.default_core
+        t.spill_overshoot = rec.get("spill")
+        cr = rec.get("credit")
+        if isinstance(cr, dict):
+            t.credit_us = min(max(float(cr.get("us", 0.0)), 0.0),
+                              BURST_CAP_US)
+            t.credit_minted_us = float(cr.get("minted", 0.0))
+            t.credit_spent_us = float(cr.get("spent", 0.0))
+        susp = rec.get("suspended")
+        if isinstance(susp, dict) and not susp.get("auto"):
+            state.suspended.add(name)
+        t.cost_ema = {str(k): float(v)
+                      for k, v in (rec.get("ema") or {}).items()}
+        t.executions = int(rec.get("execs", 0))
+        pid = rec.get("pid")
+        pidns = rec.get("pidns")
+        t.client_pid = int(pid) if pid else None
+        t.client_pidns = int(pidns) if pidns else None
+        t.grant = {"hbm": list(hbm), "core": core}
+        t.exe_shas = {str(k): str(v) for k, v
+                      in (rec.get("exes") or {}).items()}
+        t.recovered = True
+        t.accept_epoch = str(rec.get("epoch")) \
+            if rec.get("epoch") else None
+        for aid, am in (rec.get("arrays") or {}).items():
+            charges = [(int(p), int(nb))
+                       for p, nb in am.get("charges") or []]
+            for pos, nb in charges:
+                chips[pos].region.mem_acquire(slots[pos], nb, True)
+                applied.append((chips[pos], slots[pos], nb))
+            t.charges[aid] = charges
+            t.nbytes[aid] = (0 if am.get("spilled")
+                             else int(am.get("nbytes", 0)))
+            t.blob_meta[aid] = dict(am)
+    except Exception:
+        # Hand back every force-admitted byte: a refused acceptance
+        # must leave the target ledger exactly where it was.
+        for chip, slot, nb in applied:
+            chip.region.mem_release(slot, nb)
+        raise
+    if rec.get("slo"):
+        state.slo.restore(name, rec["slo"])
+    with state.mu:
+        state.recovered[name] = (t, time.monotonic()
+                                 + state.resume_grace)
+    state.recovery["tenants_recovered"] += 1
+    # -- 3. journal records (caller appends BEFORE acking) --
+    recs: List[dict] = [{
+        "op": "bind", "name": name, "devices": devices,
+        "slots": slots, "priority": t.priority,
+        "over": t.oversubscribe, "hbm": rec.get("hbm"),
+        "core": core, "spill": t.spill_overshoot,
+        "pid": t.client_pid, "pidns": t.client_pidns}]
+    for aid, am in t.blob_meta.items():
+        recs.append({"op": "put", "name": name, "id": aid,
+                     "sha": am.get("sha"), "shape": am.get("shape"),
+                     "dtype": am.get("dtype"),
+                     "nbytes": am.get("nbytes"),
+                     "charges": am.get("charges"),
+                     "spilled": bool(am.get("spilled"))})
+    for eid, sha in t.exe_shas.items():
+        recs.append({"op": "compile", "name": name, "id": eid,
+                     "sha": sha})
+    for key, val in t.cost_ema.items():
+        recs.append({"op": "ema", "name": name, "key": key,
+                     "ema": val, "execs": t.executions})
+    if t.credit_minted_us > 0.0:
+        recs.append({"op": "credit", "name": name,
+                     "us": round(t.credit_us, 1),
+                     "minted": round(t.credit_minted_us, 1),
+                     "spent": round(t.credit_spent_us, 1)})
+    if isinstance(susp, dict) and not susp.get("auto"):
+        recs.append({"op": "suspend", "name": name, "auto": False})
+    if rec.get("slo"):
+        recs.append({"op": "slo", "name": name, "state": rec["slo"]})
+    log.info("cluster: migrated-in tenant %r parked on chips %s "
+             "(%d arrays, %d programs; accept epoch %s)", name,
+             devices, len(t.blob_meta), len(t.exe_shas),
+             t.accept_epoch)
+    return ({"ok": True, "tenant": name, "devices": devices,
+             "epoch": state.epoch}, recs)
 
 
 class AdminSession(socketserver.BaseRequestHandler):
@@ -4583,6 +4966,65 @@ class AdminSession(socketserver.BaseRequestHandler):
                                  reply.get("blackout_ms", 0.0),
                                  reply.get("moved_bytes", 0))
                         P.send_msg(self.request, reply)
+                elif kind == P.MIGRATE_OUT:
+                    name = str(msg["tenant"])
+                    phase = str(msg.get("phase") or "begin")
+                    tmo = msg.get("timeout")
+                    with self.state.mu:
+                        t_obj = self.state.tenants.get(name)
+                    try:
+                        if phase == "begin":
+                            if t_obj is None:
+                                P.reply_err(
+                                    self.request, "NOT_FOUND",
+                                    f"tenant {name!r} is not bound")
+                                continue
+                            reply = migrate_out_begin(
+                                self.state, t_obj,
+                                timeout=float(tmo)
+                                if tmo is not None else None)
+                            log.info("admin: MIGRATE_OUT begin %r "
+                                     "moved=%dB", name,
+                                     reply.get("moved_bytes", 0))
+                        else:
+                            reply, close_rec = migrate_out_finish(
+                                self.state, t_obj, name, phase)
+                            jr = self.state.journal
+                            if close_rec is not None \
+                                    and jr is not None:
+                                jr.append(close_rec)
+                            log.info("admin: MIGRATE_OUT %s %r",
+                                     phase, name)
+                        P.send_msg(self.request, reply)
+                    except ValueError as e:
+                        code = str(e).partition(":")[0]
+                        if code not in ("MIGRATE_UNSUPPORTED",
+                                        "MIGRATE_CONFLICT",
+                                        "MIGRATE_CORRUPT"):
+                            code = "INTERNAL"
+                        P.reply_err(self.request, code, str(e))
+                elif kind == P.MIGRATE_IN:
+                    try:
+                        reply, in_recs = migrate_in_tenant(
+                            self.state, msg)
+                        # Journal BEFORE the ack: once the source
+                        # sees ok (and commits its teardown), the
+                        # migrated-in tenant must survive a crash
+                        # at any cut on THIS node.
+                        jr = self.state.journal
+                        if in_recs and jr is not None:
+                            jr.append_many(in_recs)
+                        log.info("admin: MIGRATE_IN %r -> chips %s",
+                                 reply.get("tenant"),
+                                 reply.get("devices"))
+                        P.send_msg(self.request, reply)
+                    except ValueError as e:
+                        code = str(e).partition(":")[0]
+                        if code not in ("MIGRATE_UNSUPPORTED",
+                                        "MIGRATE_CONFLICT",
+                                        "MIGRATE_CORRUPT"):
+                            code = "INTERNAL"
+                        P.reply_err(self.request, code, str(e))
                 elif kind == P.REPL_SYNC:
                     if msg.get("status"):
                         P.send_msg(self.request, {
@@ -4861,6 +5303,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "VTPU_JOURNAL_DIR") or None,
         help="crash-safe state journal dir (tmpfs/hostPath); unset "
              "disables recovery — see docs/BROKER_RECOVERY.md")
+    p.add_argument("--cluster", default=os.environ.get(
+        "VTPU_CLUSTER_SOCKET") or None,
+        help="cluster coordinator socket (clusterd); set to join the "
+             "node-local broker into the federation — "
+             "docs/FEDERATION.md")
+    p.add_argument("--node-name", default=os.environ.get(
+        "VTPU_CLUSTER_NODE") or None,
+        help="this node's name in the cluster ledger (default: "
+             "hostname)")
     ns = p.parse_args(argv)
     # Some images register a TPU plugin at interpreter startup and override
     # JAX_PLATFORMS; re-assert the env's explicit choice.
@@ -4898,6 +5349,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                       journal_dir=ns.journal_dir)
     log.info("vtpu-runtime serving on %s (hbm=%d core=%d%%)",
              ns.socket, hbm, ns.core_limit)
+    agent = None
+    if ns.cluster:
+        # Join the federation: a NodeAgent heartbeats this broker's
+        # inventory to clusterd; the coordinator never sits on the
+        # execute path, so its loss is fail-static here
+        # (docs/FEDERATION.md).
+        from .cluster import NodeAgent
+        try:
+            nchips = len(srv.state.jax.devices())
+        except Exception:  # noqa: BLE001 - inventory is best-effort
+            nchips = 1
+        node = ns.node_name or socket.gethostname()
+
+        def _tenants() -> List[str]:
+            with srv.state.mu:
+                return sorted(srv.state.tenants)
+
+        agent = NodeAgent(ns.cluster, node, ns.socket, nchips,
+                          hbm=hbm or None, tenants_fn=_tenants)
+        agent.start()
+        log.info("vtpu-runtime joined cluster %s as node %r "
+                 "(%d chips)", ns.cluster, node, nchips)
     try:
         srv.serve_forever()
     except KeyboardInterrupt:

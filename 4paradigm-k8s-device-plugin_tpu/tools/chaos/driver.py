@@ -770,8 +770,13 @@ def measure_control(seed: int, tenants: int = 4,
 
 
 def run_schedule(seed: int, tenants: int = 4, quick: bool = False,
-                 log=print, control: bool = True) -> Dict[str, Any]:
-    factor = 1.0
+                 log=print, control: bool = True,
+                 floor_scale: Optional[float] = None) -> Dict[str, Any]:
+    """``floor_scale``: a load factor ALREADY measured by the caller
+    (e.g. the failover suite's control cell) — applied to the strict
+    per-seed floors without re-running the control cell here.  Ignored
+    when ``control`` is on (the fresh measurement wins)."""
+    factor = 1.0 if floor_scale is None else float(floor_scale)
     ctl: Optional[Dict[str, Any]] = None
     if control:
         ctl = measure_control(seed, tenants=tenants, quick=quick,

@@ -1,0 +1,10 @@
+"""vtpu-clusterd — the federation coordinator daemon
+(docs/FEDERATION.md).
+
+Thin operational wrapper around :mod:`..runtime.cluster`: argument
+parsing, journal-dir defaulting, and a serve-forever loop.  All of
+the actual control plane — membership leases, the journaled
+placement ledger, two-level pack|spread scoring, the cross-node
+MIGRATE dance — lives in the runtime package so brokers and tests
+import it without pulling in a daemon entrypoint.
+"""

@@ -1,0 +1,58 @@
+"""``python -m vtpu.tools.clusterd`` — run the federation
+coordinator (docs/FEDERATION.md).
+
+One coordinator per cluster (or per failure domain): it owns the
+authoritative placement ledger, journaled with the same CRC-framed
+machinery node brokers use, and epoch-fenced so a superseded
+coordinator can never corrupt it.  Losing it is fail-static — nodes
+keep serving their existing tenants; only NEW cross-node placements
+wait (docs/FEDERATION.md, "coordinator loss").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from ...runtime import cluster
+from ...utils import logging as log
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="vtpu-clusterd")
+    p.add_argument("--socket", default=os.environ.get(
+        "VTPU_CLUSTER_SOCKET", "/usr/local/vtpu/vtpu-cluster.sock"))
+    p.add_argument("--journal-dir", default=None,
+                   help="placement-ledger journal dir (default: "
+                        "<socket dir>/cluster-journal)")
+    p.add_argument("--allocation-policy", choices=("pack", "spread"),
+                   default=None,
+                   help="cross-node placement policy (default pack; "
+                        "also VTPU_CLUSTER_POLICY)")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the built-in 2-node self-test and exit")
+    ns = p.parse_args(argv)
+    if ns.smoke:
+        return cluster._smoke()  # noqa: SLF001 - canonical self-test
+    journal_dir = ns.journal_dir or os.path.join(
+        os.path.dirname(os.path.abspath(ns.socket)) or ".",
+        "cluster-journal")
+    coord = cluster.Coordinator(ns.socket, journal_dir,
+                                policy=ns.allocation_policy)
+    srv = coord.make_server()
+    log.info("vtpu-clusterd serving on %s (policy=%s journal=%s "
+             "epoch=%s)", ns.socket, coord.policy, journal_dir,
+             coord.epoch)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        coord.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

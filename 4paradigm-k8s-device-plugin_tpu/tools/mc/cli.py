@@ -57,6 +57,19 @@ def _run_crash(ns: argparse.Namespace) -> Dict[str, Any]:
     }
 
 
+def _run_cluster(ns: argparse.Namespace) -> Dict[str, Any]:
+    from . import clustercut
+    stats = clustercut.explore()
+    return {
+        "records": stats.records,
+        "boundary_cuts": stats.boundary_cuts,
+        "torn_cuts": stats.torn_cuts,
+        "corrupt_checks": stats.corrupt_checks,
+        "fence_checks": stats.fence_checks,
+        "violations": stats.violations,
+    }
+
+
 def _run_selfcheck(ns: argparse.Namespace) -> int:
     from . import selfcheck
     results = selfcheck.run_all(max_schedules=ns.max_schedules)
@@ -80,7 +93,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="deterministic model checking of broker quota/"
                     "lease/crash-recovery invariants "
                     "(docs/ANALYSIS.md)")
-    ap.add_argument("--engine", choices=("interleave", "crash", "all"),
+    ap.add_argument("--engine",
+                    choices=("interleave", "crash", "cluster", "all"),
                     default="all")
     ap.add_argument("--scenario", default=None,
                     help="run one interleaving scenario by name")
@@ -144,6 +158,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if ns.engine in ("crash", "all"):
         report["crash"] = _run_crash(ns)
         violations.extend(report["crash"]["violations"])
+    if ns.engine in ("cluster", "all"):
+        report["cluster"] = _run_cluster(ns)
+        violations.extend(report["cluster"]["violations"])
 
     if ns.json:
         print(json.dumps(report, indent=2))
@@ -165,6 +182,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{cr['corrupt_checks']} corruption checks, "
                   f"{cr['repl_cuts']} replication-stream cuts, "
                   f"{cr['fence_checks']} fence checks")
+        cl = report.get("cluster")
+        if cl:
+            print(f"  cluster: {cl['records']} ledger records, "
+                  f"{cl['boundary_cuts']} boundary cuts, "
+                  f"{cl['torn_cuts']} torn cuts, "
+                  f"{cl['corrupt_checks']} corruption checks, "
+                  f"{cl['fence_checks']} fence checks")
         for v in violations:
             print(f"VIOLATION: {v}")
         print(f"vtpu-mc: {len(violations)} violation(s)")
@@ -181,6 +205,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             != report["crash"]["records"] + 1:
         print("vtpu-mc: crash engine did not cover every record "
               "boundary", file=sys.stderr)
+        return 1
+    if ns.engine in ("cluster", "all") \
+            and report["cluster"]["records"] \
+            and report["cluster"]["boundary_cuts"] \
+            != report["cluster"]["records"] + 1:
+        print("vtpu-mc: cluster engine did not cover every ledger "
+              "record boundary", file=sys.stderr)
         return 1
     if ns.min_cuts and ns.engine in ("crash", "all"):
         cr = report["crash"]

@@ -1,0 +1,504 @@
+"""vtpu-mc cluster crash-cut engine: the federation coordinator's
+placement ledger (runtime/cluster.py, docs/FEDERATION.md) cut at every
+record boundary.
+
+A canned cluster session is first RECORDED through the REAL
+:class:`~....runtime.cluster.Coordinator` — its journaled mutation
+path (``_append``: fence check, CRC frame, apply) and its real
+dispatch arms, no sockets needed — so the ledger on disk is
+byte-for-byte what a live coordinator under that membership/placement
+history would have written:
+
+  - coordinator epoch (``cepoch``), three node joins (4+4+2 chips),
+  - pack placements incl. a 2-chip and a 4-chip grant,
+  - a release followed by a re-grant of the freed chip,
+  - a cross-node migration journaled as the ``cmigrate``
+    begin/commit pair the MIGRATE orchestration writes,
+  - an ABORTED migration (begin + abort — the ledger must not move),
+  - a node death (``node_down``) whose re-placement finds no capacity
+    and falls back to releasing the grant,
+  - final releases.
+
+The ledger is then CUT exactly like the broker WAL (crashcut.py):
+at every record boundary, mid-record (the kill -9 torn tail), and
+with non-tail damage (must fail closed).  Each prefix is replayed
+TWICE through the real ``Journal.load_state`` +
+:func:`~....runtime.cluster.cluster_apply_record` (determinism),
+judged against an INDEPENDENT interpreter re-implemented from the
+docs/FEDERATION.md record contract (ground truth), audited by
+:func:`~....runtime.cluster.check_conservation` (sum of node ledgers
+== cluster ledger), and — for every tenant whose prefix ends in a
+committed migration — held to exact conservation on the journaled
+target placement.  The epoch-fence test mirrors the broker's: a
+superseded coordinator's fence check, and any ledger append behind
+it, must refuse.
+
+Violations surface through the invariant registry (invariants.py,
+engine="cluster"): ``cluster-grant-conservation``,
+``migrate-conserves-ledger-cross-node`` and
+``fenced-stale-coordinator-never-acks`` drain the buckets this engine
+fills, and tools/mc/selfcheck.py proves each row still fires on a
+deliberately broken replay.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import invariants as inv_registry
+from .crashcut import _flip_byte, split_records
+
+
+@dataclass
+class ClusterStats:
+    records: int = 0
+    boundary_cuts: int = 0
+    torn_cuts: int = 0
+    corrupt_checks: int = 0
+    fence_checks: int = 0
+    violations: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ClusterCutContext:
+    """Per-cut context handed to the engine="cluster" invariant rows.
+    The engine deposits violation strings into the named buckets (the
+    wmm pattern): detection lives with the exploration, the registry
+    stays the single declaration point."""
+    label: str
+    state_a: Dict[str, Any]
+    state_b: Dict[str, Any]
+    expected: Optional[Dict[str, Any]] = None
+    cluster_violations: List[str] = field(default_factory=list)
+    cmigrate_violations: List[str] = field(default_factory=list)
+    cfence_violations: List[str] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Recording: the canned coordinator session
+# ---------------------------------------------------------------------------
+
+def record_cluster_session(jdir: str) -> List[str]:
+    """Drive a real Coordinator (journal in ``jdir``) through the
+    canned membership/placement/migration history.  Returns violation
+    strings (dispatch refusals, a dirty final conservation audit) —
+    empty on a healthy run."""
+    from ...runtime import cluster as CL
+    from ...plugin.allocator import cluster_choose_placement
+
+    violations: List[str] = []
+    coord = CL.Coordinator(os.path.join(jdir, "cl.sock"), jdir,
+                           policy="pack", hb_dead_s=3600.0)
+
+    def ok(rep: Dict[str, Any], what: str) -> Dict[str, Any]:
+        if not rep.get("ok"):
+            violations.append(f"{what}: {rep}")
+        return rep
+
+    try:
+        for node, chips in (("n0", 4), ("n1", 4), ("n2", 2)):
+            ok(coord.dispatch({"kind": CL.CL_JOIN, "node": node,
+                               "broker": f"/run/vtpu/{node}.sock",
+                               "chips": chips, "hbm": 1 << 30,
+                               "topology": {"kind": "ring",
+                                            "size": chips}}),
+               f"join {node}")
+        # pack: a(2) lands on the tightest fit (n2), then b/c single
+        # chips fill n0, d(4) takes the only node with 4 free (n1).
+        for tenant, width, hbm in (("a", 2, 256), ("b", 1, 64),
+                                   ("c", 1, 64), ("d", 4, 128)):
+            ok(coord.dispatch({"kind": CL.CL_PLACE, "tenant": tenant,
+                               "chips": width, "hbm": hbm}),
+               f"place {tenant}")
+        # Release + re-grant: e must be able to reuse b's freed chip.
+        ok(coord.dispatch({"kind": CL.CL_RELEASE, "tenant": "b"}),
+           "release b")
+        ok(coord.dispatch({"kind": CL.CL_PLACE, "tenant": "e",
+                           "chips": 1, "hbm": 32}), "place e")
+        # Cross-node migration of the 2-chip grant, journaled exactly
+        # as Coordinator._migrate journals it around the broker dance
+        # (the dance itself needs live brokers; the LEDGER writes are
+        # what this engine checks).
+        with coord.mu:
+            src = coord.state["placements"]["a"]["node"]
+            width = len(coord.state["placements"]["a"]["chips"])
+            inv = CL.cluster_inventory(coord.state)
+        inv.pop(src, None)
+        to, chips, _sb = cluster_choose_placement(inv, width,
+                                                  policy="pack")
+        if to is None:
+            violations.append("canned migration found no target")
+        else:
+            coord._append({"op": "cmigrate", "tenant": "a",
+                           "phase": "begin", "to_node": to,
+                           "to_chips": chips})
+            coord._append({"op": "cmigrate", "tenant": "a",
+                           "phase": "commit", "to_node": to,
+                           "to_chips": chips})
+        # An aborted migration: begin + abort, ledger must not move.
+        coord._append({"op": "cmigrate", "tenant": "e",
+                       "phase": "begin", "to_node": "n2",
+                       "to_chips": [0]})
+        coord._append({"op": "cmigrate", "tenant": "e",
+                       "phase": "abort"})
+        # Node death: n1 holds the 4-chip grant and no survivor can
+        # take it — the re-placement falls back to releasing it.
+        coord._node_down("n1")
+        ok(coord.dispatch({"kind": CL.CL_RELEASE, "tenant": "c"}),
+           "release c")
+        violations.extend(CL.check_conservation(coord.state))
+    finally:
+        coord.stop()
+        coord.jr.close()
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Independent interpretation (ground truth)
+# ---------------------------------------------------------------------------
+
+def _predict_cluster(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Independent reading of a cluster-record prefix: what a correct
+    replay MUST reconstruct.  Deliberately re-implemented from the
+    docs/FEDERATION.md record contract, not from
+    ``cluster_apply_record`` — a skipped or wrong replay arm shows up
+    as a divergence.  The per-node ``used`` ledgers are DERIVED from
+    the placements (the conservation identity), never maintained
+    incrementally."""
+    epoch: Optional[str] = None
+    generation: Optional[int] = None
+    nodes: Dict[str, Dict[str, Any]] = {}
+    placements: Dict[str, Dict[str, Any]] = {}
+    migrating: Dict[str, bool] = {}
+    placements_total = 0
+    migrations_total = 0
+    for rec in records:
+        op = rec.get("op")
+        if op == "cepoch":
+            epoch = rec.get("epoch")
+            generation = rec.get("generation")
+        elif op == "node":
+            ent = nodes.setdefault(str(rec["node"]), {})
+            ent["chips"] = int(rec.get("chips") or 0)
+            ent["broker"] = rec.get("broker")
+            ent["alive"] = True
+        elif op == "node_down":
+            if str(rec.get("node")) in nodes:
+                nodes[str(rec["node"])]["alive"] = False
+        elif op == "cgrant":
+            placements[str(rec["tenant"])] = {
+                "node": str(rec["node"]),
+                "chips": [int(c) for c in rec.get("chips") or []],
+                "hbm": rec.get("hbm")}
+            placements_total += 1
+        elif op == "crelease":
+            placements.pop(str(rec.get("tenant")), None)
+        elif op == "cmigrate":
+            tenant = str(rec.get("tenant"))
+            phase = rec.get("phase")
+            if phase == "begin":
+                migrating[tenant] = True
+            elif phase == "commit":
+                old = placements.get(tenant) or {}
+                placements[tenant] = {
+                    "node": str(rec["to_node"]),
+                    "chips": [int(c) for c in rec.get("to_chips")
+                              or []],
+                    "hbm": old.get("hbm") if rec.get("hbm") is None
+                    else rec.get("hbm")}
+                migrating.pop(tenant, None)
+                migrations_total += 1
+            elif phase == "abort":
+                migrating.pop(tenant, None)
+    used: Dict[str, Dict[str, str]] = {}
+    for tenant, p in placements.items():
+        per = used.setdefault(p["node"], {})
+        for c in p["chips"]:
+            per[str(c)] = tenant
+    return {"epoch": epoch, "generation": generation,
+            "nodes": nodes, "placements": placements,
+            "used": used, "migrating": sorted(migrating),
+            "placements_total": placements_total,
+            "migrations_total": migrations_total}
+
+
+def cluster_digest(state: Dict[str, Any]) -> Dict[str, Any]:
+    """A replayed (or predicted) cluster state rendered into one
+    comparable shape.  Empty per-node ledgers are dropped: replay
+    keeps a node's empty dict around after its last release, the
+    independent reading never creates one — both mean 'nothing
+    granted'."""
+    return {
+        "epoch": state.get("epoch"),
+        "generation": state.get("generation"),
+        "nodes": {n: {"chips": int(e.get("chips") or 0),
+                      "broker": e.get("broker"),
+                      "alive": bool(e.get("alive"))}
+                  for n, e in (state.get("nodes") or {}).items()},
+        "placements": {t: {"node": p.get("node"),
+                           "chips": [int(c) for c in p.get("chips")
+                                     or []],
+                           "hbm": p.get("hbm")}
+                       for t, p in (state.get("placements")
+                                    or {}).items()},
+        "used": {n: dict(sorted(per.items()))
+                 for n, per in (state.get("used") or {}).items()
+                 if per},
+        "migrating": sorted(state.get("migrating") or {}),
+        "placements_total": int(state.get("placements_total", 0)),
+        "migrations_total": int(state.get("migrations_total", 0)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Exploration
+# ---------------------------------------------------------------------------
+
+def _load_cut(cut_dir: str) -> Dict[str, Any]:
+    """One recovery of a cut prefix through the REAL machinery: a
+    fresh Journal wired to the cluster replay arm, exactly how a
+    restarted coordinator boots."""
+    from ...runtime import cluster as CL
+    from ...runtime.journal import Journal
+    jr = Journal(cut_dir, fsync=False, snapshot_every=100_000,
+                 apply_fn=CL.cluster_apply_record)
+    try:
+        return jr.load_state() or {}
+    finally:
+        jr.close()
+
+
+def _migrate_checks(ctx: ClusterCutContext,
+                    prefix: List[Dict[str, Any]],
+                    state: Dict[str, Any]) -> None:
+    """migrate-conserves-ledger-cross-node: for every tenant whose
+    LAST ledger-affecting record in the prefix is a cmigrate COMMIT,
+    the replayed placement must sit exactly on the journaled target —
+    same node, same chips — with the target node's ledger holding
+    precisely those chips and NO other node holding any (source
+    release happened, nothing was lost or duplicated in the move)."""
+    last: Dict[str, Any] = {}
+    for rec in prefix:
+        op = rec.get("op")
+        if op == "cgrant":
+            last[str(rec["tenant"])] = ("grant", rec)
+        elif op == "crelease":
+            last[str(rec.get("tenant"))] = ("release", rec)
+        elif op == "cmigrate" and rec.get("phase") == "commit":
+            last[str(rec["tenant"])] = ("commit", rec)
+    placements = state.get("placements") or {}
+    used = state.get("used") or {}
+    for tenant, (kind, rec) in sorted(last.items()):
+        if kind != "commit":
+            continue
+        to_node = str(rec.get("to_node"))
+        want = sorted(int(c) for c in rec.get("to_chips") or [])
+        p = placements.get(tenant)
+        if p is None:
+            ctx.cmigrate_violations.append(
+                f"cut {ctx.label}: migrated tenant {tenant!r} has no "
+                f"placement after the journaled commit (the grant was "
+                f"lost in the move)")
+            continue
+        got = sorted(int(c) for c in p.get("chips") or [])
+        if p.get("node") != to_node or got != want:
+            ctx.cmigrate_violations.append(
+                f"cut {ctx.label}: migrated tenant {tenant!r} "
+                f"recovered on {p.get('node')}/{got} instead of the "
+                f"journaled target {to_node}/{want}")
+        held = sorted(int(k) for k, v in (used.get(to_node)
+                                          or {}).items()
+                      if v == tenant)
+        if held != want:
+            ctx.cmigrate_violations.append(
+                f"cut {ctx.label}: target node {to_node!r} ledger "
+                f"holds chips {held} for migrated tenant {tenant!r} "
+                f"instead of {want} (the move lost or duplicated "
+                f"chips)")
+        for node, per in sorted(used.items()):
+            if node == to_node:
+                continue
+            stray = sorted(k for k, v in per.items() if v == tenant)
+            if stray:
+                ctx.cmigrate_violations.append(
+                    f"cut {ctx.label}: node {node!r} still holds "
+                    f"chips {stray} for migrated tenant {tenant!r} "
+                    f"after the commit (source was never released — "
+                    f"the chip is double-granted across the "
+                    f"migration)")
+
+
+def explore(record_dir: Optional[str] = None,
+            workdir: Optional[str] = None) -> ClusterStats:
+    """The full cluster-ledger crash-cut exploration.  ``record_dir``:
+    reuse an existing recording (tests; seeded-violation runs record
+    PRISTINE first, then patch only the replay)."""
+    from ...runtime import cluster as CL
+    from ...runtime import replication as repl
+    from ...runtime.journal import LOG_NAME, Journal, JournalCorrupt
+
+    stats = ClusterStats()
+    tmp = workdir or tempfile.mkdtemp(prefix="vtpu-mc-cluster-")
+    own_tmp = workdir is None
+    try:
+        jdir = record_dir or os.path.join(tmp, "recording")
+        if record_dir is None:
+            os.makedirs(jdir, exist_ok=True)
+            rec_violations = record_cluster_session(jdir)
+            if rec_violations:
+                stats.violations.extend(
+                    f"[recording] {v}" for v in rec_violations)
+                return stats
+        with open(os.path.join(jdir, LOG_NAME), "rb") as f:
+            log = f.read()
+        records = split_records(log)
+        stats.records = len(records)
+        boundaries = [0] + [end for _s, end, _r in records]
+
+        def _labels(i: int) -> str:
+            if i == 0:
+                return "cboundary[0]=<empty>"
+            _s, _e, r = records[i - 1]
+            what = r.get("tenant") or r.get("node") or ""
+            op = r.get("op")
+            if op == "cmigrate":
+                op = f"cmigrate-{r.get('phase')}"
+            return f"cboundary[{i}]=after-{op}:{what}"
+
+        def _write_cut(name: str, data: bytes) -> str:
+            cut = os.path.join(tmp, name)
+            os.makedirs(cut, exist_ok=True)
+            with open(os.path.join(cut, LOG_NAME), "wb") as f:
+                f.write(data)
+            return cut
+
+        # -- every record boundary ------------------------------------
+        for i, off in enumerate(boundaries):
+            label = _labels(i)
+            cut = _write_cut(f"cut{i}", log[:off])
+            ctx = ClusterCutContext(label=label, state_a={},
+                                    state_b={})
+            raw_a = _load_cut(cut)
+            raw_b = _load_cut(cut)
+            ctx.state_a = cluster_digest(raw_a)
+            ctx.state_b = cluster_digest(raw_b)
+            if ctx.state_a != ctx.state_b:
+                ctx.cluster_violations.append(
+                    f"cut {label}: two replays of the same ledger "
+                    f"prefix disagree (replay is nondeterministic)")
+            prefix = [r for _s, _e, r in records[:i]]
+            ctx.expected = cluster_digest(_predict_cluster(prefix))
+            if ctx.state_a != ctx.expected:
+                ctx.cluster_violations.append(
+                    f"cut {label}: replayed cluster ledger diverges "
+                    f"from the independent reading: got "
+                    f"{ctx.state_a!r}, expected {ctx.expected!r}")
+            for v in CL.check_conservation(raw_a):
+                ctx.cluster_violations.append(f"cut {label}: {v}")
+            _migrate_checks(ctx, prefix, raw_a)
+            stats.violations.extend(
+                inv_registry.run_checks("cluster", "cut", ctx))
+            stats.boundary_cuts += 1
+            shutil.rmtree(cut, ignore_errors=True)
+
+        # -- torn tails: a cut MID-record must land exactly on the
+        # previous boundary (judged independently) --------------------
+        for i, (start, end, r) in enumerate(records):
+            frag = start + max((end - start) // 2, 1)
+            label = f"ctorn[{i}]=mid-{r.get('op')}"
+            cut = _write_cut(f"torn{i}", log[:frag])
+            ctx = ClusterCutContext(label=label, state_a={},
+                                    state_b={})
+            try:
+                ctx.state_a = ctx.state_b = cluster_digest(
+                    _load_cut(cut))
+                want = cluster_digest(_predict_cluster(
+                    [x for _s, _e, x in records[:i]]))
+                if ctx.state_a != want:
+                    ctx.cluster_violations.append(
+                        f"cut {label}: torn tail was not dropped "
+                        f"cleanly — recovered ledger differs from the "
+                        f"last complete boundary[{i}]")
+            except JournalCorrupt as e:
+                ctx.cluster_violations.append(
+                    f"cut {label}: torn FINAL record must be dropped, "
+                    f"not treated as corruption ({e})")
+            stats.violations.extend(
+                inv_registry.run_checks("cluster", "cut", ctx))
+            stats.torn_cuts += 1
+            shutil.rmtree(cut, ignore_errors=True)
+
+        # -- non-tail damage must fail closed -------------------------
+        for case, mutate in (
+            ("flip-mid-log", lambda b: _flip_byte(b, records)),
+            ("truncate-first-line", lambda b: b[3:]),
+        ):
+            cut = _write_cut(f"corrupt-{case}", mutate(log))
+            ctx = ClusterCutContext(label=f"ccorrupt[{case}]",
+                                    state_a={}, state_b={})
+            try:
+                _load_cut(cut)
+                ctx.cluster_violations.append(
+                    f"ccorrupt[{case}]: recovery proceeded on "
+                    f"non-tail ledger damage instead of raising "
+                    f"JournalCorrupt")
+            except JournalCorrupt:
+                pass
+            stats.violations.extend(
+                inv_registry.run_checks("cluster", "cut", ctx))
+            stats.corrupt_checks += 1
+            shutil.rmtree(cut, ignore_errors=True)
+
+        # -- epoch fencing: a superseded coordinator can never journal
+        # (and so never ack) a ledger change — the exact Coordinator
+        # wiring: Fence.claim at boot, jr.fence = fence.check ----------
+        ctx = ClusterCutContext(label="cfence[takeover]", state_a={},
+                                state_b={})
+        fdir = os.path.join(tmp, "cfence")
+        os.makedirs(fdir, exist_ok=True)
+        fpath = os.path.join(fdir, "cl.sock.fence")
+        stale = repl.Fence(fpath, enabled=True)
+        stale.claim("c-old-epoch")
+        taker = repl.Fence(fpath, enabled=True)
+        taker.claim("c-new-epoch")
+        fired = False
+        try:
+            stale.check()
+        except OSError:
+            fired = True
+        if not fired:
+            ctx.cfence_violations.append(
+                "a stale coordinator's fence check passed after a "
+                "successor claimed a newer generation")
+        fenced_jr = Journal(os.path.join(fdir, "j"),
+                            snapshot_every=100_000, fsync=False,
+                            apply_fn=CL.cluster_apply_record)
+        fenced_jr.fence = stale.check
+        try:
+            fenced_jr.append({"op": "cgrant", "tenant": "ghost",
+                              "node": "n0", "chips": [0]})
+            ctx.cfence_violations.append(
+                "a ledger journal wired to a fenced coordinator epoch "
+                "still accepted a cgrant append (a stale coordinator "
+                "could place — and ack — after its successor took "
+                "over)")
+        except OSError:
+            pass
+        fenced_jr.close()
+        try:
+            taker.check()
+        except OSError:
+            ctx.cfence_violations.append(
+                "the succeeding coordinator's own fence check refused "
+                "its freshly claimed generation")
+        stats.violations.extend(
+            inv_registry.run_checks("cluster", "cut", ctx))
+        stats.fence_checks += 1
+    finally:
+        if own_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return stats

@@ -378,6 +378,42 @@ def _chk_fenced_epoch(c: Any) -> List[str]:
     return getattr(c, "fence_violations", [])
 
 
+# ---------------------------------------------------------------------------
+# Cluster-ledger-engine checks (ctx = tools.mc.clustercut
+# .ClusterCutContext) — the federation coordinator's placement ledger
+# (runtime/cluster.py, docs/FEDERATION.md) cut at every boundary.  The
+# engine deposits into named buckets (the wmm pattern); each row
+# drains its own.
+# ---------------------------------------------------------------------------
+
+def _chk_cluster_conservation(c: Any) -> List[str]:
+    """cluster-grant-conservation: at every crash cut of the
+    coordinator's ledger, replay must be deterministic, equal the
+    independent docs/FEDERATION.md reading, drop torn tails cleanly,
+    fail closed on damage — and the recovered state must satisfy
+    ``check_conservation`` exactly: sum of per-node ledgers == the
+    cluster placement ledger, no chip granted twice, no placement on
+    an unregistered node."""
+    return getattr(c, "cluster_violations", [])
+
+
+def _chk_cluster_migrate(c: Any) -> List[str]:
+    """migrate-conserves-ledger-cross-node: a tenant whose prefix ends
+    in a journaled cmigrate COMMIT recovers exactly on the journaled
+    target node/chips, the target ledger holds precisely those chips,
+    and no other node still holds any — source release only after
+    target commit, nothing lost or double-granted in the move."""
+    return getattr(c, "cmigrate_violations", [])
+
+
+def _chk_cluster_fence(c: Any) -> List[str]:
+    """fenced-stale-coordinator-never-acks: once a successor claims a
+    newer fence generation, the stale coordinator's fence check — and
+    therefore every ledger append, and therefore every placement ack —
+    must refuse."""
+    return getattr(c, "cfence_violations", [])
+
+
 INVARIANTS: Tuple[Invariant, ...] = (
     Invariant(
         "token-conservation", "interleave", "terminal",
@@ -478,6 +514,23 @@ INVARIANTS: Tuple[Invariant, ...] = (
         "after a takeover bumps the fence generation, the stale "
         "primary can never journal (and so never ack) again",
         _chk_fenced_epoch),
+    Invariant(
+        "cluster-grant-conservation", "cluster", "cut",
+        "every crash cut of the coordinator's placement ledger "
+        "replays deterministically to the independent reading with "
+        "sum of node ledgers == cluster ledger (no double-granted "
+        "chip, no ghost placement)", _chk_cluster_conservation),
+    Invariant(
+        "migrate-conserves-ledger-cross-node", "cluster", "cut",
+        "a committed cross-node migration recovers exactly on the "
+        "journaled target placement; source released only after "
+        "target commit, no chip lost or double-granted in the move",
+        _chk_cluster_migrate),
+    Invariant(
+        "fenced-stale-coordinator-never-acks", "cluster", "cut",
+        "after a successor coordinator bumps the fence generation, "
+        "the stale coordinator can never journal (and so never ack) "
+        "a placement again", _chk_cluster_fence),
     Invariant(
         "wmm-no-torn-payload", "wmm", "litmus",
         "no seqlock/ring reader ever ACCEPTS a torn or stale payload "

@@ -18,11 +18,12 @@ from __future__ import annotations
 
 import contextlib
 import os
+import shutil
 import tempfile
 from dataclasses import dataclass
 from typing import Any, Callable, Iterator, List, Optional, Tuple
 
-from . import crashcut, interleave, scenarios
+from . import clustercut, crashcut, interleave, scenarios
 
 
 @dataclass(frozen=True)
@@ -477,6 +478,60 @@ def _seed_gate_close_lead_only() -> Iterator[None]:
         FL.BrokerLane.gate_all = orig
 
 
+# ---------------------------------------------------------------------------
+# Cluster-engine seeds (the federation coordinator's placement ledger,
+# runtime/cluster.py).  The canned ledger is recorded PRISTINE (see
+# run_seed) — these patch only the REPLAY, like the crash seeds.
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _seed_cluster_release_dropped() -> Iterator[None]:
+    """The cluster replay arm loses 'crelease': a released grant
+    resurrects at recovery, and the canned session's re-grant of the
+    freed chip turns into a DOUBLE-GRANTED chip — the exact
+    conservation break the cluster ledger exists to ban."""
+    from ...runtime import cluster as CL
+    orig = CL.cluster_apply_record
+
+    def skip_release(state: Any, rec: Any) -> None:
+        if rec.get("op") == "crelease":
+            return
+        orig(state, rec)
+
+    CL.cluster_apply_record = skip_release
+    try:
+        yield
+    finally:
+        CL.cluster_apply_record = orig
+
+
+@contextlib.contextmanager
+def _seed_cluster_lossy_migration() -> Iterator[None]:
+    """The cmigrate COMMIT replay arm silently drops one chip of the
+    sharded grant (placement and node ledger both, so the internal
+    books still balance): the recovered placement falls short of the
+    journaled target — cross-node migration stopped conserving."""
+    from ...runtime import cluster as CL
+    orig = CL.cluster_apply_record
+
+    def lossy(state: Any, rec: Any) -> None:
+        orig(state, rec)
+        if rec.get("op") == "cmigrate" and rec.get("phase") == "commit":
+            tenant = str(rec.get("tenant"))
+            p = (state.get("placements") or {}).get(tenant)
+            if p and len(p.get("chips") or []) > 1:
+                lost = p["chips"].pop()
+                per = (state.get("used") or {}).get(p["node"]) or {}
+                if per.get(str(lost)) == tenant:
+                    per.pop(str(lost), None)
+
+    CL.cluster_apply_record = lossy
+    try:
+        yield
+    finally:
+        CL.cluster_apply_record = orig
+
+
 SEEDS: Tuple[Seed, ...] = (
     Seed("broken-lease-refund", "interleave", "token-conservation",
          "batch_pipeline", _seed_broken_refund),
@@ -523,22 +578,47 @@ SEEDS: Tuple[Seed, ...] = (
          "", _seed_torn_stream_applied),
     Seed("unfenced-stale-primary", "crash", "fenced-epoch-never-acks",
          "", _seed_unfenced_stale_primary),
+    Seed("cluster-release-dropped", "cluster",
+         "cluster-grant-conservation", "",
+         _seed_cluster_release_dropped),
+    Seed("cluster-lossy-migration", "cluster",
+         "migrate-conserves-ledger-cross-node", "",
+         _seed_cluster_lossy_migration),
+    Seed("cluster-unfenced-stale-coordinator", "cluster",
+         "fenced-stale-coordinator-never-acks", "",
+         _seed_unfenced_stale_primary),
 )
 
 
 def run_seed(seed: Seed, record_dir: Optional[str] = None,
              max_schedules: int = 300) -> Tuple[bool, List[str]]:
     """Apply one seed and run its engine; returns (caught, violations).
-    ``caught`` is True when the expected invariant fired."""
-    with seed.patch():
-        if seed.engine == "interleave":
-            stats = interleave.explore_scenario(
-                scenarios.get(seed.scenario),
-                max_schedules=max_schedules)
-            violations = stats.violations
-        else:
-            stats = crashcut.explore(record_dir=record_dir)
-            violations = stats.violations
+    ``caught`` is True when the expected invariant fired.  Cluster
+    seeds record their canned ledger PRISTINE (before the patch lands)
+    — seeds break recovery, never the recording."""
+    cluster_rec: Optional[str] = None
+    if seed.engine == "cluster":
+        cluster_rec = tempfile.mkdtemp(prefix="vtpu-mc-clrec-")
+        rec_violations = clustercut.record_cluster_session(cluster_rec)
+        if rec_violations:
+            raise RuntimeError(
+                f"cluster recording not clean: {rec_violations}")
+    try:
+        with seed.patch():
+            if seed.engine == "interleave":
+                stats = interleave.explore_scenario(
+                    scenarios.get(seed.scenario),
+                    max_schedules=max_schedules)
+                violations = stats.violations
+            elif seed.engine == "cluster":
+                stats = clustercut.explore(record_dir=cluster_rec)
+                violations = stats.violations
+            else:
+                stats = crashcut.explore(record_dir=record_dir)
+                violations = stats.violations
+    finally:
+        if cluster_rec is not None:
+            shutil.rmtree(cluster_rec, ignore_errors=True)
     tag = f"[{seed.invariant}]"
     return any(tag in v for v in violations), violations
 

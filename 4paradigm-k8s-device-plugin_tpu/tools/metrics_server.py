@@ -37,11 +37,13 @@ from .vtpu_smi import find_regions
 class MetricsState:
     def __init__(self, scan: Optional[str], regions: List[str],
                  brokers: Optional[List[str]] = None,
-                 metricsd: Optional[List[str]] = None):
+                 metricsd: Optional[List[str]] = None,
+                 cluster: Optional[str] = None):
         self.scan = scan
         self.explicit = regions
         self.brokers = brokers or []
         self.metricsd = metricsd or []
+        self.cluster = cluster
         # Duty cycle: previous (busy_us, t) sample per (region, device).
         self._prev: Dict[tuple, tuple] = {}
         self.mu = threading.Lock()
@@ -97,6 +99,22 @@ class MetricsState:
                                                 8)) as ex:
             return [r for r in ex.map(scrape, self.brokers)
                     if r is not None]
+
+    def collect_cluster(self) -> Optional[Dict]:
+        """Federation coordinator scrape (docs/FEDERATION.md): the
+        CL_STATUS snapshot — node count, placement/migration counters,
+        ledger size, conservation violations.  Best-effort like the
+        broker scrape: a dead coordinator yields an explicit up=0
+        gauge, never a failed scrape."""
+        if not self.cluster:
+            return None
+        from ..runtime import cluster as cluster_mod
+        try:
+            return cluster_mod.status(self.cluster, timeout=2.0)
+        except OSError as e:
+            log.warn("cluster coordinator %s unreachable: %s",
+                     self.cluster, e)
+            return {"ok": False}
 
     def collect_metricsd(self) -> List[Dict]:
         """vtpu-metricsd self-gauges + virtualized device view over its
@@ -670,6 +688,54 @@ def to_prometheus(infos: List[Dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def cluster_prometheus(st: Optional[Dict]) -> str:
+    """vtpu_cluster_* gauges from the federation coordinator's
+    CL_STATUS (docs/FEDERATION.md).  Empty when no --cluster socket is
+    configured; up=0 (and nothing else) when it is configured but
+    unreachable — losing the coordinator must page as ITS outage, not
+    corrupt the node gauges."""
+    if st is None:
+        return ""
+    lines = [
+        "# HELP vtpu_cluster_up 1 when the federation coordinator "
+        "answered the scrape.",
+        "# TYPE vtpu_cluster_up gauge",
+        f"vtpu_cluster_up {1 if st.get('ok') else 0}",
+    ]
+    if not st.get("ok"):
+        return "\n".join(lines) + "\n"
+    nodes = st.get("nodes") or []
+    alive = sum(1 for n in nodes if n.get("alive"))
+    lines += [
+        "# HELP vtpu_cluster_nodes Cluster members by liveness "
+        "(heartbeat lease state).",
+        "# TYPE vtpu_cluster_nodes gauge",
+        f'vtpu_cluster_nodes{{state="alive"}} {alive}',
+        f'vtpu_cluster_nodes{{state="down"}} {len(nodes) - alive}',
+        "# HELP vtpu_cluster_placements_total Cross-node placements "
+        "granted by this coordinator (journaled counter).",
+        "# TYPE vtpu_cluster_placements_total counter",
+        f"vtpu_cluster_placements_total "
+        f"{int(st.get('placements_total', 0))}",
+        "# HELP vtpu_cluster_migrations_total Cross-node migrations "
+        "committed in the placement ledger.",
+        "# TYPE vtpu_cluster_migrations_total counter",
+        f"vtpu_cluster_migrations_total "
+        f"{int(st.get('migrations_total', 0))}",
+        "# HELP vtpu_cluster_ledger_bytes Size of the coordinator's "
+        "placement-ledger journal log.",
+        "# TYPE vtpu_cluster_ledger_bytes gauge",
+        f"vtpu_cluster_ledger_bytes {int(st.get('ledger_bytes', 0))}",
+        "# HELP vtpu_cluster_ledger_violations Conservation-check "
+        "failures in the authoritative ledger (any non-zero value "
+        "is a red alert).",
+        "# TYPE vtpu_cluster_ledger_violations gauge",
+        f"vtpu_cluster_ledger_violations "
+        f"{len(st.get('violations') or [])}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
 def make_handler(state: MetricsState):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # noqa: D401 - quiet
@@ -687,13 +753,15 @@ def make_handler(state: MetricsState):
             if self.path.startswith("/metrics"):
                 body = to_prometheus(state.collect()) + \
                     broker_prometheus(state.collect_brokers()) + \
-                    metricsd_prometheus(state.collect_metricsd())
+                    metricsd_prometheus(state.collect_metricsd()) + \
+                    cluster_prometheus(state.collect_cluster())
                 self._reply(200, body, "text/plain; version=0.0.4")
             elif self.path.startswith("/json"):
                 self._reply(200, json.dumps(
                     {"regions": state.collect(),
                      "brokers": state.collect_brokers(),
-                     "metricsd": state.collect_metricsd()}, indent=2),
+                     "metricsd": state.collect_metricsd(),
+                     "cluster": state.collect_cluster()}, indent=2),
                     "application/json")
             elif self.path.startswith("/healthz"):
                 self._reply(200, "ok\n", "text/plain")
@@ -707,10 +775,11 @@ def make_server(port: int, scan: Optional[str] = None,
                 regions: Optional[List[str]] = None,
                 host: str = "127.0.0.1",
                 brokers: Optional[List[str]] = None,
-                metricsd: Optional[List[str]] = None
+                metricsd: Optional[List[str]] = None,
+                cluster: Optional[str] = None
                 ) -> ThreadingHTTPServer:
     state = MetricsState(scan, regions or [], brokers or [],
-                         metricsd or [])
+                         metricsd or [], cluster)
     srv = ThreadingHTTPServer((host, port), make_handler(state))
     srv.state = state  # type: ignore[attr-defined]
     return srv
@@ -734,9 +803,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(repeatable): adds vtpu_metricsd_* gauges — "
                          "liveness, pass-through counters and the "
                          "virtualized values tenants observe")
+    ap.add_argument("--cluster", default=os.environ.get(
+        "VTPU_CLUSTER_SOCKET") or None, metavar="SOCKET",
+        help="federation coordinator socket: adds vtpu_cluster_* "
+             "gauges (membership, placements, migrations, ledger "
+             "size/conservation — docs/FEDERATION.md)")
     ns = ap.parse_args(argv)
     srv = make_server(ns.port, ns.scan, ns.region, ns.host, ns.broker,
-                      ns.metricsd)
+                      ns.metricsd, ns.cluster)
     log.info("vtpu-metrics serving on %s:%d (/metrics /json /healthz)",
              ns.host, ns.port)
     try:

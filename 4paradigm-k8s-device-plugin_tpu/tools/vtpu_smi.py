@@ -474,11 +474,57 @@ def cmd_leases(ns) -> int:
     return 1 if any(d.get("stale") for d in out) else 0
 
 
+def cmd_cluster(ns) -> int:
+    """``vtpu-smi cluster <coordinator socket>`` — the federation
+    operator view (docs/FEDERATION.md): node membership table (alive /
+    heartbeat lag / chip inventory), placements, counters, and the
+    coordinator's own ledger-conservation check — non-empty
+    ``violations`` is a red alert, it means the authoritative ledger
+    itself is inconsistent."""
+    sock = ns.cmd_arg or os.environ.get(
+        "VTPU_CLUSTER_SOCKET", "/usr/local/vtpu/vtpu-cluster.sock")
+    from ..runtime import cluster
+    try:
+        st = cluster.status(sock)
+    except OSError as e:
+        print(f"coordinator unreachable at {sock}: {e}",
+              file=sys.stderr)
+        return 1
+    if ns.json:
+        print(json.dumps(st, indent=2))
+        return 0 if st.get("ok") and not st.get("violations") else 1
+    print(f"cluster epoch={st.get('epoch')} "
+          f"generation={st.get('generation')} "
+          f"policy={st.get('policy')} "
+          f"placements={st.get('placements_total')} "
+          f"migrations={st.get('migrations_total')} "
+          f"ledger={st.get('ledger_bytes')}B")
+    rows = [("NODE", "ALIVE", "CHIPS", "FREE", "TENANTS", "LAG")]
+    for n in st.get("nodes") or []:
+        lag = n.get("lag_s")
+        rows.append((
+            str(n.get("node")),
+            "yes" if n.get("alive") else "DOWN",
+            str(n.get("chips")),
+            str(n.get("free")),
+            ",".join(n.get("tenants") or []) or "-",
+            f"{lag:.1f}s" if lag is not None else "-"))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    for t, pl in sorted((st.get("placements") or {}).items()):
+        print(f"  {t}: node={pl.get('node')} "
+              f"chips={pl.get('chips')} hbm={pl.get('hbm')}")
+    for v in st.get("violations") or []:
+        print(f"VIOLATION: {v}", file=sys.stderr)
+    return 0 if st.get("ok") and not st.get("violations") else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
     ap.add_argument("cmd", nargs="?", default=None,
                     choices=("trace", "leases", "analyze", "mc", "wmm",
-                             "metricsd", "chaos", "top"),
+                             "metricsd", "chaos", "top", "cluster"),
                     help="trace: flight-recorder spans (needs "
                          "--broker; --dump FILE exports Chrome-trace "
                          "JSON); leases: chip-lease sidecar forensics; "
@@ -495,7 +541,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "top: live htop-style per-tenant SLO / "
                          "fairness / blame table (needs --broker; "
                          "--once for one snapshot, --fake for the CI "
-                         "wiring check — docs/OBSERVABILITY.md)")
+                         "wiring check — docs/OBSERVABILITY.md); "
+                         "cluster: federation coordinator status — "
+                         "node table, placements, ledger conservation "
+                         "(cmd_arg = coordinator socket, "
+                         "docs/FEDERATION.md)")
     ap.add_argument("cmd_arg", nargs="?", default=None,
                     help="tenant name for `trace`; HOST:PORT for "
                          "`metricsd`")
@@ -549,6 +599,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "--device — docs/FAILOVER.md)")
     ap.add_argument("--device", type=int, default=None, metavar="CHIP",
                     help="with --migrate: the target chip index")
+    ap.add_argument("--migrate-to", default=None, metavar="SOCKET",
+                    help="cross-node migration (with --migrate and "
+                         "--broker = SOURCE socket): target broker's "
+                         "MAIN socket — drives the MIGRATE_OUT begin /"
+                         " MIGRATE_IN / MIGRATE_OUT commit dance, "
+                         "aborting on any failure "
+                         "(docs/FEDERATION.md)")
+    ap.add_argument("--chips", default=None, metavar="LIST",
+                    help="comma-separated target chip indices for "
+                         "--migrate-to (default: the source chip "
+                         "layout, same-topology)")
     ap.add_argument("--repl-status", action="store_true",
                     help="replication block: role, follower lag, "
                          "fence generation, takeover count "
@@ -572,6 +633,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if ns.cmd == "top":
         return cmd_top(ns)
+    if ns.cmd == "cluster":
+        return cmd_cluster(ns)
     if ns.cmd == "leases":
         return cmd_leases(ns)
     if ns.cmd == "metricsd":
@@ -648,6 +711,40 @@ def main(argv: Optional[List[str]] = None) -> int:
             if ns.core is not None:
                 msg["core_limit"] = int(ns.core)
             resp = _admin_request(ns.broker, msg)
+        elif ns.migrate and ns.migrate_to:
+            # Cross-node MIGRATE (docs/FEDERATION.md): quiesce +
+            # serialize at the source, transfer + park at the target,
+            # THEN tear the source copy down — commit only after the
+            # target acked, so the cluster never holds less than one
+            # copy.  Any failure aborts: the tenant resumes serving
+            # at the source untouched.
+            out = _admin_request(
+                ns.broker, {"kind": P.MIGRATE_OUT,
+                            "tenant": ns.migrate,
+                            "phase": "begin"}, timeout=90.0)
+            if not out.get("ok"):
+                print(json.dumps(out, indent=2))
+                return 1
+            in_msg = {"kind": P.MIGRATE_IN, "tenant": ns.migrate,
+                      "state": out.get("state"),
+                      "blobs": out.get("blobs")}
+            if ns.chips:
+                in_msg["devices"] = [int(c) for c
+                                     in ns.chips.split(",") if c]
+            accepted = _admin_request(ns.migrate_to, in_msg,
+                                      timeout=90.0)
+            if accepted.get("ok"):
+                resp = _admin_request(
+                    ns.broker, {"kind": P.MIGRATE_OUT,
+                                "tenant": ns.migrate,
+                                "phase": "commit"}, timeout=90.0)
+                resp["target"] = accepted
+            else:
+                _admin_request(ns.broker,
+                               {"kind": P.MIGRATE_OUT,
+                                "tenant": ns.migrate,
+                                "phase": "abort"}, timeout=90.0)
+                resp = accepted
         elif ns.migrate:
             msg = {"kind": P.MIGRATE, "tenant": ns.migrate}
             if ns.device is not None:

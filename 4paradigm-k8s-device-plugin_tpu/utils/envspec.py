@@ -259,6 +259,13 @@ ENV_FLAGS = {
     "VTPU_REPL_CONFIRM_S": ("broker", True),
     "VTPU_REPL_FENCE": ("broker", True),
     "VTPU_MIGRATE_TIMEOUT_S": ("broker", True),
+    # vtpu-cluster (docs/FEDERATION.md): the multi-node federation
+    # control plane — coordinator socket + per-node membership.
+    "VTPU_CLUSTER_SOCKET": ("broker", True),
+    "VTPU_CLUSTER_NODE": ("broker", True),
+    "VTPU_CLUSTER_HB_S": ("broker", True),
+    "VTPU_CLUSTER_DEAD_S": ("broker", True),
+    "VTPU_CLUSTER_POLICY": ("broker", True),
     # vtpu-wmm (docs/ANALYSIS.md "Weak memory model"): exploration
     # budgets of the weak-memory litmus engine.  Not operator-facing —
     # CI and developers tune them per run.

@@ -39,10 +39,18 @@ a real broker subprocess on the CPU backend:
             broker-reported blackout-ms, exact ledger conservation
             (used bytes identical across the move) and the client
             never seeing an error.
+  federation  multi-node federation (docs/FEDERATION.md): 3 node
+            brokers (separate subprocesses, real sockets) join a
+            clusterd coordinator; cross-node pack/spread placement,
+            coordinator kill -9 fail-static survival (tenants keep
+            serving) + journal-replay recovery, a cross-node MIGRATE
+            of a 2-chip sharded tenant with byte-identical data at
+            the target, and node kill -9 re-placement — gated on all
+            of it plus zero ledger-conservation violations.
 
 Usage:
   python benchmarks/traffic_sim.py [--quick]
-      [--cell all|burst|preempt|overload|failover|migrate]
+      [--cell all|burst|preempt|overload|failover|migrate|federation]
       [--tenants N] [--seed K] [--out BENCH_TRAFFIC_r01.json]
   python benchmarks/traffic_sim.py --smoke --check BENCH_TRAFFIC_r01.json
 
@@ -817,6 +825,217 @@ def cell_migrate(quick: bool) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
+# Cell 6: multi-node federation (docs/FEDERATION.md)
+# ---------------------------------------------------------------------------
+
+def _wait_socket(path: str, timeout_s: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            s = socketmod.socket(socketmod.AF_UNIX,
+                                 socketmod.SOCK_STREAM)
+            s.settimeout(1.0)
+            try:
+                s.connect(path)
+                return
+            except OSError:
+                pass
+            finally:
+                s.close()
+        time.sleep(0.1)
+    raise RuntimeError(f"{path} never bound")
+
+
+def cell_federation(quick: bool) -> Dict[str, Any]:
+    """Three 4-chip node brokers federated under a clusterd
+    coordinator: pack co-location + spread anti-affinity across
+    nodes, coordinator kill -9 fail-static (node tenants keep
+    serving; replay recovers the ledger), a cross-node MIGRATE of a
+    2-chip sharded tenant verified byte-identical at the target, and
+    node kill -9 re-placement — with the coordinator's own
+    conservation check clean throughout."""
+    import numpy as np
+
+    from vtpu.runtime import cluster as cl
+    from vtpu.runtime.client import RuntimeClient
+    n_nodes = 3
+    warm_s = 1.0 if quick else 2.0
+    dead_window_s = 2.0 if quick else 3.0
+    tmp = tempfile.mkdtemp(prefix="ts-federation-")
+    coord_sock = os.path.join(tmp, "coord.sock")
+    cjdir = os.path.join(tmp, "cluster-journal")
+    cenv = _broker_env({"VTPU_CLUSTER_DEAD_S": "1.5"}, 1)
+    coord_log = open(os.path.join(tmp, "clusterd.log"), "ab")
+
+    def start_coord() -> subprocess.Popen:
+        p = subprocess.Popen(
+            [sys.executable, "-m", "vtpu.tools.clusterd",
+             "--socket", coord_sock, "--journal-dir", cjdir],
+            cwd=REPO, env=cenv, stdout=coord_log,
+            stderr=subprocess.STDOUT)
+        _wait_socket(coord_sock)
+        return p
+
+    coord = start_coord()
+    brokers: Dict[str, Broker] = {}
+    out: Dict[str, Any] = {"nodes": n_nodes}
+    clients: List[Any] = []
+    stop = threading.Event()
+    try:
+        for i in range(n_nodes):
+            ntmp = os.path.join(tmp, f"n{i}")
+            os.makedirs(ntmp, exist_ok=True)
+            brokers[f"n{i}"] = Broker(ntmp, {
+                "VTPU_JOURNAL_DIR": os.path.join(ntmp, "journal"),
+                "VTPU_CLUSTER_SOCKET": coord_sock,
+                "VTPU_CLUSTER_NODE": f"n{i}",
+                "VTPU_CLUSTER_HB_S": "0.2",
+            }, chips=4)
+        # -- membership: all nodes join + heartbeat ---------------------
+        deadline = time.monotonic() + 30.0
+        alive = 0
+        while time.monotonic() < deadline:
+            st = cl.status(coord_sock)
+            alive = sum(1 for n in st.get("nodes") or []
+                        if n.get("alive"))
+            if alive == n_nodes:
+                break
+            time.sleep(0.2)
+        out["nodes_alive"] = alive
+
+        def place(tenant: str, chips: int,
+                  policy: Optional[str] = None) -> Dict[str, Any]:
+            msg = {"kind": cl.CL_PLACE, "tenant": tenant,
+                   "chips": chips}
+            if policy:
+                msg["policy"] = policy
+            return cl.request(coord_sock, msg)
+
+        # -- cross-node placement: pack co-locates, spread scatters ----
+        px = place("fed-x", 1)
+        py = place("fed-y", 1)
+        pshard = place("fed-shard", 2)
+        ps = place("fed-s", 1, policy="spread")
+        out["pack_colocated"] = (px.get("node") is not None
+                                 and px.get("node") == py.get("node"))
+        out["spread_separated"] = (ps.get("node") is not None
+                                   and ps.get("node") != px.get("node"))
+        out["shard_node"] = pshard.get("node")
+        # -- bind tenants where the coordinator placed them -------------
+        wx: Dict[str, Any] = {"steps": 0, "errors": 0}
+
+        def worker() -> None:
+            c = RuntimeClient(px["broker"], tenant="fed-x",
+                              device=int(px["chips"][0]))
+            clients.append(c)
+            exe, _hx = _setup(c)
+            while not stop.is_set():
+                try:
+                    c.execute_send_ids(exe, ["x"], ["o"])
+                    c.recv_reply()
+                    wx["steps"] += 1
+                except Exception:  # noqa: BLE001 - churn survival
+                    wx["errors"] += 1
+                    time.sleep(0.05)
+
+        th = threading.Thread(target=worker, daemon=True)
+        th.start()
+        cy = RuntimeClient(py["broker"], tenant="fed-y",
+                           device=int(py["chips"][0]))
+        clients.append(cy)
+        shard_data = np.arange(8192, dtype=np.float32).reshape(128, 64)
+        cshard = RuntimeClient(pshard["broker"], tenant="fed-shard",
+                               devices=[int(d) for d
+                                        in pshard["chips"]])
+        clients.append(cshard)
+        cshard.put(shard_data, aid="w")
+        shard_epoch = cshard.epoch
+        time.sleep(warm_s)
+        # -- coordinator kill -9: fail-static ---------------------------
+        steps_before = wx["steps"]
+        coord.send_signal(signal.SIGKILL)
+        coord.wait(timeout=10)
+        time.sleep(dead_window_s)
+        out["failstatic_steps"] = wx["steps"] - steps_before
+        # -- coordinator restart: journal replay + fencing --------------
+        gen_before = st.get("generation")
+        coord = start_coord()
+        deadline = time.monotonic() + 30.0
+        st2: Dict[str, Any] = {}
+        while time.monotonic() < deadline:
+            try:
+                st2 = cl.status(coord_sock)
+                if st2.get("ok"):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        placements = st2.get("placements") or {}
+        out["replay_placements_kept"] = set(placements) >= {
+            "fed-x", "fed-y", "fed-shard", "fed-s"}
+        out["generation_bumped"] = (st2.get("generation") or 0) > \
+            (gen_before or 0)
+        # -- cross-node MIGRATE of the 2-chip sharded tenant ------------
+        mig = cl.request(coord_sock,
+                         {"kind": cl.CL_MIGRATE, "tenant": "fed-shard"},
+                         timeout=90.0)
+        out["migrate_ok"] = bool(mig.get("ok"))
+        out["migrate_to"] = mig.get("node")
+        out["migrate_moved_bytes"] = mig.get("moved_bytes")
+        out["migrate_blackout_ms"] = mig.get("blackout_ms")
+        if mig.get("ok"):
+            c2 = RuntimeClient(mig["broker"], tenant="fed-shard",
+                               resume_epoch=shard_epoch)
+            clients.append(c2)
+            got = c2.get("w")
+            out["migrate_data_identical"] = bool(
+                np.array_equal(got, shard_data))
+            out["migrate_resumed"] = True
+        st3 = cl.status(coord_sock)
+        out["violations_after_migrate"] = st3.get("violations") or []
+        out["migrations_total"] = st3.get("migrations_total")
+        # -- node kill -9: coordinator re-places the victims ------------
+        stop.set()
+        th.join(timeout=10)
+        victim = px["node"]
+        brokers[victim].proc.send_signal(signal.SIGKILL)
+        brokers[victim].proc.wait(timeout=10)
+        deadline = time.monotonic() + 30.0
+        moved = False
+        st4: Dict[str, Any] = {}
+        while time.monotonic() < deadline:
+            st4 = cl.status(coord_sock)
+            ent = {n["node"]: n for n in st4.get("nodes") or []}
+            pl = st4.get("placements") or {}
+            if not ent.get(victim, {}).get("alive") and all(
+                    p.get("node") != victim for p in pl.values()):
+                moved = True
+                break
+            time.sleep(0.3)
+        out["node_down_replaced"] = moved
+        out["replaced"] = st4.get("replaced")
+        out["violations_final"] = st4.get("violations") or []
+        out["worker_steps"] = wx["steps"]
+    finally:
+        stop.set()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+        for b in brokers.values():
+            b.close()
+        if coord.poll() is None:
+            coord.terminate()
+            try:
+                coord.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                coord.kill()
+        coord_log.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Gates
 # ---------------------------------------------------------------------------
 
@@ -931,6 +1150,40 @@ def check(result: Dict[str, Any],
                 f"{mig.get('client_errors')} error(s) / "
                 f"{mig.get('client_state_lost')} state loss(es) — a "
                 f"live migration must be tenant-invisible")
+    fed = result.get("federation")
+    if fed:
+        if fed.get("nodes_alive") != fed.get("nodes"):
+            errs.append(
+                f"federation: only {fed.get('nodes_alive')} of "
+                f"{fed.get('nodes')} nodes joined the coordinator")
+        if not fed.get("pack_colocated"):
+            errs.append("federation: pack placement did not co-locate "
+                        "the two 1-chip tenants on one node")
+        if not fed.get("spread_separated"):
+            errs.append("federation: spread placement landed on the "
+                        "pack node (no anti-affinity)")
+        if not fed.get("failstatic_steps"):
+            errs.append(
+                "federation: zero steps served while the coordinator "
+                "was dead — the control plane is on the execute path")
+        if not fed.get("replay_placements_kept"):
+            errs.append("federation: the restarted coordinator lost "
+                        "placements (journal replay broken)")
+        if not fed.get("generation_bumped"):
+            errs.append("federation: coordinator restart did not bump "
+                        "the fence generation")
+        if not fed.get("migrate_ok"):
+            errs.append("federation: the cross-node MIGRATE failed")
+        elif not fed.get("migrate_data_identical"):
+            errs.append("federation: migrated tenant data is NOT "
+                        "byte-identical at the target")
+        if not fed.get("node_down_replaced"):
+            errs.append("federation: victims of the node kill were "
+                        "never re-placed off the dead node")
+        for kind in ("violations_after_migrate", "violations_final"):
+            if fed.get(kind):
+                errs.append(f"federation: ledger conservation "
+                            f"violated ({kind}: {fed[kind]})")
     return errs
 
 
@@ -938,7 +1191,7 @@ def main() -> int:
     ap = argparse.ArgumentParser(prog="traffic_sim", description=__doc__)
     ap.add_argument("--cell", default="all",
                     choices=("all", "burst", "preempt", "overload",
-                             "failover", "migrate"))
+                             "failover", "migrate", "federation"))
     ap.add_argument("--tenants", type=int, default=512,
                     help="distinct churn tenants in the overload cell")
     ap.add_argument("--quick", action="store_true",
@@ -984,6 +1237,11 @@ def main() -> int:
         print("[traffic_sim] migrate cell ...", file=sys.stderr)
         result["migrate"] = cell_migrate(ns.quick)
         print(f"[traffic_sim]   {result['migrate']}", file=sys.stderr)
+    if ns.cell in ("all", "federation"):
+        print("[traffic_sim] federation cell ...", file=sys.stderr)
+        result["federation"] = cell_federation(ns.quick)
+        print(f"[traffic_sim]   {result['federation']}",
+              file=sys.stderr)
     result["wall_s"] = round(time.monotonic() - t0, 1)
     committed = None
     if ns.check:

@@ -1,0 +1,440 @@
+"""vtpu-cluster tests (docs/FEDERATION.md): the slice-level control
+plane over node-local brokers.
+
+Layers under test:
+
+  - ``cluster_apply_record``: every replay arm (join, grant, release,
+    migrate begin/commit/abort, node death), idempotence under
+    compaction replay, forward-compatible unknown-op skip;
+  - ``check_conservation``: the independent "sum of node ledgers ==
+    cluster ledger" audit and each violation class it must flag;
+  - ``cluster_choose_placement``: two-level pack|spread scoring (node
+    choice, intra-node ring span, standby runner-up, typed
+    no-capacity);
+  - the Coordinator in-process: journal-before-ack placement,
+    idempotent re-place, restart replay + epoch fencing of the stale
+    instance, node-death re-placement;
+  - the NodeAgent: fail-static join/heartbeat against a served
+    coordinator socket;
+  - the mc cluster crash-cut engine end-to-end (clean run; the seeded
+    violations ride tests/test_mc.py);
+  - the single-node MIGRATE multi-chip refusal: a refused verb must be
+    a true no-op — lease and fastlane ring gate untouched, the tenant
+    keeps working (the cross-node MIGRATE_OUT/MIGRATE_IN path is what
+    moves mesh-bound grants, docs/FEDERATION.md).
+"""
+
+from __future__ import annotations
+
+import os
+import socket as socketmod
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from vtpu.plugin.allocator import cluster_choose_placement  # noqa: E402
+from vtpu.runtime import cluster as CL  # noqa: E402
+from vtpu.runtime import protocol as P  # noqa: E402
+from vtpu.runtime import replication as R  # noqa: E402
+from vtpu.runtime.client import RuntimeClient  # noqa: E402
+from vtpu.runtime.server import make_server  # noqa: E402
+
+MB = 10**6
+
+
+def _apply_all(recs):
+    state = {}
+    for rec in recs:
+        CL.cluster_apply_record(state, rec)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Replay arms
+# ---------------------------------------------------------------------------
+
+def test_apply_join_grant_release():
+    state = _apply_all([
+        {"op": "node", "node": "n0", "broker": "/b0", "chips": 4,
+         "hbm": 1 << 30, "topology": {"kind": "ring", "size": 4}},
+        {"op": "cgrant", "tenant": "t0", "node": "n0",
+         "chips": [0, 1], "hbm": 64 * MB},
+    ])
+    assert state["nodes"]["n0"]["alive"]
+    assert state["placements"]["t0"] == {
+        "node": "n0", "chips": [0, 1], "hbm": 64 * MB}
+    assert state["used"]["n0"] == {"0": "t0", "1": "t0"}
+    assert CL.free_chips(state, "n0") == [2, 3]
+    assert state["placements_total"] == 1
+    CL.cluster_apply_record(state, {"op": "crelease", "tenant": "t0"})
+    assert "t0" not in state["placements"]
+    assert state["used"]["n0"] == {}
+    assert CL.check_conservation(state) == []
+
+
+def test_apply_migrate_commit_moves_ledger():
+    state = _apply_all([
+        {"op": "node", "node": "n0", "chips": 4},
+        {"op": "node", "node": "n1", "chips": 4},
+        {"op": "cgrant", "tenant": "t0", "node": "n0",
+         "chips": [0, 1], "hbm": 8 * MB},
+        {"op": "cmigrate", "tenant": "t0", "phase": "begin",
+         "to_node": "n1", "to_chips": [2, 3]},
+    ])
+    assert state["migrating"]["t0"]["to_node"] == "n1"
+    CL.cluster_apply_record(state, {
+        "op": "cmigrate", "tenant": "t0", "phase": "commit",
+        "to_node": "n1", "to_chips": [2, 3]})
+    # The whole grant moved: old node ledger empty, hbm carried over.
+    assert state["placements"]["t0"] == {
+        "node": "n1", "chips": [2, 3], "hbm": 8 * MB}
+    assert state["used"]["n0"] == {}
+    assert state["used"]["n1"] == {"2": "t0", "3": "t0"}
+    assert "t0" not in state["migrating"]
+    assert state["migrations_total"] == 1
+    assert CL.check_conservation(state) == []
+
+
+def test_apply_migrate_abort_is_noop():
+    state = _apply_all([
+        {"op": "node", "node": "n0", "chips": 2},
+        {"op": "cgrant", "tenant": "t0", "node": "n0", "chips": [0]},
+        {"op": "cmigrate", "tenant": "t0", "phase": "begin",
+         "to_node": "n1", "to_chips": [0]},
+        {"op": "cmigrate", "tenant": "t0", "phase": "abort"},
+    ])
+    assert state["placements"]["t0"]["node"] == "n0"
+    assert state["migrating"] == {}
+    assert state.get("migrations_total", 0) == 0
+    assert CL.check_conservation(state) == []
+
+
+def test_apply_node_down_keeps_placements():
+    """node_down marks liveness only — re-placement is the
+    coordinator's journaled cmigrate/crelease decision, not a replay
+    side effect (replay must be pure)."""
+    state = _apply_all([
+        {"op": "node", "node": "n0", "chips": 2},
+        {"op": "cgrant", "tenant": "t0", "node": "n0", "chips": [0]},
+        {"op": "node_down", "node": "n0"},
+    ])
+    assert not state["nodes"]["n0"]["alive"]
+    assert state["placements"]["t0"]["node"] == "n0"
+    assert CL.cluster_inventory(state) == {}  # dead: not placeable
+
+
+def test_apply_idempotent_and_unknown_op():
+    grant = {"op": "cgrant", "tenant": "t0", "node": "n0",
+             "chips": [0]}
+    state = _apply_all([
+        {"op": "node", "node": "n0", "chips": 2}, grant, grant,
+        {"op": "some_future_op", "payload": 1},
+    ])
+    # Compaction may replay a record already in the snapshot: the
+    # ledger maps stay exact (the counter is allowed to count).
+    assert state["used"]["n0"] == {"0": "t0"}
+    assert CL.check_conservation(state) == []
+
+
+# ---------------------------------------------------------------------------
+# Conservation audit
+# ---------------------------------------------------------------------------
+
+def test_conservation_flags_double_grant():
+    state = _apply_all([{"op": "node", "node": "n0", "chips": 2}])
+    state["placements"] = {
+        "a": {"node": "n0", "chips": [0]},
+        "b": {"node": "n0", "chips": [0]}}
+    state["used"] = {"n0": {"0": "a"}}
+    errs = CL.check_conservation(state)
+    assert any("double-granted" in e for e in errs)
+
+
+def test_conservation_flags_unregistered_node_and_bounds():
+    state = _apply_all([{"op": "node", "node": "n0", "chips": 2}])
+    state["placements"] = {
+        "a": {"node": "ghost", "chips": [0]},
+        "b": {"node": "n0", "chips": [7]}}
+    state["used"] = {"n0": {"7": "b"}}
+    errs = CL.check_conservation(state)
+    assert any("unregistered" in e for e in errs)
+    assert any("beyond node" in e for e in errs)
+
+
+def test_conservation_flags_ledger_drift_and_orphan_migration():
+    state = _apply_all([
+        {"op": "node", "node": "n0", "chips": 2},
+        {"op": "cgrant", "tenant": "a", "node": "n0", "chips": [0]},
+    ])
+    state["used"]["n0"]["1"] = "stale"  # dangling node-ledger entry
+    state.setdefault("migrating", {})["ghost"] = {
+        "to_node": "n0", "to_chips": [1]}
+    errs = CL.check_conservation(state)
+    assert any("drift" in e for e in errs)
+    assert any("no placement" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# Two-level placement
+# ---------------------------------------------------------------------------
+
+def _inv(**nodes):
+    return {n: {"free": list(free), "total": total}
+            for n, (free, total) in nodes.items()}
+
+
+def test_place_pack_picks_tightest_node():
+    inv = _inv(big=([0, 1, 2, 3], 4), small=([2, 3], 4))
+    node, chips, standby = cluster_choose_placement(inv, 2,
+                                                    policy="pack")
+    assert node == "small" and chips == [2, 3]
+    assert standby == "big"  # runner-up named for pre-warming
+
+
+def test_place_spread_picks_emptiest_node():
+    inv = _inv(big=([0, 1, 2, 3], 4), small=([2, 3], 4))
+    node, _chips, standby = cluster_choose_placement(inv, 2,
+                                                     policy="spread")
+    assert node == "big"
+    assert standby == "small"
+
+
+def test_place_prefers_contiguous_ring_span():
+    # Same free count on both nodes; only ring compactness differs
+    # (on the 6-ring, 0 and 3 are antipodal: span 3 vs span 1).
+    inv = _inv(frag=([0, 3], 6), tight=([1, 2], 6))
+    node, chips, _sb = cluster_choose_placement(inv, 2, policy="pack")
+    assert node == "tight" and chips == [1, 2]
+
+
+def test_place_no_capacity_and_tiebreak():
+    assert cluster_choose_placement(_inv(n0=([0], 2)), 2) == \
+        (None, [], None)
+    # Exact tie: deterministic name order.
+    inv = _inv(b=([0, 1], 2), a=([0, 1], 2))
+    node, _c, standby = cluster_choose_placement(inv, 2, policy="pack")
+    assert (node, standby) == ("a", "b")
+
+
+# ---------------------------------------------------------------------------
+# Coordinator (in-process: dispatch, replay, fencing, node death)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def coord(tmp_path):
+    c = CL.Coordinator(str(tmp_path / "cl.sock"),
+                       str(tmp_path / "j"), policy="pack",
+                       hb_dead_s=3600.0)
+    yield c
+    c.stop()
+    c.jr.close()
+
+
+def _join(c, node, chips, broker=None):
+    rep = c.dispatch({"kind": CL.CL_JOIN, "node": node,
+                      "broker": broker or f"/run/{node}.sock",
+                      "chips": chips, "hbm": 1 << 30,
+                      "topology": {"kind": "ring", "size": chips}})
+    assert rep["ok"]
+    return rep
+
+
+def test_coordinator_place_release_status(coord):
+    _join(coord, "n0", 4)
+    _join(coord, "n1", 2)
+    rep = coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t0",
+                          "chips": 2, "hbm": 4 * MB})
+    assert rep["ok"] and rep["node"] == "n1"  # pack: tightest
+    assert rep["broker"] == "/run/n1.sock"
+    assert rep["standby"]["node"] == "n0"
+    again = coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t0",
+                            "chips": 2})
+    assert again["ok"] and again["existing"] and again["node"] == "n1"
+    st = coord.dispatch({"kind": CL.CL_STATUS})
+    assert st["violations"] == []
+    assert st["placements"]["t0"]["node"] == "n1"
+    by_name = {n["node"]: n for n in st["nodes"]}
+    assert by_name["n1"]["free"] == 0 and by_name["n0"]["free"] == 4
+    full = coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t1",
+                           "chips": 8})
+    assert not full["ok"] and full["code"] == "NO_CAPACITY"
+    assert full["retry_ms"] > 0
+    assert coord.dispatch({"kind": CL.CL_RELEASE,
+                           "tenant": "t0"})["ok"]
+    st = coord.dispatch({"kind": CL.CL_STATUS})
+    assert st["placements"] == {} and st["violations"] == []
+
+
+def test_coordinator_restart_replays_and_fences(tmp_path):
+    sock = str(tmp_path / "cl.sock")
+    jdir = str(tmp_path / "j")
+    c1 = CL.Coordinator(sock, jdir, policy="pack", hb_dead_s=3600.0)
+    _join(c1, "n0", 4)
+    assert c1.dispatch({"kind": CL.CL_PLACE, "tenant": "t0",
+                        "chips": 2})["ok"]
+    c2 = CL.Coordinator(sock, jdir, policy="pack", hb_dead_s=3600.0)
+    try:
+        # The successor replayed the exact ledger and bumped the
+        # fence generation past the stale instance's.
+        assert c2.generation > c1.generation
+        assert c2.state["placements"]["t0"]["node"] == "n0"
+        assert CL.check_conservation(c2.state) == []
+        # fenced-stale-coordinator-never-acks: every mutation is
+        # journal-before-ack, and the stale journal refuses.
+        with pytest.raises(R.FencedEpoch):
+            c1._append({"op": "cgrant", "tenant": "late",
+                        "node": "n0", "chips": [3]})
+        assert "late" not in c1.state["placements"]
+    finally:
+        c1.stop(), c1.jr.close()
+        c2.stop(), c2.jr.close()
+
+
+def test_coordinator_node_down_replaces_victims(coord):
+    _join(coord, "n0", 4)
+    _join(coord, "n1", 4)
+    rep = coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t0",
+                          "chips": 2, "policy": "spread"})
+    src = rep["node"]
+    coord._node_down(src)
+    st = coord.dispatch({"kind": CL.CL_STATUS})
+    assert st["violations"] == []
+    assert st["placements"]["t0"]["node"] != src
+    assert st["migrations_total"] == 1
+    assert coord.replaced and coord.replaced[0]["tenant"] == "t0"
+    # The dead node needs a re-join before it is placeable again.
+    hb = coord.dispatch({"kind": CL.CL_HB, "node": src})
+    assert not hb["ok"] and hb["code"] == "UNKNOWN_NODE"
+
+
+def test_coordinator_node_down_releases_without_capacity(coord):
+    _join(coord, "n0", 2)
+    assert coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t0",
+                           "chips": 2})["ok"]
+    coord._node_down("n0")
+    st = coord.dispatch({"kind": CL.CL_STATUS})
+    # No survivor: the grant releases rather than dangling on a dead
+    # node forever; conservation stays clean.
+    assert st["placements"] == {} and st["violations"] == []
+    assert coord.replaced[0]["to"] is None
+
+
+# ---------------------------------------------------------------------------
+# NodeAgent over a served socket
+# ---------------------------------------------------------------------------
+
+def test_node_agent_joins_and_heartbeats(tmp_path):
+    sock = str(tmp_path / "cl.sock")
+    coord = CL.Coordinator(sock, str(tmp_path / "j"),
+                           policy="pack", hb_dead_s=3600.0)
+    srv = coord.make_server()
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    agent = CL.NodeAgent(sock, "nA", "/run/nA.sock", chips=4,
+                         hbm=1 << 30,
+                         tenants_fn=lambda: ["t0"], hb_s=0.05)
+    agent.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            st = CL.status(sock)
+            ent = {n["node"]: n for n in st["nodes"]}.get("nA")
+            if ent and ent["alive"] and ent.get("hb_tenants") == ["t0"]:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("NodeAgent never joined + heartbeat")
+        assert agent.joined and agent.generation == coord.generation
+    finally:
+        agent.stop()
+        srv.shutdown()
+        srv.server_close()
+        coord.stop()
+        coord.jr.close()
+        agent.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# mc cluster crash-cut engine (clean end-to-end; seeds ride test_mc)
+# ---------------------------------------------------------------------------
+
+def test_clustercut_explore_clean():
+    from vtpu.tools.mc import clustercut
+    stats = clustercut.explore()
+    assert stats.violations == []
+    assert stats.records > 0
+    assert stats.boundary_cuts == stats.records + 1
+    assert stats.torn_cuts == stats.records
+    assert stats.corrupt_checks >= 2
+    assert stats.fence_checks >= 1
+
+
+# ---------------------------------------------------------------------------
+# Single-node MIGRATE refusal is a true no-op (satellite regression)
+# ---------------------------------------------------------------------------
+
+def _admin(sock: str, msg: dict) -> dict:
+    s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+    s.settimeout(30.0)
+    s.connect(sock + ".admin")
+    try:
+        P.send_msg(s, msg)
+        return P.recv_msg(s)
+    finally:
+        s.close()
+
+
+def test_refused_multichip_migrate_leaves_tenant_untouched(tmp_path):
+    """A mesh-bound (multi-chip) tenant refuses single-node MIGRATE
+    typed — and the refusal must happen BEFORE any quiesce step: no
+    suspend hold, no lease revocation, no fastlane gate close.  A
+    refusal that had already quiesced would charge the tenant a
+    blackout for nothing."""
+    from vtpu.runtime import fastlane as FL
+    sock = str(tmp_path / "mig.sock")
+    srv = make_server(sock, hbm_limit=64 * MB, core_limit=0,
+                      journal_dir=str(tmp_path / "j"))
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    c = RuntimeClient(sock, tenant="mc2", hbm_limit=8 * MB,
+                      devices=[0, 1])
+    try:
+        data = np.arange(64, dtype=np.float32)
+        c.put(data, aid="w")
+        t = srv.state.tenants["mc2"]
+        lane_before = srv.state.fastlane.lanes.get("mc2")
+        gates_before = ([r.gate() for r in lane_before.rings]
+                        if lane_before is not None else None)
+
+        rep = _admin(sock, {"kind": P.MIGRATE, "tenant": "mc2",
+                            "devices": [2, 3]})
+        assert not rep["ok"]
+        assert "MIGRATE_UNSUPPORTED" in rep["error"]
+        assert "MIGRATE_OUT" in rep["error"]  # points cross-node
+
+        # True no-op: no hold, lease not revoked, lane identity and
+        # every per-chip ring gate exactly as before the refusal.
+        assert "mc2" not in srv.state.suspended
+        assert t.lease_revoked is False
+        lane_after = srv.state.fastlane.lanes.get("mc2")
+        assert lane_after is lane_before
+        if lane_before is not None:
+            assert [r.gate() for r in lane_before.rings] == gates_before
+            assert all(g == FL.GATE_OPEN for g in gates_before)
+
+        # The tenant keeps WORKING: data intact, programs still run.
+        assert np.array_equal(c.get("w"), data)
+        exe = c.compile(lambda a: a + 1.0, [data])
+        outs = exe(c.put(data, aid="x"))
+        assert np.allclose(outs[0].fetch(), data + 1.0)
+    finally:
+        c.close()
+        srv.shutdown()
+        srv.server_close()
