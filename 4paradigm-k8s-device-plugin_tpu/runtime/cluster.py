@@ -200,19 +200,40 @@ def check_conservation(state: Dict[str, Any]) -> List[str]:
             out.append(f"node ledger drift on {node!r}: cluster "
                        f"ledger says {sorted(a.items())}, node "
                        f"ledger says {sorted(b.items())}")
-    for tenant in state.get("migrating") or {}:
+    for tenant, m in (state.get("migrating") or {}).items():
         if tenant not in placements:
             out.append(f"migrating tenant {tenant!r} has no "
                        f"placement")
+        if not isinstance(m, dict):
+            continue
+        to_node = m.get("to_node")
+        per = used.get(to_node) or {}
+        for c in m.get("to_chips") or []:
+            holder = per.get(str(int(c)))
+            if holder is not None and holder != tenant:
+                out.append(f"migration reservation collision: node "
+                           f"{to_node!r} chip {c} is reserved for the "
+                           f"in-flight migration of {tenant!r} but "
+                           f"granted to {holder!r}")
     return out
 
 
 def free_chips(state: Dict[str, Any], node: str) -> List[int]:
-    """The node's unplaced chip indices, from the replayed ledger."""
+    """The node's unplaced chip indices, from the replayed ledger.
+    Chips reserved as the TARGET of an in-flight migration
+    (``state["migrating"]``, journaled by cmigrate "begin") are not
+    free: the broker dance between begin and commit can take tens of
+    seconds, and a placement granted onto those chips in that window
+    would be double-booked the moment the commit lands.  The abort
+    arm pops the entry, which releases the reservation."""
     ent = (state.get("nodes") or {}).get(node) or {}
     per = (state.get("used") or {}).get(node) or {}
+    reserved: set = set()
+    for m in (state.get("migrating") or {}).values():
+        if isinstance(m, dict) and m.get("to_node") == node:
+            reserved.update(int(c) for c in m.get("to_chips") or [])
     return [c for c in range(int(ent.get("chips") or 0))
-            if str(c) not in per]
+            if str(c) not in per and c not in reserved]
 
 
 def cluster_inventory(state: Dict[str, Any]
@@ -335,8 +356,17 @@ class Coordinator:
         the disk) refuses never mutates the in-memory ledger, so a
         fenced stale coordinator can never ack a state change."""
         with self.mu:
-            self.jr.append(rec)
-            cluster_apply_record(self.state, rec)
+            self._append_locked(rec)
+
+    def _append_locked(self, rec: Dict[str, Any]) -> None:
+        """The append body for callers ALREADY holding self.mu —
+        placement paths must keep the lock across inventory snapshot,
+        placement choice and journal append, or two concurrent
+        requests can both see the same free chips and both journal a
+        grant for them (a double-granted chip burned into the ledger
+        forever; replay reproduces it)."""
+        self.jr.append(rec)
+        cluster_apply_record(self.state, rec)
 
     # -- dispatch --------------------------------------------------------
 
@@ -396,6 +426,12 @@ class Coordinator:
         tenant = str(msg["tenant"])
         size = int(msg.get("chips") or 1)
         policy = str(msg.get("policy") or self.policy)
+        # Snapshot, choose AND journal under ONE hold of self.mu:
+        # the server is threading, and dropping the lock between the
+        # inventory read and the cgrant append would let two
+        # concurrent CL_PLACE requests both see the same free chips
+        # and both journal a grant for them.  Placement scoring is
+        # cheap; the append was already under the lock.
         with self.mu:
             existing = self.state["placements"].get(tenant)
             if existing is not None:
@@ -407,15 +443,16 @@ class Coordinator:
                         "chips": list(existing["chips"]),
                         "standby": None, "existing": True}
             inv = cluster_inventory(self.state)
-        node, chips, standby = cluster_choose_placement(
-            inv, size, policy=policy)
-        if node is None:
-            return {"ok": False, "code": "NO_CAPACITY",
-                    "error": f"no live node has {size} free chip(s)",
-                    "retry_ms": 500}
-        self._append({"op": "cgrant", "tenant": tenant, "node": node,
-                      "chips": chips, "hbm": msg.get("hbm")})
-        with self.mu:
+            node, chips, standby = cluster_choose_placement(
+                inv, size, policy=policy)
+            if node is None:
+                return {"ok": False, "code": "NO_CAPACITY",
+                        "error": f"no live node has {size} "
+                                 f"free chip(s)",
+                        "retry_ms": 500}
+            self._append_locked({"op": "cgrant", "tenant": tenant,
+                                 "node": node, "chips": chips,
+                                 "hbm": msg.get("hbm")})
             broker = (self.state["nodes"].get(node) or {}).get("broker")
             standby_broker = (self.state["nodes"].get(standby)
                               or {}).get("broker") if standby else None
@@ -484,6 +521,13 @@ class Coordinator:
         tenant = str(msg["tenant"])
         to_node = msg.get("node")
         t0 = time.monotonic()
+        # Lookup, target choice and the journaled "begin" all under
+        # ONE hold of self.mu (the same race as _place): the applied
+        # begin record reserves to_chips in state["migrating"], which
+        # free_chips subtracts — so for the whole broker dance no
+        # concurrent CL_PLACE or CL_MIGRATE can grant the target
+        # chips to anyone else.  Commit assigns them; abort releases
+        # the reservation.
         with self.mu:
             p = self.state["placements"].get(tenant)
             if p is None:
@@ -494,24 +538,24 @@ class Coordinator:
             width = len(p.get("chips") or [])
             src_ent = self.state["nodes"].get(src_node) or {}
             inv = cluster_inventory(self.state)
-        inv.pop(src_node, None)
-        if to_node is not None:
-            inv = {k: v for k, v in inv.items() if k == str(to_node)}
-        node, chips, _standby = cluster_choose_placement(
-            inv, max(width, 1),
-            policy=str(msg.get("policy") or self.policy))
-        if node is None:
-            return {"ok": False, "code": "NO_CAPACITY",
-                    "error": f"no live target node has "
-                             f"{max(width, 1)} free chip(s)",
-                    "retry_ms": 500}
-        with self.mu:
+            inv.pop(src_node, None)
+            if to_node is not None:
+                inv = {k: v for k, v in inv.items()
+                       if k == str(to_node)}
+            node, chips, _standby = cluster_choose_placement(
+                inv, max(width, 1),
+                policy=str(msg.get("policy") or self.policy))
+            if node is None:
+                return {"ok": False, "code": "NO_CAPACITY",
+                        "error": f"no live target node has "
+                                 f"{max(width, 1)} free chip(s)",
+                        "retry_ms": 500}
             src_broker = src_ent.get("broker")
             dst_broker = (self.state["nodes"].get(node)
                           or {}).get("broker")
-        self._append({"op": "cmigrate", "tenant": tenant,
-                      "phase": "begin", "to_node": node,
-                      "to_chips": chips})
+            self._append_locked({"op": "cmigrate", "tenant": tenant,
+                                 "phase": "begin", "to_node": node,
+                                 "to_chips": chips})
         try:
             out = self._admin(src_broker + ".admin",
                               {"kind": P.MIGRATE_OUT, "tenant": tenant,
@@ -536,6 +580,19 @@ class Coordinator:
                 raise RuntimeError(
                     f"{fin.get('code')}: {fin.get('error')}")
         except Exception as e:  # noqa: BLE001 - abort back to serving
+            # Roll the TARGET back first: if MIGRATE_IN already
+            # parked a copy (e.g. the commit call failed or its ack
+            # was lost), that orphan carries journaled bind/put
+            # records and live HBM charges the cluster ledger knows
+            # nothing about — discard it before the ledger declares
+            # those chips free again.  A no-op if the park never
+            # happened (the target answers noop).
+            try:
+                self._admin(dst_broker + ".admin",
+                            {"kind": P.MIGRATE_IN, "tenant": tenant,
+                             "phase": "abort"})
+            except (OSError, P.ProtocolError):
+                pass
             try:
                 self._admin(src_broker + ".admin",
                             {"kind": P.MIGRATE_OUT, "tenant": tenant,
@@ -589,36 +646,43 @@ class Coordinator:
                 if p.get("node") == node)
         for tenant, p in victims:
             width = max(len(p.get("chips") or []), 1)
+            # Choose + journal under one hold of self.mu (the _place
+            # race): a CL_PLACE between this victim's choice and its
+            # cmigrate append must not be handed the same chips.
             with self.mu:
                 inv = cluster_inventory(self.state)
-            inv.pop(node, None)
-            to, chips, _sb = cluster_choose_placement(
-                inv, width, policy=self.policy)
-            if to is None:
-                # No capacity anywhere: release the grant rather than
-                # carry a placement on a dead node forever.
+                inv.pop(node, None)
+                to, chips, _sb = cluster_choose_placement(
+                    inv, width, policy=self.policy)
+                if to is None:
+                    # No capacity anywhere: release the grant rather
+                    # than carry a placement on a dead node forever.
+                    try:
+                        self._append_locked({"op": "crelease",
+                                             "tenant": tenant})
+                    except OSError:
+                        return
+                    self.replaced.append({"tenant": tenant,
+                                          "from": node, "to": None})
+                    continue
                 try:
-                    self._append({"op": "crelease", "tenant": tenant})
+                    self._append_locked({"op": "cmigrate",
+                                         "tenant": tenant,
+                                         "phase": "begin",
+                                         "to_node": to,
+                                         "to_chips": chips})
+                    self._append_locked({"op": "cmigrate",
+                                         "tenant": tenant,
+                                         "phase": "commit",
+                                         "to_node": to,
+                                         "to_chips": chips})
                 except OSError:
                     return
-                self.replaced.append({"tenant": tenant, "from": node,
-                                      "to": None})
-                continue
-            try:
-                self._append({"op": "cmigrate", "tenant": tenant,
-                              "phase": "begin", "to_node": to,
-                              "to_chips": chips})
-                self._append({"op": "cmigrate", "tenant": tenant,
-                              "phase": "commit", "to_node": to,
-                              "to_chips": chips})
-            except OSError:
-                return
-            with self.mu:
                 broker = (self.state["nodes"].get(to)
                           or {}).get("broker")
-            self.replaced.append({"tenant": tenant, "from": node,
-                                  "to": to, "broker": broker,
-                                  "chips": chips})
+                self.replaced.append({"tenant": tenant, "from": node,
+                                      "to": to, "broker": broker,
+                                      "chips": chips})
 
     # -- lifecycle -------------------------------------------------------
 

@@ -208,10 +208,12 @@ MIGRATE_OUT = "migrate_out"  # {tenant, phase?} -> {ok, state, blobs,
 # programs, grant and credit intact (byte-identical, the shas prove
 # it).  Same-topology sharded grants land chip-for-chip on the target
 # ``devices``; a mismatched topology refuses typed BEFORE any state
-# mutates.  Re-running a lost ack re-parks the same state, so the
-# verb classifies idempotent.
-MIGRATE_IN = "migrate_in"    # {tenant, state?, blobs?, devices?}
-                             # -> {ok, tenant, devices, epoch}
+# mutates.  Re-running a lost ack re-parks the same state, and
+# {phase: "abort"} (the coordinator's rollback when the dance fails
+# after this node accepted) discards the parked copy or no-ops if it
+# is absent or already adopted — so the verb classifies idempotent.
+MIGRATE_IN = "migrate_in"    # {tenant, state?, blobs?, devices?,
+                             #  phase?} -> {ok, tenant, devices, epoch}
 # REPL_SYNC (vtpu-failover, docs/FAILOVER.md): the hot-standby broker's
 # subscription verb.  With {status: true} it answers one frame — the
 # replication block (role, followers, lag, fence generation) — and the
@@ -344,7 +346,7 @@ WIRE_FIELDS: Dict[str, Dict[str, tuple]] = {
     MIGRATE_OUT: {"required": ("tenant",),
                   "optional": ("phase", "timeout")},
     MIGRATE_IN: {"required": ("tenant",),
-                 "optional": ("state", "blobs", "devices")},
+                 "optional": ("state", "blobs", "devices", "phase")},
     REPL_SYNC: {"required": (), "optional": ("status",)},
     SHUTDOWN: {"required": (), "optional": ()},
     DRAIN: {"required": (), "optional": ("timeout",)},

@@ -2157,6 +2157,7 @@ class RuntimeState:
             "tenants_dropped_dead": 0,
             "tenants_dropped_expired": 0,
             "tenants_dropped_replaced": 0,
+            "tenants_dropped_aborted": 0,
             "arrays_dropped": 0,
             "corrupt_recoveries": 0,
         }
@@ -4466,9 +4467,20 @@ def migrate_out_begin(state: RuntimeState, t: Tenant,
             "MIGRATE_UNSUPPORTED: cross-node migration requires the "
             "journal (program blobs ride it; set VTPU_JOURNAL_DIR)")
     # -- 1. quiesce (kept held until commit/abort) --
-    hold = t.name not in state.suspended
-    if hold:
-        with state.mu:
+    with state.mu:
+        prior = state.migrating_out.get(t.name)
+        if prior is not None:
+            # Re-driven begin (retry after a lost ack): the tenant is
+            # in state.suspended from our OWN first run, so deriving
+            # hold from membership would misread the migration's own
+            # hold as an operator admin-suspend (freezing the tenant
+            # on the target until a manual RESUME, and leaving abort
+            # unable to release the hold).  Reproduce the first run's
+            # decision instead.
+            hold = bool(prior.get("hold"))
+        else:
+            hold = t.name not in state.suspended
+        if hold:
             state.suspended.add(t.name)
     try:
         with t.chip.scheduler.mu:
@@ -4586,7 +4598,11 @@ def migrate_out_finish(state: RuntimeState, t: Optional[Tenant],
         return ({"ok": True, "tenant": name, "phase": phase,
                  "noop": True}, None)
     if phase == "abort":
-        if ent is None or ent.get("hold"):
+        # Release ONLY the hold a begin on record took: an abort with
+        # no migrating_out entry (a re-driven abort after the first
+        # one popped it, or an abort with no prior begin) must not
+        # un-suspend a tenant the operator had admin-suspended.
+        if ent is not None and ent.get("hold"):
             with state.mu:
                 state.suspended.discard(name)
         t.chip.scheduler.kick()
@@ -4793,6 +4809,40 @@ def migrate_in_tenant(state: RuntimeState, msg: dict
              t.accept_epoch)
     return ({"ok": True, "tenant": name, "devices": devices,
              "epoch": state.epoch}, recs)
+
+
+def migrate_in_abort(state: RuntimeState, name: str
+                     ) -> Tuple[dict, Optional[dict]]:
+    """Cross-node MIGRATE, target side, rollback
+    (docs/FEDERATION.md): discard the parked migrated-in copy.  The
+    coordinator's abort path drives this when the dance fails AFTER
+    MIGRATE_IN parked the tenant (the commit call failed or its ack
+    was lost): without it the orphan sits here with journaled
+    bind/put records and live HBM charges for up to resume_grace —
+    or across a restart, since the journal replays it — while the
+    cluster ledger says those chips are free, so a follow-up
+    placement onto this node collides with it.
+
+    No-op (idempotent) when the tenant is not parked: a re-driven
+    abort, an abort before MIGRATE_IN ever ran, or a tenant a client
+    already adopted — an adopted tenant is live on this node and only
+    the normal teardown paths may touch it."""
+    with state.mu:
+        ent = state.recovered.pop(name, None)
+        if ent is not None:
+            # The park may have journaled the travelling admin-freeze
+            # into state.suspended; the rollback returns the tenant
+            # (freeze included) to the source, so drop our copy.
+            state.suspended.discard(name)
+    if ent is None:
+        return ({"ok": True, "tenant": name, "phase": "abort",
+                 "noop": True}, None)
+    t = ent[0]
+    state.slo.forget(name)
+    rec = state._release_recovered(t, "tenants_dropped_aborted")
+    log.info("cluster: MIGRATE_IN abort discarded parked tenant %r",
+             name)
+    return ({"ok": True, "tenant": name, "phase": "abort"}, rec)
 
 
 class AdminSession(socketserver.BaseRequestHandler):
@@ -5005,6 +5055,20 @@ class AdminSession(socketserver.BaseRequestHandler):
                         P.reply_err(self.request, code, str(e))
                 elif kind == P.MIGRATE_IN:
                     try:
+                        if str(msg.get("phase") or "") == "abort":
+                            # Coordinator-driven rollback of a parked
+                            # migrated-in copy (the dance failed
+                            # after this node accepted).
+                            reply, close_rec = migrate_in_abort(
+                                self.state, str(msg["tenant"]))
+                            jr = self.state.journal
+                            if close_rec is not None \
+                                    and jr is not None:
+                                jr.append(close_rec)
+                            log.info("admin: MIGRATE_IN abort %r",
+                                     reply.get("tenant"))
+                            P.send_msg(self.request, reply)
+                            continue
                         reply, in_recs = migrate_in_tenant(
                             self.state, msg)
                         # Journal BEFORE the ack: once the source

@@ -314,6 +314,88 @@ def test_coordinator_node_down_replaces_victims(coord):
     assert not hb["ok"] and hb["code"] == "UNKNOWN_NODE"
 
 
+def test_coordinator_concurrent_place_never_double_grants(
+        coord, monkeypatch):
+    """TOCTOU regression: two CL_PLACE requests racing through the
+    threading server must never both be granted the same chips.  The
+    placement choice is slowed to stretch any window between the
+    inventory snapshot and the journaled cgrant — with the choice,
+    snapshot and append under one lock hold, the requests serialize
+    and the ledger stays conserved."""
+    _join(coord, "n0", 2)
+    _join(coord, "n1", 2)
+    real = cluster_choose_placement
+
+    def slow(inv, size, policy="pack"):
+        out = real(inv, size, policy=policy)
+        time.sleep(0.05)
+        return out
+
+    monkeypatch.setattr(CL, "cluster_choose_placement", slow)
+    replies = {}
+
+    def place(tenant):
+        replies[tenant] = coord.dispatch(
+            {"kind": CL.CL_PLACE, "tenant": tenant, "chips": 2})
+
+    threads = [threading.Thread(target=place, args=(t,))
+               for t in ("ra", "rb")]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert replies["ra"]["ok"] and replies["rb"]["ok"]
+    assert replies["ra"]["node"] != replies["rb"]["node"]
+    st = coord.dispatch({"kind": CL.CL_STATUS})
+    assert st["violations"] == []
+
+
+def test_migration_reservation_blocks_concurrent_place(coord):
+    """An in-flight migration's target chips are reserved from the
+    journaled begin until commit/abort: the broker dance can take
+    tens of seconds, and a CL_PLACE granted those chips mid-dance
+    would be double-booked the moment the commit lands."""
+    _join(coord, "n0", 2)
+    _join(coord, "n1", 2)
+    assert coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t0",
+                           "chips": 2})["ok"]
+    coord._append({"op": "cmigrate", "tenant": "t0",
+                   "phase": "begin", "to_node": "n1",
+                   "to_chips": [0, 1]})
+    # Both nodes are now spoken for: n0 holds t0, n1 is reserved.
+    rep = coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t1",
+                          "chips": 2})
+    assert not rep["ok"] and rep["code"] == "NO_CAPACITY"
+    st = coord.dispatch({"kind": CL.CL_STATUS})
+    assert st["violations"] == []
+    by_name = {n["node"]: n for n in st["nodes"]}
+    assert by_name["n1"]["free"] == 0  # reserved, not free
+    # Abort releases the reservation; the place now lands on n1.
+    coord._append({"op": "cmigrate", "tenant": "t0",
+                   "phase": "abort"})
+    rep = coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t1",
+                          "chips": 2})
+    assert rep["ok"] and rep["node"] == "n1"
+    assert coord.dispatch({"kind": CL.CL_STATUS})["violations"] == []
+
+
+def test_conservation_flags_reservation_collision():
+    state = _apply_all([
+        {"op": "node", "node": "n0", "chips": 2},
+        {"op": "node", "node": "n1", "chips": 2},
+        {"op": "cgrant", "tenant": "a", "node": "n0", "chips": [0]},
+        {"op": "cmigrate", "tenant": "a", "phase": "begin",
+         "to_node": "n1", "to_chips": [1]},
+    ])
+    assert CL.check_conservation(state) == []
+    # Seed the violation the reservation exists to prevent: someone
+    # else granted the reserved chip mid-dance.
+    CL.cluster_apply_record(state, {"op": "cgrant", "tenant": "b",
+                                    "node": "n1", "chips": [1]})
+    errs = CL.check_conservation(state)
+    assert any("reservation collision" in e for e in errs)
+
+
 def test_coordinator_node_down_releases_without_capacity(coord):
     _join(coord, "n0", 2)
     assert coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t0",
@@ -438,3 +520,85 @@ def test_refused_multichip_migrate_leaves_tenant_untouched(tmp_path):
         c.close()
         srv.shutdown()
         srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Cross-node MIGRATE_OUT/MIGRATE_IN abort semantics (review regressions)
+# ---------------------------------------------------------------------------
+
+def test_migrate_out_begin_redrive_and_abort_semantics(tmp_path):
+    """Three review regressions on the cross-node dance:
+
+    1. a re-driven MIGRATE_OUT begin (retry after a lost ack) must
+       reproduce the first run's record — in particular it must NOT
+       misread the migration's own suspend hold as an operator
+       admin-suspend and stamp ``suspended`` into the state rec (the
+       target would park the tenant admin-frozen);
+    2. MIGRATE_IN {phase: abort} discards a parked migrated-in copy
+       (charges released, no orphan awaiting resume) and no-ops when
+       re-driven;
+    3. MIGRATE_OUT abort with no begin on record must not release an
+       operator's admin-suspend."""
+    sock_a = str(tmp_path / "a.sock")
+    sock_b = str(tmp_path / "b.sock")
+    srv_a = make_server(sock_a, hbm_limit=64 * MB, core_limit=0,
+                        journal_dir=str(tmp_path / "ja"))
+    srv_b = make_server(sock_b, hbm_limit=64 * MB, core_limit=0,
+                        journal_dir=str(tmp_path / "jb"))
+    for srv in (srv_a, srv_b):
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+    c = RuntimeClient(sock_a, tenant="xm", hbm_limit=8 * MB)
+    try:
+        data = np.arange(32, dtype=np.float32)
+        c.put(data, aid="w")
+
+        out1 = _admin(sock_a, {"kind": P.MIGRATE_OUT, "tenant": "xm",
+                               "phase": "begin"})
+        assert out1["ok"]
+        assert "suspended" not in out1["state"]
+        # Re-driven begin: identical record, hold still owned by the
+        # migration (not reclassified as an admin freeze).
+        out2 = _admin(sock_a, {"kind": P.MIGRATE_OUT, "tenant": "xm",
+                               "phase": "begin"})
+        assert out2["ok"]
+        assert "suspended" not in out2["state"]
+        assert srv_a.state.migrating_out["xm"]["hold"] is True
+
+        # Park the copy on B, then roll it back: the abort must
+        # discard the parked tenant and release its ledger charges.
+        rin = _admin(sock_b, {"kind": P.MIGRATE_IN, "tenant": "xm",
+                              "state": out2["state"],
+                              "blobs": out2["blobs"]})
+        assert rin["ok"]
+        assert "xm" in srv_b.state.recovered
+        rab = _admin(sock_b, {"kind": P.MIGRATE_IN, "tenant": "xm",
+                              "phase": "abort"})
+        assert rab["ok"] and not rab.get("noop")
+        assert "xm" not in srv_b.state.recovered
+        assert "xm" not in srv_b.state.suspended
+        # Re-driven abort no-ops.
+        again = _admin(sock_b, {"kind": P.MIGRATE_IN, "tenant": "xm",
+                                "phase": "abort"})
+        assert again["ok"] and again.get("noop")
+
+        # Source abort releases the migration hold; the tenant
+        # resumes serving with its data intact.
+        assert _admin(sock_a, {"kind": P.MIGRATE_OUT, "tenant": "xm",
+                               "phase": "abort"})["ok"]
+        assert "xm" not in srv_a.state.suspended
+        assert np.array_equal(c.get("w"), data)
+
+        # An operator admin-suspend must survive a stray (re-driven
+        # or begin-less) MIGRATE_OUT abort.
+        assert _admin(sock_a, {"kind": P.SUSPEND,
+                               "tenant": "xm"})["ok"]
+        assert "xm" in srv_a.state.suspended
+        assert _admin(sock_a, {"kind": P.MIGRATE_OUT, "tenant": "xm",
+                               "phase": "abort"})["ok"]
+        assert "xm" in srv_a.state.suspended
+        assert _admin(sock_a, {"kind": P.RESUME, "tenant": "xm"})["ok"]
+    finally:
+        c.close()
+        for srv in (srv_a, srv_b):
+            srv.shutdown()
+            srv.server_close()
