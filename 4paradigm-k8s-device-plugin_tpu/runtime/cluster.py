@@ -39,6 +39,50 @@ the authoritative quota/placement ledger:
 Wire verbs ride the same msgpack framing as the broker protocol but
 live here, not in runtime/protocol.py: they are coordinator-only and
 never appear on a tenant or broker-admin socket.
+
+cluster-dance ground truth (vtpu-analyze):
+
+    The federation protocol is declared HERE and machine-checked by
+    ``vtpu-smi analyze`` (vtpu.tools.analyze.clusterproto), the same
+    way the lock hierarchy is declared in runtime/server.py: every
+    coordinator verb must appear in :data:`CLUSTER_VERBS` with a
+    dispatch arm, a sender binding and the idempotency class declared
+    below; every journaled op must have a replay arm in
+    :func:`cluster_apply_record`; and every dance message's
+    idempotency class must agree with runtime/protocol.py's
+    IDEMPOTENT_VERBS tables.
+
+        verb: cl_join     idempotent      journals: node
+        verb: cl_hb       idempotent      journals: -
+        verb: cl_place    idempotent      journals: cgrant
+        verb: cl_release  idempotent      journals: crelease
+        verb: cl_migrate  non-idempotent  journals: cmigrate
+        verb: cl_status   idempotent      journals: -
+        dance: cl_migrate
+        dance-commit: migrate_out(begin) -> migrate_in -> migrate_out(commit)
+        dance-abort: migrate_in(abort) -> migrate_out(abort)
+        dance-msg: migrate_out idempotent owner: coordinator
+        dance-msg: migrate_in idempotent owner: coordinator
+        record: cepoch owner: coordinator
+        record: node owner: coordinator pairs: node_down
+        record: node_down owner: coordinator
+        record: cgrant owner: coordinator pairs: crelease
+        record: crelease owner: coordinator
+        record: cmigrate owner: coordinator phases: begin -> commit | abort
+
+    "idempotent" means re-delivering the message to the same instance
+    leaves the replayed ledger state identical to a single delivery —
+    the lost-ack retry contract (cl_place re-places onto the existing
+    grant; cl_release and both dance phases no-op when already
+    applied).  cl_migrate is the one non-idempotent verb: each
+    delivery drives a fresh dance.  Every journal record is
+    coordinator-owned — brokers never write the cluster ledger — and
+    the dance's commit point is the journaled ``cmigrate commit``
+    appended at the MIGRATE_IN ack: before it the dance may only roll
+    back (abort releases the begin reservation), after it only
+    forward (source teardown is re-driven, never aborted).  The
+    re-drive contract is enforced dynamically over every message by
+    tools/dmc (docs/ANALYSIS.md "Distributed model checking").
 """
 
 from __future__ import annotations
@@ -66,6 +110,20 @@ CL_PLACE = "cl_place"      # place a tenant: -> node + chips + standby
 CL_RELEASE = "cl_release"  # release a tenant's cluster grant
 CL_MIGRATE = "cl_migrate"  # rebalance: drive a cross-node MIGRATE
 CL_STATUS = "cl_status"    # node table + placements + counters
+
+# The verb registry the clusterproto checker (tools/analyze) proves
+# complete: every CL_* constant above must be listed here, carry a
+# Coordinator.dispatch arm, at least one sender binding, and exactly
+# one of the idempotency classes below, all matching the docstring
+# grammar.  Growing the protocol without growing this registry (or
+# the grammar) fails `vtpu-smi analyze`.
+CLUSTER_VERBS = (CL_JOIN, CL_HB, CL_PLACE, CL_RELEASE, CL_MIGRATE,
+                 CL_STATUS)
+# Re-delivery classes (the lost-ack retry contract; checked
+# dynamically over every message by tools/dmc re-drive-idempotence):
+CLUSTER_IDEMPOTENT_VERBS = (CL_JOIN, CL_HB, CL_PLACE, CL_RELEASE,
+                            CL_STATUS)
+CLUSTER_NONIDEMPOTENT_VERBS = (CL_MIGRATE,)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -534,6 +592,18 @@ class Coordinator:
                 return {"ok": False, "code": "NOT_FOUND",
                         "error": f"tenant {tenant!r} has no cluster "
                                  f"placement"}
+            if tenant in (self.state.get("migrating") or {}):
+                # The begin record doubles as a per-tenant dance
+                # lock: a second dance racing this window (duplicated
+                # or retried CL_MIGRATE on the threading server) would
+                # clobber the reservation and its abort arm could
+                # discard the first dance's committed target copy —
+                # the dmc at-least-one-full-copy row caught exactly
+                # that zero-copy interleave.
+                return {"ok": False, "code": "MIGRATE_BUSY",
+                        "error": f"tenant {tenant!r} already has a "
+                                 f"migration dance in flight",
+                        "retry_ms": 500}
             src_node = p["node"]
             width = len(p.get("chips") or [])
             src_ent = self.state["nodes"].get(src_node) or {}
@@ -571,22 +641,13 @@ class Coordinator:
             if not rin.get("ok"):
                 raise RuntimeError(
                     f"{rin.get('code')}: {rin.get('error')}")
-            # Source release ONLY after target commit: the ledger
-            # never goes below one full copy of the tenant.
-            fin = self._admin(src_broker + ".admin",
-                              {"kind": P.MIGRATE_OUT, "tenant": tenant,
-                               "phase": "commit"})
-            if not fin.get("ok"):
-                raise RuntimeError(
-                    f"{fin.get('code')}: {fin.get('error')}")
         except Exception as e:  # noqa: BLE001 - abort back to serving
             # Roll the TARGET back first: if MIGRATE_IN already
-            # parked a copy (e.g. the commit call failed or its ack
-            # was lost), that orphan carries journaled bind/put
-            # records and live HBM charges the cluster ledger knows
-            # nothing about — discard it before the ledger declares
-            # those chips free again.  A no-op if the park never
-            # happened (the target answers noop).
+            # parked a copy (its ack was lost), that orphan carries
+            # journaled bind/put records and live HBM charges the
+            # cluster ledger knows nothing about — discard it before
+            # the ledger declares those chips free again.  A no-op if
+            # the park never happened (the target answers noop).
             try:
                 self._admin(dst_broker + ".admin",
                             {"kind": P.MIGRATE_IN, "tenant": tenant,
@@ -603,9 +664,38 @@ class Coordinator:
                           "phase": "abort"})
             return {"ok": False, "code": "MIGRATE_FAILED",
                     "error": f"{type(e).__name__}: {e}"}
+        # COMMIT POINT — the target acked MIGRATE_IN, so a durable
+        # full copy exists there.  Journal the ledger move BEFORE the
+        # source teardown: the old order (tear down, then journal)
+        # had a lost-ack hole the dmc at-least-one-full-copy row
+        # catches — the source executes the teardown, its ack is
+        # lost, and the abort arm then discards the parked TARGET
+        # copy too: zero copies anywhere, with the ledger still
+        # pointing at the emptied source.
         self._append({"op": "cmigrate", "tenant": tenant,
                       "phase": "commit", "to_node": node,
                       "to_chips": chips})
+        # Past the commit point the dance only rolls FORWARD: the
+        # source teardown is re-driven on a lost ack, never aborted
+        # (MIGRATE_OUT commit no-ops on an already-gone tenant).  A
+        # source that stays unreachable keeps its quiesced copy until
+        # an operator or its own restart reaps it; the ledger and the
+        # client have already moved to the target either way.
+        for _attempt in range(3):
+            try:
+                fin = self._admin(src_broker + ".admin",
+                                  {"kind": P.MIGRATE_OUT,
+                                   "tenant": tenant,
+                                   "phase": "commit"})
+            except (OSError, P.ProtocolError):
+                continue
+            if fin.get("ok"):
+                break
+        else:
+            log.warn("cluster: source %r never acked MIGRATE_OUT "
+                     "commit for %r — committed placement is on %r; "
+                     "the quiesced source copy outlives the dance",
+                     src_node, tenant, node)
         return {"ok": True, "tenant": tenant, "from": src_node,
                 "node": node, "broker": dst_broker, "chips": chips,
                 "epoch": out.get("epoch"),
@@ -734,6 +824,9 @@ class NodeAgent(threading.Thread):
         self._halt = threading.Event()
         self.joined = False
         self.generation: Optional[int] = None
+        # Dial attempts (tests assert the fail-static backoff bounds
+        # this: a dead coordinator must not cause a reconnect storm).
+        self.dials = 0
 
     def stop(self) -> None:
         self._halt.set()
@@ -746,6 +839,7 @@ class NodeAgent(threading.Thread):
     def run(self) -> None:
         while not self._halt.is_set():
             try:
+                self.dials += 1
                 with socket.socket(socket.AF_UNIX,
                                    socket.SOCK_STREAM) as s:
                     s.settimeout(max(self.hb_s * 4.0, 2.0))

@@ -84,6 +84,7 @@ lock-order ground truth (vtpu-analyze):
         order: lease.mu > region.lock
         order: bridge.global_mu > bridge.mu
         order: bridge.fn_mu > bridge.mu
+        order: coord.mu > journal.mu
         leaf: region.lock, journal.mu, flight.mu, put_cache_mu
         leaf: session.send_mu, session.pending_cond, bridge.mu
         leaf: batch.mu, slo.mu
@@ -100,6 +101,11 @@ lock-order ground truth (vtpu-analyze):
     ``slo.mu`` guards the always-on SLO plane (runtime/slo.py):
     strictly leaf — ``SloPlane.record`` is called from the metering /
     retire paths holding NO broker lock and never calls back out.
+    ``coord.mu`` is the cluster coordinator's ledger lock
+    (runtime/cluster.py): placement paths hold it across the
+    inventory snapshot, the placement choice AND the journal append
+    (journal-before-ack), so the journal write under it is deliberate
+    — it is NOT in no-blocking-under.
 
     Deliberate NON-edges the checker enforces by omission:
     scheduler.mu and tenant.mu are unordered siblings — the dispatcher

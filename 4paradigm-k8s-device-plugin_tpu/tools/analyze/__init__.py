@@ -35,6 +35,14 @@ has grown hand-maintained contracts that generic linters cannot see:
     ``shim/core.py`` ctypes mirrors match the C struct layouts
     field-for-field (offset/size) — the dynamic half is the
     ``tools/wmm`` litmus explorer.
+  - **clusterproto** — the static half of vtpu-dmc: every federation
+    verb in ``runtime/cluster.py`` must be registered in
+    ``CLUSTER_VERBS`` with a dispatch arm, a sender binding and an
+    idempotency class matching the dance grammar declared in the
+    cluster module docstring; every journaled cluster op must have a
+    replay arm and a reserve/release pairing; dance-message
+    idempotency must agree with ``protocol.py``'s retry tables — the
+    dynamic half is the ``tools/dmc`` network-fault explorer.
 
 Run as ``python -m vtpu.tools.analyze`` or ``vtpu-smi analyze``; CI runs
 it in the ``analyze`` job and fails on any finding.  There is NO
@@ -65,7 +73,7 @@ PKG_NAME = os.path.basename(PKG_DIR)
 @dataclass(frozen=True)
 class Finding:
     checker: str   # locks | verbs | envflags | journal | excsafety
-    #              # | wirefields | atomics
+    #              # | wirefields | atomics | clusterproto
     path: str      # repo-relative
     line: int
     message: str
@@ -86,12 +94,12 @@ def read_text(root: str, relpath: str) -> Optional[str]:
 
 
 def run_all(root: Optional[str] = None) -> List[Finding]:
-    from . import (atomics, envflags, excsafety, journal_schema, locks,
-                   verbs, wirefields)
+    from . import (atomics, clusterproto, envflags, excsafety,
+                   journal_schema, locks, verbs, wirefields)
     root = root or REPO_ROOT
     out: List[Finding] = []
     for mod in (locks, verbs, envflags, journal_schema, excsafety,
-                wirefields, atomics):
+                wirefields, atomics, clusterproto):
         out.extend(mod.check(root))
     return out
 

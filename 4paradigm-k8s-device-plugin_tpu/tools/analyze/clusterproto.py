@@ -1,0 +1,543 @@
+"""Cluster-federation protocol effect checker.
+
+The federation plane (runtime/cluster.py) is a distributed protocol:
+coordinator wire verbs (``CL_*``), the MIGRATE_OUT/MIGRATE_IN dance
+against broker admin sockets, and a journaled ledger replayed through
+``cluster_apply_record``.  PR 16's review hand-found five real bugs in
+exactly the seams nothing machine-checks — a verb without a replay
+arm, a reservation without a release, an abort arm that skips a
+rollback.  This checker proves the seams against the dance grammar
+declared in cluster.py's module docstring (the same
+docstring-as-ground-truth pattern as the lock-order block in
+runtime/server.py), per verb / message / record:
+
+  - every ``CL_*`` constant is registered in ``CLUSTER_VERBS`` and in
+    exactly one of ``CLUSTER_IDEMPOTENT_VERBS`` /
+    ``CLUSTER_NONIDEMPOTENT_VERBS``;
+  - every registered verb has a ``Coordinator.dispatch`` arm, at
+    least one sender binding (NodeAgent / module helpers / vtpu-smi /
+    the mc cluster engine / the federation traffic cell) and a
+    ``verb:`` grammar row whose idempotency class matches the
+    registry;
+  - every journaled op (every ``{"op": ...}`` literal cluster.py
+    appends) has a replay arm in ``cluster_apply_record``, a
+    ``record:`` grammar row, and — via the row's ``pairs:`` /
+    ``phases:`` clauses — a reserve/release pairing: a declared pair
+    op must itself replay, and a record with a ``begin`` phase must
+    declare (and replay) both ``commit`` and ``abort``;
+  - every dance message named in the ``dance-commit:`` /
+    ``dance-abort:`` sequences has a ``dance-msg:`` idempotency
+    declaration consistent with runtime/protocol.py's
+    ``IDEMPOTENT_VERBS`` / ``NONIDEMPOTENT_VERBS`` tables (the
+    re-drive contract tools/dmc enforces dynamically).
+
+No baseline, no suppressions: a finding fails CI until the code or
+the declared grammar is fixed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from . import Finding, read_text, PKG_NAME
+
+CLUSTER = f"{PKG_NAME}/runtime/cluster.py"
+PROTOCOL = f"{PKG_NAME}/runtime/protocol.py"
+# Where sender bindings may live (dict literals whose "kind" is a
+# CL_* constant): the NodeAgent/helpers in cluster.py itself, the
+# operator CLI, the mc cluster engine's canned session, and the
+# federation traffic cell.
+SENDER_FILES = [
+    CLUSTER,
+    f"{PKG_NAME}/tools/vtpu_smi.py",
+    f"{PKG_NAME}/tools/mc/clustercut.py",
+    "benchmarks/traffic_sim.py",
+]
+
+GT_HEADER = "cluster-dance ground truth (vtpu-analyze):"
+
+REGISTRY = "CLUSTER_VERBS"
+IDEM_REGISTRY = "CLUSTER_IDEMPOTENT_VERBS"
+NONIDEM_REGISTRY = "CLUSTER_NONIDEMPOTENT_VERBS"
+
+
+def _f(path: str, line: int, msg: str) -> Finding:
+    return Finding("clusterproto", path, line, msg)
+
+
+# -- ground truth ---------------------------------------------------------
+
+class Grammar:
+    def __init__(self) -> None:
+        # verb value -> (idempotency class, journaled op or "-")
+        self.verbs: Dict[str, Tuple[str, str]] = {}
+        self.dances: Set[str] = set()
+        # dance message name -> (idempotency class, owner)
+        self.dance_msgs: Dict[str, Tuple[str, str]] = {}
+        # message names appearing in dance-commit / dance-abort rows
+        self.dance_seq_msgs: Set[str] = set()
+        # record op -> {"owner", "pairs", "phases"}
+        self.records: Dict[str, Dict[str, Any]] = {}
+
+
+def parse_grammar(cluster_src: str) -> Optional[Grammar]:
+    """Pull the dance grammar out of the cluster module docstring."""
+    try:
+        tree = ast.parse(cluster_src)
+    except SyntaxError:
+        return None
+    doc = ast.get_docstring(tree) or ""
+    if GT_HEADER not in doc:
+        return None
+    g = Grammar()
+    block = doc.split(GT_HEADER, 1)[1]
+    for raw in block.splitlines():
+        line = raw.strip()
+        m = re.match(r"verb:\s*(\w+)\s+(idempotent|non-idempotent)"
+                     r"\s+journals:\s*(\S+)", line)
+        if m:
+            g.verbs[m.group(1)] = (m.group(2), m.group(3))
+            continue
+        m = re.match(r"dance:\s*(\w+)\s*$", line)
+        if m:
+            g.dances.add(m.group(1))
+            continue
+        m = re.match(r"dance-(?:commit|abort):\s*(.+)", line)
+        if m:
+            for step in m.group(1).split("->"):
+                sm = re.match(r"\s*(\w+)", step)
+                if sm:
+                    g.dance_seq_msgs.add(sm.group(1))
+            continue
+        m = re.match(r"dance-msg:\s*(\w+)\s+(idempotent|non-idempotent)"
+                     r"\s+owner:\s*(\w+)", line)
+        if m:
+            g.dance_msgs[m.group(1)] = (m.group(2), m.group(3))
+            continue
+        m = re.match(r"record:\s*(\w+)\s+owner:\s*(\w+)"
+                     r"(?:\s+pairs:\s*(\w+))?"
+                     r"(?:\s+phases:\s*(.+))?", line)
+        if m:
+            phases = None
+            if m.group(4):
+                phases = re.findall(r"\w+", m.group(4))
+            g.records[m.group(1)] = {"owner": m.group(2),
+                                     "pairs": m.group(3),
+                                     "phases": phases}
+    return g
+
+
+# -- cluster.py facts -----------------------------------------------------
+
+def _module_assigns(tree: ast.Module) -> Dict[str, ast.Assign]:
+    out: Dict[str, ast.Assign] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node
+    return out
+
+
+def verb_constants(tree: ast.Module) -> Dict[str, Tuple[str, int]]:
+    """{constant name: (wire value, line)} for module-level CL_*."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for name, node in _module_assigns(tree).items():
+        if not name.startswith("CL_"):
+            continue
+        val = node.value
+        if isinstance(val, ast.Constant) and isinstance(val.value, str):
+            out[name] = (val.value, node.lineno)
+    return out
+
+
+def registry_names(tree: ast.Module, registry: str
+                   ) -> Optional[Tuple[List[str], int]]:
+    node = _module_assigns(tree).get(registry)
+    if node is None or not isinstance(node.value,
+                                      (ast.Tuple, ast.List)):
+        return None
+    names = [el.id for el in node.value.elts
+             if isinstance(el, ast.Name)]
+    return names, node.lineno
+
+
+def _find_method(tree: ast.AST, cls: str, fn: str
+                 ) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) and sub.name == fn:
+                    return sub
+    return None
+
+
+def _find_function(tree: ast.AST, fn: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == fn:
+            return node
+    return None
+
+
+def dispatch_arms(fn: ast.FunctionDef,
+                  consts: Set[str]) -> Dict[str, int]:
+    """{CL_* constant name: line} for every ``kind == CL_X``
+    comparison in Coordinator.dispatch (bare-Name comparators — the
+    constants live in this module, unlike the broker's ``P.X``)."""
+    arms: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        for part in [node.left] + list(node.comparators):
+            if isinstance(part, ast.Name) and part.id in consts:
+                arms.setdefault(part.id, node.lineno)
+            elif isinstance(part, (ast.Tuple, ast.List)):
+                for el in part.elts:
+                    if isinstance(el, ast.Name) and el.id in consts:
+                        arms.setdefault(el.id, node.lineno)
+    return arms
+
+
+def sender_bindings(src: str, consts: Set[str]) -> Set[str]:
+    """CL_* constant names used as the ``"kind"`` of a sent message
+    dict — matches both the bare ``CL_X`` spelling (inside
+    cluster.py) and the ``CL.CL_X`` / ``cl.CL_X`` attribute spelling
+    (every other sender imports the module)."""
+    bound: Set[str] = set()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return bound
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key, val in zip(node.keys, node.values):
+            if not (isinstance(key, ast.Constant)
+                    and key.value == "kind"):
+                continue
+            if isinstance(val, ast.Name) and val.id in consts:
+                bound.add(val.id)
+            elif isinstance(val, ast.Attribute) and val.attr in consts:
+                bound.add(val.attr)
+    return bound
+
+
+def journaled_ops(tree: ast.Module) -> Dict[str, int]:
+    """{op value: line} for every ``{"op": "<x>", ...}`` dict literal
+    in cluster.py — the records the coordinator appends."""
+    out: Dict[str, int] = {}
+    apply_fn = _find_function(tree, "cluster_apply_record")
+    within_apply = set()
+    if apply_fn is not None:
+        within_apply = {id(n) for n in ast.walk(apply_fn)}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict) or id(node) in within_apply:
+            continue
+        for key, val in zip(node.keys, node.values):
+            if isinstance(key, ast.Constant) and key.value == "op" \
+                    and isinstance(val, ast.Constant) \
+                    and isinstance(val.value, str):
+                out.setdefault(val.value, node.lineno)
+    return out
+
+
+def replay_arms(tree: ast.Module
+                ) -> Tuple[Dict[str, int], Dict[str, Set[str]]]:
+    """({op: line} for every ``op == "<x>"`` arm in
+    cluster_apply_record, {op: phase strings compared inside that
+    op's arm})."""
+    fn = _find_function(tree, "cluster_apply_record")
+    if fn is None:
+        return {}, {}
+    arms: Dict[str, int] = {}
+    phases: Dict[str, Set[str]] = {}
+
+    def _cmp_values(node: ast.Compare, var: str) -> List[str]:
+        parts = [node.left] + list(node.comparators)
+        if not any(isinstance(p, ast.Name) and p.id == var
+                   for p in parts):
+            return []
+        return [p.value for p in parts
+                if isinstance(p, ast.Constant)
+                and isinstance(p.value, str)]
+
+    def _walk_ifs(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.If) or \
+                    not isinstance(sub.test, ast.Compare):
+                continue
+            for op in _cmp_values(sub.test, "op"):
+                arms.setdefault(op, sub.test.lineno)
+                ph = phases.setdefault(op, set())
+                # Scan the arm's BODY only: elif chains nest in
+                # orelse, so walking the whole If would credit this
+                # op with every later arm's phase comparisons.
+                for stmt in sub.body:
+                    for inner in ast.walk(stmt):
+                        if isinstance(inner, ast.Compare):
+                            ph.update(_cmp_values(inner, "phase"))
+    _walk_ifs(fn)
+    return arms, phases
+
+
+# -- protocol.py consistency ----------------------------------------------
+
+def protocol_idempotency(protocol_src: str
+                         ) -> Tuple[Set[str], Set[str]]:
+    """(idempotent wire values, non-idempotent wire values) from
+    runtime/protocol.py's retry-class registries."""
+    try:
+        tree = ast.parse(protocol_src)
+    except SyntaxError:
+        return set(), set()
+    consts: Dict[str, str] = {}
+    regs: Dict[str, List[str]] = {}
+    for name, node in _module_assigns(tree).items():
+        val = node.value
+        if isinstance(val, ast.Constant) and isinstance(val.value, str):
+            consts[name] = val.value
+        elif name in ("IDEMPOTENT_VERBS", "NONIDEMPOTENT_VERBS") and \
+                isinstance(val, (ast.Tuple, ast.List)):
+            regs[name] = [el.id for el in val.elts
+                          if isinstance(el, ast.Name)]
+    idem = {consts[n] for n in regs.get("IDEMPOTENT_VERBS", [])
+            if n in consts}
+    nonidem = {consts[n] for n in regs.get("NONIDEMPOTENT_VERBS", [])
+               if n in consts}
+    return idem, nonidem
+
+
+# -- the checker ----------------------------------------------------------
+
+def check_texts(cluster_src: str, protocol_src: str,
+                senders: Dict[str, str]) -> List[Finding]:
+    """Pure text-level check (tests feed fixture snippets).
+
+    ``senders`` maps relpath -> source for every file sender bindings
+    may live in; ``cluster_src`` is implicitly scanned too."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(cluster_src)
+    except SyntaxError as e:
+        return [_f(CLUSTER, e.lineno or 1, f"syntax error: {e.msg}")]
+
+    grammar = parse_grammar(cluster_src)
+    if grammar is None:
+        return [_f(CLUSTER, 1,
+                   f"module docstring has no `{GT_HEADER}` block — "
+                   f"the dance grammar must be declared")]
+
+    consts = verb_constants(tree)
+    const_names = set(consts)
+    if not consts:
+        findings.append(_f(CLUSTER, 1, "no CL_* verb constants found"))
+
+    # -- registries: membership + idempotency partition --
+    reg = registry_names(tree, REGISTRY)
+    if reg is None:
+        return findings + [_f(CLUSTER, 1,
+                              f"verb registry {REGISTRY} is missing "
+                              f"(tuple of CL_* constants)")]
+    reg_names, reg_line = reg
+    for name, (_value, line) in sorted(consts.items()):
+        if name not in reg_names:
+            findings.append(_f(CLUSTER, line,
+                               f"verb {name} is not registered in "
+                               f"{REGISTRY}"))
+    for name in reg_names:
+        if name not in const_names:
+            findings.append(_f(CLUSTER, reg_line,
+                               f"{REGISTRY} names unknown verb "
+                               f"constant {name}"))
+    idem_reg = registry_names(tree, IDEM_REGISTRY)
+    nonidem_reg = registry_names(tree, NONIDEM_REGISTRY)
+    idem_names = set(idem_reg[0]) if idem_reg else set()
+    nonidem_names = set(nonidem_reg[0]) if nonidem_reg else set()
+    if idem_reg is None or nonidem_reg is None:
+        missing = [r for r, v in ((IDEM_REGISTRY, idem_reg),
+                                  (NONIDEM_REGISTRY, nonidem_reg))
+                   if v is None]
+        findings.append(_f(CLUSTER, reg_line,
+                           f"idempotency registries missing: "
+                           f"{', '.join(missing)}"))
+    else:
+        for name in sorted(set(reg_names)
+                           - (idem_names | nonidem_names)):
+            findings.append(_f(
+                CLUSTER, reg_line,
+                f"verb {name} has no idempotency declaration "
+                f"(neither {IDEM_REGISTRY} nor {NONIDEM_REGISTRY})"))
+        for name in sorted(idem_names & nonidem_names):
+            findings.append(_f(
+                CLUSTER, reg_line,
+                f"verb {name} declared BOTH idempotent and "
+                f"non-idempotent"))
+        for name in sorted((idem_names | nonidem_names)
+                           - set(reg_names)):
+            findings.append(_f(
+                CLUSTER, reg_line,
+                f"idempotency registries name {name} which is not "
+                f"in {REGISTRY}"))
+
+    # -- grammar rows vs registry --
+    for name in reg_names:
+        if name not in const_names:
+            continue
+        value, line = consts[name]
+        row = grammar.verbs.get(value)
+        if row is None:
+            findings.append(_f(CLUSTER, line,
+                               f"verb {name} ({value!r}) has no "
+                               f"`verb:` row in the dance grammar"))
+            continue
+        declared = row[0]
+        actual = ("idempotent" if name in idem_names else
+                  "non-idempotent" if name in nonidem_names else None)
+        if actual is not None and declared != actual:
+            findings.append(_f(
+                CLUSTER, line,
+                f"verb {name}: grammar declares {declared} but the "
+                f"registry says {actual}"))
+    known_values = {consts[n][0] for n in reg_names
+                    if n in const_names}
+    for value in sorted(set(grammar.verbs) - known_values):
+        findings.append(_f(CLUSTER, 1,
+                           f"grammar `verb: {value}` row matches no "
+                           f"registered verb constant"))
+
+    # -- dispatch arms --
+    dispatch = _find_method(tree, "Coordinator", "dispatch")
+    if dispatch is None:
+        findings.append(_f(CLUSTER, 1,
+                           "Coordinator.dispatch not found"))
+    else:
+        arms = dispatch_arms(dispatch, const_names)
+        for name in reg_names:
+            if name in const_names and name not in arms:
+                findings.append(_f(
+                    CLUSTER, consts[name][1],
+                    f"verb {name} has no Coordinator.dispatch arm"))
+
+    # -- sender bindings --
+    bound: Set[str] = sender_bindings(cluster_src, const_names)
+    for _rel, src in sorted(senders.items()):
+        bound |= sender_bindings(src, const_names)
+    for name in reg_names:
+        if name in const_names and name not in bound:
+            findings.append(_f(
+                CLUSTER, consts[name][1],
+                f"verb {name} has no sender binding in "
+                f"{', '.join([CLUSTER] + sorted(senders))}"))
+
+    # -- journal records: replay arms + grammar rows + pairings --
+    appended = journaled_ops(tree)
+    arms_ops, arm_phases = replay_arms(tree)
+    for op, line in sorted(appended.items()):
+        if op not in arms_ops:
+            findings.append(_f(
+                CLUSTER, line,
+                f"journaled op {op!r} has no replay arm in "
+                f"cluster_apply_record (a crash would forget it)"))
+        if op not in grammar.records:
+            findings.append(_f(
+                CLUSTER, line,
+                f"journaled op {op!r} has no `record:` row in the "
+                f"dance grammar"))
+    for op in sorted(set(grammar.records) - set(appended)):
+        findings.append(_f(CLUSTER, 1,
+                           f"grammar `record: {op}` row matches no "
+                           f"appended journal record"))
+    for op, row in sorted(grammar.records.items()):
+        pair = row.get("pairs")
+        if pair is not None:
+            if pair not in grammar.records:
+                findings.append(_f(
+                    CLUSTER, 1,
+                    f"record {op!r} pairs with undeclared record "
+                    f"{pair!r}"))
+            if pair not in arms_ops:
+                findings.append(_f(
+                    CLUSTER, 1,
+                    f"record {op!r} pairs with {pair!r} which has no "
+                    f"replay arm (reserve without release)"))
+        declared_phases = row.get("phases")
+        if declared_phases:
+            if "begin" in declared_phases:
+                for need in ("commit", "abort"):
+                    if need not in declared_phases:
+                        findings.append(_f(
+                            CLUSTER, 1,
+                            f"record {op!r} declares a `begin` phase "
+                            f"but no `{need}` (a reservation nobody "
+                            f"can settle)"))
+            have = arm_phases.get(op, set())
+            for ph in declared_phases:
+                if ph not in have:
+                    findings.append(_f(
+                        CLUSTER, 1,
+                        f"record {op!r} declares phase {ph!r} with "
+                        f"no replay arm for it"))
+    # Every verb's declared journal op must exist.
+    for value, (_cls, jop) in sorted(grammar.verbs.items()):
+        if jop == "-":
+            continue
+        if jop not in grammar.records:
+            findings.append(_f(
+                CLUSTER, 1,
+                f"verb {value!r} journals {jop!r} which has no "
+                f"`record:` row"))
+        if jop not in arms_ops:
+            findings.append(_f(
+                CLUSTER, 1,
+                f"verb {value!r} journals {jop!r} which has no "
+                f"replay arm in cluster_apply_record"))
+
+    # -- dance messages vs protocol.py retry classes --
+    for msg in sorted(grammar.dance_seq_msgs):
+        if msg not in grammar.dance_msgs:
+            findings.append(_f(
+                CLUSTER, 1,
+                f"dance message {msg!r} has no `dance-msg:` "
+                f"idempotency declaration"))
+    for verb in sorted(grammar.dances):
+        if verb in grammar.verbs and \
+                grammar.verbs[verb][0] != "non-idempotent":
+            findings.append(_f(
+                CLUSTER, 1,
+                f"dance verb {verb!r} must be non-idempotent (each "
+                f"delivery drives a fresh dance)"))
+    p_idem, p_nonidem = protocol_idempotency(protocol_src)
+    for msg, (cls, _owner) in sorted(grammar.dance_msgs.items()):
+        if cls == "idempotent" and msg in p_nonidem:
+            findings.append(_f(
+                CLUSTER, 1,
+                f"dance message {msg!r} declared idempotent here but "
+                f"protocol.py lists it in NONIDEMPOTENT_VERBS"))
+        elif cls == "idempotent" and p_idem and msg not in p_idem:
+            findings.append(_f(
+                CLUSTER, 1,
+                f"dance message {msg!r} declared idempotent here but "
+                f"protocol.py's IDEMPOTENT_VERBS does not carry it "
+                f"(the client retry layer would not re-drive it)"))
+        elif cls == "non-idempotent" and msg in p_idem:
+            findings.append(_f(
+                CLUSTER, 1,
+                f"dance message {msg!r} declared non-idempotent here "
+                f"but protocol.py lists it in IDEMPOTENT_VERBS"))
+    return findings
+
+
+def check(root: str) -> List[Finding]:
+    cluster_src = read_text(root, CLUSTER)
+    protocol_src = read_text(root, PROTOCOL)
+    if cluster_src is None or protocol_src is None:
+        return []
+    senders = {}
+    for rel in SENDER_FILES:
+        if rel == CLUSTER:
+            continue
+        text = read_text(root, rel)
+        if text is not None:
+            senders[rel] = text
+    return check_texts(cluster_src, protocol_src, senders)

@@ -48,6 +48,7 @@ ANALYZED = [
     f"{PKG_NAME}/runtime/server.py",
     f"{PKG_NAME}/runtime/client.py",
     f"{PKG_NAME}/runtime/journal.py",
+    f"{PKG_NAME}/runtime/cluster.py",
     f"{PKG_NAME}/runtime/trace.py",
     f"{PKG_NAME}/shim/bridge.py",
     f"{PKG_NAME}/shim/core.py",

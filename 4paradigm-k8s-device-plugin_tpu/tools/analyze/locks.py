@@ -41,6 +41,7 @@ ANALYZED = [
     f"{PKG_NAME}/runtime/server.py",
     f"{PKG_NAME}/runtime/client.py",
     f"{PKG_NAME}/runtime/journal.py",
+    f"{PKG_NAME}/runtime/cluster.py",
     f"{PKG_NAME}/runtime/slo.py",
     f"{PKG_NAME}/runtime/trace.py",
     f"{PKG_NAME}/shim/bridge.py",
@@ -60,6 +61,7 @@ CLASS_LOCKS: Dict[Tuple[str, str], str] = {
     ("TenantSession", "send_mu"): "session.send_mu",
     ("TenantSession", "pending_cond"): "session.pending_cond",
     ("Journal", "mu"): "journal.mu",
+    ("Coordinator", "mu"): "coord.mu",
     ("FlightRecorder", "mu"): "flight.mu",
     ("SloPlane", "mu"): "slo.mu",
     ("Bridge", "_mu"): "bridge.mu",
@@ -84,6 +86,7 @@ CHAIN_LOCKS: Dict[Tuple[str, str], str] = {
     ("state", "chips_mu"): "chips_mu",
     ("tenant", "mu"): "tenant.mu",
     ("t", "mu"): "tenant.mu",
+    ("coord", "mu"): "coord.mu",
     ("pending_cond", ""): "session.pending_cond",
 }
 

@@ -1,0 +1,24 @@
+"""vtpu-dmc: distributed model checking of the cluster federation
+protocol (docs/ANALYSIS.md "Distributed model checking").
+
+The dynamic half of the federation-protocol contract — the static
+half is the ``clusterproto`` checker in ``tools/analyze``.  The REAL
+coordinator (``runtime/cluster.py``: dispatch arms, journal, fence,
+the MIGRATE dance) runs under exhaustive network nondeterminism:
+every cross-node message may be delivered, delayed past others,
+duplicated or dropped, the coordinator may crash-restart (real
+journal recovery + fence bump) and nodes may die mid-schedule — all
+within a small CHESS-style fault budget, with DPOR sleep-set pruning
+over commuting deliveries.  The ``dmc``-engine rows of the single
+invariant registry (``tools/mc/invariants.py``) judge every explored
+schedule: no double grant, at least one full copy, no orphan copy,
+reservation conservation, fenced coordinators never ack, and
+re-drive idempotence checked by construction on every message.
+
+Run as ``python -m vtpu.tools.dmc`` or ``vtpu-smi dmc``; CI runs the
+full exploration (floor-gated) plus the seeded-violation selfcheck.
+"""
+
+from __future__ import annotations
+
+from .cli import main  # noqa: F401  (python -m vtpu.tools.dmc)

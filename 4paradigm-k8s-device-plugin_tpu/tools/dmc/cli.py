@@ -1,0 +1,149 @@
+"""vtpu-dmc command line — scenarios, budgets, floor gate, selfcheck.
+
+Exploration is fully deterministic (DFS over delivery/fate decisions;
+no randomness anywhere), so CI needs no seed pinning: the same tree +
+the same budget flags explore the same schedules.  The CI ``dmc`` job
+prints the explored-schedule counts and floor-gates them
+(``--min-schedules``): a refactor that silently shrinks the explored
+space — a scenario that stopped branching, a budget knob regression —
+fails loudly instead of shipping a weaker checker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from . import explore, selfcheck
+
+
+def _run_suite(ns: argparse.Namespace) -> Dict[str, Any]:
+    wanted = [explore.get(ns.scenario)] if ns.scenario \
+        else list(explore.SCENARIOS)
+    out: Dict[str, Any] = {"scenarios": {}, "schedules": 0,
+                           "decisions": 0, "violations": []}
+    for scen in wanted:
+        stats = explore.explore_scenario(
+            scen, max_schedules=ns.max_schedules,
+            max_faults=ns.max_faults, max_steps=ns.max_steps)
+        out["scenarios"][scen.name] = {
+            "schedules": stats.schedules,
+            "decisions": stats.decisions,
+            "truncated": stats.truncated,
+            "violations": stats.violations,
+            "witness": stats.witness,
+        }
+        out["schedules"] += stats.schedules
+        out["decisions"] += stats.decisions
+        out["violations"].extend(
+            f"{scen.name}: {v}" for v in stats.violations)
+    return out
+
+
+def _run_selfcheck(ns: argparse.Namespace) -> int:
+    results = selfcheck.run_all(max_schedules=ns.max_schedules)
+    missed = [s.name for s, caught, _n in results if not caught]
+    for seed, caught, n in results:
+        mark = "caught" if caught else "MISSED"
+        print(f"  seed {seed.name:32s} -> {seed.invariant:32s} "
+              f"{mark} ({n} violation(s))")
+    if missed:
+        print(f"vtpu-dmc selfcheck: {len(missed)} seed(s) NOT "
+              f"caught: {missed}")
+        return 1
+    print(f"vtpu-dmc selfcheck: all {len(results)} seeded "
+          f"coordinator bugs caught")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="vtpu-dmc",
+        description="distributed model checking of the cluster "
+                    "federation protocol (docs/ANALYSIS.md)")
+    ap.add_argument("--scenario", default=None,
+                    help="run one scenario by name")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and selfcheck seeds, then "
+                         "exit")
+    ap.add_argument("--max-schedules", type=int, default=None,
+                    help="schedule budget PER scenario "
+                         "(deterministic DFS; default "
+                         "VTPU_DMC_MAX_SCHEDULES or "
+                         f"{explore.DEFAULT_MAX_SCHEDULES})")
+    ap.add_argument("--max-faults", type=int, default=None,
+                    help="network/crash fault budget per schedule "
+                         "(default VTPU_DMC_MAX_FAULTS or "
+                         f"{explore.DEFAULT_MAX_FAULTS}; fault-free "
+                         "delivery choices are never bounded)")
+    ap.add_argument("--max-steps", type=int, default=None,
+                    help="top-level step cap per schedule (default "
+                         "VTPU_DMC_MAX_STEPS or "
+                         f"{explore.DEFAULT_MAX_STEPS})")
+    ap.add_argument("--min-schedules", type=int, default=0,
+                    help="fail unless the suite explored at least "
+                         "this many schedules in total (CI floor "
+                         "gate)")
+    ap.add_argument("--selfcheck", action="store_true",
+                    help="run the seeded-violation matrix instead: "
+                         "every broken coordinator variant must be "
+                         "caught by its invariant row")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny budget: the analyze-job wiring check, "
+                         "not the real exploration")
+    ap.add_argument("--json", action="store_true")
+    ns = ap.parse_args(argv)
+
+    if ns.list:
+        print("scenarios:")
+        for scen in explore.SCENARIOS:
+            print(f"  {scen.name:16s} {scen.description}")
+        print("selfcheck seeds:")
+        for seed in selfcheck.SEEDS:
+            print(f"  {seed.name:32s} -> {seed.invariant}")
+        return 0
+
+    if ns.smoke and ns.max_schedules is None:
+        ns.max_schedules = 25
+
+    if ns.selfcheck:
+        # The seed matrix needs enough schedules to reach each bug's
+        # witness; default deeper than the suite default.
+        if ns.max_schedules is None:
+            ns.max_schedules = 4000
+        return _run_selfcheck(ns)
+
+    if ns.max_schedules is None:
+        ns.max_schedules = explore.budget_env(
+            "VTPU_DMC_MAX_SCHEDULES", explore.DEFAULT_MAX_SCHEDULES)
+    if ns.max_faults is None:
+        ns.max_faults = explore.budget_env(
+            "VTPU_DMC_MAX_FAULTS", explore.DEFAULT_MAX_FAULTS)
+    if ns.max_steps is None:
+        ns.max_steps = explore.budget_env(
+            "VTPU_DMC_MAX_STEPS", explore.DEFAULT_MAX_STEPS)
+
+    report = _run_suite(ns)
+    if ns.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for name, s in report["scenarios"].items():
+            print(f"  dmc {name:16s} schedules={s['schedules']:6d} "
+                  f"decisions={s['decisions']:8d}"
+                  + (f" truncated={s['truncated']}"
+                     if s["truncated"] else ""))
+        print(f"  dmc TOTAL: {report['schedules']} schedules, "
+              f"{report['decisions']} decisions")
+        for v in report["violations"]:
+            print(f"VIOLATION: {v}")
+        print(f"vtpu-dmc: {len(report['violations'])} violation(s)")
+
+    if ns.min_schedules and report["schedules"] < ns.min_schedules:
+        print(f"vtpu-dmc: explored-schedule FLOOR MISSED: "
+              f"{report['schedules']} < --min-schedules "
+              f"{ns.min_schedules} — the explored space silently "
+              f"shrank", file=sys.stderr)
+        return 1
+    return 1 if report["violations"] else 0

@@ -1,0 +1,292 @@
+"""vtpu-dmc explorer: DFS over network-fault schedules of the real
+coordinator, with the same two prunings as the interleaving engine
+(tools/mc/interleave.py):
+
+  - **sleep sets** (DPOR-style): after exploring choice ``t`` at a
+    decision node, ``t`` sleeps there; an alternative only wakes it
+    when their footprints intersect (two placements share the
+    inventory; a heartbeat and a placement commute; any fault/crash
+    choice is conservatively dependent on everything).  Commuting
+    delivery orders are explored once, not n! times.
+  - **bounded faults** (the CHESS bound, re-targeted): every
+    dup/drop/lose/fail/crash/down choice costs one unit of a small
+    fault budget; fault-free delivery and mid-dance injection are
+    free.  Most distributed-protocol bugs need one or two faults, and
+    the bound turns the fate space into a dense, high-yield one.
+
+Every schedule replays the scenario from scratch (fresh temp journal
+dir, fresh REAL coordinator) following the recorded decision prefix,
+then runs the default policy (cheapest choice first) to quiescence —
+where the registry's ``dmc``/``net`` rows drain the world's buckets.
+Exploration is fully deterministic: the only nondeterminism IS the
+decision sequence, and a divergence on replay is reported as a
+harness bug, never ignored.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from ...runtime import cluster as CL
+from ...utils import logging as vlog
+from . import world as W
+
+DEFAULT_MAX_SCHEDULES = 2000
+DEFAULT_MAX_FAULTS = 2
+DEFAULT_MAX_STEPS = 60
+
+
+def budget_env(name: str, default: int) -> int:
+    """Budget knob with a VTPU_DMC_* env override (docs/FLAGS.md)."""
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class ReplayDivergence(RuntimeError):
+    """A scripted choice was not enabled on replay: the world is not
+    deterministic — a harness bug, reported loudly."""
+
+
+Footprint = Optional[FrozenSet[Tuple[str, ...]]]
+
+
+def _msg_footprint(payload: Dict) -> Footprint:
+    kind = payload.get("kind")
+    if kind == CL.CL_HB:
+        n = str(payload.get("node"))
+        return frozenset({("hb", n), ("node", n)})
+    if kind == CL.CL_JOIN:
+        return frozenset({("inv",), ("node", str(payload.get("node")))})
+    if kind in (CL.CL_PLACE, CL.CL_RELEASE, CL.CL_MIGRATE):
+        return frozenset({("inv",),
+                          ("tenant", str(payload.get("tenant")))})
+    if kind == CL.CL_STATUS:
+        return frozenset({("status",)})
+    return None
+
+
+def choice_footprint(world: W.World, choice: str) -> Footprint:
+    """What ledger state a choice touches.  ``None`` = unknown =
+    conservatively dependent on everything (all fault/crash/admin
+    choices: they reshape the reachable space)."""
+    head, _, rest = choice.partition(":")
+    if head in ("deliver", "dup", "drop"):
+        for m in world.pending:
+            if m.mid == rest:
+                return _msg_footprint(m.payload)
+        return None
+    return None
+
+
+def _dependent(fa: Footprint, fb: Footprint) -> bool:
+    if fa is None or fb is None:
+        return True   # unknown footprints: be conservative, stay sound
+    return bool(fa & fb)
+
+
+@dataclass
+class Node:
+    """One decision point along the current schedule."""
+    enabled: List[str]
+    foot: Dict[str, Footprint]
+    chosen: str
+    faults_before: int = 0
+    tried: set = field(default_factory=set)
+    sleep: set = field(default_factory=set)
+
+
+@dataclass
+class ScenarioStats:
+    name: str = ""
+    schedules: int = 0
+    decisions: int = 0
+    truncated: int = 0
+    violations: List[str] = field(default_factory=list)
+    # schedule (decision list) that produced the first violation
+    witness: Optional[List[str]] = None
+
+
+@dataclass
+class Scenario:
+    name: str
+    description: str
+    setup: Callable[[W.World], None]
+
+
+SCENARIOS: Tuple[Scenario, ...] = (
+    Scenario(
+        "federation",
+        "two pre-joined nodes; place/place/migrate/release/heartbeat/"
+        "late-join under every delivery order, duplication, drop, "
+        "coordinator crash-restart and node death within the fault "
+        "budget",
+        W.setup_federation),
+)
+
+
+def get(name: str) -> Scenario:
+    for s in SCENARIOS:
+        if s.name == name:
+            return s
+    raise KeyError(f"no dmc scenario {name!r}")
+
+
+class Explorer:
+    def __init__(self, scenario: Scenario, *,
+                 max_schedules: int = DEFAULT_MAX_SCHEDULES,
+                 max_faults: int = DEFAULT_MAX_FAULTS,
+                 max_steps: int = DEFAULT_MAX_STEPS) -> None:
+        self.scenario = scenario
+        self.max_schedules = max_schedules
+        self.max_faults = max_faults
+        self.max_steps = max_steps
+        self.stats = ScenarioStats(name=scenario.name)
+
+    # -- one schedule ------------------------------------------------------
+
+    def _run_once(self, script: List[str],
+                  nodes: List[Node]) -> List[str]:
+        """Execute the scenario following ``script``; extend ``nodes``
+        with the decision points actually taken (prefix nodes are
+        reused, fresh ones appended)."""
+        step_box = [0]
+        world_box: List[Optional[W.World]] = [None]
+
+        def choose(enabled: List[str]) -> str:
+            self.stats.decisions += 1
+            step = step_box[0]
+            step_box[0] += 1
+            ids = sorted(enabled)
+            world = world_box[0]
+            foot = {c: choice_footprint(world, c) for c in ids}
+            if step < len(nodes):
+                node = nodes[step]
+                if node.chosen not in ids:
+                    raise ReplayDivergence(
+                        f"{self.scenario.name}: step {step} scripted "
+                        f"choice {node.chosen!r} not enabled "
+                        f"(enabled={ids})")
+                node.enabled = ids
+                node.foot = foot
+                return node.chosen
+            # Past the script: default policy (cheapest choice
+            # first), recorded as a fresh node.
+            parent = nodes[-1] if nodes else None
+            sleep: set = set()
+            if parent is not None:
+                chosen_foot = parent.foot.get(parent.chosen)
+                sleep = {
+                    c for c in parent.sleep | (parent.tried
+                                               - {parent.chosen})
+                    if c in foot and not _dependent(
+                        foot.get(c), chosen_foot)}
+            free = [c for c in ids if W.World.choice_cost(c) == 0]
+            pick = free[0] if free else ids[0]
+            if pick in sleep:
+                awake = [c for c in ids if c not in sleep]
+                awake_free = [c for c in awake
+                              if W.World.choice_cost(c) == 0]
+                if awake_free:
+                    pick = awake_free[0]
+                elif awake:
+                    pick = awake[0]
+            node = Node(enabled=ids, foot=foot, chosen=pick,
+                        faults_before=world.faults)
+            node.tried.add(pick)
+            node.sleep = sleep
+            nodes.append(node)
+            return pick
+
+        world, tmp = W.make_world(self.max_faults, choose)
+        world_box[0] = world
+        violations: List[str] = []
+        truncated = False
+        try:
+            with world:
+                self.scenario.setup(world)
+                world.step_checks()
+                top_steps = 0
+                while world.pending:
+                    if top_steps >= self.max_steps:
+                        truncated = True
+                        break
+                    enabled = world.top_enabled()
+                    choice = choose(enabled)
+                    world.apply_top(choice)
+                    world.step_checks()
+                    top_steps += 1
+                if truncated:
+                    self.stats.truncated += 1
+                else:
+                    violations.extend(world.collect_violations())
+        finally:
+            W.destroy_world(world, tmp)
+        return violations
+
+    # -- DFS over schedules ------------------------------------------------
+
+    def explore(self) -> ScenarioStats:
+        # Thousands of schedules re-run the coordinator's node_down /
+        # takeover paths on purpose; their operator warnings are
+        # noise here.  Errors still print.
+        prev_level = vlog._cached_level
+        vlog._cached_level = vlog.LEVEL_ERROR
+        try:
+            return self._explore()
+        finally:
+            vlog._cached_level = prev_level
+
+    def _explore(self) -> ScenarioStats:
+        nodes: List[Node] = []
+        script: List[str] = []
+        while True:
+            try:
+                violations = self._run_once(script, nodes)
+            except ReplayDivergence as e:
+                self.stats.violations.append(f"[determinism] {e}")
+                self.stats.witness = list(script)
+                break
+            self.stats.schedules += 1
+            if violations:
+                self.stats.violations.extend(violations)
+                self.stats.witness = [n.chosen for n in nodes]
+                break
+            if self.stats.schedules >= self.max_schedules:
+                break
+            # Backtrack: deepest node with an unexplored, awake,
+            # budget-feasible alternative.
+            nxt = None
+            while nodes:
+                node = nodes[-1]
+                feasible = [
+                    c for c in node.enabled
+                    if c not in node.tried and c not in node.sleep
+                    and node.faults_before + W.World.choice_cost(c)
+                    <= self.max_faults]
+                if feasible:
+                    c = feasible[0]
+                    node.tried.add(c)
+                    new = Node(enabled=node.enabled, foot=node.foot,
+                               chosen=c,
+                               faults_before=node.faults_before)
+                    new.tried = node.tried  # shared explored set
+                    new.sleep = set(node.sleep)
+                    nodes[-1] = new
+                    nxt = [n.chosen for n in nodes]
+                    break
+                nodes.pop()
+            if nxt is None:
+                break  # space exhausted
+            script = nxt
+            nodes = nodes[:len(script)]
+            for n in nodes:
+                n.foot = dict(n.foot)
+        return self.stats
+
+
+def explore_scenario(scenario: Scenario, **kw) -> ScenarioStats:
+    return Explorer(scenario, **kw).explore()

@@ -1,0 +1,385 @@
+"""vtpu-dmc seeded-violation selfcheck.
+
+A distributed model checker that reports "0 violations" is only
+trustworthy if a DELIBERATELY broken coordinator makes it scream.
+Each seed below monkey-patches one REAL coordinator code path into a
+known-bad variant — the bug classes this tool exists for, several of
+them re-introductions of ordering holes the real tree has already
+been fixed against — runs the explorer, and requires the named
+registry row (tools/mc/invariants.py, engine ``dmc``) to fire within
+the budget.  ``python -m vtpu.tools.dmc --selfcheck`` runs the matrix
+(CI does); tests/test_dmc.py drives the same seeds individually.
+
+The patches live HERE, never in the coordinator: runtime/cluster.py
+stays correct, and a seed that stops firing means the CHECKER
+regressed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Tuple
+
+from ...runtime import cluster as CL
+from ...runtime import protocol as P
+from ...runtime import replication as repl_mod
+from . import explore
+
+
+@dataclass(frozen=True)
+class Seed:
+    name: str
+    engine: str               # always "dmc" (the registry union key)
+    invariant: str            # registry row expected to fire
+    scenario: str
+    bug: str                  # one-line description of the injected bug
+    patch: Callable[[], Any]  # contextmanager applying the broken code
+
+
+# ---------------------------------------------------------------------------
+# Broken placement paths
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _seed_stale_inventory() -> Iterator[None]:
+    """cluster_inventory reports every chip free (a stale cache that
+    never subtracts the ledger): two placements share a chip."""
+    orig = CL.cluster_inventory
+
+    def stale(state: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+        inv: Dict[str, Dict[str, Any]] = {}
+        for name, ent in (state.get("nodes") or {}).items():
+            if not ent.get("alive"):
+                continue
+            total = int(ent.get("chips") or 0)
+            inv[name] = {"free": list(range(total)), "total": total}
+        return inv
+
+    CL.cluster_inventory = stale
+    try:
+        yield
+    finally:
+        CL.cluster_inventory = orig
+
+
+@contextlib.contextmanager
+def _seed_reservation_blind() -> Iterator[None]:
+    """free_chips forgets the in-flight migration reservations
+    (state["migrating"]): the target chips of a running dance are
+    handed out while the commit is on the wire."""
+    orig = CL.free_chips
+
+    def blind(state: Dict[str, Any], node: str) -> List[int]:
+        ent = (state.get("nodes") or {}).get(node) or {}
+        per = (state.get("used") or {}).get(node) or {}
+        return [c for c in range(int(ent.get("chips") or 0))
+                if str(c) not in per]   # reservations dropped
+
+    CL.free_chips = blind
+    try:
+        yield
+    finally:
+        CL.free_chips = orig
+
+
+def _place_variant(journal: bool, idempotent: bool
+                   ) -> Callable[..., Dict[str, Any]]:
+    """The real ``Coordinator._place`` body with one bug injected:
+    ``journal=False`` acks after applying state WITHOUT the journal
+    append (ack outruns durability); ``idempotent=False`` drops the
+    existing-placement arm (a retried lost ack places again)."""
+
+    def _place(self: Any, msg: Dict[str, Any]) -> Dict[str, Any]:
+        tenant = str(msg["tenant"])
+        size = int(msg.get("chips") or 1)
+        policy = str(msg.get("policy") or self.policy)
+        with self.mu:
+            if idempotent:
+                existing = self.state["placements"].get(tenant)
+                if existing is not None:
+                    ent = self.state["nodes"] \
+                        .get(existing["node"]) or {}
+                    return {"ok": True, "tenant": tenant,
+                            "node": existing["node"],
+                            "broker": ent.get("broker"),
+                            "chips": list(existing["chips"]),
+                            "standby": None, "existing": True}
+            inv = CL.cluster_inventory(self.state)
+            node, chips, _standby = CL.cluster_choose_placement(
+                inv, size, policy=policy)
+            if node is None:
+                return {"ok": False, "code": "NO_CAPACITY",
+                        "error": f"no live node has {size} "
+                                 f"free chip(s)", "retry_ms": 500}
+            rec = {"op": "cgrant", "tenant": tenant, "node": node,
+                   "chips": chips, "hbm": msg.get("hbm")}
+            if journal:
+                self._append_locked(rec)
+            else:
+                CL.cluster_apply_record(self.state, rec)  # never
+                #                                         # journaled
+            broker = (self.state["nodes"].get(node) or {}) \
+                .get("broker")
+        return {"ok": True, "tenant": tenant, "node": node,
+                "broker": broker, "chips": chips, "standby": None}
+
+    return _place
+
+
+@contextlib.contextmanager
+def _seed_ack_before_journal() -> Iterator[None]:
+    orig = CL.Coordinator._place
+    CL.Coordinator._place = _place_variant(journal=False,
+                                           idempotent=True)
+    try:
+        yield
+    finally:
+        CL.Coordinator._place = orig
+
+
+@contextlib.contextmanager
+def _seed_nonidempotent_place() -> Iterator[None]:
+    orig = CL.Coordinator._place
+    CL.Coordinator._place = _place_variant(journal=True,
+                                           idempotent=False)
+    try:
+        yield
+    finally:
+        CL.Coordinator._place = orig
+
+
+# ---------------------------------------------------------------------------
+# Broken migration dances
+# ---------------------------------------------------------------------------
+
+def _migrate_variant(*, skip_in_abort: bool = False,
+                     teardown_before_journal: bool = False,
+                     skip_abort_journal: bool = False
+                     ) -> Callable[..., Dict[str, Any]]:
+    """The real ``Coordinator._migrate`` dance with one bug injected:
+
+    - ``skip_in_abort`` — the abort arm forgets to discard the parked
+      target copy (the orphan the resume-grace reaper exists for, but
+      here it leaks on EVERY abort, not just a dropped delivery).
+    - ``teardown_before_journal`` — the pre-fix ordering: the source
+      teardown runs INSIDE the try before the commit is journaled, so
+      a lost teardown ack aborts a dance whose source copy is already
+      gone (the zero-copy window).
+    - ``skip_abort_journal`` — the abort arm rolls the brokers back
+      but never journals ``cmigrate abort``: the begin reservation
+      leaks forever.
+    """
+
+    def _migrate(self: Any, msg: Dict[str, Any]) -> Dict[str, Any]:
+        tenant = str(msg["tenant"])
+        to_node = msg.get("node")
+        with self.mu:
+            p = self.state["placements"].get(tenant)
+            if p is None:
+                return {"ok": False, "code": "NOT_FOUND",
+                        "error": f"tenant {tenant!r} has no cluster "
+                                 f"placement"}
+            src_node = p["node"]
+            width = len(p.get("chips") or [])
+            src_ent = self.state["nodes"].get(src_node) or {}
+            inv = CL.cluster_inventory(self.state)
+            inv.pop(src_node, None)
+            if to_node is not None:
+                inv = {k: v for k, v in inv.items()
+                       if k == str(to_node)}
+            node, chips, _sb = CL.cluster_choose_placement(
+                inv, max(width, 1),
+                policy=str(msg.get("policy") or self.policy))
+            if node is None:
+                return {"ok": False, "code": "NO_CAPACITY",
+                        "error": "no live target node",
+                        "retry_ms": 500}
+            src_broker = src_ent.get("broker")
+            dst_broker = (self.state["nodes"].get(node)
+                          or {}).get("broker")
+            self._append_locked({"op": "cmigrate", "tenant": tenant,
+                                 "phase": "begin", "to_node": node,
+                                 "to_chips": chips})
+        try:
+            out = self._admin(src_broker + ".admin",
+                              {"kind": P.MIGRATE_OUT,
+                               "tenant": tenant, "phase": "begin"})
+            if not out.get("ok"):
+                raise RuntimeError(
+                    f"{out.get('code')}: {out.get('error')}")
+            rin = self._admin(dst_broker + ".admin",
+                              {"kind": P.MIGRATE_IN, "tenant": tenant,
+                               "state": out.get("state"),
+                               "blobs": out.get("blobs"),
+                               "devices": chips})
+            if not rin.get("ok"):
+                raise RuntimeError(
+                    f"{rin.get('code')}: {rin.get('error')}")
+            if teardown_before_journal:
+                fin = self._admin(src_broker + ".admin",
+                                  {"kind": P.MIGRATE_OUT,
+                                   "tenant": tenant,
+                                   "phase": "commit"})
+                if not fin.get("ok"):
+                    raise RuntimeError(
+                        f"{fin.get('code')}: {fin.get('error')}")
+        except Exception as e:  # noqa: BLE001 - abort back to serving
+            if not skip_in_abort:
+                try:
+                    self._admin(dst_broker + ".admin",
+                                {"kind": P.MIGRATE_IN,
+                                 "tenant": tenant, "phase": "abort"})
+                except (OSError, P.ProtocolError):
+                    pass
+            try:
+                self._admin(src_broker + ".admin",
+                            {"kind": P.MIGRATE_OUT, "tenant": tenant,
+                             "phase": "abort"})
+            except (OSError, P.ProtocolError):
+                pass
+            if not skip_abort_journal:
+                self._append({"op": "cmigrate", "tenant": tenant,
+                              "phase": "abort"})
+            return {"ok": False, "code": "MIGRATE_FAILED",
+                    "error": f"{type(e).__name__}: {e}"}
+        self._append({"op": "cmigrate", "tenant": tenant,
+                      "phase": "commit", "to_node": node,
+                      "to_chips": chips})
+        if not teardown_before_journal:
+            for _attempt in range(3):
+                try:
+                    fin = self._admin(src_broker + ".admin",
+                                      {"kind": P.MIGRATE_OUT,
+                                       "tenant": tenant,
+                                       "phase": "commit"})
+                except (OSError, P.ProtocolError):
+                    continue
+                if fin.get("ok"):
+                    break
+        return {"ok": True, "tenant": tenant, "from": src_node,
+                "node": node, "broker": dst_broker, "chips": chips}
+
+    return _migrate
+
+
+@contextlib.contextmanager
+def _seed_skip_abort_rollback() -> Iterator[None]:
+    orig = CL.Coordinator._migrate
+    CL.Coordinator._migrate = _migrate_variant(skip_in_abort=True)
+    try:
+        yield
+    finally:
+        CL.Coordinator._migrate = orig
+
+
+@contextlib.contextmanager
+def _seed_teardown_before_journal() -> Iterator[None]:
+    orig = CL.Coordinator._migrate
+    CL.Coordinator._migrate = _migrate_variant(
+        teardown_before_journal=True)
+    try:
+        yield
+    finally:
+        CL.Coordinator._migrate = orig
+
+
+@contextlib.contextmanager
+def _seed_abort_without_journal() -> Iterator[None]:
+    orig = CL.Coordinator._migrate
+    CL.Coordinator._migrate = _migrate_variant(
+        skip_abort_journal=True)
+    try:
+        yield
+    finally:
+        CL.Coordinator._migrate = orig
+
+
+# ---------------------------------------------------------------------------
+# Broken fencing
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _seed_unfenced_coordinator() -> Iterator[None]:
+    """Fence.check never refuses: a crashed-and-replaced coordinator
+    keeps acking placements against a journal it no longer owns."""
+    orig = repl_mod.Fence.check
+    repl_mod.Fence.check = lambda self: None
+    try:
+        yield
+    finally:
+        repl_mod.Fence.check = orig
+
+
+# ---------------------------------------------------------------------------
+# The matrix
+# ---------------------------------------------------------------------------
+
+SEEDS: Tuple[Seed, ...] = (
+    Seed("stale-inventory-double-grant", "dmc",
+         "dmc-no-double-grant", "federation",
+         "cluster_inventory never subtracts the ledger: two tenants "
+         "are granted the same chip",
+         _seed_stale_inventory),
+    Seed("reservation-blind-free-chips", "dmc",
+         "dmc-no-double-grant", "federation",
+         "free_chips drops the in-flight migration reservations: the "
+         "dance's target chips are free for the taking mid-commit",
+         _seed_reservation_blind),
+    Seed("migrate-skip-abort-rollback", "dmc",
+         "dmc-no-orphan-copy", "federation",
+         "the dance's abort arm forgets MIGRATE_IN abort: a parked "
+         "target copy (journaled binds, live HBM) leaks on every "
+         "aborted dance",
+         _seed_skip_abort_rollback),
+    Seed("place-ack-before-journal", "dmc",
+         "dmc-reservation-conservation", "federation",
+         "CL_PLACE acks after mutating in-memory state but before the "
+         "journal append: the acked grant evaporates on coordinator "
+         "crash-restart",
+         _seed_ack_before_journal),
+    Seed("unfenced-stale-coordinator", "dmc",
+         "dmc-fenced-coordinator-never-acks", "federation",
+         "the epoch fence never refuses: a replaced coordinator keeps "
+         "acking placements into a journal its successor owns",
+         _seed_unfenced_coordinator),
+    Seed("non-idempotent-replace", "dmc",
+         "dmc-re-drive-idempotence", "federation",
+         "CL_PLACE drops the existing-placement arm: a client's "
+         "lost-ack retry grants a second placement and strands the "
+         "first",
+         _seed_nonidempotent_place),
+    Seed("teardown-before-commit-journal", "dmc",
+         "dmc-at-least-one-full-copy", "federation",
+         "the pre-fix dance ordering: source teardown before the "
+         "journaled commit, so a lost teardown ack aborts the target "
+         "too — zero copies cluster-wide",
+         _seed_teardown_before_journal),
+    Seed("abort-without-journal", "dmc",
+         "dmc-reservation-conservation", "federation",
+         "the abort arm rolls the brokers back but never journals "
+         "cmigrate abort: the begin reservation leaks forever",
+         _seed_abort_without_journal),
+)
+
+
+def run_seed(seed: Seed, *, max_schedules: int = 4000,
+             max_faults: int = explore.DEFAULT_MAX_FAULTS
+             ) -> Tuple[bool, int]:
+    """Run one seed; (caught, violation_count)."""
+    with seed.patch():
+        stats = explore.explore_scenario(
+            explore.get(seed.scenario),
+            max_schedules=max_schedules, max_faults=max_faults)
+    hits = [v for v in stats.violations
+            if f"[{seed.invariant}]" in v]
+    return bool(hits), len(stats.violations)
+
+
+def run_all(*, max_schedules: int = 4000
+            ) -> List[Tuple[Seed, bool, int]]:
+    out = []
+    for seed in SEEDS:
+        caught, n = run_seed(seed, max_schedules=max_schedules)
+        out.append((seed, caught, n))
+    return out

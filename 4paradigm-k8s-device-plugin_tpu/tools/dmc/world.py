@@ -1,0 +1,662 @@
+"""vtpu-dmc world: the REAL federation coordinator under a simulated
+lossy network.
+
+One :class:`World` is one explored schedule: a fresh temp journal dir,
+a fresh REAL :class:`~runtime.cluster.Coordinator` (never a
+re-implementation — its dispatch arms, journal, fence and migration
+dance run verbatim), a set of :class:`SimNode` broker models that
+answer the admin MIGRATE_OUT / MIGRATE_IN contract, and a queue of
+pending client messages whose delivery order and fates the explorer
+decides.
+
+Nondeterminism is ONLY the decision sequence the explorer feeds back
+through ``world.choose``:
+
+  - **top level** — for every pending message: ``deliver`` (free),
+    ``dup`` (re-enqueue a copy, one fault) or ``drop`` (one fault);
+    plus ``crash:coord`` (coordinator crash-restart on the same
+    journal dir, one fault) and ``down:<node>`` (node death + the
+    coordinator's real ``_node_down`` re-placement, one fault).
+  - **admin boundary** — every ``Coordinator._admin`` call the dance
+    makes is intercepted (the class staticmethod is patched for the
+    schedule): ``admin:ok`` (free), ``admin:lose`` (delivered but the
+    ack is lost — the classic 2PC hole, one fault) or ``admin:fail``
+    (never delivered, one fault); plus ``inject:<msg>`` (free) which
+    delivers another pending client message re-entrantly MID-DANCE —
+    the coordinator holds no lock at its admin call sites, so this is
+    exactly the concurrency the threading server allows.
+
+Every delivery of an idempotent verb or dance message is dispatched
+TWICE and the state digests compared — the re-drive-idempotence row
+is checked by construction on every message, not sampled.  The other
+rows drain named buckets (``World.take``) the step/terminal checks
+deposit into; the registry rows live in tools/mc/invariants.py
+(engine ``dmc``, phase ``net``).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import tempfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...runtime import cluster as CL
+from ...runtime import protocol as P
+from ...runtime import replication as repl_mod
+from ..mc import invariants as inv_registry
+
+# Serving states a SimNode copy can be in.  "serving" is a bound,
+# executing tenant; "frozen" is a quiesced MIGRATE_OUT source copy;
+# "parked" is a MIGRATE_IN target copy awaiting adoption.  All three
+# are FULL copies for the at-least-one-full-copy row.
+COPY_STATES = ("serving", "frozen", "parked")
+
+# The tenant name the fence probe places after a coordinator crash —
+# never collides with scenario tenants.
+FENCE_PROBE_TENANT = "__dmc_fence_probe__"
+
+
+class SimNode:
+    """One node-local broker model: just enough of the admin
+    MIGRATE_OUT / MIGRATE_IN contract (runtime/server.py) for the
+    coordinator's dance to run against — faithful to the broker's
+    refusal surface, because over-permissiveness here manufactures
+    false zero-copy witnesses.  MIGRATE_OUT begin quiesces only a
+    BOUND (serving/frozen) copy and refuses NOT_FOUND otherwise;
+    commit tears down only a bound copy and no-ops when the tenant is
+    gone or merely parked (mirrors ``migrate_out_finish``'s
+    ``t is None`` arm — a re-driven teardown must never destroy a copy
+    a LATER dance parked back here); MIGRATE_IN refuses
+    MIGRATE_CONFLICT when the tenant is already bound (mirrors
+    ``migrate_in_tenant``) and answers ``existing`` on a parked
+    re-drive.  Chip accounting stays in the coordinator's REAL ledger;
+    the SimNode only owns the copy lifecycle."""
+
+    def __init__(self, name: str, chips: int) -> None:
+        self.name = name
+        self.chips = int(chips)
+        self.alive = True
+        self.copies: Dict[str, str] = {}   # tenant -> COPY_STATES
+
+    def admin(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        kind = msg.get("kind")
+        tenant = str(msg.get("tenant"))
+        state = self.copies.get(tenant)
+        if kind == P.MIGRATE_OUT:
+            phase = msg.get("phase") or "begin"
+            if phase == "begin":
+                # Only a BOUND tenant can begin (re-drive on an
+                # already-quiesced one re-acks); a parked copy is not
+                # bound here (server.py migrate_out resolves through
+                # state.tenants, not state.recovered).
+                if state in ("serving", "frozen"):
+                    self.copies[tenant] = "frozen"
+                    return {"ok": True, "state": {"tenant": tenant},
+                            "blobs": [], "epoch": "e1",
+                            "moved_bytes": 0}
+                return {"ok": False, "code": "NOT_FOUND",
+                        "error": f"no bound tenant {tenant!r}"}
+            if phase == "commit":
+                # migrate_out_finish: tears down the BOUND tenant;
+                # no-op when gone or merely parked (t is None there) —
+                # a re-driven teardown must never destroy a copy a
+                # LATER dance parked back onto this node.
+                if state in ("serving", "frozen"):
+                    self.copies.pop(tenant)
+                return {"ok": True}
+            if phase == "abort":
+                if state == "frozen":
+                    self.copies[tenant] = "serving"
+                return {"ok": True}
+            return {"ok": False, "code": "BAD_PHASE",
+                    "error": str(phase)}
+        if kind == P.MIGRATE_IN:
+            if msg.get("phase") == "abort":
+                if state == "parked":
+                    self.copies.pop(tenant)
+                    return {"ok": True}
+                return {"ok": True, "noop": True}
+            if state == "parked":
+                # Idempotent re-drive after a lost ack.
+                return {"ok": True, "existing": True}
+            if state is not None:
+                # server.py migrate_in_tenant: MIGRATE_CONFLICT when
+                # the tenant is already bound on this node.
+                return {"ok": False, "code": "MIGRATE_CONFLICT",
+                        "error": f"tenant {tenant!r} already bound"}
+            self.copies[tenant] = "parked"
+            return {"ok": True}
+        return {"ok": False, "code": "BAD_KIND", "error": str(kind)}
+
+    def digest(self) -> str:
+        return json.dumps(sorted(self.copies.items()))
+
+
+class Msg:
+    """One pending client message: a stable decision label plus the
+    wire payload the coordinator's real dispatch receives."""
+
+    def __init__(self, mid: str, payload: Dict[str, Any]) -> None:
+        self.mid = mid
+        self.payload = payload
+
+
+def _state_digest(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Canonical ledger view for idempotence comparison: everything a
+    re-delivery must leave bit-identical.  Excludes epoch/generation
+    (restart-scoped) and heartbeat wall-clock bookkeeping."""
+    return {
+        "nodes": {n: {"alive": bool(e.get("alive")),
+                      "chips": int(e.get("chips") or 0)}
+                  for n, e in (state.get("nodes") or {}).items()},
+        "placements": {t: {"node": p.get("node"),
+                           "chips": sorted(int(c) for c in
+                                           p.get("chips") or [])}
+                       for t, p in
+                       (state.get("placements") or {}).items()},
+        "used": {n: sorted(per.items())
+                 for n, per in (state.get("used") or {}).items()
+                 if per},
+        "migrating": {t: {"to_node": m.get("to_node"),
+                          "to_chips": sorted(
+                              int(c) for c in m.get("to_chips") or [])}
+                      for t, m in
+                      (state.get("migrating") or {}).items()},
+        "totals": [int(state.get("placements_total", 0)),
+                   int(state.get("migrations_total", 0))],
+    }
+
+
+class World:
+    """One schedule's universe.  The explorer owns the decision policy
+    (``choose``); the world owns mechanics, fault accounting, fate
+    application and invariant-bucket deposits."""
+
+    def __init__(self, tmp: str, *, max_faults: int,
+                 choose: Callable[[List[str]], str]) -> None:
+        self.tmp = tmp
+        self.max_faults = max_faults
+        self.faults = 0
+        self.choose = choose
+        self.nodes: Dict[str, SimNode] = {}
+        self.pending: List[Msg] = []
+        self.acked: set = set()       # tenants with an acked CL_PLACE
+        self.lost: set = set()        # tenants whose data died with a node
+        self.excused: set = set()     # (node, tenant) abort/teardown
+        #                             # deliveries dropped by a fault:
+        #                             # the resume-grace reaper owns them
+        self.buckets: Dict[str, List[str]] = {}
+        self.coord_seq = 0
+        self.coord = self._boot_coordinator()
+        self._replaced_seen = 0
+        self._rejoin_seq = 0
+        self._prev_admin = None
+
+    # -- coordinator lifecycle -------------------------------------------
+
+    def _boot_coordinator(self) -> CL.Coordinator:
+        self.coord_seq += 1
+        return CL.Coordinator(
+            self.tmp + "/coord.sock", self.tmp + "/cl-journal",
+            policy="pack", hb_dead_s=1e9)
+
+    def __enter__(self) -> "World":
+        # Patch the REAL coordinator's admin channel for this schedule:
+        # every dance message routes through the simulated bus.  The
+        # original is a @staticmethod, so the patch must be one too.
+        self._prev_admin = CL.Coordinator.__dict__["_admin"]
+        world = self
+
+        def routed(sock_path: str, msg: Dict[str, Any],
+                   timeout: float = 30.0) -> Dict[str, Any]:
+            return world._admin_call(sock_path, msg)
+
+        CL.Coordinator._admin = staticmethod(routed)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        CL.Coordinator._admin = self._prev_admin
+        try:
+            self.coord.jr.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+    # -- invariant buckets ------------------------------------------------
+
+    def deposit(self, row: str, msg: str) -> None:
+        self.buckets.setdefault(row, []).append(msg)
+
+    def take(self, row: str) -> List[str]:
+        return self.buckets.pop(row, [])
+
+    # -- fault accounting -------------------------------------------------
+
+    def faults_left(self) -> int:
+        return max(self.max_faults - self.faults, 0)
+
+    @staticmethod
+    def choice_cost(choice: str) -> int:
+        head = choice.split(":", 1)[0]
+        if head in ("deliver", "inject") or choice == "admin:ok":
+            return 0
+        return 1
+
+    # -- digests ----------------------------------------------------------
+
+    def digest(self) -> str:
+        obj = _state_digest(self.coord.state)
+        obj["copies"] = {n.name: sorted(n.copies.items())
+                        for n in self.nodes.values()}
+        return json.dumps(obj, sort_keys=True)
+
+    # -- the simulated admin bus -----------------------------------------
+
+    def _admin_call(self, sock_path: str,
+                    msg: Dict[str, Any]) -> Dict[str, Any]:
+        """One coordinator->broker dance message.  The explorer picks
+        its fate; ``inject`` choices deliver pending CLIENT messages
+        re-entrantly first (mid-dance concurrency), then the fate is
+        re-asked."""
+        while True:
+            enabled = ["admin:ok"]
+            if self.faults_left() > 0:
+                enabled += ["admin:lose", "admin:fail"]
+            enabled += [f"inject:{m.mid}" for m in self.pending]
+            choice = self.choose(enabled)
+            if choice.startswith("inject:"):
+                self.deliver(choice.split(":", 1)[1])
+                self.step_checks()
+                continue
+            break
+        node = self._node_for(sock_path)
+        self.faults += self.choice_cost(choice)
+        if choice == "admin:fail":
+            # Never delivered.  A dropped abort/teardown legitimately
+            # leaves a copy behind for the resume-grace reaper — mark
+            # it excused so the orphan row doesn't misfire on the
+            # documented backstop path.
+            if node is not None:
+                kind, phase = msg.get("kind"), msg.get("phase")
+                if ((kind == P.MIGRATE_IN and phase == "abort")
+                        or (kind == P.MIGRATE_OUT
+                            and phase == "commit")):
+                    self.excused.add((node.name,
+                                      str(msg.get("tenant"))))
+            raise OSError("dmc: admin message dropped")
+        if node is None or not node.alive:
+            raise OSError(f"dmc: node for {sock_path!r} is down")
+        rep = self._deliver_admin_twice(node, msg)
+        self.step_checks()
+        if choice == "admin:lose":
+            raise OSError("dmc: admin ack lost")
+        return rep
+
+    def _node_for(self, sock_path: str) -> Optional[SimNode]:
+        base = sock_path[:-len(".admin")] \
+            if sock_path.endswith(".admin") else sock_path
+        for node in self.nodes.values():
+            if base.endswith("/" + node.name):
+                return node
+        return None
+
+    def _deliver_admin_twice(self, node: SimNode,
+                             msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Both dance messages are declared idempotent (cluster.py
+        grammar + protocol.py IDEMPOTENT_VERBS): deliver every one
+        twice and require bit-identical broker state — the lost-ack
+        retry contract, checked by construction."""
+        rep = node.admin(msg)
+        d1 = node.digest()
+        node.admin(dict(msg))
+        d2 = node.digest()
+        if d1 != d2:
+            self.deposit(
+                "dmc-re-drive-idempotence",
+                f"dance message {msg.get('kind')}/"
+                f"{msg.get('phase') or 'begin'} to {node.name!r} is "
+                f"not re-drive idempotent: {d1} != {d2}")
+        return rep
+
+    # -- client-message delivery -----------------------------------------
+
+    def _pop_pending(self, mid: str) -> Optional[Msg]:
+        for i, m in enumerate(self.pending):
+            if m.mid == mid:
+                return self.pending.pop(i)
+        return None
+
+    def _dispatch(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        try:
+            return self.coord.dispatch(dict(payload))
+        except repl_mod.FencedEpoch as e:
+            return {"ok": False, "code": "FENCED", "error": str(e)}
+        except OSError as e:
+            return {"ok": False, "code": "IO", "error": str(e)}
+
+    def deliver(self, mid: str) -> None:
+        msg = self._pop_pending(mid)
+        if msg is None:
+            return
+        payload = msg.payload
+        kind = payload.get("kind")
+        rep = self._dispatch(payload)
+        if kind in CL.CLUSTER_IDEMPOTENT_VERBS:
+            # Idempotent verbs: re-deliver and require an identical
+            # ledger — on EVERY delivery, by construction.
+            d1 = self.digest()
+            self._dispatch(payload)
+            d2 = self.digest()
+            if d1 != d2:
+                self.deposit(
+                    "dmc-re-drive-idempotence",
+                    f"verb {kind!r} ({mid}) is not re-drive "
+                    f"idempotent: ledger changed on re-delivery")
+        self._client_effects(mid, payload, rep)
+        self._reconcile_replaced()
+
+    def _client_effects(self, mid: str, payload: Dict[str, Any],
+                        rep: Dict[str, Any]) -> None:
+        kind = payload.get("kind")
+        if kind == CL.CL_PLACE and rep.get("ok"):
+            tenant = str(payload["tenant"])
+            self.acked.add(tenant)
+            node = self.nodes.get(str(rep.get("node")))
+            if node is not None and tenant not in node.copies:
+                # The client binds at the granted node: a full serving
+                # copy materializes there.
+                node.copies[tenant] = "serving"
+            self.lost.discard(tenant)
+        elif kind == CL.CL_RELEASE and rep.get("ok"):
+            tenant = str(payload["tenant"])
+            self.acked.discard(tenant)
+            self.lost.discard(tenant)
+            for node in self.nodes.values():
+                node.copies.pop(tenant, None)   # node-side teardown
+        elif kind == CL.CL_HB and not rep.get("ok") \
+                and rep.get("code") == "UNKNOWN_NODE":
+            # The NodeAgent's real reaction to UNKNOWN_NODE is a
+            # re-join (bounded re-dial loop): model it as a fresh
+            # pending CL_JOIN.
+            node = self.nodes.get(str(payload.get("node")))
+            if node is not None:
+                self._rejoin_seq += 1
+                self.enqueue(
+                    f"rejoin{self._rejoin_seq}_{node.name}",
+                    {"kind": CL.CL_JOIN, "node": node.name,
+                     "broker": self.tmp + "/" + node.name,
+                     "chips": node.chips})
+        elif kind == CL.CL_JOIN and rep.get("ok"):
+            name = str(payload.get("node"))
+            node = self.nodes.get(name)
+            if node is None:
+                # A late joiner the scenario only knew as a message:
+                # materialize its broker model so placements onto it
+                # can bind.
+                self.nodes[name] = SimNode(
+                    name, int(payload.get("chips") or 0))
+            elif not node.alive:
+                node.alive = True       # re-join: a fresh empty broker
+                node.copies = {}
+
+    def _reconcile_replaced(self) -> None:
+        """Mirror the coordinator's node_down re-placements into the
+        copy model: the tenant DATA died with the node (per-node
+        journals are node-local), so the client rebinds fresh at the
+        new placement — a new serving copy there; a no-capacity
+        crelease just releases."""
+        for ent in self.coord.replaced[self._replaced_seen:]:
+            tenant = str(ent.get("tenant"))
+            to = ent.get("to")
+            if to is None:
+                self.acked.discard(tenant)
+                self.lost.discard(tenant)
+            else:
+                node = self.nodes.get(str(to))
+                if node is not None and node.alive:
+                    node.copies[tenant] = "serving"
+                self.lost.discard(tenant)
+        self._replaced_seen = len(self.coord.replaced)
+
+    # -- scenario wiring --------------------------------------------------
+
+    def add_node(self, name: str, chips: int) -> SimNode:
+        node = SimNode(name, chips)
+        self.nodes[name] = node
+        rep = self._dispatch({"kind": CL.CL_JOIN, "node": name,
+                              "broker": self.tmp + "/" + name,
+                              "chips": chips})
+        if not rep.get("ok"):
+            raise RuntimeError(f"dmc: setup join {name!r} "
+                               f"failed: {rep}")
+        return node
+
+    def place(self, tenant: str, chips: int = 1) -> None:
+        """Setup-time placement (no decisions): grant + materialize."""
+        rep = self._dispatch({"kind": CL.CL_PLACE, "tenant": tenant,
+                              "chips": chips})
+        if rep.get("ok"):
+            self._client_effects("setup", {"kind": CL.CL_PLACE,
+                                           "tenant": tenant}, rep)
+
+    def enqueue(self, mid: str, payload: Dict[str, Any]) -> None:
+        self.pending.append(Msg(mid, payload))
+
+    # -- top-level fates --------------------------------------------------
+
+    def top_enabled(self) -> List[str]:
+        out: List[str] = []
+        seen: set = set()
+        for m in self.pending:
+            if m.mid in seen:
+                continue
+            seen.add(m.mid)
+            out.append(f"deliver:{m.mid}")
+            if self.faults_left() > 0:
+                out.append(f"dup:{m.mid}")
+                out.append(f"drop:{m.mid}")
+        if self.faults_left() > 0:
+            out.append("crash:coord")
+            for name, node in self.nodes.items():
+                ent = (self.coord.state.get("nodes") or {}).get(name)
+                if node.alive and ent is not None \
+                        and ent.get("alive"):
+                    out.append(f"down:{name}")
+        return out
+
+    def _adopt_parked(self) -> None:
+        """Between top-level steps the client rebinds: a parked copy
+        whose ledger placement is this node becomes serving (the real
+        epoch-fenced resume).  Deterministic, so chained migrations of
+        the same tenant stay explorable — a still-parked copy refuses
+        MIGRATE_OUT begin just like the real broker."""
+        placements = self.coord.state.get("placements") or {}
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            for tenant, st in list(node.copies.items()):
+                if st == "parked" and (placements.get(tenant)
+                                       or {}).get("node") == node.name:
+                    node.copies[tenant] = "serving"
+
+    def apply_top(self, choice: str) -> None:
+        self._adopt_parked()
+        self.faults += self.choice_cost(choice)
+        head, _, rest = choice.partition(":")
+        if head == "deliver":
+            self.deliver(rest)
+        elif head == "dup":
+            for m in list(self.pending):
+                if m.mid == rest:
+                    self.pending.append(Msg(m.mid, dict(m.payload)))
+                    break
+        elif head == "drop":
+            self._pop_pending(rest)
+        elif choice == "crash:coord":
+            self.crash_coordinator()
+        elif head == "down":
+            self.node_down(rest)
+        else:
+            raise RuntimeError(f"dmc: unknown choice {choice!r}")
+
+    def crash_coordinator(self) -> None:
+        """Coordinator crash-restart on the same journal dir: the
+        successor's fence claim bumps the generation, the journal
+        replays, and the STALE instance is probed with a placement —
+        which must refuse (fenced-coordinator-never-acks)."""
+        old = self.coord
+        try:
+            self.coord = self._boot_coordinator()
+        except Exception as e:  # noqa: BLE001 - recovery must not crash
+            # Recovery refusing (or blowing up) IS a conservation
+            # break: the journaled ledger failed to come back.
+            self.deposit(
+                "dmc-reservation-conservation",
+                f"coordinator recovery failed: "
+                f"{type(e).__name__}: {e}")
+            self.coord = old
+            return
+        self._replaced_seen = len(self.coord.replaced)
+        try:
+            rep = old.dispatch({"kind": CL.CL_PLACE,
+                                "tenant": FENCE_PROBE_TENANT,
+                                "chips": 1})
+            if rep.get("ok"):
+                self.deposit(
+                    "dmc-fenced-coordinator-never-acks",
+                    "stale coordinator acked a CL_PLACE after the "
+                    "successor bumped the fence generation")
+        except Exception:  # noqa: BLE001 - any refusal means fenced
+            pass   # refused: the fence held
+        try:
+            old.jr.close()
+        except Exception:  # noqa: BLE001 - stale teardown best-effort
+            pass
+        # Every placement the old instance ACKED must survive the
+        # restart (journal-before-ack): a lost one means the ack
+        # outran the journal.
+        placements = self.coord.state.get("placements") or {}
+        for tenant in sorted(self.acked):
+            if tenant not in placements:
+                self.deposit(
+                    "dmc-reservation-conservation",
+                    f"acked placement of {tenant!r} lost across "
+                    f"coordinator crash-restart (ack before journal)")
+        for v in CL.check_conservation(self.coord.state):
+            self.deposit("dmc-reservation-conservation",
+                         f"post-restart: {v}")
+
+    def node_down(self, name: str) -> None:
+        """Node death: its copies die with it, then the REAL
+        ``_node_down`` journals the death and re-places its tenants."""
+        node = self.nodes[name]
+        node.alive = False
+        for tenant in list(node.copies):
+            self.lost.add(tenant)
+        node.copies = {}
+        self.coord._node_down(name)
+        self._reconcile_replaced()
+
+    # -- invariant checks -------------------------------------------------
+
+    def step_checks(self) -> None:
+        """Cheap safety after every delivery and admin boundary."""
+        state = self.coord.state
+        for v in CL.check_conservation(state):
+            row = ("dmc-no-double-grant" if "double-granted" in v
+                   else "dmc-reservation-conservation")
+            self.deposit(row, v)
+        # Free-chip identity per live node: free + placed + reserved
+        # partition the inventory exactly.
+        for name, ent in (state.get("nodes") or {}).items():
+            if not ent.get("alive"):
+                continue
+            free = set(CL.free_chips(state, name))
+            used = {int(c) for c in (state.get("used") or {})
+                    .get(name, {})}
+            reserved: set = set()
+            for m in (state.get("migrating") or {}).values():
+                if isinstance(m, dict) and m.get("to_node") == name:
+                    reserved.update(int(c)
+                                    for c in m.get("to_chips") or [])
+            total = int(ent.get("chips") or 0)
+            if free & used or free & (reserved - used) \
+                    or len(free | used | reserved) > total:
+                self.deposit(
+                    "dmc-no-double-grant",
+                    f"node {name!r} chip partition broken: "
+                    f"free={sorted(free)} used={sorted(used)} "
+                    f"reserved={sorted(reserved)} of {total}")
+        # At least one full copy somewhere alive, at EVERY step.
+        placements = state.get("placements") or {}
+        for tenant, p in placements.items():
+            if tenant in self.lost or tenant == FENCE_PROBE_TENANT:
+                continue
+            if not any(node.alive and tenant in node.copies
+                       for node in self.nodes.values()):
+                self.deposit(
+                    "dmc-at-least-one-full-copy",
+                    f"tenant {tenant!r} is placed on "
+                    f"{p.get('node')!r} but NO live node holds any "
+                    f"copy (zero-copy window)")
+
+    def terminal_checks(self) -> None:
+        state = self.coord.state
+        placements = state.get("placements") or {}
+        for node in self.nodes.values():
+            if not node.alive:
+                continue
+            for tenant in sorted(node.copies):
+                placed_on = (placements.get(tenant) or {}).get("node")
+                if placed_on != node.name \
+                        and (node.name, tenant) not in self.excused:
+                    self.deposit(
+                        "dmc-no-orphan-copy",
+                        f"node {node.name!r} still holds a "
+                        f"{node.copies[tenant]} copy of {tenant!r} "
+                        f"but the ledger places it on {placed_on!r}")
+        for tenant, m in sorted((state.get("migrating") or {}).items()):
+            self.deposit(
+                "dmc-reservation-conservation",
+                f"migration reservation for {tenant!r} -> "
+                f"{(m or {}).get('to_node')!r} leaked to quiescence "
+                f"(abort never journaled)")
+
+    def collect_violations(self) -> List[str]:
+        self.terminal_checks()
+        return inv_registry.run_checks("dmc", "net", self)
+
+
+# ---------------------------------------------------------------------------
+# Scenario
+# ---------------------------------------------------------------------------
+
+def setup_federation(world: World) -> None:
+    """The default scenario: two 2-chip nodes pre-joined, then a
+    client workload whose every message the explorer may deliver,
+    delay (by delivering others first), duplicate or drop — a
+    1-chip place, a 2-chip place, a cross-node migration, a release,
+    a heartbeat and a late 1-chip join."""
+    world.add_node("n0", 2)
+    world.add_node("n1", 2)
+    world.enqueue("place_a", {"kind": CL.CL_PLACE, "tenant": "a",
+                              "chips": 1})
+    world.enqueue("place_b", {"kind": CL.CL_PLACE, "tenant": "b",
+                              "chips": 2})
+    world.enqueue("migrate_a", {"kind": CL.CL_MIGRATE, "tenant": "a"})
+    world.enqueue("release_b", {"kind": CL.CL_RELEASE, "tenant": "b"})
+    world.enqueue("hb_n0", {"kind": CL.CL_HB, "node": "n0"})
+    world.enqueue("join_n2", {"kind": CL.CL_JOIN, "node": "n2",
+                              "broker": world.tmp + "/n2",
+                              "chips": 1})
+
+
+def make_world(max_faults: int,
+               choose: Callable[[List[str]], str]) -> Tuple[World, str]:
+    tmp = tempfile.mkdtemp(prefix="vtpu-dmc-")
+    world = World(tmp, max_faults=max_faults, choose=choose)
+    return world, tmp
+
+
+def destroy_world(world: World, tmp: str) -> None:
+    shutil.rmtree(tmp, ignore_errors=True)

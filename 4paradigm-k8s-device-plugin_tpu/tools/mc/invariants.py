@@ -21,11 +21,18 @@ Phases:
                    of the vtpu-wmm litmus suite (``tools/wmm``): the
                    shared-region lock-free protocols under C11-ish
                    reordering, not just sequential consistency.
+  - ``net``      — checked over every explored network-fault schedule
+                   of the vtpu-dmc distributed model checker
+                   (``tools/dmc``): the REAL federation coordinator
+                   (``runtime/cluster.py``) driven under exhaustive
+                   message delay/duplication/reorder/drop and
+                   coordinator/node crash-restart.
 
 A check returns a list of human-readable violation strings (empty =
 holds).  Its ``ctx`` is the interleaving ``Harness`` for step/terminal
-checks, a ``CutContext`` for cut checks, and a ``WmmContext``
-(``tools/wmm/model.py``) for litmus checks.
+checks, a ``CutContext`` for cut checks, a ``WmmContext``
+(``tools/wmm/model.py``) for litmus checks, and a ``World``
+(``tools/dmc/world.py``) for net checks.
 """
 
 from __future__ import annotations
@@ -39,8 +46,8 @@ EPS_US = 1.0
 @dataclass(frozen=True)
 class Invariant:
     name: str
-    engine: str        # "interleave" | "crash"
-    phase: str         # "step" | "terminal" | "cut"
+    engine: str        # "interleave" | "crash" | "cluster" | "wmm" | "dmc"
+    phase: str         # "step" | "terminal" | "cut" | "litmus" | "net"
     description: str
     check: Callable[[Any], List[str]]
 
@@ -414,6 +421,20 @@ def _chk_cluster_fence(c: Any) -> List[str]:
     return getattr(c, "cfence_violations", [])
 
 
+# ---------------------------------------------------------------------------
+# DMC network-fault-engine checks (ctx = tools.dmc.world.World) — the
+# REAL coordinator driven under exhaustive message fates (deliver /
+# delay / duplicate / drop) plus coordinator crash-restart and node
+# death.  The world deposits into named buckets as it steps; each row
+# drains its own (the wmm pattern).
+# ---------------------------------------------------------------------------
+
+def _dmc_bucket(row: str) -> Callable[[Any], List[str]]:
+    def chk(ctx: Any) -> List[str]:
+        return ctx.take(row)
+    return chk
+
+
 INVARIANTS: Tuple[Invariant, ...] = (
     Invariant(
         "token-conservation", "interleave", "terminal",
@@ -567,6 +588,42 @@ INVARIANTS: Tuple[Invariant, ...] = (
         "descriptors in FIFO order, never executes an unpublished "
         "descriptor, and its credit gate never leaks or over-admits",
         _wmm_bucket("wmm-ring-fifo")),
+    Invariant(
+        "dmc-no-double-grant", "dmc", "net",
+        "under any message fate schedule no chip is ever granted to "
+        "two tenants at once (per-node free/placed/reserved ledgers "
+        "stay disjoint and exact at every step)",
+        _dmc_bucket("dmc-no-double-grant")),
+    Invariant(
+        "dmc-at-least-one-full-copy", "dmc", "net",
+        "a placed tenant always has at least one full model copy "
+        "(serving, quiesced or parked) on a live node at every step "
+        "of any migration/fault schedule — no lost-ack ordering may "
+        "pass through a zero-copy window",
+        _dmc_bucket("dmc-at-least-one-full-copy")),
+    Invariant(
+        "dmc-no-orphan-copy", "dmc", "net",
+        "at quiescence no node holds a model copy for a tenant whose "
+        "ledger placement is elsewhere, except copies whose abort "
+        "delivery was dropped by the fault budget (those are owned "
+        "by the resume-grace reaper)", _dmc_bucket("dmc-no-orphan-copy")),
+    Invariant(
+        "dmc-reservation-conservation", "dmc", "net",
+        "coordinator check_conservation holds at every step and "
+        "after every crash-restart; every acked placement is "
+        "durable across coordinator crash; no migration reservation "
+        "leaks to quiescence", _dmc_bucket("dmc-reservation-conservation")),
+    Invariant(
+        "dmc-fenced-coordinator-never-acks", "dmc", "net",
+        "after a crash-restart bumps the fence generation, the stale "
+        "coordinator instance can never ack a placement again",
+        _dmc_bucket("dmc-fenced-coordinator-never-acks")),
+    Invariant(
+        "dmc-re-drive-idempotence", "dmc", "net",
+        "re-delivering any idempotent verb or dance message "
+        "(checked by construction on EVERY delivery) leaves "
+        "coordinator ledger and node copies bit-identical",
+        _dmc_bucket("dmc-re-drive-idempotence")),
 )
 
 

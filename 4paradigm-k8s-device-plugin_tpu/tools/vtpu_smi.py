@@ -524,7 +524,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="vtpu-smi")
     ap.add_argument("cmd", nargs="?", default=None,
                     choices=("trace", "leases", "analyze", "mc", "wmm",
-                             "metricsd", "chaos", "top", "cluster"),
+                             "dmc", "metricsd", "chaos", "top",
+                             "cluster"),
                     help="trace: flight-recorder spans (needs "
                          "--broker; --dump FILE exports Chrome-trace "
                          "JSON); leases: chip-lease sidecar forensics; "
@@ -536,6 +537,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "check); wmm: weak-memory-model litmus "
                          "exploration of the shared-region lock-free "
                          "protocols (--smoke for the wiring check); "
+                         "dmc: distributed model checking of the "
+                         "cluster federation protocol under network "
+                         "faults (--smoke for the wiring check); "
                          "metricsd: the quota-virtualized "
                          "view stock tpu-info sees (docs/METRICSD.md); "
                          "top: live htop-style per-tenant SLO / "
@@ -572,8 +576,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(no broker; the analyze CI job's wiring "
                          "check)")
     ap.add_argument("--smoke", action="store_true",
-                    help="with `mc`/`wmm`/`chaos`: tiny-budget wiring "
-                         "check (the analyze CI job's smokes)")
+                    help="with `mc`/`wmm`/`dmc`/`chaos`: tiny-budget "
+                         "wiring check (the analyze CI job's smokes)")
     ap.add_argument("--sweep-host", action="store_true",
                     help="reclaim slots of dead host pids (node mode only)")
     ap.add_argument("--broker", default=None, metavar="SOCKET",
@@ -672,6 +676,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         if ns.cmd_arg:
             args.extend(["--scenario", ns.cmd_arg])
         return mc_main(args)
+    if ns.cmd == "dmc":
+        # Distributed model checker (tools/dmc): the REAL federation
+        # coordinator under exhaustive network nondeterminism, held
+        # to the dmc rows of the mc invariant registry
+        # (docs/ANALYSIS.md "Distributed model checking").  --smoke
+        # is the cheap wiring check the analyze CI job runs; budgets,
+        # the floor gate and selfcheck live on
+        # `python -m vtpu.tools.dmc` directly.
+        from .dmc import main as dmc_main
+        args = []
+        if ns.json:
+            args.append("--json")
+        if ns.smoke:
+            args.append("--smoke")
+        if ns.cmd_arg:
+            args.extend(["--scenario", ns.cmd_arg])
+        return dmc_main(args)
     if ns.cmd == "wmm":
         # Weak-memory litmus explorer (tools/wmm): the shared-region
         # lock-free protocols under C11-ish reordering, held to the

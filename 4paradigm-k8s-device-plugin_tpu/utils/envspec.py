@@ -272,6 +272,12 @@ ENV_FLAGS = {
     "VTPU_WMM_MAX_EXECUTIONS": ("tools", False),
     "VTPU_WMM_PREEMPTIONS": ("tools", False),
     "VTPU_WMM_MAX_STEPS": ("tools", False),
+    # vtpu-dmc (docs/ANALYSIS.md "Distributed model checking"):
+    # exploration budgets of the distributed network-fault engine.
+    # Not operator-facing — CI and developers tune them per run.
+    "VTPU_DMC_MAX_SCHEDULES": ("tools", False),
+    "VTPU_DMC_MAX_FAULTS": ("tools", False),
+    "VTPU_DMC_MAX_STEPS": ("tools", False),
     # Tools / bench.
     "VTPU_METRICS_PORT": ("tools", True),
     "VTPU_BENCH_CHAIN": ("bench", False),

@@ -846,6 +846,35 @@ def _wait_socket(path: str, timeout_s: float = 30.0) -> None:
     raise RuntimeError(f"{path} never bound")
 
 
+def _replay_cluster_journal(cjdir: str) -> Dict[str, Any]:
+    """Offline replay of the coordinator's journal dir through the
+    REAL recovery machinery (Journal.load_state wired to
+    cluster_apply_record), then the coordinator's own conservation
+    check over the recovered ledger.  Run AFTER the coordinator
+    process exits, so the log is quiescent — this is the hard
+    post-cell gate: a coordinator that kept its in-memory books
+    straight but journaled a divergent history fails here even
+    though every live CL_STATUS looked clean."""
+    from vtpu.runtime import cluster as cl
+    from vtpu.runtime.journal import Journal
+    out: Dict[str, Any] = {"replayed": False, "violations": []}
+    try:
+        jr = Journal(cjdir, fsync=False, snapshot_every=100_000,
+                     apply_fn=cl.cluster_apply_record)
+        try:
+            state = jr.load_state() or {}
+        finally:
+            jr.close()
+        out["replayed"] = True
+        out["violations"] = cl.check_conservation(state)
+        out["placements"] = sorted(state.get("placements") or {})
+        out["migrations_total"] = state.get("migrations_total")
+        out["migrating_open"] = sorted(state.get("migrating") or {})
+    except Exception as e:  # noqa: BLE001 - gate reports, not raises
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def cell_federation(quick: bool) -> Dict[str, Any]:
     """Three 4-chip node brokers federated under a clusterd
     coordinator: pack co-location + spread anti-affinity across
@@ -1032,6 +1061,8 @@ def cell_federation(quick: bool) -> Dict[str, Any]:
             except subprocess.TimeoutExpired:
                 coord.kill()
         coord_log.close()
+    # -- hard post-cell assertion: offline journal replay -----------
+    out["journal_replay"] = _replay_cluster_journal(cjdir)
     return out
 
 
@@ -1184,6 +1215,24 @@ def check(result: Dict[str, Any],
             if fed.get(kind):
                 errs.append(f"federation: ledger conservation "
                             f"violated ({kind}: {fed[kind]})")
+        # Hard post-cell assertion: the quiescent journal must replay
+        # through the real recovery path to a conservation-clean
+        # ledger — live CL_STATUS checks can't see a divergent
+        # journaled history; this replay can.
+        replay = fed.get("journal_replay") or {}
+        if not replay.get("replayed"):
+            errs.append(
+                f"federation: offline journal replay FAILED "
+                f"({replay.get('error', 'no replay attempted')})")
+        elif replay.get("violations"):
+            errs.append(
+                f"federation: replayed journal violates conservation "
+                f"({replay['violations']})")
+        elif replay.get("migrating_open"):
+            errs.append(
+                f"federation: replayed journal left migration "
+                f"dance(s) open ({replay['migrating_open']}) — a "
+                f"begin record was never committed or aborted")
     return errs
 
 

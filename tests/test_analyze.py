@@ -15,7 +15,7 @@ if REPO_ROOT not in sys.path:
 
 from vtpu.tools import analyze  # noqa: E402
 from vtpu.tools.analyze import (  # noqa: E402
-    envflags, journal_schema, locks, verbs)
+    clusterproto, envflags, journal_schema, locks, verbs)
 
 SERVER_REL = locks.SERVER
 
@@ -645,3 +645,124 @@ def absorb(resp):
 
 def test_wirefields_real_tree_clean():
     assert wirefields.check(REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# clusterproto (federation dance grammar vs cluster.py effects)
+# ---------------------------------------------------------------------------
+
+def _cluster_sources():
+    with open(os.path.join(REPO_ROOT, clusterproto.CLUSTER)) as f:
+        cluster_src = f.read()
+    with open(os.path.join(REPO_ROOT, clusterproto.PROTOCOL)) as f:
+        protocol_src = f.read()
+    senders = {}
+    for rel in clusterproto.SENDER_FILES:
+        if rel == clusterproto.CLUSTER:
+            continue
+        with open(os.path.join(REPO_ROOT, rel)) as f:
+            senders[rel] = f.read()
+    return cluster_src, protocol_src, senders
+
+
+def _cp_findings(cluster_src, protocol_src=None, senders=None):
+    real_cluster, real_proto, real_senders = _cluster_sources()
+    return clusterproto.check_texts(
+        cluster_src if cluster_src is not None else real_cluster,
+        protocol_src if protocol_src is not None else real_proto,
+        real_senders if senders is None else senders)
+
+
+def _mutated_cluster(old, new):
+    cluster_src, _proto, _senders = _cluster_sources()
+    assert old in cluster_src, old
+    return cluster_src.replace(old, new)
+
+
+def test_clusterproto_unregistered_verb_caught():
+    src = _mutated_cluster(
+        'CL_STATUS = "cl_status"',
+        'CL_STATUS = "cl_status"\nCL_EVICT = "cl_evict"')
+    msgs = [f.message for f in _cp_findings(src)]
+    assert any("CL_EVICT is not registered" in m for m in msgs), msgs
+
+
+def test_clusterproto_missing_dispatch_arm_caught():
+    src = _mutated_cluster(
+        "        if kind == CL_STATUS:\n"
+        "            return self._status()\n",
+        "")
+    msgs = [f.message for f in _cp_findings(src)]
+    assert any("CL_STATUS has no Coordinator.dispatch arm" in m
+               for m in msgs), msgs
+
+
+def test_clusterproto_missing_sender_binding_caught():
+    # With the external sender files withheld, any verb bound only
+    # there (the operator CLI drives CL_MIGRATE) loses its binding.
+    msgs = [f.message for f in _cp_findings(None, senders={})]
+    assert any("CL_MIGRATE has no sender binding" in m
+               for m in msgs), msgs
+
+
+def test_clusterproto_idempotency_mismatch_caught():
+    # Move CL_RELEASE to the non-idempotent registry; the grammar's
+    # `verb: cl_release idempotent` row now contradicts it.
+    src = _mutated_cluster(
+        "CLUSTER_IDEMPOTENT_VERBS = (CL_JOIN, CL_HB, CL_PLACE, "
+        "CL_RELEASE,\n                            CL_STATUS)\n"
+        "CLUSTER_NONIDEMPOTENT_VERBS = (CL_MIGRATE,)",
+        "CLUSTER_IDEMPOTENT_VERBS = (CL_JOIN, CL_HB, CL_PLACE,\n"
+        "                            CL_STATUS)\n"
+        "CLUSTER_NONIDEMPOTENT_VERBS = (CL_MIGRATE, CL_RELEASE)")
+    msgs = [f.message for f in _cp_findings(src)]
+    assert any("CL_RELEASE: grammar declares idempotent but the "
+               "registry says non-idempotent" in m for m in msgs), msgs
+
+
+def test_clusterproto_unreplayed_journal_op_caught():
+    # A journaled op cluster_apply_record cannot replay: a crash
+    # would forget it.
+    src = _mutated_cluster('{"op": "node_down", "node": node}',
+                           '{"op": "cnode_gone", "node": node}')
+    msgs = [f.message for f in _cp_findings(src)]
+    assert any("'cnode_gone' has no replay arm" in m for m in msgs), msgs
+    assert any("'cnode_gone' has no `record:` row" in m
+               for m in msgs), msgs
+
+
+def test_clusterproto_begin_without_abort_phase_caught():
+    src = _mutated_cluster(
+        "record: cmigrate owner: coordinator "
+        "phases: begin -> commit | abort",
+        "record: cmigrate owner: coordinator phases: begin -> commit")
+    msgs = [f.message for f in _cp_findings(src)]
+    assert any("declares a `begin` phase but no `abort`" in m
+               for m in msgs), msgs
+
+
+def test_clusterproto_reserve_without_release_pairing_caught():
+    src = _mutated_cluster(
+        "record: cgrant owner: coordinator pairs: crelease",
+        "record: cgrant owner: coordinator pairs: cfree")
+    msgs = [f.message for f in _cp_findings(src)]
+    assert any("pairs with undeclared record 'cfree'" in m
+               for m in msgs), msgs
+    assert any("reserve without release" in m for m in msgs), msgs
+
+
+def test_clusterproto_dance_msg_class_vs_protocol_caught():
+    # The grammar's dance-message class must match protocol.py's
+    # retry tables — the re-drive contract tools/dmc enforces
+    # dynamically.
+    src = _mutated_cluster(
+        "dance-msg: migrate_out idempotent owner: coordinator",
+        "dance-msg: migrate_out non-idempotent owner: coordinator")
+    msgs = [f.message for f in _cp_findings(src)]
+    assert any("'migrate_out' declared non-idempotent here but "
+               "protocol.py lists it in IDEMPOTENT_VERBS" in m
+               for m in msgs), msgs
+
+
+def test_clusterproto_real_tree_clean():
+    assert clusterproto.check(REPO_ROOT) == []

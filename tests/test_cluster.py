@@ -26,9 +26,12 @@ Layers under test:
 
 from __future__ import annotations
 
+import atexit
 import os
+import shutil
 import socket as socketmod
 import sys
+import tempfile
 import threading
 import time
 
@@ -443,6 +446,69 @@ def test_node_agent_joins_and_heartbeats(tmp_path):
         agent.join(timeout=5.0)
 
 
+def test_node_agent_rejoins_after_unknown_node(tmp_path):
+    """A node_down verdict (heartbeat silence, coordinator restart
+    amnesia) answers the agent's next CL_HB with UNKNOWN_NODE; the
+    agent's fail-static loop must treat that as a re-dial + re-JOIN —
+    the node comes back alive without operator action (the same
+    recovery the dmc world models as a pending rejoin CL_JOIN)."""
+    sock = str(tmp_path / "cl.sock")
+    coord = CL.Coordinator(sock, str(tmp_path / "j"),
+                           policy="pack", hb_dead_s=3600.0)
+    srv = coord.make_server()
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    agent = CL.NodeAgent(sock, "nA", "/run/nA.sock", chips=2,
+                         hb_s=0.05)
+    agent.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not agent.joined:
+            time.sleep(0.02)
+        assert agent.joined
+        coord._node_down("nA")
+        ent = {n["node"]: n for n in
+               CL.status(sock)["nodes"]}.get("nA")
+        assert ent is None or not ent["alive"]
+        # ...and the agent re-joins on its own.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            ent = {n["node"]: n for n in
+                   CL.status(sock)["nodes"]}.get("nA")
+            if ent and ent["alive"] and agent.joined:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("agent never re-joined after UNKNOWN_NODE")
+        assert agent.generation == coord.generation
+    finally:
+        agent.stop()
+        srv.shutdown()
+        srv.server_close()
+        coord.stop()
+        coord.jr.close()
+        agent.join(timeout=5.0)
+
+
+def test_node_agent_fail_static_bounds_redial(tmp_path):
+    """Dead coordinator: the agent keeps re-dialing on the heartbeat
+    backoff — never a reconnect storm (dials stays linear in elapsed
+    time), never joined, and the hosting broker is untouched."""
+    agent = CL.NodeAgent(str(tmp_path / "no-coordinator.sock"), "nB",
+                         "/run/nB.sock", chips=2, hb_s=0.1)
+    agent.start()
+    try:
+        time.sleep(1.0)
+        assert not agent.joined
+        # backoff = min(hb_s, 1.0) = 0.1s -> ~10 dials in 1s; anything
+        # far past that is a spin loop regression.
+        assert 2 <= agent.dials <= 20, agent.dials
+    finally:
+        agent.stop()
+        agent.join(timeout=5.0)
+        assert not agent.is_alive()
+
+
 # ---------------------------------------------------------------------------
 # mc cluster crash-cut engine (clean end-to-end; seeds ride test_mc)
 # ---------------------------------------------------------------------------
@@ -456,6 +522,100 @@ def test_clustercut_explore_clean():
     assert stats.torn_cuts == stats.records
     assert stats.corrupt_checks >= 2
     assert stats.fence_checks >= 1
+
+
+# ---------------------------------------------------------------------------
+# parametrized cluster crash-cut sweep: one visible test case per
+# canned-ledger record boundary (and per torn mid-record cut), so a
+# regression names the exact record it breaks behind instead of
+# hiding inside one aggregate sweep.
+# ---------------------------------------------------------------------------
+
+_CREC_DIR = None
+
+
+def _cluster_recording():
+    global _CREC_DIR
+    if _CREC_DIR is None:
+        from vtpu.tools.mc import clustercut
+        _CREC_DIR = tempfile.mkdtemp(prefix="vtpu-clustercut-rec-")
+        atexit.register(shutil.rmtree, _CREC_DIR, ignore_errors=True)
+        violations = clustercut.record_cluster_session(_CREC_DIR)
+        assert violations == [], violations
+    return _CREC_DIR
+
+
+def _cluster_records():
+    from vtpu.runtime.journal import LOG_NAME
+    from vtpu.tools.mc import clustercut
+    with open(os.path.join(_cluster_recording(), LOG_NAME), "rb") as f:
+        log = f.read()
+    return log, clustercut.split_records(log)
+
+
+def pytest_generate_tests(metafunc):
+    if "cboundary_idx" in metafunc.fixturenames:
+        _log, records = _cluster_records()
+        metafunc.parametrize("cboundary_idx",
+                             list(range(len(records) + 1)))
+    if "ctorn_idx" in metafunc.fixturenames:
+        _log, records = _cluster_records()
+        metafunc.parametrize("ctorn_idx", list(range(len(records))))
+
+
+def test_cluster_session_coverage_floor():
+    """The canned session must stay rich enough that the per-boundary
+    sweep means something: every record type, every cmigrate phase,
+    and at least 15 records."""
+    _log, records = _cluster_records()
+    recs = [r for _s, _e, r in records]
+    assert len(recs) >= 15, len(recs)
+    ops = {r.get("op") for r in recs}
+    assert {"cepoch", "node", "cgrant", "crelease", "cmigrate",
+            "node_down"} <= ops, ops
+    phases = {r.get("phase") for r in recs if r.get("op") == "cmigrate"}
+    assert {"begin", "commit", "abort"} <= phases, phases
+
+
+def _cluster_cut(tmp_path, data):
+    from vtpu.runtime.journal import LOG_NAME
+    cut = str(tmp_path / "cut")
+    os.makedirs(cut, exist_ok=True)
+    with open(os.path.join(cut, LOG_NAME), "wb") as f:
+        f.write(data)
+    return cut
+
+
+def test_cluster_boundary_cut_recovers_ground_truth(cboundary_idx,
+                                                    tmp_path):
+    """Coordinator crash at ledger boundary N: the real recovery
+    (Journal.load_state + cluster_apply_record) must reconstruct
+    exactly what the independent docs/FEDERATION.md interpreter says
+    records[:N] imply, and conserve."""
+    from vtpu.tools.mc import clustercut
+    log, records = _cluster_records()
+    off = 0 if cboundary_idx == 0 else records[cboundary_idx - 1][1]
+    raw = clustercut._load_cut(_cluster_cut(tmp_path, log[:off]))
+    got = clustercut.cluster_digest(raw)
+    want = clustercut.cluster_digest(clustercut._predict_cluster(
+        [r for _s, _e, r in records[:cboundary_idx]]))
+    assert got == want
+    assert CL.check_conservation(raw) == []
+
+
+def test_cluster_torn_cut_drops_tail_exactly(ctorn_idx, tmp_path):
+    """Crash MID-record (the kill -9 torn tail): recovery must land on
+    the previous boundary — never a guessed partial ledger, never
+    JournalCorrupt."""
+    from vtpu.tools.mc import clustercut
+    log, records = _cluster_records()
+    start, end, _r = records[ctorn_idx]
+    frag = start + max((end - start) // 2, 1)
+    raw = clustercut._load_cut(_cluster_cut(tmp_path, log[:frag]))
+    got = clustercut.cluster_digest(raw)
+    want = clustercut.cluster_digest(clustercut._predict_cluster(
+        [r for _s, _e, r in records[:ctorn_idx]]))
+    assert got == want
 
 
 # ---------------------------------------------------------------------------

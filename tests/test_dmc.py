@@ -1,0 +1,259 @@
+"""vtpu-dmc tests (tools/dmc, docs/ANALYSIS.md "Distributed model
+checking").
+
+Five layers:
+
+  - engine sanity: the federation scenario explores clean under a
+    bounded budget, the space actually branches, exploration is
+    deterministic (same budget twice -> same schedules/decisions),
+    and the broker model mirrors the real admin refusal surface
+    (over-permissiveness there manufactures false witnesses);
+  - registry wiring: the six dmc rows live in the single mc invariant
+    registry under engine "dmc" / phase "net";
+  - seeded violations: every deliberately broken coordinator variant
+    (tools/dmc/selfcheck.py patches REAL cluster.py code paths) is
+    caught by its invariant row;
+  - CLI + vtpu-smi wiring, including the explored-schedule floor gate;
+  - the true-positive regressions the engine found in
+    runtime/cluster.py ``_migrate``: the commit-point ordering with a
+    re-driven source teardown (lost-ack hole), and the per-tenant
+    dance lock (a concurrent duplicated CL_MIGRATE used to clobber
+    the reservation and discard the first dance's committed copy).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from vtpu.runtime import cluster as CL  # noqa: E402
+from vtpu.runtime import protocol as P  # noqa: E402
+from vtpu.tools.dmc import cli as dmc_cli  # noqa: E402
+from vtpu.tools.dmc import explore, selfcheck  # noqa: E402
+from vtpu.tools.dmc import world as W  # noqa: E402
+from vtpu.tools.mc import invariants  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Engine sanity
+# ---------------------------------------------------------------------------
+
+def _explore(**kw):
+    kw.setdefault("max_schedules", 120)
+    return explore.explore_scenario(explore.SCENARIOS[0], **kw)
+
+
+def test_engine_small_budget_green_and_branching():
+    stats = _explore()
+    assert stats.violations == [], stats.violations
+    assert stats.schedules == 120       # the space is larger than this
+    assert stats.decisions > stats.schedules  # multi-decision schedules
+
+
+def test_exploration_is_deterministic():
+    a = _explore(max_schedules=80)
+    b = _explore(max_schedules=80)
+    assert (a.schedules, a.decisions) == (b.schedules, b.decisions)
+    assert a.violations == b.violations == []
+
+
+def test_fault_free_space_is_green_and_finite():
+    """With a zero fault budget only delivery orders remain; the DFS
+    must exhaust that space (no truncation churn) with no violations."""
+    stats = _explore(max_schedules=5000, max_faults=0)
+    assert stats.violations == []
+    assert 1 <= stats.schedules < 5000   # exhausted, not budget-capped
+
+
+def test_simnode_mirrors_broker_refusal_surface():
+    """The broker model's refusals are load-bearing: MIGRATE_IN must
+    refuse a bound tenant (migrate_in_tenant's MIGRATE_CONFLICT) and
+    MIGRATE_OUT commit must no-op on a parked copy
+    (migrate_out_finish's ``t is None`` arm) — the exact semantics
+    that make a re-driven teardown safe against a later dance."""
+    n = W.SimNode("n0", 2)
+    park = n.admin({"kind": P.MIGRATE_IN, "tenant": "t"})
+    assert park["ok"] and n.copies["t"] == "parked"
+    again = n.admin({"kind": P.MIGRATE_IN, "tenant": "t"})
+    assert again["ok"] and again.get("existing")
+    # A parked copy is not bound: it cannot be quiesced...
+    out = n.admin({"kind": P.MIGRATE_OUT, "tenant": "t",
+                   "phase": "begin"})
+    assert not out["ok"] and out["code"] == "NOT_FOUND"
+    # ...and a stale re-driven teardown must not destroy it.
+    fin = n.admin({"kind": P.MIGRATE_OUT, "tenant": "t",
+                   "phase": "commit"})
+    assert fin["ok"] and n.copies["t"] == "parked"
+    # Once bound, MIGRATE_IN refuses and the dance quiesces/pops.
+    n.copies["t"] = "serving"
+    clash = n.admin({"kind": P.MIGRATE_IN, "tenant": "t"})
+    assert not clash["ok"] and clash["code"] == "MIGRATE_CONFLICT"
+    assert n.admin({"kind": P.MIGRATE_OUT, "tenant": "t",
+                    "phase": "begin"})["ok"]
+    assert n.copies["t"] == "frozen"
+    assert n.admin({"kind": P.MIGRATE_OUT, "tenant": "t",
+                    "phase": "commit"})["ok"]
+    assert "t" not in n.copies
+
+
+# ---------------------------------------------------------------------------
+# Registry wiring
+# ---------------------------------------------------------------------------
+
+def test_dmc_rows_are_registered():
+    rows = {inv.name for inv in invariants.for_engine("dmc", "net")}
+    assert rows == {
+        "dmc-no-double-grant",
+        "dmc-at-least-one-full-copy",
+        "dmc-no-orphan-copy",
+        "dmc-reservation-conservation",
+        "dmc-fenced-coordinator-never-acks",
+        "dmc-re-drive-idempotence",
+    }
+    for seed in selfcheck.SEEDS:
+        assert seed.invariant in rows, seed.name
+
+
+# ---------------------------------------------------------------------------
+# Seeded coordinator bugs (selfcheck)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", selfcheck.SEEDS, ids=lambda s: s.name)
+def test_seeded_coordinator_bug_is_caught(seed):
+    caught, violations = selfcheck.run_seed(seed)
+    assert caught, (f"seed {seed.name} did not trigger "
+                    f"[{seed.invariant}]; violations: {violations[:3]}")
+
+
+# ---------------------------------------------------------------------------
+# CLI + vtpu-smi wiring
+# ---------------------------------------------------------------------------
+
+def test_cli_smoke_and_list():
+    assert dmc_cli.main(["--smoke"]) == 0
+    assert dmc_cli.main(["--list"]) == 0
+
+
+def test_cli_floor_gate_fails_loudly():
+    assert dmc_cli.main(["--smoke", "--min-schedules",
+                         str(10**9)]) == 1
+
+
+def test_vtpu_smi_dmc_wiring():
+    from vtpu.tools.vtpu_smi import main as smi_main
+    assert smi_main(["dmc", "--smoke"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# The true-positive _migrate regressions (found by this engine)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def coord(tmp_path):
+    c = CL.Coordinator(str(tmp_path / "cl.sock"),
+                       str(tmp_path / "j"), policy="pack",
+                       hb_dead_s=3600.0)
+    yield c
+    c.stop()
+    c.jr.close()
+
+
+def _join(c, node, chips):
+    rep = c.dispatch({"kind": CL.CL_JOIN, "node": node,
+                      "broker": f"/run/{node}.sock", "chips": chips})
+    assert rep["ok"]
+
+
+class _ScriptedBus:
+    """A broker pair that acks the dance but loses the FIRST source
+    teardown ack (OSError after... well, before any effect — the
+    coordinator cannot tell)."""
+
+    def __init__(self, fail_commits: int = 1) -> None:
+        self.fail_commits = fail_commits
+        self.calls = []
+
+    def __call__(self, sock_path, msg, timeout=30.0):
+        self.calls.append((msg.get("kind"), msg.get("phase")))
+        if msg.get("kind") == P.MIGRATE_OUT \
+                and msg.get("phase") == "commit" \
+                and self.fail_commits > 0:
+            self.fail_commits -= 1
+            raise OSError("teardown ack lost")
+        if msg.get("kind") == P.MIGRATE_OUT:
+            return {"ok": True, "state": {}, "blobs": [],
+                    "epoch": "e1", "moved_bytes": 0}
+        return {"ok": True}
+
+
+def test_migrate_redrives_lost_teardown_ack(coord, monkeypatch):
+    """Commit-point regression: once cmigrate commit is journaled the
+    dance only rolls FORWARD — a lost teardown ack is re-driven, never
+    turned into an abort that would discard the committed target copy
+    (the pre-fix order tore down before journaling and aborted on the
+    lost ack: a zero-copy window the dmc at-least-one-full-copy row
+    caught)."""
+    _join(coord, "n0", 2)
+    _join(coord, "n1", 2)
+    src = coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t0",
+                          "chips": 1})["node"]
+    bus = _ScriptedBus(fail_commits=1)
+    monkeypatch.setattr(CL.Coordinator, "_admin", staticmethod(bus))
+    journaled = []
+    orig_append = coord._append
+
+    def spy(rec):
+        journaled.append((rec.get("op"), rec.get("phase")))
+        return orig_append(rec)
+
+    coord._append = spy
+    rep = coord.dispatch({"kind": CL.CL_MIGRATE, "tenant": "t0"})
+    assert rep["ok"] and rep["from"] == src and rep["node"] != src
+    # The teardown was re-driven past the lost ack...
+    assert bus.calls.count((P.MIGRATE_OUT, "commit")) == 2
+    # ...and the ledger committed exactly once, with no abort.
+    assert ("cmigrate", "commit") in journaled
+    assert ("cmigrate", "abort") not in journaled
+    st = coord.dispatch({"kind": CL.CL_STATUS})
+    assert st["violations"] == []
+    assert st["placements"]["t0"]["node"] == rep["node"]
+    assert coord.state.get("migrating") in (None, {})
+
+
+def test_concurrent_migrate_dance_refused_busy(coord, monkeypatch):
+    """Per-tenant dance lock: while a dance is in flight (the begin
+    record reserves + locks), a second CL_MIGRATE for the same tenant
+    must refuse MIGRATE_BUSY without touching a broker — the pre-fix
+    coordinator let it clobber ``migrating`` and its abort arm could
+    discard the first dance's committed parked copy (the zero-copy
+    interleave the dmc engine found)."""
+    _join(coord, "n0", 2)
+    _join(coord, "n1", 2)
+    assert coord.dispatch({"kind": CL.CL_PLACE, "tenant": "t0",
+                           "chips": 1})["ok"]
+    coord._append({"op": "cmigrate", "tenant": "t0",
+                   "phase": "begin", "to_node": "n1",
+                   "to_chips": [0]})
+
+    def no_bus(sock_path, msg, timeout=30.0):
+        raise AssertionError("a busy-refused dance touched a broker")
+
+    monkeypatch.setattr(CL.Coordinator, "_admin", staticmethod(no_bus))
+    rep = coord.dispatch({"kind": CL.CL_MIGRATE, "tenant": "t0"})
+    assert not rep["ok"] and rep["code"] == "MIGRATE_BUSY"
+    assert rep["retry_ms"] > 0
+    # The first dance's reservation survived untouched.
+    assert coord.state["migrating"]["t0"]["to_node"] == "n1"
+    # Once the dance resolves (here: abort), migration works again.
+    coord._append({"op": "cmigrate", "tenant": "t0", "phase": "abort"})
+    bus = _ScriptedBus(fail_commits=0)
+    monkeypatch.setattr(CL.Coordinator, "_admin", staticmethod(bus))
+    rep = coord.dispatch({"kind": CL.CL_MIGRATE, "tenant": "t0"})
+    assert rep["ok"]
+    assert coord.dispatch({"kind": CL.CL_STATUS})["violations"] == []

@@ -98,6 +98,9 @@ def test_registry_has_both_engines_and_all_phases():
     # the weak-memory engine's rows live in the same registry
     # (tools/wmm, docs/ANALYSIS.md "Weak memory model")
     assert ("wmm", "litmus") in engines
+    # ... as do the distributed network-fault engine's (tools/dmc,
+    # docs/ANALYSIS.md "Distributed model checking")
+    assert ("dmc", "net") in engines
     # Every invariant name is unique (the seeded tests key on them).
     names = [i.name for i in invariants.INVARIANTS]
     assert len(names) == len(set(names))
@@ -193,12 +196,16 @@ def test_seeded_violation_caught(seed):
 
 def test_every_invariant_has_a_seed():
     # The wmm rows are seeded by the weak-memory engine's own matrix
-    # (tools/wmm/selfcheck.py, driven in tests/test_wmm.py); the union
-    # must cover the registry exactly — an invariant no engine can
-    # demonstrably trigger proves nothing with its green runs.
+    # (tools/wmm/selfcheck.py, driven in tests/test_wmm.py) and the
+    # dmc rows by the network-fault engine's (tools/dmc/selfcheck.py,
+    # driven in tests/test_dmc.py); the union must cover the registry
+    # exactly — an invariant no engine can demonstrably trigger
+    # proves nothing with its green runs.
+    from vtpu.tools.dmc import selfcheck as dmc_selfcheck
     from vtpu.tools.wmm import selfcheck as wmm_selfcheck
     seeded = {s.invariant for s in selfcheck.SEEDS}
     seeded |= {s.invariant for s in wmm_selfcheck.SEEDS}
+    seeded |= {s.invariant for s in dmc_selfcheck.SEEDS}
     all_invs = {i.name for i in invariants.INVARIANTS}
     assert seeded == all_invs, (
         f"unseeded invariants: {sorted(all_invs - seeded)}; "
